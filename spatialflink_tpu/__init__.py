@@ -1,0 +1,26 @@
+"""spatialflink_tpu — a TPU-native spatial stream processing framework.
+
+A ground-up rebuild of the capabilities of GeoFlink (mpetrun5/SpatialFlink):
+continuous range / kNN / join / trajectory queries over streaming spatial data,
+pruned by a uniform grid index — re-designed for TPU:
+
+- The unit of execution is the *window batch*: a padded, fixed-shape
+  structure-of-arrays of points / polygons / linestrings plus int32 cell ids.
+- All geometry math (distance predicates, top-k, cell-hash joins,
+  point-in-polygon) runs as jax.jit / vmap / Pallas kernels on device.
+- Grid-cell pruning (the reference's guaranteed/candidate neighboring-cell
+  sets, UniformGrid.java:165-444) becomes dense boolean cell masks or pure
+  index arithmetic — gathers and compares, not hash-set probes.
+- Multi-device scaling replaces Flink's keyBy shuffle with jax.sharding
+  meshes + shard_map and XLA collectives (see spatialflink_tpu.parallel).
+
+Host-side Python owns streaming concerns only: sources, ser/de, event-time
+watermarks, window assembly, keyed state, sinks (see spatialflink_tpu.streams
+and spatialflink_tpu.runtime).
+"""
+
+__version__ = "0.1.0"
+
+from spatialflink_tpu.index import UniformGrid, GridParams
+
+__all__ = ["UniformGrid", "GridParams", "__version__"]

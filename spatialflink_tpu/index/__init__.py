@@ -1,0 +1,5 @@
+"""Spatial index layer (reference: GeoFlink/spatialIndices/)."""
+
+from spatialflink_tpu.index.uniform_grid import UniformGrid, GridParams
+
+__all__ = ["UniformGrid", "GridParams"]

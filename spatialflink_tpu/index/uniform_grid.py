@@ -1,0 +1,290 @@
+"""Uniform grid spatial index.
+
+TPU-native re-design of the reference's ``UniformGrid``
+(``spatialIndices/UniformGrid.java:33-519``):
+
+- Cells are identified by a single int32 ``cell = cx * n + cy`` instead of two
+  concatenated zero-padded 5-digit strings (``UniformGrid.java:92``); the
+  string form is still available for wire-format parity via :meth:`cell_key`.
+- Guaranteed / candidate neighboring-cell *sets* become dense boolean masks of
+  shape ``(n*n,)`` so that device kernels test membership with one gather
+  (``mask[cell]``) instead of a hash-set probe.
+- For point queries the layer geometry is pure index arithmetic: a cell
+  ``(px,py)`` is within layer ``L`` of ``(qx,qy)`` iff the Chebyshev distance
+  ``max(|px-qx|,|py-qy|) <= L`` — device kernels can use this directly without
+  materializing any mask (see :func:`cells_within_layers`).
+
+Layer math mirrors the reference exactly:
+- guaranteed layers  = floor(r / (cellLength*sqrt(2))) - 1
+  (``UniformGrid.java:427-438``; -1 means "no guaranteed cells", 0 means
+  "only the query cell itself").
+- candidate layers   = ceil(r / cellLength)   (``UniformGrid.java:440-444``).
+- radius == 0 in getNeighboringCells returns *all* grid cells
+  (``UniformGrid.java:264-266``).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Iterable, NamedTuple, Set, Tuple, Union
+
+import numpy as np
+
+
+class GridParams(NamedTuple):
+    """Static grid geometry, safe to close over in a jitted function.
+
+    All fields are Python scalars, so they are compile-time constants under
+    ``jax.jit`` — changing the grid triggers (correctly) a recompile.
+    """
+
+    min_x: float
+    min_y: float
+    cell_length: float
+    n: int  # grid is n x n cells
+
+    @property
+    def num_cells(self) -> int:
+        return self.n * self.n
+
+
+class UniformGrid:
+    """An n x n square grid over a bounding box.
+
+    Two constructors mirror the reference:
+
+    - ``UniformGrid(min_x, max_x, min_y, max_y, num_grid_partitions=n)``
+      (cell-count ctor, ``UniformGrid.java:74-85``).
+    - ``UniformGrid(min_x, max_x, min_y, max_y, cell_length=L)``
+      (cell-length ctor, ``UniformGrid.java:47-72``): first expands the
+      shorter bbox axis symmetrically to make the bbox square
+      (``adjustCoordinatesForSquareGrid``, ``UniformGrid.java:114-134``),
+      then derives the partition count from the *degree-space Euclidean*
+      width (the reference feeds lon/lat degrees through the same formula).
+    """
+
+    def __init__(
+        self,
+        min_x: float,
+        max_x: float,
+        min_y: float,
+        max_y: float,
+        *,
+        num_grid_partitions: int | None = None,
+        cell_length: float | None = None,
+    ):
+        if (num_grid_partitions is None) == (cell_length is None):
+            raise ValueError(
+                "pass exactly one of num_grid_partitions or cell_length"
+            )
+
+        self.min_x, self.max_x = float(min_x), float(max_x)
+        self.min_y, self.max_y = float(min_y), float(max_y)
+
+        if cell_length is not None:
+            self._adjust_for_square_grid()
+            grid_length = math.hypot(0.0, self.max_x - self.min_x)
+            rows = grid_length / cell_length
+            self.n = 1 if rows < 1 else int(math.ceil(rows))
+            self.cell_length = (self.max_x - self.min_x) / self.n
+        else:
+            self.n = int(num_grid_partitions)
+            self.cell_length = (self.max_x - self.min_x) / self.n
+
+    def _adjust_for_square_grid(self) -> None:
+        dx = self.max_x - self.min_x
+        dy = self.max_y - self.min_y
+        if dx > dy:
+            d = (dx - dy) / 2
+            self.max_y += d
+            self.min_y -= d
+        elif dy > dx:
+            d = (dy - dx) / 2
+            self.max_x += d
+            self.min_x -= d
+
+    # ------------------------------------------------------------------ #
+    # basic geometry
+
+    @property
+    def num_cells(self) -> int:
+        return self.n * self.n
+
+    @property
+    def params(self) -> GridParams:
+        return GridParams(self.min_x, self.min_y, self.cell_length, self.n)
+
+    def cell_indices(self, x, y) -> Tuple[np.ndarray, np.ndarray]:
+        """(x, y) coordinates -> integer cell indices (cx, cy); vectorized.
+
+        Pure floor-division, as ``HelperClass.assignGridCellID``
+        (``utils/HelperClass.java:104-116``). Out-of-bbox coordinates yield
+        out-of-range indices (negative or >= n) — they are *not* clamped,
+        matching the reference, and will never compare equal to a valid cell.
+        """
+        cx = np.floor((np.asarray(x, np.float64) - self.min_x) / self.cell_length)
+        cy = np.floor((np.asarray(y, np.float64) - self.min_y) / self.cell_length)
+        return cx.astype(np.int64), cy.astype(np.int64)
+
+    def valid_indices(self, cx, cy):
+        """``UniformGrid.validKey`` (``UniformGrid.java:224-229``)."""
+        cx, cy = np.asarray(cx), np.asarray(cy)
+        return (cx >= 0) & (cy >= 0) & (cx < self.n) & (cy < self.n)
+
+    def assign_cell(self, x, y) -> Tuple[np.ndarray, np.ndarray]:
+        """Coordinates -> (cell id int32, valid bool); cell is -1 if invalid."""
+        cx, cy = self.cell_indices(x, y)
+        valid = self.valid_indices(cx, cy)
+        cell = np.where(valid, cx * self.n + cy, -1).astype(np.int32)
+        return cell, valid
+
+    def cell_id(self, cx: int, cy: int) -> int:
+        return int(cx) * self.n + int(cy)
+
+    def cell_xy(self, cell) -> Tuple[np.ndarray, np.ndarray]:
+        cell = np.asarray(cell)
+        return cell // self.n, cell % self.n
+
+    def cell_key(self, cell: int) -> str:
+        """Reference wire format: two 5-digit zero-padded indices concatenated
+        (``CELLINDEXSTRLENGTH = 5``, ``UniformGrid.java:40,92``)."""
+        cx, cy = int(cell) // self.n, int(cell) % self.n
+        return f"{cx:05d}{cy:05d}"
+
+    def cell_from_key(self, key: str) -> int:
+        return self.cell_id(int(key[:5]), int(key[5:]))
+
+    def cell_bounds(self, cell: int) -> Tuple[float, float, float, float]:
+        """(min_x, min_y, max_x, max_y) of a cell
+        (``UniformGrid.getCellMinMaxBoundary``, ``UniformGrid.java:149-158``)."""
+        cx, cy = int(cell) // self.n, int(cell) % self.n
+        return (
+            self.min_x + cx * self.cell_length,
+            self.min_y + cy * self.cell_length,
+            self.min_x + (cx + 1) * self.cell_length,
+            self.min_y + (cy + 1) * self.cell_length,
+        )
+
+    def bbox_cells(self, min_x: float, min_y: float, max_x: float, max_y: float) -> Set[int]:
+        """All valid cells overlapped by a bounding box
+        (``HelperClass.assignGridCellID(bBox, uGrid)``,
+        ``utils/HelperClass.java:123-143``)."""
+        cx1, cy1 = self.cell_indices(min_x, min_y)
+        cx2, cy2 = self.cell_indices(max_x, max_y)
+        out: Set[int] = set()
+        for cx in range(int(cx1), int(cx2) + 1):
+            for cy in range(int(cy1), int(cy2) + 1):
+                if 0 <= cx < self.n and 0 <= cy < self.n:
+                    out.add(self.cell_id(cx, cy))
+        return out
+
+    # ------------------------------------------------------------------ #
+    # layer math (reference parity)
+
+    def guaranteed_layers(self, radius: float) -> int:
+        """floor(r / cellDiagonal) - 1; -1 => no guaranteed cells
+        (``UniformGrid.java:427-438``)."""
+        cell_diagonal = self.cell_length * math.sqrt(2.0)
+        return int(math.floor(radius / cell_diagonal - 1))
+
+    def candidate_layers(self, radius: float) -> int:
+        """ceil(r / cellLength) (``UniformGrid.java:440-444``)."""
+        return int(math.ceil(radius / self.cell_length))
+
+    # ------------------------------------------------------------------ #
+    # neighboring-cell masks (dense over the n*n grid)
+
+    def _layer_mask(self, cells: Iterable[int], layers: int) -> np.ndarray:
+        """Boolean (n*n,) mask of all valid cells within Chebyshev distance
+        ``layers`` of any seed cell."""
+        mask = np.zeros((self.n, self.n), dtype=bool)
+        if layers < 0:
+            return mask.reshape(-1)
+        for cell in cells:
+            cx, cy = int(cell) // self.n, int(cell) % self.n
+            x0, x1 = max(0, cx - layers), min(self.n, cx + layers + 1)
+            y0, y1 = max(0, cy - layers), min(self.n, cy + layers + 1)
+            mask[x0:x1, y0:y1] = True
+        return mask.reshape(-1)
+
+    @staticmethod
+    def _as_cells(cells: Union[int, Iterable[int]]) -> Iterable[int]:
+        if isinstance(cells, (int, np.integer)):
+            return (int(cells),)
+        return cells
+
+    def guaranteed_cells_mask(self, radius: float, cells: Union[int, Iterable[int]]) -> np.ndarray:
+        """Guaranteed neighboring cells of query cell(s) as a dense mask.
+
+        Mirrors ``getGuaranteedNeighboringCells`` for a point cell
+        (``UniformGrid.java:165-190``) and its polygon/linestring overloads
+        (union over the geometry's cells, ``:193-222``).
+        """
+        return self._layer_mask(self._as_cells(cells), self.guaranteed_layers(radius))
+
+    def candidate_cells_mask(
+        self,
+        radius: float,
+        cells: Union[int, Iterable[int]],
+        guaranteed_mask: np.ndarray | None = None,
+    ) -> np.ndarray:
+        """Candidate neighboring cells = within candidate layers, minus the
+        guaranteed set (``getCandidateNeighboringCells``,
+        ``UniformGrid.java:367-425``). Mutually exclusive with the GN mask."""
+        if guaranteed_mask is None:
+            guaranteed_mask = self.guaranteed_cells_mask(radius, cells)
+        cand = self._layer_mask(self._as_cells(cells), self.candidate_layers(radius))
+        return cand & ~guaranteed_mask
+
+    def neighboring_cells_mask(self, radius: float, cells: Union[int, Iterable[int]]) -> np.ndarray:
+        """GN ∪ CN. ``radius == 0`` selects *all* cells
+        (``getNeighboringCells``, ``UniformGrid.java:261-293``)."""
+        if radius == 0:
+            return np.ones(self.num_cells, dtype=bool)
+        return self._layer_mask(self._as_cells(cells), self.candidate_layers(radius))
+
+    def neighboring_layer_cells_mask(self, cell: int, layer: int) -> np.ndarray:
+        """The ring of cells at exactly Chebyshev distance ``layer``
+        (``getNeighboringLayerCells``, ``UniformGrid.java:446-479``)."""
+        outer = self._layer_mask((cell,), layer)
+        inner = self._layer_mask((cell,), layer - 1) if layer > 0 else np.zeros(self.num_cells, bool)
+        return outer & ~inner
+
+    def all_neighboring_layers(self, cell: int) -> list:
+        """Non-empty rings around a cell, nearest first
+        (``getAllNeighboringLayers``, ``UniformGrid.java:482-500``)."""
+        out = []
+        for layer in range(self.n):
+            ring = self.neighboring_layer_cells_mask(cell, layer)
+            if not ring.any():
+                break
+            out.append(ring)
+        return out
+
+    def cell_layer_wrt(self, query_cell: int, cell: int) -> int:
+        """Chebyshev layer of ``cell`` w.r.t. ``query_cell``
+        (``HelperClass.getCellLayerWRTQueryCell``, ``utils/HelperClass.java:278-296``)."""
+        qx, qy = query_cell // self.n, query_cell % self.n
+        cx, cy = cell // self.n, cell % self.n
+        return max(abs(qx - cx), abs(qy - cy))
+
+    def __repr__(self) -> str:
+        return (
+            f"UniformGrid(n={self.n}, cell_length={self.cell_length:.6g}, "
+            f"bbox=[{self.min_x}, {self.min_y}, {self.max_x}, {self.max_y}])"
+        )
+
+
+def cells_within_layers(cell_a, cell_b, layers: int, n: int):
+    """Device-friendly predicate: is cell_a within ``layers`` Chebyshev layers
+    of cell_b on an n x n grid?  Works on jnp/np int32 arrays; invalid cells
+    (-1) never match.  This is the arithmetic form of the reference's
+    neighboring-cell set membership test for point queries.
+    """
+    import jax.numpy as jnp
+
+    cell_a, cell_b = jnp.asarray(cell_a), jnp.asarray(cell_b)
+    ax, ay = cell_a // n, cell_a % n
+    bx, by = cell_b // n, cell_b % n
+    ok = (cell_a >= 0) & (cell_b >= 0)
+    return ok & (jnp.maximum(jnp.abs(ax - bx), jnp.abs(ay - by)) <= layers)

@@ -1,0 +1,35 @@
+"""Spatial object model.
+
+Two representations:
+
+- :mod:`spatialflink_tpu.models.objects` — host-side Python objects (one per
+  stream record / query geometry), the analogue of the reference's
+  ``spatialObjects/`` POJOs.
+- :mod:`spatialflink_tpu.models.batches` — padded, fixed-shape
+  structure-of-arrays device batches; the unit handed to TPU kernels.
+"""
+
+from spatialflink_tpu.models.objects import (
+    SpatialObject,
+    Point,
+    Polygon,
+    LineString,
+    MultiPoint,
+    MultiPolygon,
+    MultiLineString,
+    GeometryCollection,
+)
+from spatialflink_tpu.models.batches import PointBatch, EdgeGeomBatch
+
+__all__ = [
+    "SpatialObject",
+    "Point",
+    "Polygon",
+    "LineString",
+    "MultiPoint",
+    "MultiPolygon",
+    "MultiLineString",
+    "GeometryCollection",
+    "PointBatch",
+    "EdgeGeomBatch",
+]

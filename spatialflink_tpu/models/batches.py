@@ -1,0 +1,179 @@
+"""Padded device batches — the unit of TPU execution.
+
+A *window batch* is what the host streaming runtime hands to device kernels:
+a fixed-shape structure-of-arrays with validity masks. NamedTuples are JAX
+pytrees, so batches pass transparently through jit / vmap / shard_map.
+
+Device-side conventions:
+- coordinates: float32 (degree space, like the reference's hot paths)
+- object ids: int32 (interned from strings by the host, utils.IdInterner)
+- timestamps: int32 milliseconds relative to the batch's ``ts_base`` — an
+  epoch-millis int64 kept host-side as a static aux field — so device arrays
+  avoid x64 mode while windows spanning ±24 days stay exact.
+- cell ids:   int32 ``cx * n + cy``; -1 marks out-of-grid
+- ``valid``:  bool; padded slots are False and must be masked by every kernel
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+from spatialflink_tpu.index import UniformGrid
+from spatialflink_tpu.models import objects as sobj
+from spatialflink_tpu.utils import IdInterner, bucket_size, pad_to
+
+
+class PointBatch(NamedTuple):
+    """A batch of N points (N padded to a bucket size)."""
+
+    x: np.ndarray        # (N,) f32
+    y: np.ndarray        # (N,) f32
+    obj_id: np.ndarray   # (N,) i32
+    ts: np.ndarray       # (N,) i32, millis offset from ts_base
+    cell: np.ndarray     # (N,) i32, -1 = outside grid
+    valid: np.ndarray    # (N,) bool
+
+    @property
+    def capacity(self) -> int:
+        return self.x.shape[-1]
+
+    @staticmethod
+    def from_arrays(
+        x,
+        y,
+        *,
+        grid: Optional[UniformGrid] = None,
+        obj_id=None,
+        ts=None,
+        ts_base: int = 0,
+        pad: Optional[int] = None,
+    ) -> "PointBatch":
+        """Build from host float64 arrays; assigns cells and pads."""
+        x = np.asarray(x, np.float64)
+        y = np.asarray(y, np.float64)
+        n = x.shape[0]
+        obj_id = np.zeros(n, np.int32) if obj_id is None else np.asarray(obj_id, np.int32)
+        if ts is None:
+            ts32 = np.zeros(n, np.int32)
+        else:
+            ts32 = (np.asarray(ts, np.int64) - int(ts_base)).astype(np.int32)
+        if grid is not None:
+            cell, _ = grid.assign_cell(x, y)
+        else:
+            cell = np.full(n, -1, np.int32)
+        size = bucket_size(n) if pad is None else pad
+        valid = pad_to(np.ones(n, bool), size)
+        return PointBatch(
+            x=pad_to(x.astype(np.float32), size),
+            y=pad_to(y.astype(np.float32), size),
+            obj_id=pad_to(obj_id, size),
+            ts=pad_to(ts32, size),
+            cell=pad_to(cell, size, fill=-1),
+            valid=valid,
+        )
+
+    @staticmethod
+    def from_points(
+        points: Sequence[sobj.Point],
+        grid: Optional[UniformGrid] = None,
+        interner: Optional[IdInterner] = None,
+        ts_base: int = 0,
+        pad: Optional[int] = None,
+    ) -> "PointBatch":
+        interner = interner if interner is not None else IdInterner()
+        x = np.array([p.x for p in points], np.float64)
+        y = np.array([p.y for p in points], np.float64)
+        oid = np.array([interner.intern(p.obj_id) for p in points], np.int32)
+        ts = np.array([p.timestamp for p in points], np.int64)
+        return PointBatch.from_arrays(
+            x, y, grid=grid, obj_id=oid, ts=ts, ts_base=ts_base, pad=pad
+        )
+
+
+class EdgeGeomBatch(NamedTuple):
+    """A batch of G polygon/linestring geometries as padded edge arrays.
+
+    ``is_areal`` distinguishes polygons (areal: containment counts, distance 0
+    inside) from linestrings (curve: boundary distance only). Mixed batches
+    are allowed — the flag is per geometry.
+    """
+
+    edges: np.ndarray      # (G, E, 4) f32 — [x1,y1,x2,y2] per edge
+    edge_mask: np.ndarray  # (G, E) bool
+    bbox: np.ndarray       # (G, 4) f32 — [minx,miny,maxx,maxy]
+    obj_id: np.ndarray     # (G,) i32
+    ts: np.ndarray         # (G,) i32
+    cell: np.ndarray       # (G,) i32 representative cell
+    cells: np.ndarray      # (G, C) i32 overlapped cells, -1 padded
+    cells_mask: np.ndarray # (G, C) bool
+    is_areal: np.ndarray   # (G,) bool
+    valid: np.ndarray      # (G,) bool
+
+    @property
+    def capacity(self) -> int:
+        return self.edges.shape[-3]
+
+    @staticmethod
+    def from_objects(
+        geoms: Sequence[sobj._EdgeGeom],
+        grid: Optional[UniformGrid] = None,
+        interner: Optional[IdInterner] = None,
+        ts_base: int = 0,
+        pad: Optional[int] = None,
+        edge_pad: Optional[int] = None,
+        cell_pad: Optional[int] = None,
+    ) -> "EdgeGeomBatch":
+        interner = interner if interner is not None else IdInterner()
+        g = len(geoms)
+        edge_arrays = [geo.edge_array() for geo in geoms]
+        max_e = max(e.shape[0] for e, _ in edge_arrays)
+        E = bucket_size(max_e, 8) if edge_pad is None else edge_pad
+        max_c = max((len(geo.cells) for geo in geoms), default=1) or 1
+        C = bucket_size(max_c, 8) if cell_pad is None else cell_pad
+
+        edges = np.zeros((g, E, 4), np.float32)
+        emask = np.zeros((g, E), bool)
+        cells = np.full((g, C), -1, np.int32)
+        cmask = np.zeros((g, C), bool)
+        for i, (e, m) in enumerate(edge_arrays):
+            edges[i, : e.shape[0]] = e.astype(np.float32)
+            emask[i, : e.shape[0]] = m
+            cs = sorted(geoms[i].cells)[:C]
+            cells[i, : len(cs)] = cs
+            cmask[i, : len(cs)] = True
+
+        bbox = np.asarray([geo.bbox for geo in geoms], np.float32).reshape(g, 4)
+        oid = np.array([interner.intern(geo.obj_id) for geo in geoms], np.int32)
+        ts = (np.array([geo.timestamp for geo in geoms], np.int64) - int(ts_base)).astype(np.int32)
+        cell = np.array([geo.cell for geo in geoms], np.int32)
+        areal = np.array(
+            [isinstance(geo, (sobj.Polygon, sobj.MultiPolygon)) for geo in geoms], bool
+        )
+
+        size = bucket_size(g, 8) if pad is None else pad
+        return EdgeGeomBatch(
+            edges=pad_to(edges, size),
+            edge_mask=pad_to(emask, size),
+            bbox=pad_to(bbox, size),
+            obj_id=pad_to(oid, size),
+            ts=pad_to(ts, size),
+            cell=pad_to(cell, size, fill=-1),
+            cells=pad_to(cells, size, fill=-1),
+            cells_mask=pad_to(cmask, size),
+            is_areal=pad_to(areal, size),
+            valid=pad_to(np.ones(g, bool), size),
+        )
+
+
+def single_query_edges(
+    geom: sobj._EdgeGeom, edge_pad: Optional[int] = None
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Padded (E,4)/(E,) edge arrays for one query geometry."""
+    e, m = geom.edge_array()
+    E = bucket_size(e.shape[0], 8) if edge_pad is None else edge_pad
+    return (
+        pad_to(e.astype(np.float32), E),
+        pad_to(m, E),
+    )

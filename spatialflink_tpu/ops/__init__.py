@@ -1,0 +1,9 @@
+"""Device-side geometry kernels (jax.jit / vmap / Pallas).
+
+This package is the TPU replacement for the work the reference delegates to
+the JTS library and per-tuple Flink operators (``utils/DistanceFunctions.java``
+and the hot loops in ``spatialOperators/{range,knn,join}``): everything here
+operates on padded, masked, fixed-shape arrays.
+"""
+
+from spatialflink_tpu.ops import distances  # noqa: F401

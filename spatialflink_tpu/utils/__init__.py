@@ -1,0 +1,6 @@
+"""Cross-cutting host utilities."""
+
+from spatialflink_tpu.utils.padding import bucket_size, pad_to
+from spatialflink_tpu.utils.interner import IdInterner
+
+__all__ = ["bucket_size", "pad_to", "IdInterner"]

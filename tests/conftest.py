@@ -1,0 +1,19 @@
+"""Test environment: force an 8-device virtual CPU platform.
+
+Note: this image's axon sitecustomize imports jax at interpreter start and
+calls ``jax.config.update("jax_platforms", "axon,cpu")``, which overrides the
+JAX_PLATFORMS env var. Setting env vars is therefore not enough — we must
+write the config value back (and do it before any jax backend initializes,
+which conftest import order guarantees)."""
+
+import os
+
+# XLA_FLAGS is read at backend-init time, so the env route works for it.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402  (sitecustomize may have imported it already)
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,126 @@
+"""Pure-NumPy float64 reference implementations used as correctness oracles.
+
+These intentionally re-derive the reference's semantics independently of the
+device kernels (no jax imports) — the rebuild's analogue of GeoFlink's naive
+exhaustive-scan twins (SURVEY.md §4)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def pp_dist(x1, y1, x2, y2):
+    return np.hypot(np.asarray(x2) - x1, np.asarray(y2) - y1)
+
+
+def point_segment_dist(px, py, x1, y1, x2, y2):
+    px, py = float(px), float(py)
+    cx, cy = x2 - x1, y2 - y1
+    len_sq = cx * cx + cy * cy
+    if len_sq == 0:
+        return np.hypot(px - x1, py - y1)
+    t = max(0.0, min(1.0, ((px - x1) * cx + (py - y1) * cy) / len_sq))
+    return np.hypot(px - (x1 + t * cx), py - (y1 + t * cy))
+
+
+def point_bbox_dist(px, py, bx1, by1, bx2, by2):
+    dx = max(bx1 - px, px - bx2, 0.0)
+    dy = max(by1 - py, py - by2, 0.0)
+    return np.hypot(dx, dy)
+
+
+def bbox_bbox_dist(a, b):
+    dx = max(a[0] - b[2], b[0] - a[2], 0.0)
+    dy = max(a[1] - b[3], b[1] - a[3], 0.0)
+    return np.hypot(dx, dy)
+
+
+def point_in_rings(px, py, rings) -> bool:
+    """Even-odd rule over a list of rings (each a closed (k,2) array)."""
+    inside = False
+    for ring in rings:
+        r = np.asarray(ring, np.float64)
+        x1, y1 = r[:-1, 0], r[:-1, 1]
+        x2, y2 = r[1:, 0], r[1:, 1]
+        straddle = (y1 > py) != (y2 > py)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            x_at = x1 + (py - y1) / (y2 - y1) * (x2 - x1)
+        crossings = straddle & (px < x_at)
+        inside ^= bool(np.sum(crossings) % 2)
+    return inside
+
+
+def point_rings_boundary_dist(px, py, rings) -> float:
+    d = np.inf
+    for ring in rings:
+        r = np.asarray(ring, np.float64)
+        for i in range(len(r) - 1):
+            d = min(d, point_segment_dist(px, py, r[i, 0], r[i, 1], r[i + 1, 0], r[i + 1, 1]))
+    return d
+
+
+def point_polygon_dist(px, py, rings) -> float:
+    """JTS Point.distance(Polygon): 0 inside the areal geometry."""
+    if point_in_rings(px, py, rings):
+        return 0.0
+    return point_rings_boundary_dist(px, py, rings)
+
+
+def _orient(ax, ay, bx, by, cx, cy):
+    return (bx - ax) * (cy - ay) - (by - ay) * (cx - ax)
+
+
+def segments_intersect(a, b) -> bool:
+    d1 = _orient(b[0], b[1], b[2], b[3], a[0], a[1])
+    d2 = _orient(b[0], b[1], b[2], b[3], a[2], a[3])
+    d3 = _orient(a[0], a[1], a[2], a[3], b[0], b[1])
+    d4 = _orient(a[0], a[1], a[2], a[3], b[2], b[3])
+    return ((d1 > 0) != (d2 > 0)) and ((d3 > 0) != (d4 > 0))
+
+
+def seg_seg_dist(a, b) -> float:
+    if segments_intersect(a, b):
+        return 0.0
+    return min(
+        point_segment_dist(a[0], a[1], b[0], b[1], b[2], b[3]),
+        point_segment_dist(a[2], a[3], b[0], b[1], b[2], b[3]),
+        point_segment_dist(b[0], b[1], a[0], a[1], a[2], a[3]),
+        point_segment_dist(b[2], b[3], a[0], a[1], a[2], a[3]),
+    )
+
+
+def rings_to_segments(rings):
+    segs = []
+    for ring in rings:
+        r = np.asarray(ring, np.float64)
+        for i in range(len(r) - 1):
+            segs.append((r[i, 0], r[i, 1], r[i + 1, 0], r[i + 1, 1]))
+    return segs
+
+
+def polygon_polygon_dist(rings_a, rings_b) -> float:
+    """JTS Polygon.distance(Polygon): 0 if they intersect/contain."""
+    a0 = np.asarray(rings_a[0], np.float64)[0]
+    b0 = np.asarray(rings_b[0], np.float64)[0]
+    if point_in_rings(a0[0], a0[1], rings_b) or point_in_rings(b0[0], b0[1], rings_a):
+        return 0.0
+    d = np.inf
+    for sa in rings_to_segments(rings_a):
+        for sb in rings_to_segments(rings_b):
+            d = min(d, seg_seg_dist(sa, sb))
+    return d
+
+
+def knn(qx, qy, xs, ys, obj_ids, k, radius=None):
+    """Top-k nearest objects with per-object dedup (keep min distance),
+    mirroring KNNQuery's PQ + objID-dedup merge (knn/KNNQuery.java:204-300).
+    Returns (obj_ids, dists) sorted ascending, at most k entries."""
+    d = pp_dist(qx, qy, np.asarray(xs), np.asarray(ys))
+    best = {}
+    for oid, dist in zip(np.asarray(obj_ids), d):
+        if radius is not None and dist > radius:
+            continue
+        if oid not in best or dist < best[oid]:
+            best[oid] = dist
+    items = sorted(best.items(), key=lambda kv: kv[1])[:k]
+    return [o for o, _ in items], [float(v) for _, v in items]

@@ -1,0 +1,169 @@
+"""Distance kernels vs float64 NumPy oracles."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from spatialflink_tpu.ops import distances as D
+from tests import oracles as O
+
+RNG = np.random.default_rng(42)
+ATOL = 1e-4  # f32 device math vs f64 oracle on ~100-degree magnitudes
+
+
+def rand_pts(n, lo=-10, hi=10):
+    return RNG.uniform(lo, hi, size=(n, 2))
+
+
+class TestPointPoint:
+    def test_matches_oracle(self):
+        a, b = rand_pts(200), rand_pts(200)
+        got = np.asarray(D.pp_dist(a[:, 0], a[:, 1], b[:, 0], b[:, 1]))
+        want = O.pp_dist(a[:, 0], a[:, 1], b[:, 0], b[:, 1])
+        np.testing.assert_allclose(got, want, atol=ATOL)
+
+    def test_haversine_known_value(self):
+        # Beijing center-ish 1-degree longitude at 40N ~ 85.2 km
+        got = float(D.haversine(116.0, 40.0, 117.0, 40.0))
+        assert got == pytest.approx(85175, rel=2e-3)
+
+
+class TestPointSegment:
+    def test_matches_oracle(self):
+        for _ in range(300):
+            (px, py), (x1, y1), (x2, y2) = rand_pts(3)
+            got = float(D.point_segment_dist(px, py, x1, y1, x2, y2))
+            want = O.point_segment_dist(px, py, x1, y1, x2, y2)
+            assert got == pytest.approx(want, abs=ATOL)
+
+    def test_degenerate_segment(self):
+        got = float(D.point_segment_dist(0.0, 0.0, 3.0, 4.0, 3.0, 4.0))
+        assert got == pytest.approx(5.0, abs=ATOL)
+
+    def test_projection_clamps(self):
+        # beyond both endpoints
+        assert float(D.point_segment_dist(-1, 0, 0, 0, 1, 0)) == pytest.approx(1.0, abs=ATOL)
+        assert float(D.point_segment_dist(2, 0, 0, 0, 1, 0)) == pytest.approx(1.0, abs=ATOL)
+        # interior projection
+        assert float(D.point_segment_dist(0.5, 2, 0, 0, 1, 0)) == pytest.approx(2.0, abs=ATOL)
+
+
+class TestBBox:
+    def test_point_bbox(self):
+        for _ in range(200):
+            px, py = RNG.uniform(-10, 10, 2)
+            x1, y1 = RNG.uniform(-5, 0, 2)
+            x2, y2 = x1 + RNG.uniform(0, 5), y1 + RNG.uniform(0, 5)
+            got = float(D.point_bbox_dist(px, py, x1, y1, x2, y2))
+            want = O.point_bbox_dist(px, py, x1, y1, x2, y2)
+            assert got == pytest.approx(want, abs=ATOL)
+
+    def test_inside_is_zero(self):
+        assert float(D.point_bbox_dist(0.5, 0.5, 0, 0, 1, 1)) == 0.0
+
+    def test_bbox_bbox(self):
+        for _ in range(200):
+            a = np.sort(RNG.uniform(-5, 5, (2, 2)), axis=0).T.reshape(-1)  # minx,miny,maxx,maxy? build manually
+            ax1, ay1 = RNG.uniform(-5, 0, 2)
+            a = np.array([ax1, ay1, ax1 + RNG.uniform(0, 4), ay1 + RNG.uniform(0, 4)])
+            bx1, by1 = RNG.uniform(-5, 0, 2)
+            b = np.array([bx1, by1, bx1 + RNG.uniform(0, 4), by1 + RNG.uniform(0, 4)])
+            got = float(D.bbox_bbox_dist(jnp.asarray(a), jnp.asarray(b)))
+            want = O.bbox_bbox_dist(a, b)
+            assert got == pytest.approx(want, abs=ATOL)
+
+    def test_bbox_bbox_overlap_zero(self):
+        a = jnp.array([0.0, 0.0, 2.0, 2.0])
+        b = jnp.array([1.0, 1.0, 3.0, 3.0])
+        assert float(D.bbox_bbox_dist(a, b)) == 0.0
+
+
+def make_edges(rings):
+    """rings -> padded (E,4)/(E,) arrays with 3 junk pad edges."""
+    segs = O.rings_to_segments(rings)
+    e = np.asarray(segs, np.float64)
+    pad = np.zeros((3, 4))
+    edges = np.concatenate([e, pad]).astype(np.float32)
+    mask = np.concatenate([np.ones(len(e), bool), np.zeros(3, bool)])
+    return jnp.asarray(edges), jnp.asarray(mask)
+
+
+SQUARE = [np.array([[0, 0], [4, 0], [4, 4], [0, 4], [0, 0]], np.float64)]
+DONUT = SQUARE + [np.array([[1, 1], [3, 1], [3, 3], [1, 3], [1, 1]], np.float64)]
+
+
+class TestPointInRings:
+    def test_square(self):
+        edges, mask = make_edges(SQUARE)
+        assert bool(D.point_in_rings(2.0, 2.0, edges, mask))
+        assert not bool(D.point_in_rings(5.0, 2.0, edges, mask))
+        assert not bool(D.point_in_rings(-1.0, 2.0, edges, mask))
+
+    def test_donut_hole(self):
+        edges, mask = make_edges(DONUT)
+        assert not bool(D.point_in_rings(2.0, 2.0, edges, mask))  # in the hole
+        assert bool(D.point_in_rings(0.5, 2.0, edges, mask))      # in the ring body
+
+    def test_random_vs_oracle(self):
+        poly = [np.array([[0, 0], [5, 1], [6, 4], [3, 6], [-1, 3], [0, 0]], np.float64)]
+        edges, mask = make_edges(poly)
+        pts = rand_pts(300, -2, 7)
+        got = np.asarray(D.point_in_rings(pts[:, 0, None], pts[:, 1, None],
+                                          edges[None], mask[None])).reshape(-1)
+        for i in range(300):
+            assert got[i] == O.point_in_rings(pts[i, 0], pts[i, 1], poly)
+
+
+class TestPointPolygonDist:
+    def test_inside_zero_outside_boundary(self):
+        edges, mask = make_edges(SQUARE)
+        assert float(D.point_polygon_dist(2.0, 2.0, edges, mask)) == 0.0
+        assert float(D.point_polygon_dist(6.0, 2.0, edges, mask)) == pytest.approx(2.0, abs=ATOL)
+
+    def test_hole_interior_positive(self):
+        edges, mask = make_edges(DONUT)
+        # center of the hole: nearest boundary is the inner ring, distance 1
+        assert float(D.point_polygon_dist(2.0, 2.0, edges, mask)) == pytest.approx(1.0, abs=ATOL)
+
+    def test_random_vs_oracle(self):
+        poly = [np.array([[0, 0], [5, 1], [6, 4], [3, 6], [-1, 3], [0, 0]], np.float64)]
+        edges, mask = make_edges(poly)
+        for _ in range(100):
+            px, py = RNG.uniform(-3, 8, 2)
+            got = float(D.point_polygon_dist(px, py, edges, mask))
+            want = O.point_polygon_dist(px, py, poly)
+            assert got == pytest.approx(want, abs=1e-3)
+
+
+class TestSegSeg:
+    def test_crossing_zero(self):
+        a = jnp.array([0.0, 0.0, 2.0, 2.0])
+        b = jnp.array([0.0, 2.0, 2.0, 0.0])
+        assert float(D.seg_seg_dist2(a, b)) == 0.0
+
+    def test_parallel(self):
+        a = jnp.array([0.0, 0.0, 1.0, 0.0])
+        b = jnp.array([0.0, 1.0, 1.0, 1.0])
+        assert float(jnp.sqrt(D.seg_seg_dist2(a, b))) == pytest.approx(1.0, abs=ATOL)
+
+    def test_random_vs_oracle(self):
+        for _ in range(300):
+            a = RNG.uniform(-3, 3, 4)
+            b = RNG.uniform(-3, 3, 4)
+            got = float(np.sqrt(D.seg_seg_dist2(jnp.asarray(a), jnp.asarray(b))))
+            want = O.seg_seg_dist(a, b)
+            assert got == pytest.approx(want, abs=1e-3)
+
+
+class TestEdgesEdges:
+    def test_polygon_polygon_vs_oracle(self):
+        pa = [np.array([[0, 0], [2, 0], [2, 2], [0, 2], [0, 0]], np.float64)]
+        for dx in (0.0, 1.0, 3.0, 5.0):
+            pb = [pa[0] + np.array([dx, 0.0])]
+            ea, ma = make_edges(pa)
+            eb, mb = make_edges(pb)
+            got = float(np.sqrt(D.edges_edges_dist2(ea, ma, eb, mb)))
+            # boundary-boundary distance (overlapping squares share boundary pts)
+            want = 0.0 if dx <= 2.0 else dx - 2.0
+            assert got == pytest.approx(want, abs=ATOL)

@@ -1,0 +1,179 @@
+"""UniformGrid parity tests against a direct reading of UniformGrid.java."""
+
+import math
+
+import numpy as np
+import pytest
+
+from spatialflink_tpu.index import UniformGrid
+from spatialflink_tpu.index.uniform_grid import cells_within_layers
+
+# Canonical Beijing / T-Drive config (conf/geoflink-conf.yml:20-21)
+BBOX = dict(min_x=115.50, max_x=117.60, min_y=39.60, max_y=41.10)
+
+
+def make_grid(n=100):
+    return UniformGrid(BBOX["min_x"], BBOX["max_x"], BBOX["min_y"], BBOX["max_y"],
+                       num_grid_partitions=n)
+
+
+class TestConstruction:
+    def test_cell_count_ctor(self):
+        g = make_grid(100)
+        assert g.n == 100
+        assert g.cell_length == pytest.approx((117.60 - 115.50) / 100)
+
+    def test_cell_length_ctor_squares_bbox(self):
+        # UniformGrid.java:47-72 + adjustCoordinatesForSquareGrid :114-134
+        g = UniformGrid(0.0, 10.0, 0.0, 4.0, cell_length=1.0)
+        # x span 10 > y span 4 -> y expanded symmetrically to 10
+        assert (g.min_y, g.max_y) == (-3.0, 7.0)
+        assert g.n == 10
+        assert g.cell_length == pytest.approx(1.0)
+
+    def test_cell_length_ctor_non_integer(self):
+        g = UniformGrid(0.0, 10.0, 0.0, 10.0, cell_length=3.0)
+        assert g.n == math.ceil(10 / 3)  # 4
+        assert g.cell_length == pytest.approx(10 / 4)
+
+
+class TestCellAssignment:
+    def test_floor_division(self):
+        g = make_grid(100)
+        cell, valid = g.assign_cell(115.50, 39.60)
+        assert valid and cell == 0
+        # interior point
+        cell, _ = g.assign_cell(116.55, 40.35)
+        cx = math.floor((116.55 - g.min_x) / g.cell_length)
+        cy = math.floor((40.35 - g.min_y) / g.cell_length)
+        assert cell == cx * 100 + cy
+
+    def test_out_of_bbox_invalid(self):
+        g = make_grid(100)
+        cell, valid = g.assign_cell(110.0, 39.9)
+        assert not valid and cell == -1
+        cell, valid = g.assign_cell(117.61, 39.9)
+        assert not valid
+
+    def test_vectorized_matches_scalar(self):
+        g = make_grid(100)
+        rng = np.random.default_rng(0)
+        xs = rng.uniform(115.0, 118.0, 500)
+        ys = rng.uniform(39.0, 41.5, 500)
+        cells, valid = g.assign_cell(xs, ys)
+        for i in range(0, 500, 37):
+            c, v = g.assign_cell(xs[i], ys[i])
+            assert cells[i] == c and valid[i] == v
+
+    def test_cell_key_roundtrip(self):
+        g = make_grid(100)
+        key = g.cell_key(g.cell_id(7, 42))
+        assert key == "0000700042"  # 5-digit zero padding, UniformGrid.java:92
+        assert g.cell_from_key(key) == g.cell_id(7, 42)
+
+    def test_cell_bounds(self):
+        g = make_grid(100)
+        x1, y1, x2, y2 = g.cell_bounds(g.cell_id(3, 5))
+        assert x1 == pytest.approx(g.min_x + 3 * g.cell_length)
+        assert y2 == pytest.approx(g.min_y + 6 * g.cell_length)
+
+
+class TestLayerMath:
+    def test_guaranteed_layers_formula(self):
+        g = make_grid(100)
+        diag = g.cell_length * math.sqrt(2)
+        for r in (0.005, 0.01, 0.05, 0.1, 0.5, 1.0):
+            assert g.guaranteed_layers(r) == int(math.floor(r / diag - 1))
+
+    def test_candidate_layers_formula(self):
+        g = make_grid(100)
+        for r in (0.005, 0.01, 0.05, 0.1, 0.5):
+            assert g.candidate_layers(r) == int(math.ceil(r / g.cell_length))
+
+    def test_small_radius_no_guaranteed(self):
+        g = make_grid(100)
+        # r much smaller than a cell diagonal => guaranteed layers == -1
+        assert g.guaranteed_layers(0.005) == -1
+        mask = g.guaranteed_cells_mask(0.005, g.cell_id(50, 50))
+        assert not mask.any()
+
+    def test_gn_zero_layers_only_query_cell(self):
+        g = make_grid(100)
+        diag = g.cell_length * math.sqrt(2)
+        r = 1.5 * diag  # floor(1.5 - 1) = 0 layers
+        assert g.guaranteed_layers(r) == 0
+        mask = g.guaranteed_cells_mask(r, g.cell_id(50, 50))
+        assert mask.sum() == 1 and mask[g.cell_id(50, 50)]
+
+
+class TestNeighborMasks:
+    def test_gn_cn_mutually_exclusive(self):
+        g = make_grid(100)
+        c = g.cell_id(50, 50)
+        for r in (0.05, 0.1, 0.3, 0.5):
+            gn = g.guaranteed_cells_mask(r, c)
+            cn = g.candidate_cells_mask(r, c, gn)
+            assert not (gn & cn).any()
+            # union == all cells within candidate layers
+            assert ((gn | cn) == g.neighboring_cells_mask(r, c)).all()
+
+    def test_candidate_count_exact(self):
+        g = make_grid(100)
+        c = g.cell_id(50, 50)
+        r = 0.5
+        L = g.candidate_layers(r)
+        nb = g.neighboring_cells_mask(r, c)
+        assert nb.sum() == (2 * L + 1) ** 2  # interior cell, no clipping
+
+    def test_border_clipping(self):
+        g = make_grid(100)
+        c = g.cell_id(0, 0)
+        r = 0.5
+        L = g.candidate_layers(r)
+        nb = g.neighboring_cells_mask(r, c)
+        assert nb.sum() == (L + 1) ** 2  # corner cell keeps one quadrant
+
+    def test_radius_zero_all_cells(self):
+        g = make_grid(100)
+        nb = g.neighboring_cells_mask(0.0, g.cell_id(10, 10))
+        assert nb.all()  # UniformGrid.java:264-266
+
+    def test_polygon_union_semantics(self):
+        g = make_grid(100)
+        seeds = [g.cell_id(10, 10), g.cell_id(12, 10)]
+        gn = g.guaranteed_cells_mask(0.2, seeds)
+        per_seed = [g.guaranteed_cells_mask(0.2, s) for s in seeds]
+        assert (gn == (per_seed[0] | per_seed[1])).all()
+
+    def test_layer_rings(self):
+        g = make_grid(100)
+        c = g.cell_id(50, 50)
+        ring0 = g.neighboring_layer_cells_mask(c, 0)
+        ring2 = g.neighboring_layer_cells_mask(c, 2)
+        assert ring0.sum() == 1
+        assert ring2.sum() == 5 * 5 - 3 * 3
+        layers = g.all_neighboring_layers(c)
+        assert layers[0].sum() == 1 and len(layers) >= 50
+
+    def test_cell_layer_wrt(self):
+        g = make_grid(100)
+        q = g.cell_id(50, 50)
+        assert g.cell_layer_wrt(q, q) == 0
+        assert g.cell_layer_wrt(q, g.cell_id(53, 48)) == 3
+
+
+class TestDevicePredicate:
+    def test_cells_within_layers_matches_mask(self):
+        g = make_grid(100)
+        q = g.cell_id(50, 50)
+        r = 0.3
+        L = g.candidate_layers(r)
+        mask = g.neighboring_cells_mask(r, q)
+        cells = np.arange(g.num_cells, dtype=np.int32)
+        got = np.asarray(cells_within_layers(cells, np.int32(q), L, g.n))
+        assert (got == mask).all()
+
+    def test_invalid_cells_never_match(self):
+        g = make_grid(100)
+        got = cells_within_layers(np.array([-1], np.int32), np.int32(0), 100, g.n)
+        assert not np.asarray(got).any()

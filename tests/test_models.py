@@ -1,0 +1,114 @@
+"""Spatial objects and padded device batches."""
+
+import numpy as np
+import pytest
+
+from spatialflink_tpu.index import UniformGrid
+from spatialflink_tpu.models import (
+    EdgeGeomBatch,
+    LineString,
+    MultiPolygon,
+    Point,
+    PointBatch,
+    Polygon,
+)
+from spatialflink_tpu.utils import IdInterner, bucket_size
+
+
+def make_grid(n=100):
+    return UniformGrid(115.50, 117.60, 39.60, 41.10, num_grid_partitions=n)
+
+
+class TestObjects:
+    def test_point_cell_assignment(self):
+        g = make_grid()
+        p = Point.create(116.5, 40.5, g, obj_id="p1", timestamp=1000)
+        cell, _ = g.assign_cell(116.5, 40.5)
+        assert p.cell == cell
+
+    def test_polygon_auto_close_and_bbox(self):
+        poly = Polygon.create([[(0, 0), (4, 0), (4, 4), (0, 4)]])
+        assert poly.rings[0][0] == poly.rings[0][-1]  # auto-closed
+        assert poly.bbox == (0.0, 0.0, 4.0, 4.0)
+
+    def test_polygon_shell_is_largest_ring(self):
+        hole = [(1, 1), (2, 1), (2, 2), (1, 2)]
+        shell = [(0, 0), (4, 0), (4, 4), (0, 4)]
+        # pass the hole first: ctor must still pick the shell by area
+        poly = Polygon.create([hole, shell])
+        assert poly.rings[0][0] == (0.0, 0.0)
+
+    def test_polygon_cells_cover_bbox(self):
+        g = make_grid()
+        poly = Polygon.create(
+            [[(116.0, 40.0), (116.1, 40.0), (116.1, 40.1), (116.0, 40.1)]], g
+        )
+        assert poly.cells == g.bbox_cells(116.0, 40.0, 116.1, 40.1)
+        assert poly.cell in poly.cells
+
+    def test_linestring_edges(self):
+        ls = LineString.create([(0, 0), (1, 0), (1, 1)])
+        edges, mask = ls.edge_array()
+        assert edges.shape == (2, 4)
+        assert mask.all()
+
+    def test_multipolygon_edges(self):
+        mp = MultiPolygon.create(
+            [[[(0, 0), (1, 0), (1, 1)]], [[(5, 5), (6, 5), (6, 6)]]]
+        )
+        edges, _ = mp.edge_array()
+        assert edges.shape == (6, 4)  # two triangles, 3 closed edges each
+        assert mp.bbox == (0.0, 0.0, 6.0, 6.0)
+
+
+class TestPointBatch:
+    def test_build_and_pad(self):
+        g = make_grid()
+        pts = [Point.create(116.0 + i * 0.01, 40.0, g, obj_id=f"o{i}", timestamp=i)
+               for i in range(10)]
+        b = PointBatch.from_points(pts, g)
+        assert b.capacity == bucket_size(10)
+        assert b.valid.sum() == 10
+        assert not b.valid[10:].any()
+        assert (b.cell[:10] >= 0).all()
+        assert (b.cell[10:] == -1).all()
+
+    def test_ts_offset(self):
+        base = 1_700_000_000_000
+        pts = [Point.create(116.0, 40.0, obj_id="a", timestamp=base + 5000)]
+        b = PointBatch.from_points(pts, ts_base=base)
+        assert b.ts[0] == 5000
+        assert b.ts.dtype == np.int32
+
+    def test_interner_shared(self):
+        it = IdInterner()
+        pts = [Point.create(116.0, 40.0, obj_id="x"), Point.create(116.1, 40.0, obj_id="x")]
+        b = PointBatch.from_points(pts, interner=it)
+        assert b.obj_id[0] == b.obj_id[1]
+        assert it.lookup(int(b.obj_id[0])) == "x"
+
+
+class TestEdgeGeomBatch:
+    def test_mixed_batch(self):
+        g = make_grid()
+        geoms = [
+            Polygon.create([[(116.0, 40.0), (116.1, 40.0), (116.1, 40.1)]], g, obj_id="poly"),
+            LineString.create([(116.2, 40.2), (116.3, 40.3)], g, obj_id="line"),
+        ]
+        b = EdgeGeomBatch.from_objects(geoms, g)
+        assert b.valid.sum() == 2
+        assert bool(b.is_areal[0]) and not bool(b.is_areal[1])
+        assert b.edge_mask[0].sum() == 3  # closed triangle
+        assert b.edge_mask[1].sum() == 1
+        # padded geometry slots are fully masked
+        assert not b.edge_mask[2:].any()
+
+    def test_cells_padded(self):
+        g = make_grid()
+        poly = Polygon.create(
+            [[(116.0, 40.0), (116.5, 40.0), (116.5, 40.5), (116.0, 40.5)]], g
+        )
+        b = EdgeGeomBatch.from_objects([poly], g)
+        want = np.array(sorted(poly.cells), np.int32)
+        got = b.cells[0][b.cells_mask[0]]
+        assert set(got.tolist()) == set(want.tolist()) or len(got) == b.cells.shape[1]

@@ -275,16 +275,22 @@ class UniformGrid:
         )
 
 
-def cells_within_layers(cell_a, cell_b, layers: int, n: int):
-    """Device-friendly predicate: is cell_a within ``layers`` Chebyshev layers
-    of cell_b on an n x n grid?  Works on jnp/np int32 arrays; invalid cells
-    (-1) never match.  This is the arithmetic form of the reference's
-    neighboring-cell set membership test for point queries.
-    """
+def cheb_layers(cell_a, cell_b, n: int):
+    """Chebyshev layer distance between two cell ids on an n x n grid;
+    a huge sentinel if either cell is invalid (-1). jnp-array friendly.
+
+    This is the single arithmetic form of the reference's neighboring-cell
+    membership test for point queries: ``cheb_layers(a, b, n) <= L`` is
+    "cell a lies within L layers of cell b"."""
     import jax.numpy as jnp
 
     cell_a, cell_b = jnp.asarray(cell_a), jnp.asarray(cell_b)
     ax, ay = cell_a // n, cell_a % n
     bx, by = cell_b // n, cell_b % n
-    ok = (cell_a >= 0) & (cell_b >= 0)
-    return ok & (jnp.maximum(jnp.abs(ax - bx), jnp.abs(ay - by)) <= layers)
+    layers = jnp.maximum(jnp.abs(ax - bx), jnp.abs(ay - by))
+    return jnp.where((cell_a >= 0) & (cell_b >= 0), layers, jnp.int32(2**30))
+
+
+def cells_within_layers(cell_a, cell_b, layers: int, n: int):
+    """Boolean form of :func:`cheb_layers`: invalid cells (-1) never match."""
+    return cheb_layers(cell_a, cell_b, n) <= layers

@@ -6,4 +6,4 @@ and the hot loops in ``spatialOperators/{range,knn,join}``): everything here
 operates on padded, masked, fixed-shape arrays.
 """
 
-from spatialflink_tpu.ops import distances  # noqa: F401
+from spatialflink_tpu.ops import distances, geom, join, knn, range  # noqa: F401

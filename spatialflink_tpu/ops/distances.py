@@ -16,6 +16,13 @@ reference's hot paths call JTS ``geom.distance()`` on lon/lat degrees,
 - :func:`seg_seg_dist` / :func:`edges_edges_dist` <- JTS boundary-boundary
                                 distance (0 when boundaries cross)
 
+Precision model: device coordinates are float32 absolute degrees. The f32
+quantum at |x| ~ 116 deg is ~7.6e-6 deg (<1 m), which bounds every distance
+below; the reference's canonical radii (0.005-0.5 deg) sit 3-5 orders of
+magnitude above that floor. Kernels avoid *adding* error on top of storage
+quantization (centered matmul expansion in ops.join, squared-distance
+comparisons instead of sqrt).
+
 Conventions: every "batch" geometry is a padded edge array
 ``edges: (..., E, 4)`` holding ``[x1, y1, x2, y2]`` per edge plus a boolean
 ``edge_mask: (..., E)``; padded edges must be excluded by the mask.  All

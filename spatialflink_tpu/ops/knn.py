@@ -1,0 +1,124 @@
+"""k-nearest-neighbor window kernels with object-id dedup.
+
+Reference semantics (``knn/PointPointKNNQuery.java:138-191`` +
+``knn/KNNQuery.java:204-300``): the radius r selects the neighboring-cell set
+(GN ∪ CN) but the exact distance is NOT radius-filtered in windowed mode; the
+per-cell windows keep a k-element max-heap, and the global ``windowAll`` merge
+deduplicates by objID keeping the *minimum* distance per object.
+
+TPU re-design: instead of per-cell heaps + a parallelism-1 merge, we compute
+all masked distances in one shot, deduplicate by objID with a lexicographic
+sort (sort by (objID, dist); the first row of each objID run carries its min
+distance), then take a single ``lax.top_k``. The same kernel runs per shard
+under shard_map, with partial top-k results merged by all-gather + re-top-k
+(see spatialflink_tpu.parallel) — that kills the reference's windowAll
+bottleneck.
+
+The trajectory variant (tKnn) *does* enforce the exact radius
+(``tKnn/PointPointTKNNQuery.java:95-111``); pass ``enforce_radius=True``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from spatialflink_tpu.models.batches import PointBatch
+from spatialflink_tpu.ops import distances as D
+from spatialflink_tpu.ops.range import cheb_layers
+
+_BIG = jnp.float32(3.4e38)
+_OID_SENTINEL = jnp.int32(2**31 - 1)
+
+
+class KnnResult(NamedTuple):
+    obj_id: jnp.ndarray  # (k,) i32; sentinel 2^31-1 in empty slots
+    dist: jnp.ndarray    # (k,) f32; +BIG in empty slots
+    valid: jnp.ndarray   # (k,) bool
+
+
+def dedup_min_by_id(obj_id, dist, eligible):
+    """Per-object minimum distance via one lexicographic sort.
+
+    Returns (obj_id_sorted, dist_sorted, keep) where ``keep`` marks the first
+    occurrence of each object id (which, after an ascending (id, dist) sort,
+    carries that object's min distance). Ineligible rows get a sentinel id so
+    they sort to the back and are never kept.
+    """
+    oid = jnp.where(eligible, obj_id, _OID_SENTINEL)
+    d = jnp.where(eligible, dist, _BIG)
+    oid_s, d_s = jax.lax.sort((oid, d), num_keys=2)
+    prev = jnp.concatenate([jnp.full((1,), -1, oid_s.dtype), oid_s[:-1]])
+    keep = (oid_s != prev) & (oid_s != _OID_SENTINEL)
+    return oid_s, d_s, keep
+
+
+def topk_by_distance(obj_id, dist, eligible, k: int) -> KnnResult:
+    """Dedup by object id (keep min dist) then top-k smallest distances."""
+    oid_s, d_s, keep = dedup_min_by_id(obj_id, dist, eligible)
+    d_masked = jnp.where(keep, d_s, _BIG)
+    neg_top, idx = jax.lax.top_k(-d_masked, k)
+    top_d = -neg_top
+    top_oid = jnp.where(top_d < _BIG, oid_s[idx], _OID_SENTINEL)
+    return KnnResult(obj_id=top_oid, dist=top_d, valid=top_d < _BIG)
+
+
+@partial(jax.jit, static_argnames=("n", "k", "enforce_radius"))
+def knn_point(
+    points: PointBatch,
+    qx,
+    qy,
+    q_cell,
+    radius,
+    nb_layers,
+    *,
+    n: int,
+    k: int,
+    enforce_radius: bool = False,
+) -> KnnResult:
+    """kNN of a query point over a window batch.
+
+    nb_layers: candidate layer count (``UniformGrid.candidate_layers``);
+    pass ``n`` (the grid size) to disable cell pruning (radius 0 semantics:
+    all cells are neighbors, ``UniformGrid.java:264-266``).
+    """
+    layers = cheb_layers(points.cell, q_cell, n)
+    eligible = points.valid & (layers <= nb_layers)
+    d = D.pp_dist(points.x, points.y, qx, qy)
+    if enforce_radius:
+        eligible = eligible & (d <= radius)
+    return topk_by_distance(points.obj_id, d, eligible, k)
+
+
+@partial(jax.jit, static_argnames=("k", "enforce_radius"))
+def knn_with_dists(
+    obj_id,
+    dists,
+    nb_mask,
+    cell,
+    valid,
+    radius,
+    *,
+    k: int,
+    enforce_radius: bool = False,
+) -> KnnResult:
+    """Generic kNN: caller supplies distances (e.g. point->polygon) and a
+    dense neighboring-cells mask for the query geometry."""
+    cell_ok = cell >= 0
+    eligible = valid & cell_ok & nb_mask[jnp.maximum(cell, 0)]
+    if enforce_radius:
+        eligible = eligible & (dists <= radius)
+    return topk_by_distance(obj_id, dists, eligible, k)
+
+
+def merge_knn(results, k: int) -> KnnResult:
+    """Merge per-shard/per-window partial KnnResults (the reference's
+    ``kNNWinAllEvaluationPointStream`` dedup+merge, without the
+    parallelism-1 bottleneck: concatenate, dedup, re-top-k)."""
+    obj_id = jnp.concatenate([r.obj_id for r in results])
+    dist = jnp.concatenate([r.dist for r in results])
+    valid = jnp.concatenate([r.valid for r in results])
+    return topk_by_distance(obj_id, dist, valid, k)

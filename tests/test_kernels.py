@@ -1,0 +1,391 @@
+"""Window-batch kernels (range / knn / join / geom) vs NumPy oracles.
+
+The oracle for every pruned kernel is an exhaustive scan — the same
+methodology the reference implies with its naive-twin operators (SURVEY §4).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from spatialflink_tpu.index import UniformGrid
+from spatialflink_tpu.models import EdgeGeomBatch, Point, PointBatch, Polygon, LineString
+from spatialflink_tpu.models.batches import single_query_edges
+from spatialflink_tpu.ops import geom as G
+from spatialflink_tpu.ops import join as J
+from spatialflink_tpu.ops import knn as K
+from spatialflink_tpu.ops import range as R
+from tests import oracles as O
+
+RNG = np.random.default_rng(7)
+GRID = UniformGrid(115.50, 117.60, 39.60, 41.10, num_grid_partitions=100)
+
+
+def random_batch(n, n_objects=None, seed=0):
+    rng = np.random.default_rng(seed)
+    xs = rng.uniform(115.4, 117.7, n)  # a few points fall outside the grid
+    ys = rng.uniform(39.5, 41.2, n)
+    oid = rng.integers(0, n_objects or n, n).astype(np.int32)
+    b = PointBatch.from_arrays(xs, ys, grid=GRID, obj_id=oid)
+    return b, xs, ys, oid
+
+
+class TestRangeFilter:
+    QX, QY = 116.5, 40.5
+
+    def _reference_mask(self, xs, ys, r):
+        """Oracle: GN points always pass; CN points pass iff dist <= r;
+        everything else fails."""
+        q_cell, _ = GRID.assign_cell(self.QX, self.QY)
+        gn = GRID.guaranteed_cells_mask(r, int(q_cell))
+        cn = GRID.candidate_cells_mask(r, int(q_cell), gn)
+        out = np.zeros(len(xs), bool)
+        for i, (x, y) in enumerate(zip(xs, ys)):
+            c, valid = GRID.assign_cell(x, y)
+            if not valid:
+                continue
+            if gn[c]:
+                out[i] = True
+            elif cn[c]:
+                out[i] = O.pp_dist(x, y, self.QX, self.QY) <= r
+        return out
+
+    @pytest.mark.parametrize("r", [0.05, 0.3, 0.5])
+    def test_point_query_matches_oracle(self, r):
+        b, xs, ys, _ = random_batch(800)
+        q_cell, _ = GRID.assign_cell(self.QX, self.QY)
+        mask, dists = R.range_filter_point(
+            b, self.QX, self.QY, jnp.int32(q_cell), r,
+            GRID.guaranteed_layers(r), GRID.candidate_layers(r), n=GRID.n,
+        )
+        want = self._reference_mask(xs, ys, r)
+        got = np.asarray(mask)[: len(xs)]
+        # tolerate f32-vs-f64 boundary flips: only exact-boundary points may differ
+        diff = np.nonzero(got != want)[0]
+        for i in diff:
+            d = O.pp_dist(xs[i], ys[i], self.QX, self.QY)
+            assert abs(d - r) < 1e-4, f"non-boundary disagreement at {i} (d={d})"
+
+    def test_gn_bypasses_distance(self):
+        # a GN point farther than r must still be selected (reference behavior)
+        r = 0.5
+        q_cell, _ = GRID.assign_cell(self.QX, self.QY)
+        gn_layers = GRID.guaranteed_layers(r)
+        assert gn_layers >= 0
+        b, xs, ys, _ = random_batch(400)
+        mask, dists = R.range_filter_point(
+            b, self.QX, self.QY, jnp.int32(q_cell), r,
+            gn_layers, GRID.candidate_layers(r), n=GRID.n,
+        )
+        # find any GN point with dist > r: it must be in the mask with inf dist
+        gn_mask_np = GRID.guaranteed_cells_mask(r, int(q_cell))
+        for i in range(len(xs)):
+            c, valid = GRID.assign_cell(xs[i], ys[i])
+            if valid and gn_mask_np[c] and O.pp_dist(xs[i], ys[i], self.QX, self.QY) > r:
+                assert bool(mask[i])
+                assert np.isinf(float(dists[i]))
+                break
+        else:
+            pytest.skip("no far GN point in sample")
+
+    def test_approximate_mode_skips_distance(self):
+        r = 0.3
+        b, xs, ys, _ = random_batch(400)
+        q_cell, _ = GRID.assign_cell(self.QX, self.QY)
+        mask, _ = R.range_filter_point(
+            b, self.QX, self.QY, jnp.int32(q_cell), r,
+            GRID.guaranteed_layers(r), GRID.candidate_layers(r),
+            n=GRID.n, approximate=True,
+        )
+        nb = GRID.neighboring_cells_mask(r, int(q_cell))
+        for i in range(len(xs)):
+            c, valid = GRID.assign_cell(xs[i], ys[i])
+            assert bool(mask[i]) == (bool(valid) and bool(nb[c]))
+
+    def test_masks_variant_matches_point_variant(self):
+        r = 0.3
+        b, *_ = random_batch(500)
+        q_cell, _ = GRID.assign_cell(self.QX, self.QY)
+        gn = GRID.guaranteed_cells_mask(r, int(q_cell))
+        cn = GRID.candidate_cells_mask(r, int(q_cell), gn)
+        from spatialflink_tpu.ops.distances import pp_dist
+
+        dists = pp_dist(b.x, b.y, self.QX, self.QY)
+        got = R.range_filter_masks(b, jnp.asarray(gn), jnp.asarray(cn), dists, r)
+        want, _ = R.range_filter_point(
+            b, self.QX, self.QY, jnp.int32(q_cell), r,
+            GRID.guaranteed_layers(r), GRID.candidate_layers(r), n=GRID.n,
+        )
+        assert (np.asarray(got) == np.asarray(want)).all()
+
+
+class TestKnn:
+    QX, QY = 116.5, 40.5
+
+    @pytest.mark.parametrize("k", [1, 10, 50])
+    def test_matches_oracle_no_pruning(self, k):
+        b, xs, ys, oid = random_batch(700, n_objects=120)
+        res = K.knn_point(
+            b, self.QX, self.QY, jnp.int32(0), 0.0, GRID.n, n=GRID.n, k=k
+        )
+        want_ids, want_d = O.knn(self.QX, self.QY, xs, ys, oid, k)
+        got_d = np.asarray(res.dist)[np.asarray(res.valid)]
+        np.testing.assert_allclose(got_d, want_d[: len(got_d)], atol=1e-4)
+        # ids must match wherever distances are not tied
+        got_ids = np.asarray(res.obj_id)[np.asarray(res.valid)]
+        for i, (gi, wi) in enumerate(zip(got_ids, want_ids)):
+            if gi != wi:
+                assert abs(want_d[i] - got_d[i]) < 1e-4  # tie or f32 flip
+
+    def test_dedup_keeps_min_distance(self):
+        # same object appears twice; result must carry the nearer distance
+        xs = np.array([116.51, 117.0])
+        ys = np.array([40.5, 40.5])
+        b = PointBatch.from_arrays(xs, ys, grid=GRID, obj_id=np.array([5, 5], np.int32))
+        res = K.knn_point(b, self.QX, self.QY, jnp.int32(0), 0.0, GRID.n, n=GRID.n, k=10)
+        assert int(res.valid.sum()) == 1
+        assert int(res.obj_id[0]) == 5
+        assert float(res.dist[0]) == pytest.approx(0.01, abs=1e-4)
+
+    def test_cell_pruning_limits_candidates(self):
+        r = 0.1
+        b, xs, ys, oid = random_batch(700, n_objects=500)
+        q_cell, _ = GRID.assign_cell(self.QX, self.QY)
+        res = K.knn_point(
+            b, self.QX, self.QY, jnp.int32(q_cell), r,
+            GRID.candidate_layers(r), n=GRID.n, k=20,
+        )
+        nb = GRID.neighboring_cells_mask(r, int(q_cell))
+        # oracle restricted to neighboring cells
+        keep = []
+        for i in range(len(xs)):
+            c, valid = GRID.assign_cell(xs[i], ys[i])
+            if valid and nb[c]:
+                keep.append(i)
+        want_ids, want_d = O.knn(self.QX, self.QY, xs[keep], ys[keep], oid[keep], 20)
+        got_d = np.asarray(res.dist)[np.asarray(res.valid)]
+        np.testing.assert_allclose(got_d, want_d, atol=1e-4)
+
+    def test_enforce_radius(self):
+        b, xs, ys, oid = random_batch(500, n_objects=400)
+        r = 0.2
+        res = K.knn_point(
+            b, self.QX, self.QY, jnp.int32(0), r, GRID.n,
+            n=GRID.n, k=50, enforce_radius=True,
+        )
+        got_d = np.asarray(res.dist)[np.asarray(res.valid)]
+        assert (got_d <= r + 1e-4).all()
+        want_ids, want_d = O.knn(self.QX, self.QY, xs, ys, oid, 50, radius=r)
+        assert len(got_d) == len(want_d)
+
+    def test_merge_partials(self):
+        b1, x1, y1, o1 = random_batch(300, n_objects=80, seed=1)
+        b2, x2, y2, o2 = random_batch(300, n_objects=80, seed=2)
+        r1 = K.knn_point(b1, self.QX, self.QY, jnp.int32(0), 0.0, GRID.n, n=GRID.n, k=10)
+        r2 = K.knn_point(b2, self.QX, self.QY, jnp.int32(0), 0.0, GRID.n, n=GRID.n, k=10)
+        merged = K.merge_knn([r1, r2], 10)
+        want_ids, want_d = O.knn(
+            self.QX, self.QY,
+            np.concatenate([x1, x2]), np.concatenate([y1, y2]),
+            np.concatenate([o1, o2]), 10,
+        )
+        got_d = np.asarray(merged.dist)[np.asarray(merged.valid)]
+        np.testing.assert_allclose(got_d, want_d[: len(got_d)], atol=1e-4)
+
+
+class TestJoin:
+    def test_matches_oracle(self):
+        r = 0.1
+        a, ax, ay, _ = random_batch(300, seed=3)
+        b, bx, by, _ = random_batch(100, seed=4)
+        L = GRID.candidate_layers(r)
+        cx = (GRID.min_x + GRID.max_x) / 2
+        cy = (GRID.min_y + GRID.max_y) / 2
+        m = np.asarray(J.join_mask(a, b, r, L, cx, cy, n=GRID.n))
+        nb_masks = {}
+        for j in range(len(bx)):
+            c, valid = GRID.assign_cell(bx[j], by[j])
+            nb_masks[j] = GRID.neighboring_cells_mask(r, int(c)) if valid else None
+        for i in range(len(ax)):
+            ca, va = GRID.assign_cell(ax[i], ay[i])
+            for j in range(len(bx)):
+                want = False
+                if va and nb_masks[j] is not None and nb_masks[j][ca]:
+                    d = O.pp_dist(ax[i], ay[i], bx[j], by[j])
+                    want = d <= r
+                if m[i, j] != want:
+                    d = O.pp_dist(ax[i], ay[i], bx[j], by[j])
+                    assert abs(d - r) < 1e-3, f"non-boundary join mismatch {i},{j}"
+
+    def test_counts_match_mask(self):
+        r = 0.15
+        a, *_ = random_batch(512, seed=5)
+        b, *_ = random_batch(256, seed=6)
+        L = GRID.candidate_layers(r)
+        cx = (GRID.min_x + GRID.max_x) / 2
+        cy = (GRID.min_y + GRID.max_y) / 2
+        m = np.asarray(J.join_mask(a, b, r, L, cx, cy, n=GRID.n))
+        per_a, total = J.join_counts(a, b, r, L, cx, cy, n=GRID.n, tile=256)
+        assert (np.asarray(per_a) == m.sum(axis=1)).all()
+        assert int(total) == m.sum()
+
+    def test_pairs_host_extraction(self):
+        r = 0.1
+        a, ax, ay, _ = random_batch(300, seed=8)
+        b, bx, by, _ = random_batch(300, seed=9)
+        pairs = set()
+        for ai, bi in J.join_pairs_host(a, b, r, GRID, tile=128):
+            pairs.update(zip(ai.tolist(), bi.tolist()))
+        # every pair satisfies the distance predicate
+        for i, j in list(pairs)[:200]:
+            assert O.pp_dist(ax[i], ay[i], bx[j], by[j]) <= r + 1e-3
+
+    def test_pairwise_dist2_precision_with_centering(self):
+        # Close points at degree magnitude. The error floor is the f32
+        # *storage* quantization of the inputs (~7.6e-6 deg at |x|~116, i.e.
+        # <1 m); the centered matmul itself adds nothing beyond it.
+        ax = np.array([116.5000, 116.5001], np.float64)
+        ay = np.array([40.5000, 40.5000], np.float64)
+        d2 = np.asarray(J.pairwise_dist2(
+            jnp.asarray(ax, jnp.float32), jnp.asarray(ay, jnp.float32),
+            jnp.asarray(ax, jnp.float32), jnp.asarray(ay, jnp.float32),
+            116.55, 40.35,
+        ))
+        assert np.sqrt(d2[0, 1]) == pytest.approx(1e-4, abs=1.6e-5)
+        # without centering the cancellation would be ~2e-3 — catastrophically
+        # larger than the 1e-4 separation; verify centering keeps us at the floor
+        d2_raw = np.asarray(J.pairwise_dist2(
+            jnp.asarray(ax, jnp.float32), jnp.asarray(ay, jnp.float32),
+            jnp.asarray(ax, jnp.float32), jnp.asarray(ay, jnp.float32),
+        ))
+        assert abs(np.sqrt(d2[0, 1]) - 1e-4) <= abs(np.sqrt(d2_raw[0, 1]) - 1e-4)
+
+
+class TestGeomKernels:
+    POLY = Polygon.create(
+        [[(116.0, 40.0), (116.4, 40.0), (116.4, 40.4), (116.0, 40.4)],
+         [(116.1, 40.1), (116.3, 40.1), (116.3, 40.3), (116.1, 40.3)]],
+        GRID, obj_id="donut",
+    )
+    TRI = Polygon.create([[(117.0, 40.0), (117.2, 40.0), (117.1, 40.2)]], GRID, obj_id="tri")
+    LINE = LineString.create([(116.6, 40.6), (116.8, 40.8), (117.0, 40.6)], GRID, obj_id="ls")
+
+    def batch(self):
+        return EdgeGeomBatch.from_objects([self.POLY, self.TRI, self.LINE], GRID)
+
+    def test_points_to_geoms_dist(self):
+        gb = self.batch()
+        pts = PointBatch.from_arrays(
+            np.array([116.2, 116.05, 117.1, 116.8]),
+            np.array([40.2, 40.2, 40.05, 40.9]),
+            grid=GRID,
+        )
+        d = np.asarray(G.points_to_geoms_dist(pts, gb))
+        # point in donut hole -> boundary dist 0.1 ; point in donut body -> 0
+        assert d[0, 0] == pytest.approx(0.1, abs=1e-3)
+        assert d[1, 0] == 0.0
+        # point inside triangle -> 0
+        assert d[2, 1] == 0.0
+        # point above the linestring apex
+        want = O.point_segment_dist(116.8, 40.9, 116.6, 40.6, 116.8, 40.8)
+        assert d[3, 2] == pytest.approx(want, abs=1e-3)
+
+    def test_single_geom_variant(self):
+        gb = self.batch()
+        pts = PointBatch.from_arrays(
+            np.array([116.2, 116.5]), np.array([40.2, 40.5]), grid=GRID
+        )
+        e, m = single_query_edges(self.POLY)
+        d = np.asarray(G.points_to_single_geom_dist(pts, jnp.asarray(e), jnp.asarray(m), True))
+        full = np.asarray(G.points_to_geoms_dist(pts, gb))[:, 0]
+        np.testing.assert_allclose(d, full, atol=1e-5)
+
+    def test_geoms_to_single_geom(self):
+        gb = self.batch()
+        q = Polygon.create([[(116.35, 40.35), (116.6, 40.35), (116.6, 40.6), (116.35, 40.6)]],
+                           GRID, obj_id="q")
+        e, m = single_query_edges(q)
+        d = np.asarray(G.geoms_to_single_geom_dist(gb, jnp.asarray(e), jnp.asarray(m), True))
+        # query overlaps the donut shell corner -> 0
+        assert d[0] == 0.0
+        want = O.polygon_polygon_dist([np.asarray(self.TRI.rings[0])], [np.asarray(q.rings[0])])
+        assert d[1] == pytest.approx(want, abs=1e-3)
+
+    def test_containment_both_ways(self):
+        inner = Polygon.create([[(116.45, 40.45), (116.5, 40.45), (116.5, 40.5), (116.45, 40.5)]],
+                               GRID, obj_id="inner")
+        outer = Polygon.create([[(116.4, 40.4), (116.6, 40.4), (116.6, 40.6), (116.4, 40.6)]],
+                               GRID, obj_id="outer")
+        gb = EdgeGeomBatch.from_objects([inner], GRID)
+        e, m = single_query_edges(outer)
+        d = np.asarray(G.geoms_to_single_geom_dist(gb, jnp.asarray(e), jnp.asarray(m), True))
+        assert d[0] == 0.0  # inner fully inside query
+        gb2 = EdgeGeomBatch.from_objects([outer], GRID)
+        e2, m2 = single_query_edges(inner)
+        d2 = np.asarray(G.geoms_to_single_geom_dist(gb2, jnp.asarray(e2), jnp.asarray(m2), True))
+        assert d2[0] == 0.0  # query fully inside batch geometry
+
+    def test_gn_subset_rule(self):
+        gb = self.batch()
+        # target mask covering ALL cells -> every geometry passes the all-rule
+        all_mask = jnp.ones(GRID.num_cells, bool)
+        allw = np.asarray(G.geom_cells_all_within(gb.cells, gb.cells_mask, all_mask))
+        assert allw[: 3].all()
+        # empty mask -> nothing passes
+        none = np.asarray(G.geom_cells_all_within(gb.cells, gb.cells_mask,
+                                                  jnp.zeros(GRID.num_cells, bool)))
+        assert not none.any()
+
+    def test_bbox_prefilter(self):
+        gb = self.batch()
+        q_bbox = jnp.asarray(np.array([116.45, 40.0, 116.55, 40.1], np.float32))
+        d = np.asarray(G.geoms_bbox_dist(gb, q_bbox))
+        want0 = O.bbox_bbox_dist(np.asarray(self.POLY.bbox), [116.45, 40.0, 116.55, 40.1])
+        assert d[0] == pytest.approx(want0, abs=1e-3)
+
+
+class TestReviewRegressions:
+    """Regressions for code-review findings on the phase-2 kernels."""
+
+    def test_join_counts_small_batch_default_tile(self):
+        # batches smaller than the default tile must not crash (tile clamps)
+        a, *_ = random_batch(100, seed=11)
+        b, *_ = random_batch(100, seed=12)
+        cx = (GRID.min_x + GRID.max_x) / 2
+        cy = (GRID.min_y + GRID.max_y) / 2
+        per_a, total = J.join_counts(a, b, 0.1, GRID.candidate_layers(0.1), cx, cy, n=GRID.n)
+        m = np.asarray(J.join_mask(a, b, 0.1, GRID.candidate_layers(0.1), cx, cy, n=GRID.n))
+        assert int(total) == m.sum()
+
+    def test_multipolygon_component_containment(self):
+        # one component far away, the other strictly inside the query:
+        # JTS distance is 0; the vertex test must scan all components
+        from spatialflink_tpu.models import MultiPolygon
+
+        mp = MultiPolygon.create(
+            [[[(117.0, 41.0), (117.05, 41.0), (117.05, 41.05), (117.0, 41.05)]],
+             [[(116.45, 40.45), (116.5, 40.45), (116.5, 40.5), (116.45, 40.5)]]],
+            GRID, obj_id="mp",
+        )
+        outer = Polygon.create(
+            [[(116.4, 40.4), (116.6, 40.4), (116.6, 40.6), (116.4, 40.6)]], GRID
+        )
+        gb = EdgeGeomBatch.from_objects([mp], GRID)
+        e, m = single_query_edges(outer)
+        d = np.asarray(G.geoms_to_single_geom_dist(gb, jnp.asarray(e), jnp.asarray(m), True))
+        assert d[0] == 0.0
+
+    def test_padded_slot_not_zero_when_query_contains_origin(self):
+        # padded geometry slots have all-zero edges; a query polygon covering
+        # (0,0) must NOT produce distance 0 for them
+        tri = Polygon.create([[(117.0, 40.0), (117.2, 40.0), (117.1, 40.2)]], GRID)
+        gb = EdgeGeomBatch.from_objects([tri], GRID, pad=8)
+        origin_poly_edges = np.array(
+            [[-1, -1, 1, -1], [1, -1, 1, 1], [1, 1, -1, 1], [-1, 1, -1, -1]], np.float32
+        )
+        d = np.asarray(G.geoms_to_single_geom_dist(
+            gb, jnp.asarray(origin_poly_edges), jnp.ones(4, bool), True
+        ))
+        assert (d[1:] > 1e18).all()  # padded slots stay at the +inf sentinel

@@ -1,0 +1,30 @@
+"""Spatial query operators (reference: ``spatialOperators/``).
+
+Operator classes mirror the reference API surface: construct with a
+:class:`QueryConfiguration` + grid(s), then ``run(stream, query, radius,...)``.
+``run`` consumes an iterator of spatial objects and yields result events —
+per sealed window in window mode, per micro-batch in real-time mode.
+
+The execution model differs deliberately (SURVEY §7): instead of Flink's
+per-cell keyed window operators + shuffles, each window is one padded device
+batch evaluated by a masked kernel (spatialflink_tpu.ops), optionally
+sharded over a device mesh (spatialflink_tpu.parallel).
+"""
+
+from spatialflink_tpu.operators.base import (
+    QueryConfiguration,
+    QueryType,
+    WindowResult,
+)
+from spatialflink_tpu.operators.range_query import PointPointRangeQuery
+from spatialflink_tpu.operators.knn_query import PointPointKNNQuery
+from spatialflink_tpu.operators.join_query import PointPointJoinQuery
+
+__all__ = [
+    "QueryConfiguration",
+    "QueryType",
+    "WindowResult",
+    "PointPointRangeQuery",
+    "PointPointKNNQuery",
+    "PointPointJoinQuery",
+]

@@ -1,0 +1,91 @@
+"""Operator plumbing: query configuration, window/micro-batch drivers.
+
+Reference parity:
+- :class:`QueryType` — ``spatialOperators/QueryType.java:3-7`` (RealTime,
+  WindowBased, CountBased; CountBased is declared-but-unsupported in the
+  reference — here it raises the same way).
+- :class:`QueryConfiguration` — ``spatialOperators/QueryConfiguration.java``
+  plus the window/approximate fields the reference passes via ``Params``.
+- Real-time mode: the reference uses tiny tumbling windows with
+  fire-per-element triggers (``tJoin/TJoinQuery.java:216-268``). The TPU
+  equivalent is micro-batching: arrivals are grouped into batches of at most
+  ``realtime_batch_size`` records and evaluated in one kernel launch, giving
+  per-arrival-group latency without per-tuple kernel dispatch.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Iterable, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from spatialflink_tpu.index import UniformGrid
+from spatialflink_tpu.models import Point, PointBatch
+from spatialflink_tpu.runtime import WindowAssembler, WindowSpec
+from spatialflink_tpu.utils import IdInterner
+
+
+class QueryType(enum.Enum):
+    RealTime = "realtime"
+    WindowBased = "window"
+    CountBased = "count"  # declared but unsupported, like the reference
+
+
+@dataclass
+class QueryConfiguration:
+    query_type: QueryType = QueryType.WindowBased
+    window_size_ms: int = 10_000
+    slide_ms: int = 5_000
+    allowed_lateness_ms: int = 0
+    approximate: bool = False
+    realtime_batch_size: int = 512
+    k: int = 10  # kNN only
+
+    def window_spec(self) -> WindowSpec:
+        return WindowSpec.sliding(self.window_size_ms, self.slide_ms)
+
+
+@dataclass
+class WindowResult:
+    """One emitted result event: the records selected in [start, end)."""
+
+    window_start: int
+    window_end: int
+    records: List = field(default_factory=list)
+    extras: dict = field(default_factory=dict)
+
+
+class SpatialOperator:
+    """Shared driver: turns a record stream into point-window batches."""
+
+    def __init__(self, conf: QueryConfiguration, grid: UniformGrid,
+                 grid2: Optional[UniformGrid] = None):
+        if conf.query_type is QueryType.CountBased:
+            raise NotImplementedError("CountBased queries are not yet supported")
+        self.conf = conf
+        self.grid = grid
+        self.grid2 = grid2 or grid
+        self.interner = IdInterner()
+
+    # ---------------------------------------------------------------- #
+
+    def _point_batch(self, records: List[Point], ts_base: int) -> PointBatch:
+        return PointBatch.from_points(records, self.grid, self.interner, ts_base=ts_base)
+
+    def _windows(self, stream: Iterable[Point]) -> Iterator[Tuple[int, int, List[Point]]]:
+        wa = WindowAssembler(self.conf.window_spec(), self.conf.allowed_lateness_ms)
+        for rec in stream:
+            yield from wa.add(rec.timestamp, rec)
+        yield from wa.flush()
+
+    def _micro_batches(self, stream: Iterable[Point]) -> Iterator[List[Point]]:
+        buf: List[Point] = []
+        for rec in stream:
+            buf.append(rec)
+            if len(buf) >= self.conf.realtime_batch_size:
+                yield buf
+                buf = []
+        if buf:
+            yield buf

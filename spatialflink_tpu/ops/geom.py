@@ -17,8 +17,6 @@ reduction operands that XLA fuses; nothing of that size is materialized.
 
 from __future__ import annotations
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 

@@ -1,0 +1,23 @@
+"""Multi-device execution: meshes, sharded window batches, collective merges.
+
+This package replaces the reference's distribution mechanisms (SURVEY §2.5):
+Flink's keyBy hash shuffle becomes an 8-way (or pod-scale) device mesh with
+window batches sharded across devices; the parallelism-1 ``windowAll`` global
+merges become all-gather + re-top-k tree merges on ICI; query objects are
+broadcast (replicated sharding) instead of flatMap-replicated per cell.
+"""
+
+from spatialflink_tpu.parallel.mesh import make_mesh, shard_batch
+from spatialflink_tpu.parallel.ops import (
+    distributed_knn,
+    distributed_range_count,
+    distributed_join_counts,
+)
+
+__all__ = [
+    "make_mesh",
+    "shard_batch",
+    "distributed_knn",
+    "distributed_range_count",
+    "distributed_join_counts",
+]

@@ -1,0 +1,49 @@
+"""Device mesh construction and window-batch sharding.
+
+The canonical layout is a 1-D "cells" mesh axis: a window batch is sharded
+across devices on its point dimension. The host groups points so that whole
+grid cells land on one device (cell-hash bucketing), which is the moral
+equivalent of the reference's ``keyBy(gridID)`` partitioning — but any
+permutation is *correct* here, because kernels are cell-oblivious masked
+reductions; cell grouping only improves pruning locality, it is not a
+correctness requirement like in the reference's per-cell window operators.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+CELL_AXIS = "cells"
+
+
+def make_mesh(n_devices: Optional[int] = None, axis: str = CELL_AXIS) -> Mesh:
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    if n > len(devs):
+        raise ValueError(f"requested {n} devices, only {len(devs)} available")
+    return Mesh(np.array(devs[:n]), (axis,))
+
+
+def shard_batch(batch, mesh: Mesh, axis: str = CELL_AXIS):
+    """Place a window batch with its leading (point) dim sharded over the mesh.
+
+    Capacity must divide the mesh size — guaranteed when bucket sizes are
+    powers of two >= the device count.
+    """
+    sharding = NamedSharding(mesh, P(axis))
+    return jax.device_put(batch, sharding)
+
+
+def cell_hash_order(cell: np.ndarray, n_shards: int) -> np.ndarray:
+    """Host-side permutation placing whole cells on the same shard (stable
+    within a cell). Returns indices; apply with ``tree.map(lambda a: a[idx])``.
+
+    This mirrors keyBy(gridID)'s co-location property for operators that
+    want per-shard cell locality (e.g. future per-cell aggregations).
+    """
+    shard = np.where(cell >= 0, cell % n_shards, n_shards - 1)
+    return np.argsort(shard, kind="stable")

@@ -1,0 +1,29 @@
+"""Host-side stream I/O (reference: GeoFlink/spatialStreams/).
+
+Parsing/serialization of spatial wire formats (GeoJSON / WKT / CSV / TSV),
+stream sources (synthetic, file replay, in-memory, Kafka when available) and
+sinks. Everything here is plain Python on the host — device work starts at
+the window batch (spatialflink_tpu.runtime.windows).
+"""
+
+from spatialflink_tpu.streams.formats import parse_spatial, serialize_spatial
+from spatialflink_tpu.streams.sources import (
+    FileReplaySource,
+    ListSource,
+    SyntheticPointSource,
+    kafka_source,
+)
+from spatialflink_tpu.streams.sinks import CollectSink, FileSink, LatencySink, StdoutSink
+
+__all__ = [
+    "parse_spatial",
+    "serialize_spatial",
+    "FileReplaySource",
+    "ListSource",
+    "SyntheticPointSource",
+    "kafka_source",
+    "CollectSink",
+    "FileSink",
+    "LatencySink",
+    "StdoutSink",
+]

@@ -1,0 +1,73 @@
+"""Stream sinks (reference: Kafka producers in ``Serialization.java`` and the
+latency sinks in ``utils/HelperClass.java:455-529``)."""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import List, Optional
+
+from spatialflink_tpu.streams.formats import serialize_spatial
+
+
+class CollectSink:
+    """Accumulates records in memory (test/driver path)."""
+
+    def __init__(self):
+        self.records: List = []
+
+    def emit(self, record):
+        self.records.append(record)
+
+    def close(self):
+        pass
+
+
+class StdoutSink:
+    def __init__(self, fmt: Optional[str] = None):
+        self.fmt = fmt
+
+    def emit(self, record):
+        if self.fmt and hasattr(record, "obj_id"):
+            record = serialize_spatial(record, self.fmt)
+        print(record, file=sys.stdout)
+
+    def close(self):
+        sys.stdout.flush()
+
+
+class FileSink:
+    def __init__(self, path: str, fmt: Optional[str] = None):
+        self.fmt = fmt
+        self._f = open(path, "w")
+
+    def emit(self, record):
+        if self.fmt and hasattr(record, "obj_id"):
+            record = serialize_spatial(record, self.fmt)
+        self._f.write(str(record) + "\n")
+
+    def close(self):
+        self._f.close()
+
+
+class LatencySink:
+    """Per-record latency in millis: now - ingestion_time (or event ts),
+    mirroring ``HelperClass.LatencySinkPoint`` et al. Collects values and
+    exposes percentiles for the bench harness."""
+
+    def __init__(self, use_event_time: bool = False):
+        self.use_event_time = use_event_time
+        self.latencies_ms: List[float] = []
+
+    def emit(self, record):
+        now = time.time() * 1000
+        base = record.timestamp if self.use_event_time else record.ingestion_time
+        self.latencies_ms.append(now - base)
+
+    def percentile(self, p: float) -> float:
+        import numpy as np
+
+        return float(np.percentile(self.latencies_ms, p)) if self.latencies_ms else 0.0
+
+    def close(self):
+        pass

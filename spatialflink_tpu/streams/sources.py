@@ -1,0 +1,123 @@
+"""Stream sources.
+
+The reference consumes Kafka topics (``StreamingJob.java:473``); this module
+provides the same role with host-side iterators:
+
+- :class:`ListSource` — in-memory records (the test/bench path; analogue of
+  ``env.fromCollection`` in the reference's queryOption 99 harness,
+  ``StreamingJob.java:1571-1618``).
+- :class:`SyntheticPointSource` — deterministic random-walk trajectories,
+  the rebuild of the queryOption-99 dummy-data generator.
+- :class:`FileReplaySource` — newline-delimited records from disk.
+- :func:`kafka_source` — real Kafka consumer when a client library exists;
+  raises a clear error otherwise (this image ships none).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Iterable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from spatialflink_tpu.index import UniformGrid
+from spatialflink_tpu.models import Point
+
+
+class ListSource:
+    def __init__(self, records: Sequence):
+        self._records = list(records)
+
+    def __iter__(self):
+        return iter(self._records)
+
+
+class FileReplaySource:
+    """Replays a file of newline-delimited records (GeoJSON lines, CSV, ...)."""
+
+    def __init__(self, path: str, limit: Optional[int] = None, cycle: bool = False):
+        self.path = path
+        self.limit = limit
+        self.cycle = cycle
+
+    def __iter__(self) -> Iterator[str]:
+        def lines():
+            while True:
+                with open(self.path) as f:
+                    for line in f:
+                        line = line.strip()
+                        if line:
+                            yield line
+                if not self.cycle:
+                    return
+
+        it = lines()
+        return itertools.islice(it, self.limit) if self.limit else it
+
+
+class SyntheticPointSource:
+    """Deterministic random-walk trajectory generator over a grid bbox.
+
+    Emits :class:`Point` objects with object ids ``traj-<i>`` and timestamps
+    advancing ``dt_ms`` per step, in arrival order interleaved across
+    trajectories — a faithful stand-in for a Kafka taxi-trace topic.
+    """
+
+    def __init__(
+        self,
+        grid: UniformGrid,
+        num_trajectories: int = 100,
+        steps: int = 100,
+        dt_ms: int = 1000,
+        step_std: float = 0.002,
+        start_ts: int = 1_700_000_000_000,
+        seed: int = 0,
+        out_of_order_fraction: float = 0.0,
+        out_of_order_max_ms: int = 0,
+    ):
+        self.grid = grid
+        self.num_trajectories = num_trajectories
+        self.steps = steps
+        self.dt_ms = dt_ms
+        self.step_std = step_std
+        self.start_ts = start_ts
+        self.seed = seed
+        self.out_of_order_fraction = out_of_order_fraction
+        self.out_of_order_max_ms = out_of_order_max_ms
+
+    def __iter__(self) -> Iterator[Point]:
+        rng = np.random.default_rng(self.seed)
+        g = self.grid
+        xs = rng.uniform(g.min_x, g.min_x + g.cell_length * g.n, self.num_trajectories)
+        ys = rng.uniform(g.min_y, g.min_y + g.cell_length * g.n, self.num_trajectories)
+        for step in range(self.steps):
+            ts = self.start_ts + step * self.dt_ms
+            xs = xs + rng.normal(0, self.step_std, self.num_trajectories)
+            ys = ys + rng.normal(0, self.step_std, self.num_trajectories)
+            for i in range(self.num_trajectories):
+                t = ts
+                if self.out_of_order_fraction and rng.random() < self.out_of_order_fraction:
+                    t -= int(rng.integers(0, self.out_of_order_max_ms + 1))
+                yield Point.create(
+                    float(xs[i]), float(ys[i]), self.grid,
+                    obj_id=f"traj-{i}", timestamp=t,
+                )
+
+
+def kafka_source(topic: str, bootstrap_servers: str, **consumer_kwargs) -> Iterable[str]:
+    """Kafka consumer yielding record values as strings.
+
+    Gated on an available client library; the bare image has none, so this
+    raises with instructions rather than failing deep in a pipeline.
+    """
+    try:
+        from kafka import KafkaConsumer  # type: ignore
+    except ImportError as e:
+        raise RuntimeError(
+            "kafka_source requires the kafka-python package, which is not "
+            "installed in this environment. Use FileReplaySource/ListSource, "
+            "or install kafka-python where networking is available."
+        ) from e
+    consumer = KafkaConsumer(topic, bootstrap_servers=bootstrap_servers, **consumer_kwargs)
+    for msg in consumer:
+        yield msg.value.decode() if isinstance(msg.value, bytes) else msg.value

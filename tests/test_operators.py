@@ -1,0 +1,166 @@
+"""End-to-end operator pipelines on synthetic streams (the minimum slice)."""
+
+import numpy as np
+import pytest
+
+from spatialflink_tpu.index import UniformGrid
+from spatialflink_tpu.models import Point
+from spatialflink_tpu.operators import (
+    PointPointJoinQuery,
+    PointPointKNNQuery,
+    PointPointRangeQuery,
+    QueryConfiguration,
+    QueryType,
+)
+from spatialflink_tpu.streams import SyntheticPointSource
+from tests import oracles as O
+
+GRID = UniformGrid(115.50, 117.60, 39.60, 41.10, num_grid_partitions=100)
+QUERY = Point.create(116.5, 40.5, GRID, obj_id="q")
+
+
+def window_conf(**kw):
+    return QueryConfiguration(
+        query_type=QueryType.WindowBased, window_size_ms=10_000, slide_ms=5_000, **kw
+    )
+
+
+def source(**kw):
+    defaults = dict(num_trajectories=50, steps=30, dt_ms=1000, seed=3)
+    defaults.update(kw)
+    return SyntheticPointSource(GRID, **defaults)
+
+
+class TestRangePipeline:
+    def test_window_results_match_oracle(self):
+        r = 0.3
+        op = PointPointRangeQuery(window_conf(), GRID)
+        results = list(op.run(source(), QUERY, r))
+        assert results, "no windows sealed"
+        # oracle per window: replay records through the same window assembler
+        from spatialflink_tpu.runtime import WindowAssembler, WindowSpec
+
+        wa = WindowAssembler(WindowSpec.sliding(10_000, 5_000))
+        windows = {}
+        for p in source():
+            for s, e, recs in wa.add(p.timestamp, p):
+                windows[s] = recs
+        for res in results:
+            if res.window_start not in windows:
+                continue
+            recs = windows[res.window_start]
+            want = set()
+            gn = GRID.guaranteed_cells_mask(r, QUERY.cell)
+            cn = GRID.candidate_cells_mask(r, QUERY.cell, gn)
+            for p in recs:
+                if p.cell >= 0 and (
+                    gn[p.cell]
+                    or (cn[p.cell] and O.pp_dist(p.x, p.y, QUERY.x, QUERY.y) <= r)
+                ):
+                    want.add((p.obj_id, p.timestamp))
+            got = {(p.obj_id, p.timestamp) for p in res.records}
+            boundary = {
+                t for t in got ^ want
+            }
+            for oid, ts in boundary:
+                p = next(p for p in recs if (p.obj_id, p.timestamp) == (oid, ts))
+                assert abs(O.pp_dist(p.x, p.y, QUERY.x, QUERY.y) - r) < 1e-3
+
+    def test_realtime_mode_emits(self):
+        op = PointPointRangeQuery(
+            QueryConfiguration(query_type=QueryType.RealTime, realtime_batch_size=128),
+            GRID,
+        )
+        results = list(op.run(source(), QUERY, 0.5))
+        assert results
+        assert all(len(r.records) > 0 for r in results)
+
+    def test_count_based_raises(self):
+        with pytest.raises(NotImplementedError):
+            PointPointRangeQuery(
+                QueryConfiguration(query_type=QueryType.CountBased), GRID
+            )
+
+    def test_incremental_matches_full(self):
+        r = 0.3
+        op_full = PointPointRangeQuery(window_conf(), GRID)
+        op_inc = PointPointRangeQuery(window_conf(), GRID)
+        full = {
+            res.window_start: {(p.obj_id, p.timestamp) for p in res.records}
+            for res in op_full.run(source(), QUERY, r)
+        }
+        inc = {
+            res.window_start: {(p.obj_id, p.timestamp) for p in res.records}
+            for res in op_inc.run_incremental(source(), QUERY, r)
+        }
+        shared = set(full) & set(inc)
+        assert shared
+        for s in shared:
+            assert full[s] == inc[s], f"window {s} differs"
+
+
+class TestKnnPipeline:
+    def test_window_knn_matches_oracle(self):
+        k, r = 10, 0.0  # r=0: no pruning
+        op = PointPointKNNQuery(window_conf(k=k), GRID)
+        results = list(op.run(source(), QUERY, r))
+        assert results
+        from spatialflink_tpu.runtime import WindowAssembler, WindowSpec
+
+        wa = WindowAssembler(WindowSpec.sliding(10_000, 5_000))
+        windows = {}
+        for p in source():
+            for s, e, recs in wa.add(p.timestamp, p):
+                windows[s] = recs
+        checked = 0
+        for res in results:
+            recs = windows.get(res.window_start)
+            if not recs:
+                continue
+            want_ids, want_d = O.knn(
+                QUERY.x, QUERY.y,
+                [p.x for p in recs], [p.y for p in recs],
+                [p.obj_id for p in recs], k,
+            )
+            got_d = [d for _, d in res.records]
+            np.testing.assert_allclose(got_d, want_d, atol=1e-4)
+            checked += 1
+        assert checked
+
+
+class TestJoinPipeline:
+    def test_join_pairs_match_oracle(self):
+        r = 0.05
+        conf = window_conf()
+        op = PointPointJoinQuery(conf, GRID)
+        ordinary = list(source(seed=10, num_trajectories=40, steps=20))
+        queries = list(source(seed=11, num_trajectories=10, steps=20))
+        results = list(op.run(iter(ordinary), iter(queries), r))
+        assert results
+        total_pairs = sum(len(res.records) for res in results)
+        assert total_pairs > 0
+        for res in results[:3]:
+            for pa, pb in res.records:
+                assert O.pp_dist(pa.x, pa.y, pb.x, pb.y) <= r + 1e-3
+
+
+class TestJoinRegressions:
+    def test_realtime_join_emits_microbatches(self):
+        conf = QueryConfiguration(query_type=QueryType.RealTime, realtime_batch_size=64)
+        op = PointPointJoinQuery(conf, GRID)
+        ordinary = list(source(seed=20, num_trajectories=20, steps=10))
+        queries = list(source(seed=21, num_trajectories=5, steps=10))
+        results = list(op.run(iter(ordinary), iter(queries), 0.5))
+        assert results, "realtime join must emit per micro-batch"
+
+    def test_one_sided_windows_are_emitted_and_freed(self):
+        conf = window_conf()
+        op = PointPointJoinQuery(conf, GRID)
+        # query side goes quiet after the first 10 seconds
+        ordinary = list(source(seed=22, num_trajectories=10, steps=40))
+        queries = [p for p in source(seed=23, num_trajectories=5, steps=40)
+                   if p.timestamp < ordinary[0].timestamp + 10_000]
+        results = list(op.run(iter(ordinary), iter(queries), 0.5))
+        starts = [r.window_start for r in results]
+        # windows long after the query side stopped must still be emitted
+        assert max(starts) > min(starts) + 20_000

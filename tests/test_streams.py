@@ -1,0 +1,220 @@
+"""Ser/de round-trips per (format x type), sources, watermarks, windows."""
+
+import numpy as np
+import pytest
+
+from spatialflink_tpu.index import UniformGrid
+from spatialflink_tpu.models import (
+    GeometryCollection,
+    LineString,
+    MultiLineString,
+    MultiPoint,
+    MultiPolygon,
+    Point,
+    Polygon,
+)
+from spatialflink_tpu.runtime import BoundedOutOfOrderness, WindowAssembler, WindowSpec
+from spatialflink_tpu.streams import (
+    SyntheticPointSource,
+    kafka_source,
+    parse_spatial,
+    serialize_spatial,
+)
+from spatialflink_tpu.streams.formats import parse_timestamp
+
+GRID = UniformGrid(115.50, 117.60, 39.60, 41.10, num_grid_partitions=100)
+
+
+class TestGeoJSON:
+    KAFKA_RECORD = (
+        '{"key":136138,"value":{"geometry":{"coordinates":[116.44412,39.93984],'
+        '"type":"Point"},"properties":{"oID":"2560","timestamp":"2008-02-02 20:12:32"},'
+        '"type":"Feature"}}'
+    )
+
+    def test_kafka_envelope_trajectory_point(self):
+        # the exact record format documented at Deserialization.java:119
+        p = parse_spatial(self.KAFKA_RECORD, "GeoJSON", GRID)
+        assert isinstance(p, Point)
+        assert p.obj_id == "2560"
+        assert p.x == pytest.approx(116.44412)
+        assert p.timestamp == parse_timestamp("2008-02-02 20:12:32")
+        assert p.cell >= 0
+
+    def test_bare_geometry(self):
+        p = parse_spatial('{"coordinates":[116.5,40.5],"type":"Point"}', "GeoJSON", GRID)
+        assert isinstance(p, Point) and p.obj_id == ""
+
+    @pytest.mark.parametrize("obj", [
+        Point.create(116.5, 40.5, GRID, "p1", 5000),
+        Polygon.create([[(116.0, 40.0), (116.1, 40.0), (116.1, 40.1)]], GRID, "poly", 5000),
+        Polygon.create([[(116.0, 40.0), (116.4, 40.0), (116.4, 40.4), (116.0, 40.4)],
+                        [(116.1, 40.1), (116.3, 40.1), (116.3, 40.3), (116.1, 40.3)]],
+                       GRID, "donut", 5000),
+        LineString.create([(116.0, 40.0), (116.2, 40.2), (116.4, 40.1)], GRID, "ls", 5000),
+        MultiPoint.create([(116.0, 40.0), (116.2, 40.2)], GRID, "mpt", 5000),
+        MultiPolygon.create([[[(116.0, 40.0), (116.1, 40.0), (116.1, 40.1)]],
+                             [[(117.0, 41.0), (117.1, 41.0), (117.1, 41.05)]]],
+                            GRID, "mp", 5000),
+        MultiLineString.create([[(116.0, 40.0), (116.1, 40.1)],
+                                [(116.5, 40.5), (116.6, 40.6)]], GRID, "ml", 5000),
+    ])
+    def test_roundtrip_all_types(self, obj):
+        s = serialize_spatial(obj, "GeoJSON")
+        back = parse_spatial(s, "GeoJSON", GRID, date_format=None)
+        assert type(back) is type(obj)
+        assert back.obj_id == obj.obj_id
+        assert back.timestamp == obj.timestamp
+
+    def test_geometrycollection_roundtrip(self):
+        gc = GeometryCollection.create(
+            [Point.create(116.5, 40.5), LineString.create([(116.0, 40.0), (116.1, 40.1)])],
+            obj_id="gc", timestamp=99,
+        )
+        s = serialize_spatial(gc, "GeoJSON")
+        back = parse_spatial(s, "GeoJSON", GRID, date_format=None)
+        assert isinstance(back, GeometryCollection)
+        assert len(back.geometries) == 2
+        assert isinstance(back.geometries[0], Point)
+
+
+class TestWKT:
+    @pytest.mark.parametrize("obj", [
+        Point.create(116.5, 40.5, GRID, "p1"),
+        Polygon.create([[(116.0, 40.0), (116.1, 40.0), (116.1, 40.1)]], GRID, "poly"),
+        LineString.create([(116.0, 40.0), (116.2, 40.2)], GRID, "ls"),
+        MultiPoint.create([(116.0, 40.0), (116.2, 40.2)], GRID, "mpt"),
+        MultiPolygon.create([[[(116.0, 40.0), (116.1, 40.0), (116.1, 40.1)]],
+                             [[(117.0, 41.0), (117.1, 41.0), (117.1, 41.05)]]], GRID, "mp"),
+        MultiLineString.create([[(116.0, 40.0), (116.1, 40.1)],
+                                [(116.5, 40.5), (116.6, 40.6)]], GRID, "ml"),
+    ])
+    def test_roundtrip(self, obj):
+        s = serialize_spatial(obj, "WKT")
+        back = parse_spatial(s, "WKT", GRID)
+        assert type(back) is type(obj)
+
+    def test_trajectory_fields_before_geometry(self):
+        p = parse_spatial("42, 1700000000123, POINT (116.5 40.5)", "WKT", GRID)
+        assert p.obj_id == "42"
+        assert p.timestamp == 1700000000123
+        assert p.x == pytest.approx(116.5)
+
+    def test_polygon_with_hole(self):
+        wkt = "POLYGON ((0 0, 4 0, 4 4, 0 4, 0 0), (1 1, 2 1, 2 2, 1 2, 1 1))"
+        poly = parse_spatial(wkt, "WKT")
+        assert isinstance(poly, Polygon)
+        assert len(poly.rings) == 2
+
+
+class TestCSV:
+    def test_schema_indices(self):
+        # schema [oID, time, x, y] at positions 0..3 (Deserialization.java:313-317)
+        p = parse_spatial("2560, 1202933552000, 116.44412, 39.93984", "CSV", GRID)
+        assert p.obj_id == "2560" and p.timestamp == 1202933552000
+        p2 = parse_spatial("116.5\t40.5\tfoo\t7", "TSV", GRID, schema=(2, None, 0, 1))
+        assert p2.x == pytest.approx(116.5) and p2.obj_id == "foo"
+
+    def test_roundtrip(self):
+        p = Point.create(116.5, 40.5, GRID, "p9", 777)
+        s = serialize_spatial(p, "CSV")
+        back = parse_spatial(s, "CSV", GRID)
+        assert back.obj_id == "p9" and back.timestamp == 777
+
+    def test_date_format_timestamps(self):
+        p = parse_spatial("a, 2008-02-02 20:12:32, 116.5, 40.5", "CSV", GRID)
+        assert p.timestamp == parse_timestamp("2008-02-02 20:12:32")
+
+
+class TestSources:
+    def test_synthetic_deterministic(self):
+        src = SyntheticPointSource(GRID, num_trajectories=5, steps=3, seed=42)
+        a = [(p.obj_id, p.x, p.timestamp) for p in src]
+        b = [(p.obj_id, p.x, p.timestamp) for p in src]
+        assert a == b
+        assert len(a) == 15
+        assert a[0][0] == "traj-0"
+
+    def test_synthetic_timestamps_advance(self):
+        src = SyntheticPointSource(GRID, num_trajectories=2, steps=3, dt_ms=500)
+        ts = [p.timestamp for p in src]
+        assert ts[0] + 500 == ts[2] and ts[2] + 500 == ts[4]
+
+    def test_kafka_source_clear_error(self):
+        with pytest.raises(RuntimeError, match="kafka"):
+            next(iter(kafka_source("topic", "localhost:9092")))
+
+
+class TestWatermarks:
+    def test_monotonic_and_lateness(self):
+        wm = BoundedOutOfOrderness(allowed_lateness_ms=100)
+        wm.on_event(1000)
+        assert wm.watermark == 900
+        wm.on_event(500)  # out-of-order does not regress the watermark
+        assert wm.watermark == 900
+        assert wm.is_late(800)
+        assert not wm.is_late(950)
+
+
+class TestWindows:
+    def test_sliding_assignment(self):
+        spec = WindowSpec.sliding(10_000, 5_000)
+        assert spec.assign(12_000) == [10_000, 5_000]
+        assert spec.assign(4_999) == [0, -5_000]
+
+    def test_tumbling_assignment(self):
+        spec = WindowSpec.tumbling(5_000)
+        assert spec.assign(12_000) == [10_000]
+
+    def test_seal_on_watermark(self):
+        wa = WindowAssembler(WindowSpec.tumbling(1_000))
+        sealed = list(wa.add(100, "a"))
+        assert sealed == []
+        sealed = list(wa.add(1_500, "b"))  # watermark 1500 seals [0,1000)
+        assert len(sealed) == 1
+        start, end, records = sealed[0]
+        assert (start, end, records) == (0, 1_000, ["a"])
+
+    def test_lateness_delays_sealing_and_drops(self):
+        wa = WindowAssembler(WindowSpec.tumbling(1_000), allowed_lateness_ms=500)
+        assert list(wa.add(100, "a")) == []
+        assert list(wa.add(1_200, "b")) == []  # wm=700 < 1000: not sealed yet
+        sealed = list(wa.add(1_600, "c"))      # wm=1100 seals [0,1000)
+        assert len(sealed) == 1 and sealed[0][2] == ["a"]
+        # a record at ts=900 is now late (wm=1100) and must be dropped
+        assert list(wa.add(900, "late")) == []
+        assert wa.late_dropped == 1
+
+    def test_sliding_windows_share_records(self):
+        wa = WindowAssembler(WindowSpec.sliding(10_000, 5_000))
+        list(wa.add(7_000, "x"))
+        out = {s: recs for s, e, recs in wa.flush()}
+        assert out == {0: ["x"], 5_000: ["x"]}
+
+    def test_end_to_end_synthetic_window_counts(self):
+        src = SyntheticPointSource(GRID, num_trajectories=10, steps=20, dt_ms=1000,
+                                  start_ts=1_700_000_000_000)
+        wa = WindowAssembler(WindowSpec.sliding(10_000, 5_000))
+        sealed = []
+        for p in src:
+            sealed.extend(wa.add(p.timestamp, p))
+        sealed.extend(wa.flush())
+        # each full window holds 10 trajectories x 10 steps
+        full = [r for s, e, r in sealed if len(r) == 100]
+        assert full, "expected at least one full 10s window"
+
+
+class TestFormatRegressions:
+    """Regressions for code-review findings on the streams layer."""
+
+    def test_bare_multicoord_wkt_no_garbage_oid(self):
+        ls = parse_spatial("LINESTRING (1 2, 3 4)", "WKT", GRID)
+        assert isinstance(ls, LineString)
+        assert ls.obj_id == ""
+        poly = parse_spatial("POLYGON ((0 0, 1 0, 1 1, 0 0))", "WKT", GRID)
+        assert poly.obj_id == ""
+
+    def test_null_geometry_falls_back(self):
+        with pytest.raises(ValueError):
+            parse_spatial('{"type":"Feature","geometry":null,"properties":{"oID":"a"}}',
+                          "GeoJSON", GRID)

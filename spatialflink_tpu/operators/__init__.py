@@ -9,6 +9,11 @@ The execution model differs deliberately (SURVEY §7): instead of Flink's
 per-cell keyed window operators + shuffles, each window is one padded device
 batch evaluated by a masked kernel (spatialflink_tpu.ops), optionally
 sharded over a device mesh (spatialflink_tpu.parallel).
+
+All 9 stream-type x query-type pairs of SURVEY §2.2 are exported under their
+reference names for each of range/kNN/join; pairs sharing a device
+representation share an implementation (polygon and linestring streams are
+both padded edge-array batches).
 """
 
 from spatialflink_tpu.operators.base import (
@@ -16,15 +21,50 @@ from spatialflink_tpu.operators.base import (
     QueryType,
     WindowResult,
 )
-from spatialflink_tpu.operators.range_query import PointPointRangeQuery
-from spatialflink_tpu.operators.knn_query import PointPointKNNQuery
-from spatialflink_tpu.operators.join_query import PointPointJoinQuery
+from spatialflink_tpu.operators.range_query import (
+    PointPointRangeQuery,
+    PointPolygonRangeQuery,
+    PointLineStringRangeQuery,
+    PolygonPointRangeQuery,
+    PolygonPolygonRangeQuery,
+    PolygonLineStringRangeQuery,
+    LineStringPointRangeQuery,
+    LineStringPolygonRangeQuery,
+    LineStringLineStringRangeQuery,
+)
+from spatialflink_tpu.operators.knn_query import (
+    PointPointKNNQuery,
+    PointPolygonKNNQuery,
+    PointLineStringKNNQuery,
+    PolygonPointKNNQuery,
+    PolygonPolygonKNNQuery,
+    PolygonLineStringKNNQuery,
+    LineStringPointKNNQuery,
+    LineStringPolygonKNNQuery,
+    LineStringLineStringKNNQuery,
+)
+from spatialflink_tpu.operators.join_query import (
+    PointPointJoinQuery,
+    PointPolygonJoinQuery,
+    PointLineStringJoinQuery,
+    PolygonPointJoinQuery,
+    PolygonPolygonJoinQuery,
+    PolygonLineStringJoinQuery,
+    LineStringPointJoinQuery,
+    LineStringPolygonJoinQuery,
+    LineStringLineStringJoinQuery,
+)
 
 __all__ = [
     "QueryConfiguration",
     "QueryType",
     "WindowResult",
-    "PointPointRangeQuery",
-    "PointPointKNNQuery",
-    "PointPointJoinQuery",
+] + [
+    f"{pair}{kind}Query"
+    for pair in (
+        "PointPoint", "PointPolygon", "PointLineString",
+        "PolygonPoint", "PolygonPolygon", "PolygonLineString",
+        "LineStringPoint", "LineStringPolygon", "LineStringLineString",
+    )
+    for kind in ("Range", "KNN", "Join")
 ]

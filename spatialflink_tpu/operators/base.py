@@ -89,3 +89,60 @@ class SpatialOperator:
                 buf = []
         if buf:
             yield buf
+
+    def _geom_batch(self, records: List, ts_base: int):
+        from spatialflink_tpu.models.batches import EdgeGeomBatch
+
+        return EdgeGeomBatch.from_objects(records, self.grid, self.interner,
+                                          ts_base=ts_base)
+
+    def _drive(self, stream: Iterable, eval_batch) -> Iterator["WindowResult"]:
+        """Shared window/realtime driver: eval_batch(records, ts_base) -> list."""
+        if self.conf.query_type is QueryType.RealTime:
+            for records in self._micro_batches(stream):
+                sel = eval_batch(records, records[0].timestamp if records else 0)
+                if sel:
+                    yield WindowResult(sel[0].timestamp if hasattr(sel[0], "timestamp")
+                                       else records[0].timestamp,
+                                       records[-1].timestamp, sel)
+        else:
+            for start, end, records in self._windows(stream):
+                yield WindowResult(start, end, eval_batch(records, start))
+
+
+class GeomQueryMixin:
+    """Query-side precomputation shared by all operators: dense GN/CN/NB cell
+    masks (union over the query geometry's cells — ``UniformGrid.java:193-222``)
+    and padded query edge arrays."""
+
+    def _query_cells(self, query) -> list:
+        if isinstance(query, Point):
+            return [query.cell] if query.cell >= 0 else []
+        return sorted(query.cells)
+
+    def _query_masks(self, query, radius: float):
+        import jax.numpy as jnp
+
+        cells = self._query_cells(query)
+        gn = self.grid.guaranteed_cells_mask(radius, cells)
+        cn = self.grid.candidate_cells_mask(radius, cells, gn)
+        nb = self.grid.neighboring_cells_mask(radius, cells)
+        return jnp.asarray(gn), jnp.asarray(cn), jnp.asarray(nb)
+
+    def _query_edges(self, query):
+        from spatialflink_tpu.models.batches import single_query_edges
+        import jax.numpy as jnp
+
+        e, m = single_query_edges(query)
+        from spatialflink_tpu.models.objects import Polygon as _P, MultiPolygon as _MP
+
+        areal = isinstance(query, (_P, _MP))
+        return jnp.asarray(e), jnp.asarray(m), areal
+
+    def _query_bbox(self, query):
+        import jax.numpy as jnp
+        import numpy as np
+
+        return jnp.asarray(np.asarray(query.bbox, np.float32))
+
+

@@ -113,3 +113,88 @@ class PointPointJoinQuery(SpatialOperator):
                     if i < len(recs_a) and j < len(recs_b)
                 )
         return WindowResult(start, end, pairs)
+
+
+class _GenericStreamJoin(PointPointJoinQuery):
+    """Shared two-stream windowed/realtime join driver; subclasses override
+    batch construction and the pair-lattice kernel."""
+
+    def _join_window(self, start, end, recs_a, recs_b, radius) -> WindowResult:
+        import numpy as np
+
+        pairs = []
+        if recs_a and recs_b:
+            batch_a = self._batch_a(recs_a, start)
+            batch_b = self._batch_b(recs_b, start)
+            m = np.asarray(self._lattice(batch_a, batch_b, radius))
+            ai, bi = np.nonzero(m)
+            pairs = [
+                (recs_a[i], recs_b[j])
+                for i, j in zip(ai.tolist(), bi.tolist())
+                if i < len(recs_a) and j < len(recs_b)
+            ]
+        return WindowResult(start, end, pairs)
+
+    def _nb_layers(self, radius):
+        # radius 0 => all cells neighbors (UniformGrid.java:264-266)
+        return self.grid.n if radius == 0 else self.grid.candidate_layers(radius)
+
+
+class PointGeomJoinQuery(_GenericStreamJoin):
+    """Point stream x polygon/linestring query stream
+    (``join/PointPolygonJoinQuery.java``, ``PointLineStringJoinQuery``)."""
+
+    def _batch_a(self, recs, ts_base):
+        return self._point_batch(recs, ts_base)
+
+    def _batch_b(self, recs, ts_base):
+        return self._geom_batch(recs, ts_base)
+
+    def _lattice(self, a, b, radius):
+        from spatialflink_tpu.ops.join import join_point_geom_mask
+
+        return join_point_geom_mask(a, b, radius, self._nb_layers(radius), n=self.grid.n)
+
+
+class GeomPointJoinQuery(_GenericStreamJoin):
+    """Polygon/linestring stream x point query stream
+    (``join/PolygonPointJoinQuery.java``, ``LineStringPointJoinQuery``)."""
+
+    def _batch_a(self, recs, ts_base):
+        return self._geom_batch(recs, ts_base)
+
+    def _batch_b(self, recs, ts_base):
+        return self._point_batch(recs, ts_base)
+
+    def _lattice(self, a, b, radius):
+        from spatialflink_tpu.ops.join import join_point_geom_mask
+
+        # reuse the point x geom lattice with sides swapped
+        return join_point_geom_mask(b, a, radius, self._nb_layers(radius),
+                                    n=self.grid.n).T
+
+    
+class GeomGeomJoinQuery(_GenericStreamJoin):
+    """Polygon/linestring stream x polygon/linestring query stream
+    (``join/PolygonPolygonJoinQuery.java`` + 3 sibling pairs)."""
+
+    def _batch_a(self, recs, ts_base):
+        return self._geom_batch(recs, ts_base)
+
+    _batch_b = _batch_a
+
+    def _lattice(self, a, b, radius):
+        from spatialflink_tpu.ops.join import join_geom_geom_mask
+
+        return join_geom_geom_mask(a, b, radius, self._nb_layers(radius), n=self.grid.n)
+
+
+# Reference-named aliases
+PointPolygonJoinQuery = PointGeomJoinQuery
+PointLineStringJoinQuery = PointGeomJoinQuery
+PolygonPointJoinQuery = GeomPointJoinQuery
+LineStringPointJoinQuery = GeomPointJoinQuery
+PolygonPolygonJoinQuery = GeomGeomJoinQuery
+PolygonLineStringJoinQuery = GeomGeomJoinQuery
+LineStringPolygonJoinQuery = GeomGeomJoinQuery
+LineStringLineStringJoinQuery = GeomGeomJoinQuery

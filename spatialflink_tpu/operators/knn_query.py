@@ -20,6 +20,7 @@ import numpy as np
 
 from spatialflink_tpu.models import Point
 from spatialflink_tpu.operators.base import (
+    GeomQueryMixin,
     QueryConfiguration,
     QueryType,
     SpatialOperator,
@@ -32,9 +33,12 @@ class PointPointKNNQuery(SpatialOperator):
     def run(self, stream: Iterable[Point], query_point: Point, radius: float,
             k: Optional[int] = None) -> Iterator[WindowResult]:
         k = k or self.conf.k
-        if self.conf.query_type is QueryType.RealTime:
-            return self._run_realtime(stream, query_point, radius, k)
-        return self._run_window(stream, query_point, radius, k)
+        for result in self._drive(
+            stream, lambda records, ts_base: self._eval(records, query_point,
+                                                        radius, k, ts_base)
+        ):
+            result.extras["k"] = k
+            yield result
 
     def _eval(self, records: List[Point], query_point: Point, radius: float,
               k: int, ts_base: int) -> List[Tuple[str, float]]:
@@ -59,15 +63,125 @@ class PointPointKNNQuery(SpatialOperator):
         dists = np.asarray(res.dist)[valid]
         return [(self.interner.lookup(int(o)), float(d)) for o, d in zip(oids, dists)]
 
-    def _run_window(self, stream, query_point, radius, k) -> Iterator[WindowResult]:
-        for start, end, records in self._windows(stream):
-            ranked = self._eval(records, query_point, radius, k, start)
-            yield WindowResult(start, end, ranked, extras={"k": k})
 
-    def _run_realtime(self, stream, query_point, radius, k) -> Iterator[WindowResult]:
-        for records in self._micro_batches(stream):
-            ranked = self._eval(records, query_point, radius, k,
-                                records[0].timestamp if records else 0)
-            if ranked:
-                yield WindowResult(records[0].timestamp, records[-1].timestamp,
-                                   ranked, extras={"k": k})
+
+class _GenericKnn(SpatialOperator, GeomQueryMixin):
+    """Shared kNN driver: subclasses provide (eligible, dists) per batch.
+
+    Reference semantics for every pair (e.g.
+    ``knn/PointPolygonKNNQuery.java:100-183``): radius prunes cells only;
+    approximate mode substitutes bbox distance; global merge dedups objID
+    keeping min distance (here: one dedup+top-k kernel).
+    """
+
+    def run(self, stream, query, radius: float, k: Optional[int] = None
+            ) -> Iterator[WindowResult]:
+        k = k or self.conf.k
+        setup = self._setup(query, radius)
+
+        def eval_batch(records, ts_base):
+            if not records:
+                return []
+            from spatialflink_tpu.ops.knn import knn_eligible
+
+            batch, eligible, dists = self._eligibility(records, ts_base, setup)
+            res = knn_eligible(batch.obj_id, dists, eligible, k=k)
+            valid = np.asarray(res.valid)
+            oids = np.asarray(res.obj_id)[valid]
+            ds = np.asarray(res.dist)[valid]
+            return [(self.interner.lookup(int(o)), float(d)) for o, d in zip(oids, ds)]
+
+        for result in self._drive(stream, eval_batch):
+            result.extras["k"] = k
+            yield result
+
+
+class PointGeomKNNQuery(_GenericKnn):
+    """Point stream x polygon/linestring query (``PointPolygonKNNQuery``,
+    ``PointLineStringKNNQuery``)."""
+
+    def _setup(self, query, radius):
+        import jax.numpy as jnp
+
+        nb = jnp.asarray(self.grid.neighboring_cells_mask(radius, self._query_cells(query)))
+        return dict(nb=nb, edges=self._query_edges(query), bbox=self._query_bbox(query))
+
+    def _eligibility(self, records, ts_base, setup):
+        from spatialflink_tpu.ops.distances import point_bbox_dist
+        from spatialflink_tpu.ops.geom import points_to_single_geom_dist
+        from spatialflink_tpu.ops.knn import point_stream_eligibility
+
+        batch = self._point_batch(records, ts_base)
+        eligible = point_stream_eligibility(batch.cell, batch.valid, setup["nb"])
+        q_edges, q_mask, q_areal = setup["edges"]
+        if self.conf.approximate:
+            b = setup["bbox"]
+            dists = point_bbox_dist(batch.x, batch.y, b[0], b[1], b[2], b[3])
+        else:
+            dists = points_to_single_geom_dist(batch, q_edges, q_mask, q_areal)
+        return batch, eligible, dists
+
+
+class GeomPointKNNQuery(_GenericKnn):
+    """Polygon/linestring stream x point query (``PolygonPointKNNQuery``,
+    ``LineStringPointKNNQuery``)."""
+
+    def _setup(self, query, radius):
+        import jax.numpy as jnp
+
+        nb = jnp.asarray(self.grid.neighboring_cells_mask(radius, self._query_cells(query)))
+        return dict(nb=nb, query=query)
+
+    def _eligibility(self, records, ts_base, setup):
+        from spatialflink_tpu.ops.distances import point_bbox_dist
+        from spatialflink_tpu.ops.geom import geom_cells_any_within, point_to_geoms_dist
+
+        q = setup["query"]
+        geoms = self._geom_batch(records, ts_base)
+        eligible = geoms.valid & geom_cells_any_within(geoms.cells, geoms.cells_mask,
+                                                       setup["nb"])
+        if self.conf.approximate:
+            dists = point_bbox_dist(q.x, q.y, geoms.bbox[:, 0], geoms.bbox[:, 1],
+                                    geoms.bbox[:, 2], geoms.bbox[:, 3])
+        else:
+            dists = point_to_geoms_dist(q.x, q.y, geoms)
+        return geoms, eligible, dists
+
+
+class GeomGeomKNNQuery(_GenericKnn):
+    """Polygon/linestring stream x polygon/linestring query (the remaining
+    4 pairs of SURVEY §2.2)."""
+
+    def _setup(self, query, radius):
+        import jax.numpy as jnp
+
+        nb = jnp.asarray(self.grid.neighboring_cells_mask(radius, self._query_cells(query)))
+        return dict(nb=nb, edges=self._query_edges(query), bbox=self._query_bbox(query))
+
+    def _eligibility(self, records, ts_base, setup):
+        from spatialflink_tpu.ops.distances import bbox_bbox_dist
+        from spatialflink_tpu.ops.geom import (
+            geom_cells_any_within,
+            geoms_to_single_geom_dist,
+        )
+
+        geoms = self._geom_batch(records, ts_base)
+        eligible = geoms.valid & geom_cells_any_within(geoms.cells, geoms.cells_mask,
+                                                       setup["nb"])
+        q_edges, q_mask, q_areal = setup["edges"]
+        if self.conf.approximate:
+            dists = bbox_bbox_dist(geoms.bbox, setup["bbox"][None, :])
+        else:
+            dists = geoms_to_single_geom_dist(geoms, q_edges, q_mask, q_areal)
+        return geoms, eligible, dists
+
+
+# Reference-named aliases
+PointPolygonKNNQuery = PointGeomKNNQuery
+PointLineStringKNNQuery = PointGeomKNNQuery
+PolygonPointKNNQuery = GeomPointKNNQuery
+LineStringPointKNNQuery = GeomPointKNNQuery
+PolygonPolygonKNNQuery = GeomGeomKNNQuery
+PolygonLineStringKNNQuery = GeomGeomKNNQuery
+LineStringPolygonKNNQuery = GeomGeomKNNQuery
+LineStringLineStringKNNQuery = GeomGeomKNNQuery

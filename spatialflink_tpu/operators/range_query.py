@@ -15,6 +15,7 @@ import numpy as np
 
 from spatialflink_tpu.models import Point
 from spatialflink_tpu.operators.base import (
+    GeomQueryMixin,
     QueryConfiguration,
     QueryType,
     SpatialOperator,
@@ -26,9 +27,10 @@ from spatialflink_tpu.ops.range import range_filter_point
 class PointPointRangeQuery(SpatialOperator):
     def run(self, stream: Iterable[Point], query_point: Point, radius: float
             ) -> Iterator[WindowResult]:
-        if self.conf.query_type is QueryType.RealTime:
-            return self._run_realtime(stream, query_point, radius)
-        return self._run_window(stream, query_point, radius)
+        return self._drive(
+            stream, lambda records, ts_base: self._eval(records, query_point,
+                                                        radius, ts_base)
+        )
 
     # ---------------------------------------------------------------- #
 
@@ -50,18 +52,6 @@ class PointPointRangeQuery(SpatialOperator):
         )
         idx = np.nonzero(np.asarray(mask))[0]
         return [records[i] for i in idx if i < len(records)]
-
-    def _run_window(self, stream, query_point, radius) -> Iterator[WindowResult]:
-        for start, end, records in self._windows(stream):
-            selected = self._eval(records, query_point, radius, start)
-            yield WindowResult(start, end, selected)
-
-    def _run_realtime(self, stream, query_point, radius) -> Iterator[WindowResult]:
-        for records in self._micro_batches(stream):
-            selected = self._eval(records, query_point, radius,
-                                  records[0].timestamp if records else 0)
-            if selected:
-                yield WindowResult(selected[0].timestamp, selected[-1].timestamp, selected)
 
     # ---------------------------------------------------------------- #
 
@@ -88,3 +78,119 @@ class PointPointRangeQuery(SpatialOperator):
             prev = out
             prev_window_start = start
             yield WindowResult(start, end, list(out.values()))
+
+
+class PointGeomRangeQuery(SpatialOperator, GeomQueryMixin):
+    """Point stream x polygon/linestring query
+    (``range/PointPolygonRangeQuery.java``, ``PointLineStringRangeQuery``).
+
+    Approximate mode filters on the bbox distance instead of the exact
+    geometry distance (the reference's approximateQuery flag)."""
+
+    def run(self, stream: Iterable[Point], query_geom, radius: float
+            ) -> Iterator[WindowResult]:
+        gn, cn, _nb = self._query_masks(query_geom, radius)
+        q_edges, q_mask, q_areal = self._query_edges(query_geom)
+        q_bbox = self._query_bbox(query_geom)
+
+        def eval_batch(records, ts_base):
+            if not records:
+                return []
+            from spatialflink_tpu.ops.distances import point_bbox_dist
+            from spatialflink_tpu.ops.geom import points_to_single_geom_dist
+            from spatialflink_tpu.ops.range import range_filter_masks
+
+            batch = self._point_batch(records, ts_base)
+            if self.conf.approximate:
+                dists = point_bbox_dist(batch.x, batch.y,
+                                        q_bbox[0], q_bbox[1], q_bbox[2], q_bbox[3])
+            else:
+                dists = points_to_single_geom_dist(batch, q_edges, q_mask, q_areal)
+            mask = range_filter_masks(batch, gn, cn, dists, radius)
+            idx = np.nonzero(np.asarray(mask))[0]
+            return [records[i] for i in idx if i < len(records)]
+
+        return self._drive(stream, eval_batch)
+
+
+class GeomPointRangeQuery(SpatialOperator, GeomQueryMixin):
+    """Polygon/linestring stream x point query
+    (``range/PolygonPointRangeQuery.java``, ``LineStringPointRangeQuery``).
+    GN-subset rule: a geometry passes without distance math only if ALL its
+    cells are guaranteed neighbors (``:54-87``)."""
+
+    def run(self, stream: Iterable, query_point: Point, radius: float
+            ) -> Iterator[WindowResult]:
+        gn, _cn, nb = self._query_masks(query_point, radius)
+
+        def eval_batch(records, ts_base):
+            if not records:
+                return []
+            from spatialflink_tpu.ops.distances import point_bbox_dist
+            from spatialflink_tpu.ops.geom import (
+                geom_cells_all_within,
+                geom_cells_any_within,
+                point_to_geoms_dist,
+            )
+            from spatialflink_tpu.ops.range import range_filter_geom_stream
+
+            geoms = self._geom_batch(records, ts_base)
+            all_gn = geom_cells_all_within(geoms.cells, geoms.cells_mask, gn)
+            any_nb = geom_cells_any_within(geoms.cells, geoms.cells_mask, nb)
+            if self.conf.approximate:
+                dists = point_bbox_dist(query_point.x, query_point.y,
+                                        geoms.bbox[:, 0], geoms.bbox[:, 1],
+                                        geoms.bbox[:, 2], geoms.bbox[:, 3])
+            else:
+                dists = point_to_geoms_dist(query_point.x, query_point.y, geoms)
+            mask = range_filter_geom_stream(all_gn, any_nb, dists, radius, geoms.valid)
+            idx = np.nonzero(np.asarray(mask))[0]
+            return [records[i] for i in idx if i < len(records)]
+
+        return self._drive(stream, eval_batch)
+
+
+class GeomGeomRangeQuery(SpatialOperator, GeomQueryMixin):
+    """Polygon/linestring stream x polygon/linestring query
+    (``range/PolygonPolygonRangeQuery.java`` and the 3 sibling pairs)."""
+
+    def run(self, stream: Iterable, query_geom, radius: float
+            ) -> Iterator[WindowResult]:
+        gn, _cn, nb = self._query_masks(query_geom, radius)
+        q_edges, q_mask, q_areal = self._query_edges(query_geom)
+        q_bbox = self._query_bbox(query_geom)
+
+        def eval_batch(records, ts_base):
+            if not records:
+                return []
+            from spatialflink_tpu.ops.distances import bbox_bbox_dist
+            from spatialflink_tpu.ops.geom import (
+                geom_cells_all_within,
+                geom_cells_any_within,
+                geoms_to_single_geom_dist,
+            )
+            from spatialflink_tpu.ops.range import range_filter_geom_stream
+
+            geoms = self._geom_batch(records, ts_base)
+            all_gn = geom_cells_all_within(geoms.cells, geoms.cells_mask, gn)
+            any_nb = geom_cells_any_within(geoms.cells, geoms.cells_mask, nb)
+            if self.conf.approximate:
+                dists = bbox_bbox_dist(geoms.bbox, q_bbox[None, :])
+            else:
+                dists = geoms_to_single_geom_dist(geoms, q_edges, q_mask, q_areal)
+            mask = range_filter_geom_stream(all_gn, any_nb, dists, radius, geoms.valid)
+            idx = np.nonzero(np.asarray(mask))[0]
+            return [records[i] for i in idx if i < len(records)]
+
+        return self._drive(stream, eval_batch)
+
+
+# Reference-named aliases (stream type x query type), SURVEY §2.2
+PointPolygonRangeQuery = PointGeomRangeQuery
+PointLineStringRangeQuery = PointGeomRangeQuery
+PolygonPointRangeQuery = GeomPointRangeQuery
+LineStringPointRangeQuery = GeomPointRangeQuery
+PolygonPolygonRangeQuery = GeomGeomRangeQuery
+PolygonLineStringRangeQuery = GeomGeomRangeQuery
+LineStringPolygonRangeQuery = GeomGeomRangeQuery
+LineStringLineStringRangeQuery = GeomGeomRangeQuery

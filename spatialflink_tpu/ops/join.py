@@ -122,3 +122,54 @@ def join_pairs_host(a: PointBatch, b: PointBatch, radius, grid, tile: int = 4096
         ai, bi = np.nonzero(m)
         if ai.size:
             yield ai, bi + start
+
+
+def pair_min_cheb(cells_a, mask_a, cells_b, mask_b, n):
+    """(Ga, Gb) minimum Chebyshev layer distance between any valid cell pair
+    of two multi-cell geometry batches.
+
+    This is the arithmetic form of the reference's replication join for
+    polygons/linestrings: object a (replicated to its own cells,
+    ``HelperClass.java:299-376``) meets query b (replicated to the
+    GN∪CN of its cells, ``join/JoinQuery.java:93-141``) iff some cell of a
+    is within the candidate layers of some cell of b.
+    """
+    ch = cheb_layers(
+        cells_a[:, None, :, None], cells_b[None, :, None, :], n
+    )  # (Ga, Gb, Ca, Cb)
+    valid = mask_a[:, None, :, None] & mask_b[None, :, None, :]
+    return jnp.min(jnp.where(valid, ch, jnp.int32(2**30)), axis=(-2, -1))
+
+
+@partial(jax.jit, static_argnames=("n",))
+def join_point_geom_mask(points: PointBatch, geoms, radius, nb_layers, *, n: int):
+    """(N, G) join lattice: point stream x polygon/linestring query stream
+    (``join/PointPolygonJoinQuery.java``). Cell predicate: the point's cell
+    within nb_layers of ANY geometry cell; exact distance <= r."""
+    from spatialflink_tpu.ops.geom import points_to_geoms_dist
+
+    d = points_to_geoms_dist(points, geoms)
+    ch = cheb_layers(points.cell[:, None, None], geoms.cells[None], n)  # (N, G, C)
+    cell_ok = jnp.any(
+        (ch <= nb_layers) & geoms.cells_mask[None], axis=-1
+    )
+    return (
+        cell_ok
+        & (d <= radius)
+        & points.valid[:, None]
+        & geoms.valid[None, :]
+    )
+
+
+@partial(jax.jit, static_argnames=("n",))
+def join_geom_geom_mask(a, b, radius, nb_layers, *, n: int):
+    """(Ga, Gb) join lattice: polygon/linestring stream x polygon/linestring
+    query stream (``join/PolygonPolygonJoinQuery.java`` etc.)."""
+    from spatialflink_tpu.ops.geom import geoms_to_single_geom_dist
+
+    d = jax.vmap(
+        lambda eb, mb, areal: geoms_to_single_geom_dist(a, eb, mb, areal),
+        out_axes=1,
+    )(b.edges, b.edge_mask, b.is_areal)  # (Ga, Gb)
+    cell_ok = pair_min_cheb(a.cells, a.cells_mask, b.cells, b.cells_mask, n) <= nb_layers
+    return cell_ok & (d <= radius) & a.valid[:, None] & b.valid[None, :]

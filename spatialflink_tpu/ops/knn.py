@@ -107,8 +107,7 @@ def knn_with_dists(
 ) -> KnnResult:
     """Generic kNN: caller supplies distances (e.g. point->polygon) and a
     dense neighboring-cells mask for the query geometry."""
-    cell_ok = cell >= 0
-    eligible = valid & cell_ok & nb_mask[jnp.maximum(cell, 0)]
+    eligible = point_stream_eligibility(cell, valid, nb_mask)
     if enforce_radius:
         eligible = eligible & (dists <= radius)
     return topk_by_distance(obj_id, dists, eligible, k)
@@ -122,3 +121,17 @@ def merge_knn(results, k: int) -> KnnResult:
     dist = jnp.concatenate([r.dist for r in results])
     valid = jnp.concatenate([r.valid for r in results])
     return topk_by_distance(obj_id, dist, valid, k)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def knn_eligible(obj_id, dists, eligible, *, k: int) -> KnnResult:
+    """Jitted dedup+top-k over caller-computed eligibility and distances —
+    the generic entry for polygon/linestring streams and geometry queries."""
+    return topk_by_distance(obj_id, dists, eligible, k)
+
+
+def point_stream_eligibility(cell, valid, nb_mask):
+    """Shared point-stream eligibility rule: valid, in-grid, and in a
+    neighboring cell of the query (dense mask form). Single source of truth
+    for knn_with_dists and the operator layer."""
+    return valid & (cell >= 0) & nb_mask[jnp.maximum(cell, 0)]

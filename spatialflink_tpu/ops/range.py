@@ -84,3 +84,19 @@ def range_filter_masks(
     if approximate:
         return points.valid & (in_gn | in_cn)
     return points.valid & (in_gn | (in_cn & (dists <= radius)))
+
+
+@jax.jit
+def range_filter_geom_stream(all_gn, any_nb, dists, radius, valid):
+    """Range filter for polygon/linestring STREAMS against any query.
+
+    Reference rule (``range/PolygonPointRangeQuery.java:54-87``): a geometry
+    whose grid cells are ALL guaranteed neighbors passes without distance
+    computation; otherwise it passes iff distance <= r. The caller supplies
+    ``dists`` as the exact geometry distance — or the bbox distance in
+    approximate mode, so only the needed kernel ever runs.
+
+    all_gn / any_nb: (G,) cell predicates (see ops.geom.geom_cells_all_within
+    / geom_cells_any_within).
+    """
+    return valid & (all_gn | (any_nb & ~all_gn & (dists <= radius)))

@@ -43,6 +43,15 @@ from spatialflink_tpu.operators.knn_query import (
     LineStringPolygonKNNQuery,
     LineStringLineStringKNNQuery,
 )
+from spatialflink_tpu.operators.trajectory import (
+    PointTFilterQuery,
+    PointPolygonTRangeQuery,
+    PointTStatsQuery,
+    PointTAggregateQuery,
+    PointPointTJoinQuery,
+    PointPointTKNNQuery,
+    assemble_subtrajectories,
+)
 from spatialflink_tpu.operators.join_query import (
     PointPointJoinQuery,
     PointPolygonJoinQuery,
@@ -67,4 +76,12 @@ __all__ = [
         "LineStringPoint", "LineStringPolygon", "LineStringLineString",
     )
     for kind in ("Range", "KNN", "Join")
+] + [
+    "PointTFilterQuery",
+    "PointPolygonTRangeQuery",
+    "PointTStatsQuery",
+    "PointTAggregateQuery",
+    "PointPointTJoinQuery",
+    "PointPointTKNNQuery",
+    "assemble_subtrajectories",
 ]

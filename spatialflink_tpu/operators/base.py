@@ -102,8 +102,9 @@ class SpatialOperator:
             for records in self._micro_batches(stream):
                 sel = eval_batch(records, records[0].timestamp if records else 0)
                 if sel:
-                    yield WindowResult(sel[0].timestamp if hasattr(sel[0], "timestamp")
-                                       else records[0].timestamp,
+                    # one convention for every operator: the result bounds are
+                    # the micro-batch's own event-time span
+                    yield WindowResult(records[0].timestamp,
                                        records[-1].timestamp, sel)
         else:
             for start, end, records in self._windows(stream):
@@ -128,6 +129,15 @@ class GeomQueryMixin:
         cn = self.grid.candidate_cells_mask(radius, cells, gn)
         nb = self.grid.neighboring_cells_mask(radius, cells)
         return jnp.asarray(gn), jnp.asarray(cn), jnp.asarray(nb)
+
+    def _query_nb(self, query, radius: float):
+        """Dense neighboring-cells (GN ∪ CN) mask for a query geometry —
+        radius 0 selects all cells (UniformGrid.java:264-266)."""
+        import jax.numpy as jnp
+
+        return jnp.asarray(
+            self.grid.neighboring_cells_mask(radius, self._query_cells(query))
+        )
 
     def _query_edges(self, query):
         from spatialflink_tpu.models.batches import single_query_edges

@@ -36,6 +36,8 @@ def _merge_by_time(a: Iterable[Point], b: Iterable[Point]) -> Iterator[Tuple[int
 
 
 class PointPointJoinQuery(SpatialOperator):
+    prune_cells = True  # naive twins disable grid pruning (exact filter only)
+
     def run(self, ordinary: Iterable[Point], query_stream: Iterable[Point],
             radius: float) -> Iterator[WindowResult]:
         if self.conf.query_type is QueryType.RealTime:
@@ -106,7 +108,9 @@ class PointPointJoinQuery(SpatialOperator):
         if recs_a and recs_b:
             batch_a = self._point_batch(recs_a, start)
             batch_b = self._point_batch(recs_b, start)
-            for ai, bi in join_pairs_host(batch_a, batch_b, radius, self.grid):
+            nb_layers = None if self.prune_cells else self.grid.n
+            for ai, bi in join_pairs_host(batch_a, batch_b, radius, self.grid,
+                                          nb_layers=nb_layers):
                 pairs.extend(
                     (recs_a[i], recs_b[j])
                     for i, j in zip(ai.tolist(), bi.tolist())

@@ -101,10 +101,8 @@ class PointGeomKNNQuery(_GenericKnn):
     ``PointLineStringKNNQuery``)."""
 
     def _setup(self, query, radius):
-        import jax.numpy as jnp
-
-        nb = jnp.asarray(self.grid.neighboring_cells_mask(radius, self._query_cells(query)))
-        return dict(nb=nb, edges=self._query_edges(query), bbox=self._query_bbox(query))
+        return dict(nb=self._query_nb(query, radius),
+                    edges=self._query_edges(query), bbox=self._query_bbox(query))
 
     def _eligibility(self, records, ts_base, setup):
         from spatialflink_tpu.ops.distances import point_bbox_dist
@@ -127,10 +125,7 @@ class GeomPointKNNQuery(_GenericKnn):
     ``LineStringPointKNNQuery``)."""
 
     def _setup(self, query, radius):
-        import jax.numpy as jnp
-
-        nb = jnp.asarray(self.grid.neighboring_cells_mask(radius, self._query_cells(query)))
-        return dict(nb=nb, query=query)
+        return dict(nb=self._query_nb(query, radius), query=query)
 
     def _eligibility(self, records, ts_base, setup):
         from spatialflink_tpu.ops.distances import point_bbox_dist
@@ -153,13 +148,11 @@ class GeomGeomKNNQuery(_GenericKnn):
     4 pairs of SURVEY §2.2)."""
 
     def _setup(self, query, radius):
-        import jax.numpy as jnp
-
-        nb = jnp.asarray(self.grid.neighboring_cells_mask(radius, self._query_cells(query)))
-        return dict(nb=nb, edges=self._query_edges(query), bbox=self._query_bbox(query))
+        return dict(nb=self._query_nb(query, radius),
+                    edges=self._query_edges(query), bbox=self._query_bbox(query))
 
     def _eligibility(self, records, ts_base, setup):
-        from spatialflink_tpu.ops.distances import bbox_bbox_dist
+        from spatialflink_tpu.ops.geom import geoms_bbox_dist
         from spatialflink_tpu.ops.geom import (
             geom_cells_any_within,
             geoms_to_single_geom_dist,
@@ -170,7 +163,7 @@ class GeomGeomKNNQuery(_GenericKnn):
                                                        setup["nb"])
         q_edges, q_mask, q_areal = setup["edges"]
         if self.conf.approximate:
-            dists = bbox_bbox_dist(geoms.bbox, setup["bbox"][None, :])
+            dists = geoms_bbox_dist(geoms, setup["bbox"])
         else:
             dists = geoms_to_single_geom_dist(geoms, q_edges, q_mask, q_areal)
         return geoms, eligible, dists

@@ -163,10 +163,10 @@ class GeomGeomRangeQuery(SpatialOperator, GeomQueryMixin):
         def eval_batch(records, ts_base):
             if not records:
                 return []
-            from spatialflink_tpu.ops.distances import bbox_bbox_dist
             from spatialflink_tpu.ops.geom import (
                 geom_cells_all_within,
                 geom_cells_any_within,
+                geoms_bbox_dist,
                 geoms_to_single_geom_dist,
             )
             from spatialflink_tpu.ops.range import range_filter_geom_stream
@@ -175,7 +175,7 @@ class GeomGeomRangeQuery(SpatialOperator, GeomQueryMixin):
             all_gn = geom_cells_all_within(geoms.cells, geoms.cells_mask, gn)
             any_nb = geom_cells_any_within(geoms.cells, geoms.cells_mask, nb)
             if self.conf.approximate:
-                dists = bbox_bbox_dist(geoms.bbox, q_bbox[None, :])
+                dists = geoms_bbox_dist(geoms, q_bbox)
             else:
                 dists = geoms_to_single_geom_dist(geoms, q_edges, q_mask, q_areal)
             mask = range_filter_geom_stream(all_gn, any_nb, dists, radius, geoms.valid)

@@ -1,0 +1,408 @@
+"""Trajectory operators (reference: ``spatialOperators/t*``).
+
+All six families of SURVEY §2.3, keyed by object id:
+
+- :class:`PointTFilterQuery`   — trajectory-id filter + windowed LineString
+  re-assembly (``tFilter/PointTFilterQuery.java``).
+- :class:`PointPolygonTRangeQuery` — trajectories intersecting a polygon set
+  (``tRange/PointPolygonTRangeQuery.java``), with the naive exhaustive twin
+  (``tRange/TRangeQuery.java:33-63``) as :meth:`run_naive`.
+- :class:`PointTStatsQuery`    — running spatial/temporal length + speed via
+  the sorted-segment device kernel (ops.trajectory.tstats_update).
+- :class:`PointTAggregateQuery`— per-cell heatmap of trajectory lengths with
+  SUM/AVG/MIN/MAX/COUNT/ALL and stale-trajectory eviction
+  (``tAggregate/TAggregateQuery.java``).
+- :class:`PointPointTJoinQuery`— trajectory-trajectory proximity join deduped
+  per (trajectory, partner) keeping the latest timestamp
+  (``tJoin/PointPointTJoinQuery.java:133-177``), self-join variant
+  :meth:`run_single`, naive all-pairs twin :meth:`run_naive`.
+- :class:`PointPointTKNNQuery` — k nearest *trajectories* within radius
+  (exact-radius filtered, ``tKnn/PointPointTKNNQuery.java:95-111``), naive
+  twin :meth:`run_naive`.
+
+Windowed modes re-assemble each selected trajectory's window points into
+time-sorted sub-trajectory LineStrings, as the reference does.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from spatialflink_tpu.models import LineString, Point, Polygon
+from spatialflink_tpu.operators.base import (
+    GeomQueryMixin,
+    QueryType,
+    SpatialOperator,
+    WindowResult,
+)
+
+
+def assemble_subtrajectories(records: List[Point]) -> Dict[str, object]:
+    """objID -> time-sorted LineString of its window points (a single point
+    stays a Point), mirroring the windowed re-assembly in
+    ``tFilter/PointTFilterQuery.java:79-123``."""
+    per_obj: Dict[str, List[Point]] = defaultdict(list)
+    for p in records:
+        per_obj[p.obj_id].append(p)
+    out: Dict[str, object] = {}
+    for oid, pts in per_obj.items():
+        pts.sort(key=lambda p: p.timestamp)
+        if len(pts) >= 2:
+            out[oid] = LineString.create(
+                [(p.x, p.y) for p in pts], None, oid, pts[-1].timestamp
+            )
+        else:
+            out[oid] = pts[0]
+    return out
+
+
+class PointTFilterQuery(SpatialOperator):
+    """Keep only trajectories whose objID is in ``traj_ids`` (empty => all)."""
+
+    def run(self, stream: Iterable[Point], traj_ids: Set[str]
+            ) -> Iterator[WindowResult]:
+        allowed = set(traj_ids)
+
+        def want(p: Point) -> bool:
+            return not allowed or p.obj_id in allowed
+
+        if self.conf.query_type is QueryType.RealTime:
+            for records in self._micro_batches(stream):
+                sel = [p for p in records if want(p)]
+                if sel:
+                    yield WindowResult(sel[0].timestamp, sel[-1].timestamp, sel)
+        else:
+            for start, end, records in self._windows(stream):
+                sel = [p for p in records if want(p)]
+                yield WindowResult(
+                    start, end, list(assemble_subtrajectories(sel).values())
+                )
+
+
+class PointPolygonTRangeQuery(SpatialOperator, GeomQueryMixin):
+    """Trajectories passing through any of a set of query polygons."""
+
+    def _prepare(self, polygons):
+        """Precompute the immutable query side once per run: the polygon
+        edge batch and the union cell prefilter mask."""
+        from spatialflink_tpu.models.batches import EdgeGeomBatch
+
+        gb = EdgeGeomBatch.from_objects(list(polygons), self.grid, self.interner)
+        cells = set()
+        for poly in polygons:
+            cells |= poly.cells
+        cell_mask = np.zeros(self.grid.num_cells, bool)
+        cell_mask[sorted(cells)] = True
+        return gb, cell_mask
+
+    def _match_mask(self, records: List[Point], gb, cell_mask, ts_base: int,
+                    prune_cells: bool) -> np.ndarray:
+        """Per-record bool: inside any query polygon."""
+        from spatialflink_tpu.ops.geom import points_in_geoms
+
+        batch = self._point_batch(records, ts_base)
+        inside = np.asarray(
+            points_in_geoms(batch.x, batch.y, gb.edges, gb.edge_mask)
+        ) & np.asarray(gb.valid)[None, :]
+        mask = inside.any(axis=1) & np.asarray(batch.valid)
+        if prune_cells:
+            # realtime cell prefilter (tRange/PointPolygonTRangeQuery.java:53-87):
+            # only points in cells overlapped by some query polygon can match
+            pc = np.asarray(batch.cell)
+            mask &= (pc >= 0) & cell_mask[np.maximum(pc, 0)]
+        return mask
+
+    def run(self, stream: Iterable[Point], polygons: Sequence[Polygon]
+            ) -> Iterator[WindowResult]:
+        gb, cell_mask = self._prepare(polygons)
+        if self.conf.query_type is QueryType.RealTime:
+            for records in self._micro_batches(stream):
+                m = self._match_mask(records, gb, cell_mask,
+                                     records[0].timestamp, prune_cells=True)
+                sel = [records[i] for i in np.nonzero(m)[0] if i < len(records)]
+                if sel:
+                    yield WindowResult(sel[0].timestamp, sel[-1].timestamp, sel)
+        else:
+            # windowed: find matched trajectory ids, then emit those
+            # trajectories' FULL window points as sub-trajectories
+            # (tRange/PointPolygonTRangeQuery.java:90-177)
+            for start, end, records in self._windows(stream):
+                m = self._match_mask(records, gb, cell_mask, start, prune_cells=True)
+                matched_ids = {records[i].obj_id
+                               for i in np.nonzero(m)[0] if i < len(records)}
+                sel = [p for p in records if p.obj_id in matched_ids]
+                yield WindowResult(
+                    start, end, list(assemble_subtrajectories(sel).values()),
+                    extras={"matched_ids": matched_ids},
+                )
+
+    def run_naive(self, stream: Iterable[Point], polygons: Sequence[Polygon]
+                  ) -> Iterator[WindowResult]:
+        """Exhaustive twin: every polygon tested per point, no cell pruning
+        (``tRange/TRangeQuery.java:33-63``)."""
+        gb, cell_mask = self._prepare(polygons)
+        for records in self._micro_batches(stream):
+            m = self._match_mask(records, gb, cell_mask, records[0].timestamp,
+                                 prune_cells=False)
+            sel = [records[i] for i in np.nonzero(m)[0] if i < len(records)]
+            if sel:
+                yield WindowResult(sel[0].timestamp, sel[-1].timestamp, sel)
+
+
+class PointTStatsQuery(SpatialOperator):
+    """Per-trajectory spatial length / temporal length / average speed.
+
+    Realtime mode carries device state across micro-batches (the reference's
+    per-objID ValueStates); windowed mode recomputes per window from fresh
+    state (``tStats/TStatsQuery.java:153-197``).
+    """
+
+    def run(self, stream: Iterable[Point], traj_ids: Optional[Set[str]] = None
+            ) -> Iterator[WindowResult]:
+        from spatialflink_tpu.runtime.state import TrajStateStore
+
+        allowed = set(traj_ids or ())
+
+        if self.conf.query_type is QueryType.RealTime:
+            store = TrajStateStore()
+            run_ts_base = None  # ONE base for the whole run: the carried
+            # state's last_ts offsets must stay comparable across batches
+            for records in self._micro_batches(stream):
+                if allowed:
+                    records = [p for p in records if p.obj_id in allowed]
+                if not records:
+                    continue
+                if run_ts_base is None:
+                    run_ts_base = records[0].timestamp
+                tuples = self._update(store, records, run_ts_base)
+                if tuples:
+                    yield WindowResult(records[0].timestamp,
+                                       records[-1].timestamp, tuples)
+        else:
+            for start, end, records in self._windows(stream):
+                if allowed:
+                    records = [p for p in records if p.obj_id in allowed]
+                store = TrajStateStore()  # fresh per window
+                tuples = self._update(store, records, start)
+                # windowed mode reports one tuple per trajectory (final stats)
+                final: Dict[str, Tuple] = {}
+                for t in tuples:
+                    final[t[0]] = t
+                yield WindowResult(start, end, list(final.values()))
+
+    def _update(self, store, records: List[Point], ts_base: int) -> List[Tuple]:
+        from spatialflink_tpu.ops.trajectory import tstats_update
+
+        batch = self._point_batch(records, ts_base)
+        store.ensure(len(self.interner))
+        store.state, out = tstats_update(store.state, batch)
+        emit = np.asarray(out.emit)
+        oids = np.asarray(out.obj_id)[emit]
+        sp = np.asarray(out.spatial)[emit]
+        tp = np.asarray(out.temporal)[emit]
+        speed = np.asarray(out.speed)[emit]
+        return [
+            (self.interner.lookup(int(o)), float(s), int(t), float(v))
+            for o, s, t, v in zip(oids, sp, tp, speed)
+        ]
+
+
+class PointTAggregateQuery(SpatialOperator):
+    """Per-cell heatmap of trajectory lengths.
+
+    ``aggregate`` in {SUM, AVG, MIN, MAX, COUNT, ALL}. Realtime mode merges
+    (cell, objID) group extents into host state with stale-trajectory
+    eviction after ``traj_deletion_threshold_ms``
+    (``tAggregate/TAggregateQuery.java:367-376``).
+    """
+
+    def run(self, stream: Iterable[Point], aggregate: str = "SUM",
+            traj_deletion_threshold_ms: int = 0) -> Iterator[WindowResult]:
+        from spatialflink_tpu.ops.trajectory import taggregate_groups, taggregate_heatmap
+
+        agg = aggregate.upper()
+        if self.conf.query_type is QueryType.RealTime:
+            yield from self._run_realtime(stream, agg, traj_deletion_threshold_ms)
+            return
+        for start, end, records in self._windows(stream):
+            if not records:
+                yield WindowResult(start, end, [])
+                continue
+            batch = self._point_batch(records, start)
+            groups = taggregate_groups(batch, num_cells=self.grid.num_cells)
+            if agg == "ALL":
+                first = np.asarray(groups.first)
+                records_out = list(zip(
+                    np.asarray(groups.cell)[first].tolist(),
+                    [self.interner.lookup(int(o))
+                     for o in np.asarray(groups.obj_id)[first]],
+                    np.asarray(groups.length)[first].tolist(),
+                ))
+                yield WindowResult(start, end, records_out)
+            else:
+                hm = taggregate_heatmap(groups, num_cells=self.grid.num_cells, agg=agg)
+                yield WindowResult(start, end, [], extras={"heatmap": np.asarray(hm)})
+
+    def _run_realtime(self, stream, agg, eviction_ms) -> Iterator[WindowResult]:
+        # host state: (cell, objID) -> [min_ts, max_ts, last_seen]
+        state: Dict[Tuple[int, str], List[int]] = {}
+        for records in self._micro_batches(stream):
+            latest = 0
+            for p in records:
+                if p.cell < 0:
+                    continue
+                latest = max(latest, p.timestamp)
+                key = (p.cell, p.obj_id)
+                ent = state.get(key)
+                if ent is None:
+                    state[key] = [p.timestamp, p.timestamp, p.timestamp]
+                else:
+                    ent[0] = min(ent[0], p.timestamp)
+                    ent[1] = max(ent[1], p.timestamp)
+                    ent[2] = max(ent[2], p.timestamp)
+            if eviction_ms > 0:
+                stale = [k for k, v in state.items() if latest - v[2] > eviction_ms]
+                for k in stale:
+                    del state[k]
+            heatmap = self._aggregate_state(state, agg)
+            yield WindowResult(
+                records[0].timestamp, records[-1].timestamp, [],
+                extras={"heatmap": heatmap},
+            )
+
+    def _aggregate_state(self, state, agg) -> np.ndarray:
+        hm = np.zeros(self.grid.num_cells, np.float64)
+        if agg in ("MIN",):
+            hm[:] = np.inf
+        if agg in ("MAX",):
+            hm[:] = -np.inf
+        counts = np.zeros(self.grid.num_cells, np.int64)
+        for (cell, _oid), (mn, mx, _seen) in state.items():
+            length = mx - mn
+            counts[cell] += 1
+            if agg in ("SUM", "AVG"):
+                hm[cell] += length
+            elif agg == "MIN":
+                hm[cell] = min(hm[cell], length)
+            elif agg == "MAX":
+                hm[cell] = max(hm[cell], length)
+            elif agg == "COUNT":
+                hm[cell] += 1
+        if agg == "AVG":
+            hm = np.where(counts > 0, hm / np.maximum(counts, 1), 0.0)
+        hm[~np.isfinite(hm)] = 0.0
+        return hm
+
+
+class PointPointTJoinQuery(SpatialOperator):
+    """Trajectory-trajectory proximity join: one output per
+    (trajectory, partner) pair per window, keeping the LATEST co-located
+    timestamp (``tJoin/PointPointTJoinQuery.java:133-177``)."""
+
+    def run(self, ordinary: Iterable[Point], query_stream: Iterable[Point],
+            radius: float) -> Iterator[WindowResult]:
+        from spatialflink_tpu.operators.join_query import PointPointJoinQuery
+
+        inner = PointPointJoinQuery(self.conf, self.grid)
+        inner.interner = self.interner
+        for res in inner.run(ordinary, query_stream, radius):
+            yield self._dedup(res)
+
+    def run_single(self, stream: Iterable[Point], radius: float
+                   ) -> Iterator[WindowResult]:
+        """Self-join variant skipping identical objIDs
+        (``tJoin/PointPointTJoinQuery.java:341-435``)."""
+        records = list(stream)
+        from spatialflink_tpu.operators.join_query import PointPointJoinQuery
+
+        inner = PointPointJoinQuery(self.conf, self.grid)
+        inner.interner = self.interner
+        for res in inner.run(iter(records), iter(list(records)), radius):
+            res.records = [(a, b) for a, b in res.records if a.obj_id != b.obj_id]
+            yield self._dedup(res)
+
+    def run_naive(self, ordinary: Iterable[Point], query_stream: Iterable[Point],
+                  radius: float) -> Iterator[WindowResult]:
+        """All-pairs twin without cell pruning
+        (``tJoin/TJoinQuery.java:61-155``); the exact distance filter still
+        applies."""
+        from spatialflink_tpu.operators.join_query import PointPointJoinQuery
+
+        inner = PointPointJoinQuery(self.conf, self.grid)
+        inner.interner = self.interner
+        inner.prune_cells = False
+        for res in inner.run(ordinary, query_stream, radius):
+            yield self._dedup(res)
+
+    @staticmethod
+    def _dedup(res: WindowResult) -> WindowResult:
+        best: Dict[Tuple[str, str], Tuple[Point, Point]] = {}
+        for a, b in res.records:
+            key = (a.obj_id, b.obj_id)
+            cur = best.get(key)
+            if cur is None or max(a.timestamp, b.timestamp) > max(
+                cur[0].timestamp, cur[1].timestamp
+            ):
+                best[key] = (a, b)
+        return WindowResult(res.window_start, res.window_end,
+                            list(best.values()), res.extras)
+
+
+class PointPointTKNNQuery(SpatialOperator):
+    """k nearest trajectories to a query point within ``radius`` (exact
+    radius enforced, unlike plain kNN)."""
+
+    def run(self, stream: Iterable[Point], query_point: Point, radius: float,
+            k: Optional[int] = None) -> Iterator[WindowResult]:
+        yield from self._run(stream, query_point, radius, k, prune=True)
+
+    def run_naive(self, stream: Iterable[Point], query_point: Point,
+                  radius: float, k: Optional[int] = None
+                  ) -> Iterator[WindowResult]:
+        """Exhaustive twin (``tKnn/PointPointTKNNQuery.java:59-78``)."""
+        yield from self._run(stream, query_point, radius, k, prune=False)
+
+    def _run(self, stream, query_point, radius, k, prune) -> Iterator[WindowResult]:
+        import jax.numpy as jnp
+
+        from spatialflink_tpu.ops.knn import knn_point
+
+        k = k or self.conf.k
+        nb_layers = (
+            self.grid.candidate_layers(radius) if (prune and radius > 0) else self.grid.n
+        )
+
+        def eval_batch(records, ts_base):
+            if not records:
+                return []
+            batch = self._point_batch(records, ts_base)
+            res = knn_point(
+                batch, query_point.x, query_point.y,
+                jnp.int32(query_point.cell), radius, nb_layers,
+                n=self.grid.n, k=k, enforce_radius=radius > 0,
+            )
+            valid = np.asarray(res.valid)
+            oids = [self.interner.lookup(int(o))
+                    for o in np.asarray(res.obj_id)[valid]]
+            dists = np.asarray(res.dist)[valid]
+            selected_ids = set(oids)
+            subs = assemble_subtrajectories(
+                [p for p in records if p.obj_id in selected_ids]
+            )
+            return [(oid, float(d), subs.get(oid)) for oid, d in zip(oids, dists)]
+
+        for result in self._drive(stream, eval_batch):
+            result.extras["k"] = k
+            yield result
+
+
+# Reference base-class names
+TFilterQuery = PointTFilterQuery
+TRangeQuery = PointPolygonTRangeQuery
+TStatsQuery = PointTStatsQuery
+TAggregateQuery = PointTAggregateQuery
+TJoinQuery = PointPointTJoinQuery
+TKNNQuery = PointPointTKNNQuery

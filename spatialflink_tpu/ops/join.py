@@ -99,7 +99,8 @@ def join_counts(
     return per_a, jnp.sum(per_a)
 
 
-def join_pairs_host(a: PointBatch, b: PointBatch, radius, grid, tile: int = 4096):
+def join_pairs_host(a: PointBatch, b: PointBatch, radius, grid, tile: int = 4096,
+                    nb_layers=None):
     """Host-side sparse pair extraction (the actual joined output stream).
 
     Iterates b tiles, pulls each tile's boolean lattice, and yields
@@ -108,8 +109,9 @@ def join_pairs_host(a: PointBatch, b: PointBatch, radius, grid, tile: int = 4096
     """
     import numpy as np
 
-    # radius 0 => all cells are neighbors (UniformGrid.java:264-266)
-    nb_layers = grid.n if radius == 0 else grid.candidate_layers(radius)
+    if nb_layers is None:
+        # radius 0 => all cells are neighbors (UniformGrid.java:264-266)
+        nb_layers = grid.n if radius == 0 else grid.candidate_layers(radius)
     cx = grid.min_x + grid.cell_length * grid.n / 2
     cy = grid.min_y + grid.cell_length * grid.n / 2
     nb = b.x.shape[0]

@@ -1,0 +1,230 @@
+"""Trajectory (stateful, per-object) kernels.
+
+The reference's trajectory operators are Flink keyed-state machines driven
+one tuple at a time (``tStats/TStatsQuery.java:44-150``,
+``tAggregate/TAggregateQuery.java:53-377``). The TPU re-design turns each
+micro-batch/window into sorted segment computations:
+
+- :func:`tstats_update` — running per-trajectory spatial length / temporal
+  length / speed with carried device state. A batch is sorted by
+  (objID, ts); per-object runs become segments; the reference's sequential
+  ValueState update becomes (gather state) -> (segment prefix sums) ->
+  (scatter state), with the out-of-order drop rule (``:118``) expressed as
+  "strictly increasing event time within the sorted run and above the
+  carried last_ts".
+- :func:`taggregate_window` — per-cell heatmap of trajectory lengths
+  (max_ts - min_ts per (cell, objID) group) with SUM/AVG/MIN/MAX/COUNT
+  aggregation as dense segment reductions over the n*n cell array.
+
+All outputs are in *sorted* order with an ``order`` array mapping back to
+the input batch positions.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from spatialflink_tpu.models.batches import PointBatch
+from spatialflink_tpu.ops import distances as D
+
+INT32_MIN = jnp.int32(-(2**31))
+_OID_SENTINEL = jnp.int32(2**31 - 1)
+
+
+class TrajStatsState(NamedTuple):
+    """Per-object carried state, sized (M,) for M interned object ids."""
+
+    last_x: jnp.ndarray   # f32
+    last_y: jnp.ndarray   # f32
+    last_ts: jnp.ndarray  # i32; INT32_MIN = uninitialized
+    spatial: jnp.ndarray  # f32 running spatial length (degrees)
+    temporal: jnp.ndarray # i32 running temporal length (ms)
+
+    @staticmethod
+    def zeros(m: int) -> "TrajStatsState":
+        return TrajStatsState(
+            last_x=jnp.zeros(m, jnp.float32),
+            last_y=jnp.zeros(m, jnp.float32),
+            last_ts=jnp.full(m, INT32_MIN, jnp.int32),
+            spatial=jnp.zeros(m, jnp.float32),
+            temporal=jnp.zeros(m, jnp.int32),
+        )
+
+
+class TStatsOut(NamedTuple):
+    """Per-input-point emissions, in sorted (objID, ts) order."""
+
+    obj_id: jnp.ndarray    # (N,) i32
+    spatial: jnp.ndarray   # (N,) f32 running spatial length
+    temporal: jnp.ndarray  # (N,) i32 running temporal length
+    speed: jnp.ndarray     # (N,) f32 spatial/temporal
+    emit: jnp.ndarray      # (N,) bool — reference emits only in-order,
+                           # state-initialized tuples
+    order: jnp.ndarray     # (N,) i32 original batch position
+
+
+def _propagate_run_value(value_at_first, is_first):
+    """Broadcast a per-run scalar (defined at run-first positions) across the
+    run, relying on the values being nondecreasing across runs (true for
+    cumsum offsets, since contributions are non-negative). Dtype-generic:
+    uses the dtype's minimum as the seed for non-first positions."""
+    if jnp.issubdtype(value_at_first.dtype, jnp.floating):
+        lo = -jnp.inf
+    else:
+        lo = jnp.iinfo(value_at_first.dtype).min
+    seeded = jnp.where(is_first, value_at_first, lo)
+    return jax.lax.cummax(seeded)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def tstats_update(state: TrajStatsState, batch: PointBatch):
+    """-> (new_state, TStatsOut). Batch obj_id must be < state size."""
+    n = batch.x.shape[0]
+    m = state.last_x.shape[0]
+
+    oid = jnp.where(batch.valid, batch.obj_id, _OID_SENTINEL)
+    order0 = jnp.arange(n, dtype=jnp.int32)
+    oid_s, ts_s, x_s, y_s, order = jax.lax.sort(
+        (oid, batch.ts, batch.x, batch.y, order0), num_keys=2
+    )
+    valid_s = oid_s != _OID_SENTINEL
+    safe_oid = jnp.where(valid_s, oid_s, 0)
+
+    prev_oid = jnp.concatenate([jnp.full((1,), -1, jnp.int32), oid_s[:-1]])
+    run_first = oid_s != prev_oid
+
+    st_last_ts = state.last_ts[safe_oid]
+    # accepted: strictly newer than the carried state AND first of its exact
+    # (oid, ts) group — sorted order makes both checks locally evaluable
+    prev_ts = jnp.concatenate([jnp.full((1,), INT32_MIN, jnp.int32), ts_s[:-1]])
+    tie = (~run_first) & (ts_s == prev_ts)
+    accepted = valid_s & ~tie & (ts_s > st_last_ts)
+
+    # previous *accepted* element of the same object (in-batch link)
+    pos = jnp.where(accepted, jnp.arange(n, dtype=jnp.int32), -1)
+    prev_acc_pos = jnp.concatenate([jnp.full((1,), -1, jnp.int32),
+                                    jax.lax.cummax(pos)[:-1]])
+    has_batch_prev = (prev_acc_pos >= 0) & (
+        oid_s[jnp.maximum(prev_acc_pos, 0)] == oid_s
+    )
+    gp = jnp.maximum(prev_acc_pos, 0)
+    state_init = st_last_ts != INT32_MIN
+    px = jnp.where(has_batch_prev, x_s[gp], state.last_x[safe_oid])
+    py = jnp.where(has_batch_prev, y_s[gp], state.last_y[safe_oid])
+    pts = jnp.where(has_batch_prev, ts_s[gp], st_last_ts)
+    has_prev = has_batch_prev | state_init
+
+    emit = accepted & has_prev
+    contrib_d = jnp.where(emit, D.pp_dist(px, py, x_s, y_s), 0.0)
+    contrib_t = jnp.where(emit, ts_s - pts, 0)
+
+    # running totals: carried base + within-run prefix sums. Note: the global
+    # i32 cumsum bounds total in-batch temporal contributions to < 2^31 ms
+    # (~24 days summed across the batch) — ample for any window/micro-batch.
+    cd = jnp.cumsum(contrib_d)
+    ct = jnp.cumsum(contrib_t.astype(jnp.int32))
+    base_d = _propagate_run_value(cd - contrib_d, run_first)
+    base_t = _propagate_run_value(ct - contrib_t, run_first)
+    run_d = state.spatial[safe_oid] + (cd - base_d).astype(jnp.float32)
+    run_t = state.temporal[safe_oid] + (ct - base_t)
+    speed = jnp.where(run_t > 0, run_d / run_t.astype(jnp.float32), 0.0)
+
+    # ---- state scatter ------------------------------------------------- #
+    seg = safe_oid
+    upd_d = jax.ops.segment_sum(contrib_d, seg, num_segments=m)
+    upd_t = jax.ops.segment_sum(contrib_t, seg, num_segments=m)
+    acc_ts = jnp.where(accepted, ts_s, INT32_MIN)
+    new_last_ts_seg = jax.ops.segment_max(acc_ts, seg, num_segments=m)
+    new_last_ts = jnp.maximum(state.last_ts, new_last_ts_seg)
+
+    # coords of the newest accepted element per object: accepted ts are
+    # strictly increasing within a run, so the match below is unique
+    is_newest = accepted & (ts_s == new_last_ts_seg[safe_oid])
+    scat = jnp.where(is_newest, safe_oid, m)  # m = dropped (out of bounds)
+    new_last_x = state.last_x.at[scat].set(x_s, mode="drop")
+    new_last_y = state.last_y.at[scat].set(y_s, mode="drop")
+
+    new_state = TrajStatsState(
+        last_x=new_last_x,
+        last_y=new_last_y,
+        last_ts=new_last_ts,
+        spatial=state.spatial + upd_d,
+        temporal=state.temporal + upd_t,
+    )
+    out = TStatsOut(obj_id=oid_s, spatial=run_d, temporal=run_t, speed=speed,
+                    emit=emit, order=order)
+    return new_state, out
+
+
+# ------------------------------------------------------------------------- #
+# TAggregate: per-cell heatmap of trajectory lengths
+
+
+class TAggregateGroups(NamedTuple):
+    """Per-(cell, objID) groups of a window, in sorted order."""
+
+    cell: jnp.ndarray     # (N,) i32 group cell (garbage where ~first)
+    obj_id: jnp.ndarray   # (N,) i32 group object
+    length: jnp.ndarray   # (N,) i32 max_ts - min_ts of the group
+    first: jnp.ndarray    # (N,) bool marks group representatives
+
+
+@partial(jax.jit, static_argnames=("num_cells",))
+def taggregate_groups(batch: PointBatch, *, num_cells: int) -> TAggregateGroups:
+    """Group a window by (cell, objID); per-group trajectory length =
+    max - min timestamp (``tAggregate/TAggregateQuery.java:381-494``)."""
+    n = batch.x.shape[0]
+    ok = batch.valid & (batch.cell >= 0)
+    cell = jnp.where(ok, batch.cell, num_cells)  # sentinel cell sorts last
+    oid = jnp.where(ok, batch.obj_id, _OID_SENTINEL)
+    cell_s, oid_s, ts_s = jax.lax.sort((cell, oid, batch.ts), num_keys=3)
+
+    prev_cell = jnp.concatenate([jnp.full((1,), -1, jnp.int32), cell_s[:-1]])
+    prev_oid = jnp.concatenate([jnp.full((1,), -1, jnp.int32), oid_s[:-1]])
+    first = ((cell_s != prev_cell) | (oid_s != prev_oid)) & (cell_s < num_cells)
+
+    gid = jnp.cumsum(first.astype(jnp.int32)) - 1  # dense group ids
+    gid = jnp.where(cell_s < num_cells, gid, n - 1)
+    min_ts = jax.ops.segment_min(ts_s, gid, num_segments=n)
+    max_ts = jax.ops.segment_max(ts_s, gid, num_segments=n)
+    length = (max_ts - min_ts)[gid]
+    return TAggregateGroups(cell=cell_s, obj_id=oid_s, length=length, first=first)
+
+
+@partial(jax.jit, static_argnames=("num_cells", "agg"))
+def taggregate_heatmap(groups: TAggregateGroups, *, num_cells: int, agg: str):
+    """Dense (num_cells,) heatmap from (cell, objID) groups.
+
+    agg in {SUM, AVG, MIN, MAX, COUNT} (conf aggregate,
+    ``geoflink-conf.yml:53``; ALL is served by the groups themselves)."""
+    cell = jnp.where(groups.first, groups.cell, num_cells)
+    length = groups.length.astype(jnp.float32)
+    if agg in ("SUM", "AVG"):
+        total = jax.ops.segment_sum(
+            jnp.where(groups.first, length, 0.0), cell, num_segments=num_cells + 1
+        )
+        if agg == "SUM":
+            return total[:num_cells]
+        count = jax.ops.segment_sum(
+            groups.first.astype(jnp.float32), cell, num_segments=num_cells + 1
+        )
+        return jnp.where(count[:num_cells] > 0, total[:num_cells] / count[:num_cells], 0.0)
+    if agg == "COUNT":
+        return jax.ops.segment_sum(
+            groups.first.astype(jnp.float32), cell, num_segments=num_cells + 1
+        )[:num_cells]
+    if agg == "MIN":
+        v = jax.ops.segment_min(
+            jnp.where(groups.first, length, jnp.inf), cell, num_segments=num_cells + 1
+        )[:num_cells]
+        return jnp.where(jnp.isfinite(v), v, 0.0)
+    if agg == "MAX":
+        v = jax.ops.segment_max(
+            jnp.where(groups.first, length, -jnp.inf), cell, num_segments=num_cells + 1
+        )[:num_cells]
+        return jnp.where(jnp.isfinite(v), v, 0.0)
+    raise ValueError(f"unknown aggregate {agg!r}")

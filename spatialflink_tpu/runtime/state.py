@@ -1,0 +1,86 @@
+"""Keyed operator state with explicit snapshot/restore.
+
+The reference leans on Flink managed state (``ValueState``/``MapState``/
+``ListState``) and would get checkpointing from Flink if it were configured
+(SURVEY §5: it never is). Here host-side operator state is explicit and
+snapshot-able: device state pytrees hop to host numpy for serialization, and
+:meth:`CheckpointableState.save` / :meth:`load` round-trip through a single
+``.npz`` file — the rebuild's checkpoint/resume story.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+class CheckpointableState:
+    """A named bag of numpy/jax arrays + JSON-able metadata."""
+
+    def __init__(self):
+        self.arrays: Dict[str, Any] = {}
+        self.meta: Dict[str, Any] = {}
+
+    def save(self, path: str) -> None:
+        host = {k: np.asarray(v) for k, v in self.arrays.items()}
+        np.savez(path, __meta__=json.dumps(self.meta), **host)
+
+    @classmethod
+    def load(cls, path: str) -> "CheckpointableState":
+        out = cls()
+        with np.load(path, allow_pickle=False) as z:
+            for k in z.files:
+                if k == "__meta__":
+                    out.meta = json.loads(str(z[k]))
+                else:
+                    out.arrays[k] = z[k]
+        return out
+
+
+class TrajStateStore:
+    """Host wrapper around a device :class:`TrajStatsState` that grows with
+    the interner and snapshots to disk."""
+
+    def __init__(self, capacity: int = 256):
+        from spatialflink_tpu.ops.trajectory import TrajStatsState
+
+        self.capacity = capacity
+        self.state = TrajStatsState.zeros(capacity)
+
+    def ensure(self, min_capacity: int) -> None:
+        """Grow (power-of-two) so new interned object ids fit."""
+        if min_capacity <= self.capacity:
+            return
+        from spatialflink_tpu.ops.trajectory import TrajStatsState
+        from spatialflink_tpu.utils import bucket_size
+
+        new_cap = bucket_size(min_capacity, self.capacity * 2)
+        old = self.state
+        grown = TrajStatsState.zeros(new_cap)
+        import jax.numpy as jnp
+
+        self.state = TrajStatsState(
+            *(g.at[: self.capacity].set(o) for g, o in zip(grown, old))
+        )
+        self.capacity = new_cap
+
+    def snapshot(self) -> CheckpointableState:
+        cp = CheckpointableState()
+        cp.meta["capacity"] = self.capacity
+        for name, arr in self.state._asdict().items():
+            cp.arrays[name] = arr
+        return cp
+
+    @classmethod
+    def restore(cls, cp: CheckpointableState) -> "TrajStateStore":
+        from spatialflink_tpu.ops.trajectory import TrajStatsState
+        import jax.numpy as jnp
+
+        store = cls(capacity=int(cp.meta["capacity"]))
+        store.state = TrajStatsState(
+            **{k: jnp.asarray(v) for k, v in cp.arrays.items()}
+        )
+        return store

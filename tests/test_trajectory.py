@@ -1,0 +1,295 @@
+"""Trajectory operators vs sequential NumPy oracles and naive twins."""
+
+import numpy as np
+import pytest
+
+from spatialflink_tpu.index import UniformGrid
+from spatialflink_tpu.models import LineString, Point, Polygon
+from spatialflink_tpu.operators import (
+    PointPointTJoinQuery,
+    PointPointTKNNQuery,
+    PointPolygonTRangeQuery,
+    PointTAggregateQuery,
+    PointTFilterQuery,
+    PointTStatsQuery,
+    QueryConfiguration,
+    QueryType,
+)
+from spatialflink_tpu.streams import SyntheticPointSource
+from tests import oracles as O
+
+GRID = UniformGrid(115.50, 117.60, 39.60, 41.10, num_grid_partitions=100)
+BASE = 1_700_000_000_000
+
+
+def source(**kw):
+    defaults = dict(num_trajectories=20, steps=25, dt_ms=1000, seed=9)
+    defaults.update(kw)
+    return SyntheticPointSource(GRID, **defaults)
+
+
+def window_conf(**kw):
+    return QueryConfiguration(window_size_ms=10_000, slide_ms=10_000, **kw)
+
+
+def realtime_conf(**kw):
+    kw.setdefault("realtime_batch_size", 100)
+    return QueryConfiguration(query_type=QueryType.RealTime, **kw)
+
+
+class TestTFilter:
+    def test_realtime_filters_ids(self):
+        op = PointTFilterQuery(realtime_conf(), GRID)
+        results = list(op.run(source(), {"traj-1", "traj-2"}))
+        assert results
+        for res in results:
+            assert {p.obj_id for p in res.records} <= {"traj-1", "traj-2"}
+
+    def test_empty_set_passes_all(self):
+        op = PointTFilterQuery(realtime_conf(), GRID)
+        n = sum(len(r.records) for r in op.run(source(), set()))
+        assert n == 20 * 25
+
+    def test_windowed_builds_linestrings(self):
+        op = PointTFilterQuery(window_conf(), GRID)
+        results = list(op.run(source(), {"traj-3"}))
+        full = [r for r in results if r.records]
+        assert full
+        for res in full:
+            assert all(isinstance(t, LineString) for t in res.records)
+            assert res.records[0].obj_id == "traj-3"
+            # coords are time-sorted
+            ts = res.records[0].coords_list
+            assert len(ts) >= 2
+
+
+class TestTStats:
+    def _oracle(self, points):
+        """Sequential reference semantics (TStatsQuery.java:89-148)."""
+        state = {}
+        out = []
+        for p in points:
+            st = state.get(p.obj_id)
+            if st is None:
+                state[p.obj_id] = [p.x, p.y, p.timestamp, 0.0, 0]
+                continue
+            if p.timestamp > st[2]:
+                d = O.pp_dist(st[0], st[1], p.x, p.y)
+                st[3] += d
+                st[4] += p.timestamp - st[2]
+                st[0], st[1], st[2] = p.x, p.y, p.timestamp
+                out.append((p.obj_id, st[3], st[4], st[3] / st[4]))
+        return out
+
+    def test_realtime_matches_sequential_oracle(self):
+        pts = list(source(num_trajectories=5, steps=30))
+        op = PointTStatsQuery(realtime_conf(realtime_batch_size=37), GRID)
+        got = []
+        for res in op.run(iter(pts)):
+            got.extend(res.records)
+        want = self._oracle(pts)
+        assert len(got) == len(want)
+        got_by_obj = {}
+        for oid, s, t, v in got:
+            got_by_obj.setdefault(oid, []).append((s, t, v))
+        want_by_obj = {}
+        for oid, s, t, v in want:
+            want_by_obj.setdefault(oid, []).append((s, t, v))
+        for oid in want_by_obj:
+            g, w = got_by_obj[oid], want_by_obj[oid]
+            # emission order within object follows event time in both;
+            # atol covers f32 coordinate-quantization drift (~2e-6/segment,
+            # see ops.distances precision model) over the run
+            np.testing.assert_allclose([x[0] for x in g], [x[0] for x in w],
+                                       atol=1e-4)
+            assert [x[1] for x in g] == [x[1] for x in w]
+
+    def test_out_of_order_dropped(self):
+        pts = [
+            Point.create(116.0, 40.0, GRID, "a", BASE + 1000),
+            Point.create(116.1, 40.0, GRID, "a", BASE + 3000),
+            Point.create(116.2, 40.0, GRID, "a", BASE + 2000),  # late: dropped
+            Point.create(116.3, 40.0, GRID, "a", BASE + 4000),
+        ]
+        op = PointTStatsQuery(realtime_conf(realtime_batch_size=2), GRID)
+        got = []
+        for res in op.run(iter(pts)):
+            got.extend(res.records)
+        want = self._oracle(pts)
+        assert len(got) == len(want) == 2
+        np.testing.assert_allclose(got[-1][1], want[-1][1], rtol=1e-4)
+
+    def test_state_carries_across_micro_batches(self):
+        pts = [Point.create(116.0 + 0.01 * i, 40.0, GRID, "a", BASE + i * 1000)
+               for i in range(10)]
+        op1 = PointTStatsQuery(realtime_conf(realtime_batch_size=3), GRID)
+        op2 = PointTStatsQuery(realtime_conf(realtime_batch_size=1000), GRID)
+        final1 = [r.records[-1] for r in op1.run(iter(pts))][-1]
+        final2 = [r.records[-1] for r in op2.run(iter(pts))][-1]
+        np.testing.assert_allclose(final1[1], final2[1], rtol=1e-4)
+        assert final1[2] == final2[2]
+
+
+class TestTAggregate:
+    def test_windowed_sum_matches_oracle(self):
+        pts = list(source(num_trajectories=8, steps=20))
+        op = PointTAggregateQuery(window_conf(), GRID)
+        results = [r for r in op.run(iter(pts), "SUM") if "heatmap" in r.extras]
+        assert results
+        # oracle for the first emitted window
+        from spatialflink_tpu.runtime import WindowAssembler, WindowSpec
+
+        wa = WindowAssembler(WindowSpec.sliding(10_000, 10_000))
+        windows = {}
+        for p in pts:
+            for s, e, recs in wa.add(p.timestamp, p):
+                windows[s] = recs
+        res = results[0]
+        recs = windows[res.window_start]
+        want = np.zeros(GRID.num_cells)
+        groups = {}
+        for p in recs:
+            if p.cell >= 0:
+                g = groups.setdefault((p.cell, p.obj_id), [p.timestamp, p.timestamp])
+                g[0] = min(g[0], p.timestamp)
+                g[1] = max(g[1], p.timestamp)
+        for (cell, _oid), (mn, mx) in groups.items():
+            want[cell] += mx - mn
+        np.testing.assert_allclose(res.extras["heatmap"], want, rtol=1e-5)
+
+    @pytest.mark.parametrize("agg", ["AVG", "MIN", "MAX", "COUNT"])
+    def test_other_aggregates_run(self, agg):
+        pts = list(source(num_trajectories=5, steps=12))
+        op = PointTAggregateQuery(window_conf(), GRID)
+        results = [r for r in op.run(iter(pts), agg) if "heatmap" in r.extras]
+        assert results and np.isfinite(results[0].extras["heatmap"]).all()
+
+    def test_all_mode_returns_groups(self):
+        pts = list(source(num_trajectories=4, steps=10))
+        op = PointTAggregateQuery(window_conf(), GRID)
+        results = list(op.run(iter(pts), "ALL"))
+        assert any(r.records for r in results)
+        cell, oid, length = results[0].records[0]
+        assert isinstance(oid, str) and length >= 0
+
+    def test_realtime_eviction(self):
+        pts = [Point.create(116.0, 40.0, GRID, "a", BASE),
+               Point.create(116.0, 40.0, GRID, "a", BASE + 1000),
+               Point.create(116.5, 40.5, GRID, "b", BASE + 60_000)]
+        op = PointTAggregateQuery(realtime_conf(realtime_batch_size=2), GRID)
+        results = list(op.run(iter(pts), "COUNT", traj_deletion_threshold_ms=10_000))
+        hm = results[-1].extras["heatmap"]
+        cell_a, _ = GRID.assign_cell(116.0, 40.0)
+        assert hm[int(cell_a)] == 0  # trajectory a evicted after 60s gap
+
+
+class TestTJoin:
+    def test_dedup_keeps_latest(self):
+        a = [Point.create(116.5, 40.5, GRID, "A", BASE + i * 1000) for i in range(3)]
+        b = [Point.create(116.5001, 40.5, GRID, "B", BASE + i * 1000) for i in range(3)]
+        op = PointPointTJoinQuery(window_conf(), GRID)
+        results = [r for r in op.run(iter(a), iter(b), 0.05) if r.records]
+        assert results
+        assert len(results[0].records) == 1  # one output per (A, B)
+        pa, pb = results[0].records[0]
+        assert max(pa.timestamp, pb.timestamp) == BASE + 2000
+
+    def test_self_join_skips_same_object(self):
+        pts = [Point.create(116.5 + i * 1e-4, 40.5, GRID, f"t{i % 2}", BASE + i * 500)
+               for i in range(8)]
+        op = PointPointTJoinQuery(window_conf(), GRID)
+        results = [r for r in op.run_single(iter(pts), 0.05) if r.records]
+        assert results
+        for res in results:
+            for x, y in res.records:
+                assert x.obj_id != y.obj_id
+
+    def test_pruned_matches_naive(self):
+        a = list(source(seed=30, num_trajectories=10, steps=12))
+        b = list(source(seed=31, num_trajectories=5, steps=12))
+        op1 = PointPointTJoinQuery(window_conf(), GRID)
+        op2 = PointPointTJoinQuery(window_conf(), GRID)
+        r = 0.08
+        pruned = {(res.window_start, x.obj_id, y.obj_id)
+                  for res in op1.run(iter(a), iter(b), r) for x, y in res.records}
+        naive = {(res.window_start, x.obj_id, y.obj_id)
+                 for res in op2.run_naive(iter(a), iter(b), r) for x, y in res.records}
+        assert pruned == naive
+
+
+class TestTKnn:
+    def test_nearest_trajectories_with_radius(self):
+        pts = list(source(seed=33, num_trajectories=15, steps=12))
+        q = Point.create(116.5, 40.5, GRID, obj_id="q")
+        op = PointPointTKNNQuery(window_conf(k=5), GRID)
+        results = [r for r in op.run(iter(pts), q, 0.5) if r.records]
+        assert results
+        for res in results:
+            dists = [d for _, d, _ in res.records]
+            assert all(d <= 0.5 + 1e-3 for d in dists)
+            assert dists == sorted(dists)
+            for oid, d, sub in res.records:
+                assert sub is None or getattr(sub, "obj_id", oid) == oid
+
+    def test_pruned_matches_naive(self):
+        pts = list(source(seed=34, num_trajectories=12, steps=10))
+        q = Point.create(116.5, 40.5, GRID, obj_id="q")
+        op1 = PointPointTKNNQuery(window_conf(k=4), GRID)
+        op2 = PointPointTKNNQuery(window_conf(k=4), GRID)
+        pruned = [(r.window_start, [(o, round(d, 4)) for o, d, _ in r.records])
+                  for r in op1.run(iter(pts), q, 0.3)]
+        naive = [(r.window_start, [(o, round(d, 4)) for o, d, _ in r.records])
+                 for r in op2.run_naive(iter(pts), q, 0.3)]
+        assert pruned == naive
+
+
+class TestTRange:
+    POLYS = [
+        Polygon.create([[(116.4, 40.4), (116.6, 40.4), (116.6, 40.6), (116.4, 40.6)]],
+                       GRID, obj_id="z1"),
+        Polygon.create([[(116.0, 40.0), (116.1, 40.0), (116.1, 40.1), (116.0, 40.1)]],
+                       GRID, obj_id="z2"),
+    ]
+
+    def test_realtime_matches_naive(self):
+        pts = list(source(seed=35, num_trajectories=10, steps=15))
+        op1 = PointPolygonTRangeQuery(realtime_conf(), GRID)
+        op2 = PointPolygonTRangeQuery(realtime_conf(), GRID)
+        got = {(p.obj_id, p.timestamp)
+               for r in op1.run(iter(pts), self.POLYS) for p in r.records}
+        naive = {(p.obj_id, p.timestamp)
+                 for r in op2.run_naive(iter(pts), self.POLYS) for p in r.records}
+        assert got == naive
+
+    def test_windowed_returns_full_subtrajectories(self):
+        pts = list(source(seed=36, num_trajectories=8, steps=15))
+        op = PointPolygonTRangeQuery(window_conf(), GRID)
+        results = [r for r in op.run(iter(pts), self.POLYS) if r.records]
+        for res in results:
+            assert res.extras["matched_ids"]
+            ids = {getattr(t, "obj_id") for t in res.records}
+            assert ids == res.extras["matched_ids"]
+
+
+class TestStateCheckpoint:
+    def test_snapshot_restore_roundtrip(self, tmp_path):
+        from spatialflink_tpu.runtime.state import TrajStateStore
+        from spatialflink_tpu.ops.trajectory import tstats_update
+        from spatialflink_tpu.models import PointBatch
+
+        store = TrajStateStore(capacity=256)
+        b = PointBatch.from_arrays(
+            np.array([116.0, 116.1]), np.array([40.0, 40.0]),
+            grid=GRID, obj_id=np.array([1, 1], np.int32),
+            ts=np.array([BASE, BASE + 1000], np.int64), ts_base=BASE,
+        )
+        store.state, _ = tstats_update(store.state, b)
+        path = str(tmp_path / "state.npz")
+        store.snapshot().save(path)
+        from spatialflink_tpu.runtime.state import CheckpointableState
+
+        restored = TrajStateStore.restore(CheckpointableState.load(path))
+        assert restored.capacity == store.capacity
+        np.testing.assert_allclose(np.asarray(restored.state.spatial),
+                                   np.asarray(store.state.spatial))
+        assert int(np.asarray(restored.state.last_ts)[1]) == 1000

@@ -1,0 +1,271 @@
+"""YAML config system (reference: ``utils/Params.java:74-489`` +
+``utils/ConfigType.java`` + ``conf/geoflink-conf.yml``).
+
+The reference loads a snakeyaml POJO and null-checks every field with typed
+exceptions; here the same schema is parsed into dataclasses with explicit
+validation errors naming the offending key. The YAML key names are kept
+byte-identical to the reference's so an existing ``geoflink-conf.yml`` drops
+in unchanged (the leading ``!!GeoFlink.utils.ConfigType`` java type tag is
+tolerated and stripped).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from spatialflink_tpu.index import UniformGrid
+from spatialflink_tpu.models import LineString, Point, Polygon
+
+SUPPORTED_FORMATS = ("GeoJSON", "WKT", "CSV", "TSV")
+SUPPORTED_AGGREGATES = ("ALL", "SUM", "AVG", "MIN", "MAX", "COUNT")
+SUPPORTED_WINDOW_TYPES = ("TIME", "COUNT")
+
+
+class ConfigError(ValueError):
+    """Raised on a missing/invalid config field (the reference throws
+    ``NullPointerException``/``IllegalArgumentException`` per field,
+    ``utils/Params.java:100-489``)."""
+
+
+def _req(d: Dict[str, Any], key: str, where: str):
+    if key not in d or d[key] is None:
+        raise ConfigError(f"{where}: missing required key {key!r}")
+    return d[key]
+
+
+def _opt(d: Dict[str, Any], key: str, default):
+    v = d.get(key)
+    return default if v is None else v
+
+
+def _normalize_delimiter(v: str) -> str:
+    # the reference conf writes TSV delimiters as a literal TAB, "\t", or
+    # "\\\\t" (conf/geoflink-conf.yml:24,40); all map to TAB
+    if v in ("\\t", "\\\\t", "\t"):
+        return "\t"
+    return v
+
+
+@dataclass
+class StreamConfig:
+    """One ``inputStream{1,2}`` block (``utils/ConfigType.java:20-40``)."""
+
+    topic_name: str = ""
+    format: str = "GeoJSON"
+    date_format: Optional[str] = "%Y-%m-%d %H:%M:%S"
+    geojson_obj_id_attr: str = "oID"
+    geojson_timestamp_attr: str = "timestamp"
+    csv_tsv_schema: Sequence[int] = (0, 1, 2, 3)
+    grid_bbox: Tuple[float, float, float, float] = (0.0, 0.0, 1.0, 1.0)
+    num_grid_cells: int = 100
+    cell_length: float = 0.0
+    delimiter: str = ","
+    charset: str = "UTF-8"
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any], where: str) -> "StreamConfig":
+        fmt = str(_req(d, "format", where))
+        if fmt not in SUPPORTED_FORMATS:
+            raise ConfigError(
+                f"{where}.format: {fmt!r} not in {SUPPORTED_FORMATS}")
+        bbox = _req(d, "gridBBox", where)
+        if len(bbox) != 4:
+            raise ConfigError(f"{where}.gridBBox: need [minX, minY, maxX, maxY]")
+        num_cells = int(_opt(d, "numGridCells", 0))
+        cell_len = float(_opt(d, "cellLength", 0.0))
+        if num_cells <= 0 and cell_len <= 0:
+            raise ConfigError(
+                f"{where}: one of numGridCells/cellLength must be positive")
+        gj = list(_opt(d, "geoJSONSchemaAttr", ["oID", "timestamp"]))
+        schema = [int(i) for i in _opt(d, "csvTsvSchemaAttr", [0, 1, 2, 3])]
+        date_fmt = _java_date_format_to_python(
+            _opt(d, "dateFormat", "yyyy-MM-dd HH:mm:ss"))
+        return cls(
+            topic_name=str(_req(d, "topicName", where)),
+            format=fmt,
+            date_format=date_fmt,
+            geojson_obj_id_attr=gj[0] if gj else "oID",
+            geojson_timestamp_attr=gj[1] if len(gj) > 1 else "timestamp",
+            csv_tsv_schema=schema,
+            grid_bbox=(float(bbox[0]), float(bbox[1]),
+                       float(bbox[2]), float(bbox[3])),
+            num_grid_cells=num_cells,
+            cell_length=cell_len,
+            delimiter=_normalize_delimiter(str(_opt(d, "delimiter", ","))),
+            charset=str(_opt(d, "charset", "UTF-8")),
+        )
+
+    def make_grid(self) -> UniformGrid:
+        """Grid per the stream's bbox — cellLength (meters-style) takes
+        precedence when positive, like ``StreamingJob.java:309-315``."""
+        min_x, min_y, max_x, max_y = self.grid_bbox
+        if self.cell_length > 0:
+            return UniformGrid(min_x, max_x, min_y, max_y,
+                               cell_length=self.cell_length)
+        return UniformGrid(min_x, max_x, min_y, max_y,
+                           num_grid_partitions=self.num_grid_cells)
+
+
+def _java_date_format_to_python(fmt: Optional[str]) -> Optional[str]:
+    """yyyy-MM-dd HH:mm:ss → %Y-%m-%d %H:%M:%S (SimpleDateFormat subset)."""
+    if not fmt:
+        return None
+    table = [
+        ("yyyy", "%Y"), ("yy", "%y"), ("MM", "%m"), ("dd", "%d"),
+        ("HH", "%H"), ("mm", "%M"), ("ss", "%S"), ("SSS", "%f"),
+    ]
+    out = str(fmt)
+    for j, p in table:
+        out = out.replace(j, p)
+    return out
+
+
+@dataclass
+class OutputStreamConfig:
+    topic_name: str = "output"
+    delimiter: str = ","
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "OutputStreamConfig":
+        return cls(
+            topic_name=str(_opt(d, "topicName", "output")),
+            delimiter=_normalize_delimiter(str(_opt(d, "delimiter", ","))),
+        )
+
+
+@dataclass
+class QueryConfig:
+    """``query:`` block (``conf/geoflink-conf.yml:49-72``)."""
+
+    option: int = 1
+    approximate: bool = False
+    radius: float = 0.0
+    aggregate_function: str = "SUM"
+    k: int = 10
+    omega_duration_s: int = 10
+    traj_ids: List[str] = field(default_factory=list)
+    query_points: List[Tuple[float, float]] = field(default_factory=list)
+    query_polygons: List[List[Tuple[float, float]]] = field(default_factory=list)
+    query_linestrings: List[List[Tuple[float, float]]] = field(default_factory=list)
+    traj_deletion_threshold_s: int = 0
+    allowed_lateness_s: int = 0
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "QueryConfig":
+        agg = str(_opt(d, "aggregateFunction", "SUM")).upper()
+        if agg not in SUPPORTED_AGGREGATES:
+            raise ConfigError(
+                f"query.aggregateFunction: {agg!r} not in {SUPPORTED_AGGREGATES}")
+        th = _opt(d, "thresholds", {})
+        return cls(
+            option=int(_req(d, "option", "query")),
+            approximate=bool(_opt(d, "approximate", False)),
+            radius=float(_opt(d, "radius", 0.0)),
+            aggregate_function=agg,
+            k=int(_opt(d, "k", 10)),
+            omega_duration_s=int(_opt(d, "omegaDuration", 10)),
+            traj_ids=[str(t) for t in _opt(d, "trajIDs", [])],
+            query_points=[tuple(map(float, p))
+                          for p in _opt(d, "queryPoints", [])],
+            query_polygons=[[tuple(map(float, c)) for c in poly]
+                            for poly in _opt(d, "queryPolygons", [])],
+            query_linestrings=[[tuple(map(float, c)) for c in ls]
+                               for ls in _opt(d, "queryLineStrings", [])],
+            traj_deletion_threshold_s=int(_opt(th, "trajDeletion", 0)),
+            allowed_lateness_s=int(_opt(th, "outOfOrderTuples", 0)),
+        )
+
+
+@dataclass
+class WindowConfig:
+    """``window:`` block — TIME windows in seconds (``geoflink-conf.yml:74-78``)."""
+
+    type: str = "TIME"
+    interval_s: float = 5.0
+    step_s: float = 5.0
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "WindowConfig":
+        wt = str(_opt(d, "type", "TIME")).upper()
+        if wt not in SUPPORTED_WINDOW_TYPES:
+            raise ConfigError(
+                f"window.type: {wt!r} not in {SUPPORTED_WINDOW_TYPES}")
+        interval = float(_req(d, "interval", "window"))
+        step = float(_opt(d, "step", interval))
+        if interval <= 0 or step <= 0:
+            raise ConfigError("window.interval/step must be positive")
+        return cls(type=wt, interval_s=interval, step_s=step)
+
+
+@dataclass
+class Params:
+    """Validated full config (``utils/Params.java``)."""
+
+    cluster_mode: bool = False
+    kafka_bootstrap_servers: str = "localhost:9092"
+    input1: StreamConfig = field(default_factory=StreamConfig)
+    input2: StreamConfig = field(default_factory=StreamConfig)
+    output: OutputStreamConfig = field(default_factory=OutputStreamConfig)
+    query: QueryConfig = field(default_factory=QueryConfig)
+    window: WindowConfig = field(default_factory=WindowConfig)
+
+    # ------------------------------------------------------------------ #
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Params":
+        in1 = StreamConfig.from_dict(_req(d, "inputStream1", "config"),
+                                     "inputStream1")
+        in2_raw = d.get("inputStream2")
+        in2 = (StreamConfig.from_dict(in2_raw, "inputStream2")
+               if in2_raw else in1)
+        return cls(
+            cluster_mode=bool(_opt(d, "clusterMode", False)),
+            kafka_bootstrap_servers=str(
+                _opt(d, "kafkaBootStrapServers", "localhost:9092")),
+            input1=in1,
+            input2=in2,
+            output=OutputStreamConfig.from_dict(_opt(d, "outputStream", {})),
+            query=QueryConfig.from_dict(_req(d, "query", "config")),
+            window=WindowConfig.from_dict(_req(d, "window", "config")),
+        )
+
+    @classmethod
+    def from_yaml(cls, path: str) -> "Params":
+        import yaml
+
+        with open(path, "r", encoding="utf-8") as f:
+            text = f.read()
+        # strip the java type tag the reference's snakeyaml needs
+        text = re.sub(r"^!!\S+\s*\n", "", text)
+        data = yaml.safe_load(text)
+        if not isinstance(data, dict):
+            raise ConfigError(f"{path}: not a mapping")
+        return cls.from_dict(data)
+
+    # -------------------------- derived objects ----------------------- #
+
+    def grids(self) -> Tuple[UniformGrid, UniformGrid]:
+        """(uGrid, qGrid) like ``StreamingJob.java:309-315``."""
+        return self.input1.make_grid(), self.input2.make_grid()
+
+    def query_point_objects(self, grid: UniformGrid) -> List[Point]:
+        return [Point.create(x, y, grid=grid)
+                for x, y in self.query.query_points]
+
+    def query_polygon_objects(self, grid: UniformGrid) -> List[Polygon]:
+        return [Polygon.create([list(c)], grid=grid)
+                for c in self.query.query_polygons]
+
+    def query_linestring_objects(self, grid: UniformGrid) -> List[LineString]:
+        return [LineString.create(list(c), grid=grid)
+                for c in self.query.query_linestrings]
+
+    def window_ms(self) -> Tuple[int, int]:
+        return (int(self.window.interval_s * 1000),
+                int(self.window.step_s * 1000))
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)

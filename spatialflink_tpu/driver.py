@@ -1,0 +1,387 @@
+"""Driver layer (reference: ``GeoFlink/StreamingJob.java:68-1704``).
+
+The reference's ``main`` is a ~1700-line ``switch(queryOption)`` wiring Kafka
+sources through deserializers into one of ~120 query pipelines. Here the same
+option space is a declarative registry: ``CASES[option]`` describes the
+family (range/knn/join/trajectory/deser), the stream/query geometry types,
+window vs real-time mode, and the latency/naive variants; :func:`run_option`
+builds the pipeline and returns the result iterator.
+
+Option numbering parity (``StreamingJob.java:470-1704``):
+
+- range:     1/2 + 5*i   (window/realtime) over the 9 ordered type pairs
+- kNN:       51/52 + 5*i
+- join:      101/102 + 5*i
+- latency variants: 8/9 (range), 58/59 (kNN), 108/109 (join) — point-polygon
+- trajectory: 201..212 (+ naive twins 2030/2090/2011)
+- ser/de round-trips: 401..906
+- shapefile: 1001..1003; synthetic harness: 99
+- apps: 1010..1012 (StayTime), 2000 (CheckIn)
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from dataclasses import dataclass
+from typing import Iterable, Iterator, List, Optional
+
+from spatialflink_tpu import operators as ops
+from spatialflink_tpu.config import Params, StreamConfig
+from spatialflink_tpu.index import UniformGrid
+from spatialflink_tpu.models import Point, SpatialObject
+from spatialflink_tpu.operators import QueryConfiguration, QueryType, WindowResult
+from spatialflink_tpu.streams.formats import parse_spatial, serialize_spatial
+
+_PAIRS = [
+    ("Point", "Point"), ("Point", "Polygon"), ("Point", "LineString"),
+    ("Polygon", "Point"), ("Polygon", "Polygon"), ("Polygon", "LineString"),
+    ("LineString", "Point"), ("LineString", "Polygon"),
+    ("LineString", "LineString"),
+]
+
+
+@dataclass(frozen=True)
+class CaseSpec:
+    family: str                # range|knn|join|tfilter|trange|tstats|taggregate|tjoin|tknn|deser|shapefile|synthetic|staytime|checkin
+    stream: str = "Point"      # geometry type of input stream 1
+    query: str = "Point"       # geometry type of the query side
+    mode: str = "window"       # window|realtime
+    latency: bool = False
+    naive: bool = False
+    fmt: Optional[str] = None         # deser cases force a format
+    timestamped: bool = False         # deser trajectory variants
+
+
+def _build_cases() -> dict:
+    c: dict = {}
+    for i, (s, q) in enumerate(_PAIRS):
+        c[1 + 5 * i] = CaseSpec("range", s, q, "window")
+        c[2 + 5 * i] = CaseSpec("range", s, q, "realtime")
+        c[51 + 5 * i] = CaseSpec("knn", s, q, "window")
+        c[52 + 5 * i] = CaseSpec("knn", s, q, "realtime")
+        c[101 + 5 * i] = CaseSpec("join", s, q, "window")
+        c[102 + 5 * i] = CaseSpec("join", s, q, "realtime")
+    # latency variants (StreamingJob.java:506-522, 685-700, 863-886)
+    c[8] = CaseSpec("range", "Point", "Polygon", "window", latency=True)
+    c[9] = CaseSpec("range", "Point", "Polygon", "realtime", latency=True)
+    c[58] = CaseSpec("knn", "Point", "Polygon", "window", latency=True)
+    c[59] = CaseSpec("knn", "Point", "Polygon", "realtime", latency=True)
+    c[108] = CaseSpec("join", "Point", "Polygon", "window", latency=True)
+    c[109] = CaseSpec("join", "Point", "Polygon", "realtime", latency=True)
+    # trajectory queries (StreamingJob.java:1163-1287)
+    c[201] = CaseSpec("tfilter", mode="realtime")
+    c[202] = CaseSpec("tfilter", mode="window")
+    c[203] = CaseSpec("trange", mode="realtime")
+    c[2030] = CaseSpec("trange", mode="realtime", naive=True)
+    c[204] = CaseSpec("trange", mode="window")
+    c[205] = CaseSpec("tstats", mode="realtime")
+    c[206] = CaseSpec("tstats", mode="window")
+    c[207] = CaseSpec("taggregate", mode="realtime")
+    c[208] = CaseSpec("taggregate", mode="window")
+    c[209] = CaseSpec("tjoin", mode="realtime")
+    c[2090] = CaseSpec("tjoin", mode="realtime", naive=True)
+    c[210] = CaseSpec("tjoin", mode="window")
+    c[211] = CaseSpec("tknn", mode="realtime")
+    c[2011] = CaseSpec("tknn", mode="realtime", naive=True)
+    c[212] = CaseSpec("tknn", mode="window")
+    # ser/de conformance pipelines (StreamingJob.java:1289-1545)
+    _types = ["Point", "Polygon", "LineString", "GeometryCollection",
+              "MultiPoint"]
+    for base, fmt, ts in ((400, "GeoJSON", False), (500, "WKT", False),
+                          (600, "WKT", False), (700, "GeoJSON", True),
+                          (800, "WKT", True), (900, "WKT", True)):
+        delim_fmt = "TSV" if base in (600, 900) else "CSV"
+        for j, t in enumerate(_types, start=1):
+            c[base + j] = CaseSpec("deser", t, fmt=fmt, timestamped=ts)
+        # x06: plain (non-WKT) CSV/TSV point rows
+        c[base + 6] = CaseSpec("deser", "Point", fmt=delim_fmt, timestamped=ts)
+    # shapefile batch inputs (StreamingJob.java:1546-1569)
+    c[1001] = CaseSpec("shapefile", "Point")
+    c[1002] = CaseSpec("shapefile", "Polygon")
+    c[1003] = CaseSpec("shapefile", "LineString")
+    c[99] = CaseSpec("synthetic")
+    # apps
+    c[1010] = CaseSpec("staytime")
+    c[1011] = CaseSpec("staytime", latency=True)
+    c[1012] = CaseSpec("staytime", naive=True)  # sensor-intersection variant
+    c[2000] = CaseSpec("checkin")
+    return c
+
+
+CASES = _build_cases()
+
+
+# --------------------------------------------------------------------- #
+# stream decoding
+
+
+def decode_stream(records: Iterable, cfg: StreamConfig, grid: UniformGrid
+                  ) -> Iterator[SpatialObject]:
+    """Raw lines/dicts → spatial objects; already-parsed objects pass through
+    (the reference's per-case ``Deserialization.*Stream`` stage)."""
+    for rec in records:
+        if isinstance(rec, SpatialObject):
+            yield rec
+            continue
+        yield parse_spatial(
+            rec, cfg.format, grid,
+            delimiter=cfg.delimiter,
+            schema=cfg.csv_tsv_schema,
+            date_format=cfg.date_format,
+            property_obj_id=cfg.geojson_obj_id_attr,
+            property_timestamp=cfg.geojson_timestamp_attr,
+        )
+
+
+def _query_conf(params: Params, spec: CaseSpec) -> QueryConfiguration:
+    size_ms, step_ms = params.window_ms()
+    return QueryConfiguration(
+        query_type=(QueryType.RealTime if spec.mode == "realtime"
+                    else QueryType.WindowBased),
+        window_size_ms=size_ms,
+        slide_ms=step_ms,
+        allowed_lateness_ms=params.query.allowed_lateness_s * 1000,
+        approximate=params.query.approximate,
+        k=params.query.k,
+    )
+
+
+def _query_object(params: Params, grid: UniformGrid, kind: str):
+    if kind == "Point":
+        pts = params.query_point_objects(grid)
+        if not pts:
+            raise ValueError("query.queryPoints is empty")
+        return pts[0]
+    if kind == "Polygon":
+        polys = params.query_polygon_objects(grid)
+        if not polys:
+            raise ValueError("query.queryPolygons is empty")
+        return polys[0]
+    lss = params.query_linestring_objects(grid)
+    if not lss:
+        raise ValueError("query.queryLineStrings is empty")
+    return lss[0]
+
+
+def _with_latency(results: Iterator[WindowResult]) -> Iterator[WindowResult]:
+    """Annotate each result with per-record latency millis (reference:
+    ``now - ingestionTime`` shipped to a Kafka topic,
+    ``utils/HelperClass.java:455-529``)."""
+    for r in results:
+        now = int(time.time() * 1000)
+        lats = []
+        for rec in r.records:
+            obj = rec[0] if isinstance(rec, tuple) else rec
+            base = getattr(obj, "ingestion_time", None)
+            if isinstance(base, (int, float)) and base > 0:
+                lats.append(now - int(base))
+        r.extras["latency_ms"] = lats
+        yield r
+
+
+# --------------------------------------------------------------------- #
+
+
+def run_option(params: Params, stream1: Iterable, stream2: Optional[Iterable]
+               = None) -> Iterator:
+    """Wire and run the pipeline for ``params.query.option``.
+
+    ``stream1``/``stream2`` are iterables of raw records (str/dict) or parsed
+    spatial objects — the host-side stand-ins for the reference's two Kafka
+    consumers."""
+    opt = params.query.option
+    if opt not in CASES:
+        raise ValueError(f"unknown queryOption {opt}")
+    spec = CASES[opt]
+    u_grid, q_grid = params.grids()
+    conf = _query_conf(params, spec)
+    radius = params.query.radius
+
+    if spec.family in ("range", "knn", "join"):
+        cls = getattr(ops, f"{spec.stream}{spec.query}"
+                           f"{ {'range': 'Range', 'knn': 'KNN', 'join': 'Join'}[spec.family] }Query")
+        s1 = decode_stream(stream1, params.input1, u_grid)
+        if spec.family == "join":
+            op = cls(conf, u_grid, q_grid)
+            if stream2 is None:
+                raise ValueError(f"queryOption {opt} (join) needs stream2")
+            s2 = decode_stream(stream2, params.input2, q_grid)
+            out = op.run(s1, s2, radius)
+        else:
+            op = cls(conf, u_grid)
+            q = _query_object(params, u_grid, spec.query)
+            if spec.family == "knn":
+                out = op.run(s1, q, radius, params.query.k)
+            else:
+                out = op.run(s1, q, radius)
+        return _with_latency(out) if spec.latency else out
+
+    if spec.family in ("tfilter", "trange", "tstats", "taggregate", "tjoin",
+                       "tknn"):
+        return _run_trajectory(params, spec, conf, u_grid, q_grid,
+                               stream1, stream2)
+
+    if spec.family == "deser":
+        return _run_deser(params, spec, u_grid, stream1)
+
+    if spec.family == "shapefile":
+        from spatialflink_tpu.streams.shapefile import read_shapefile
+
+        # stream1 is a path (or iterable of paths) to .shp files
+        paths = [stream1] if isinstance(stream1, (str, bytes)) else list(stream1)
+        return iter([obj for p in paths for obj in read_shapefile(p, u_grid)])
+
+    if spec.family == "synthetic":
+        return _run_synthetic(params, conf, u_grid)
+
+    if spec.family == "staytime":
+        from spatialflink_tpu.apps.stay_time import StayTime
+
+        app = StayTime(conf, u_grid)
+        s1 = decode_stream(stream1, params.input1, u_grid)
+        if spec.naive:  # 1012: sensor-range intersection stage alone
+            if stream2 is None:
+                raise ValueError("queryOption 1012 needs a polygon stream2")
+            s2 = decode_stream(stream2, params.input2, q_grid)
+            return app.cell_sensor_range_intersection(s2)
+        if stream2 is not None:
+            s2 = decode_stream(stream2, params.input2, q_grid)
+            return app.normalized_cell_stay_time(s1, s2)
+        return app.cell_stay_time(s1)
+
+    if spec.family == "checkin":
+        from spatialflink_tpu.apps.check_in import CheckIn
+
+        app = CheckIn(conf)
+        s1 = decode_stream(stream1, params.input1, u_grid)
+        return app.run(s1)
+
+    raise AssertionError(f"unhandled family {spec.family}")
+
+
+def _run_trajectory(params, spec, conf, u_grid, q_grid, stream1, stream2):
+    s1 = decode_stream(stream1, params.input1, u_grid)
+    q = params.query
+    if spec.family == "tfilter":
+        return ops.PointTFilterQuery(conf, u_grid).run(s1, set(q.traj_ids))
+    if spec.family == "trange":
+        polys = params.query_polygon_objects(u_grid)
+        op = ops.PointPolygonTRangeQuery(conf, u_grid)
+        return op.run_naive(s1, polys) if spec.naive else op.run(s1, polys)
+    if spec.family == "tstats":
+        return ops.PointTStatsQuery(conf, u_grid).run(
+            s1, set(q.traj_ids) or None)
+    if spec.family == "taggregate":
+        return ops.PointTAggregateQuery(conf, u_grid).run(
+            s1, q.aggregate_function,
+            traj_deletion_threshold_ms=q.traj_deletion_threshold_s * 1000)
+    if spec.family == "tjoin":
+        if stream2 is None:
+            raise ValueError("trajectory join needs stream2")
+        s2 = decode_stream(stream2, params.input2, q_grid)
+        op = ops.PointPointTJoinQuery(conf, u_grid, q_grid)
+        run = op.run_naive if spec.naive else op.run
+        return run(s1, s2, params.query.radius)
+    if spec.family == "tknn":
+        qp = _query_object(params, u_grid, "Point")
+        op = ops.PointPointTKNNQuery(conf, u_grid)
+        run = op.run_naive if spec.naive else op.run
+        return run(s1, qp, params.query.radius, q.k)
+    raise AssertionError(spec.family)
+
+
+def _run_deser(params, spec, grid, stream1) -> Iterator:
+    """Parse each record with the case's forced format and immediately
+    re-serialize — the reference's parse→print→produce conformance path
+    (``StreamingJob.java:1289-1545``)."""
+    fmt = spec.fmt
+    delim = "\t" if fmt == "TSV" else params.input1.delimiter or ","
+    for rec in stream1:
+        obj = rec if isinstance(rec, SpatialObject) else parse_spatial(
+            rec, fmt, grid,
+            delimiter=delim,
+            schema=params.input1.csv_tsv_schema,
+            date_format=params.input1.date_format,
+        )
+        yield obj, serialize_spatial(
+            obj, fmt, delimiter=delim,
+            date_format=params.input1.date_format if spec.timestamped else None)
+
+
+def _run_synthetic(params: Params, conf, grid) -> Iterator[WindowResult]:
+    """queryOption 99: run the trajectory queries over deterministic synthetic
+    trajectories (reference harness ``StreamingJob.java:1571-1618``)."""
+    from spatialflink_tpu.streams.sources import SyntheticPointSource
+
+    def src():
+        return SyntheticPointSource(grid, num_trajectories=16, steps=8, seed=7)
+
+    yield from ops.PointTStatsQuery(conf, grid).run(src())
+    yield from ops.PointTAggregateQuery(conf, grid).run(
+        src(), params.query.aggregate_function)
+
+
+# --------------------------------------------------------------------- #
+# CLI
+
+
+def _emit(result, sink) -> None:
+    if isinstance(result, WindowResult):
+        sink.emit({
+            "window": [result.window_start, result.window_end],
+            "count": len(result.records),
+            **{k: v for k, v in result.extras.items() if k != "latency_ms"},
+        })
+    else:
+        sink.emit(result)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="spatialflink-tpu",
+        description="TPU-native spatial stream query driver "
+                    "(StreamingJob equivalent)")
+    ap.add_argument("--config", required=True, help="YAML config path")
+    ap.add_argument("--input1", help="newline-delimited input file for stream 1")
+    ap.add_argument("--input2", help="newline-delimited input file for stream 2")
+    ap.add_argument("--limit", type=int, default=None,
+                    help="max records to read per stream")
+    ap.add_argument("--option", type=int, default=None,
+                    help="override query.option")
+    args = ap.parse_args(argv)
+
+    params = Params.from_yaml(args.config)
+    if args.option is not None:
+        params.query.option = args.option
+
+    from spatialflink_tpu.streams.sinks import StdoutSink
+    from spatialflink_tpu.streams.sources import FileReplaySource
+
+    spec = CASES.get(params.query.option)
+    if spec is None:
+        print(f"unknown queryOption {params.query.option}", file=sys.stderr)
+        return 2
+    if not args.input1 and spec.family not in ("synthetic",):
+        print("--input1 is required for this queryOption", file=sys.stderr)
+        return 2
+    if spec.family == "shapefile":
+        stream1 = args.input1
+    elif spec.family == "synthetic":
+        stream1 = []
+    else:
+        stream1 = FileReplaySource(args.input1, limit=args.limit)
+    stream2 = FileReplaySource(args.input2, limit=args.limit) if args.input2 else None
+
+    sink = StdoutSink()
+    n = 0
+    for result in run_option(params, stream1, stream2):
+        _emit(result, sink)
+        n += 1
+    print(f"# emitted {n} results", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -73,7 +73,8 @@ class PointTFilterQuery(SpatialOperator):
             for records in self._micro_batches(stream):
                 sel = [p for p in records if want(p)]
                 if sel:
-                    yield WindowResult(sel[0].timestamp, sel[-1].timestamp, sel)
+                    yield WindowResult(records[0].timestamp,
+                                       records[-1].timestamp, sel)
         else:
             for start, end, records in self._windows(stream):
                 sel = [p for p in records if want(p)]
@@ -98,8 +99,14 @@ class PointPolygonTRangeQuery(SpatialOperator, GeomQueryMixin):
         cell_mask[sorted(cells)] = True
         return gb, cell_mask
 
-    def _match_mask(self, records: List[Point], gb, cell_mask, ts_base: int,
-                    prune_cells: bool) -> np.ndarray:
+    def _cell_prefilter(self, records: List[Point], cell_mask) -> List[Point]:
+        """Real pruning, BEFORE the kernel runs (the reference filters the
+        stream by cell membership first, ``PointPolygonTRangeQuery.java:53-87``).
+        Safe: a point inside a polygon lies in the polygon's bbox, so its cell
+        is in the polygon's ``bbox_cells`` superset."""
+        return [p for p in records if p.cell >= 0 and cell_mask[p.cell]]
+
+    def _match_mask(self, records: List[Point], gb, ts_base: int) -> np.ndarray:
         """Per-record bool: inside any query polygon."""
         from spatialflink_tpu.ops.geom import points_in_geoms
 
@@ -107,32 +114,32 @@ class PointPolygonTRangeQuery(SpatialOperator, GeomQueryMixin):
         inside = np.asarray(
             points_in_geoms(batch.x, batch.y, gb.edges, gb.edge_mask)
         ) & np.asarray(gb.valid)[None, :]
-        mask = inside.any(axis=1) & np.asarray(batch.valid)
-        if prune_cells:
-            # realtime cell prefilter (tRange/PointPolygonTRangeQuery.java:53-87):
-            # only points in cells overlapped by some query polygon can match
-            pc = np.asarray(batch.cell)
-            mask &= (pc >= 0) & cell_mask[np.maximum(pc, 0)]
-        return mask
+        return inside.any(axis=1) & np.asarray(batch.valid)
 
     def run(self, stream: Iterable[Point], polygons: Sequence[Polygon]
             ) -> Iterator[WindowResult]:
         gb, cell_mask = self._prepare(polygons)
         if self.conf.query_type is QueryType.RealTime:
             for records in self._micro_batches(stream):
-                m = self._match_mask(records, gb, cell_mask,
-                                     records[0].timestamp, prune_cells=True)
-                sel = [records[i] for i in np.nonzero(m)[0] if i < len(records)]
+                cand = self._cell_prefilter(records, cell_mask)
+                if not cand:
+                    continue
+                m = self._match_mask(cand, gb, records[0].timestamp)
+                sel = [cand[i] for i in np.nonzero(m)[0] if i < len(cand)]
                 if sel:
-                    yield WindowResult(sel[0].timestamp, sel[-1].timestamp, sel)
+                    yield WindowResult(records[0].timestamp,
+                                       records[-1].timestamp, sel)
         else:
             # windowed: find matched trajectory ids, then emit those
             # trajectories' FULL window points as sub-trajectories
             # (tRange/PointPolygonTRangeQuery.java:90-177)
             for start, end, records in self._windows(stream):
-                m = self._match_mask(records, gb, cell_mask, start, prune_cells=True)
-                matched_ids = {records[i].obj_id
-                               for i in np.nonzero(m)[0] if i < len(records)}
+                cand = self._cell_prefilter(records, cell_mask)
+                matched_ids = set()
+                if cand:
+                    m = self._match_mask(cand, gb, start)
+                    matched_ids = {cand[i].obj_id
+                                   for i in np.nonzero(m)[0] if i < len(cand)}
                 sel = [p for p in records if p.obj_id in matched_ids]
                 yield WindowResult(
                     start, end, list(assemble_subtrajectories(sel).values()),
@@ -143,13 +150,13 @@ class PointPolygonTRangeQuery(SpatialOperator, GeomQueryMixin):
                   ) -> Iterator[WindowResult]:
         """Exhaustive twin: every polygon tested per point, no cell pruning
         (``tRange/TRangeQuery.java:33-63``)."""
-        gb, cell_mask = self._prepare(polygons)
+        gb, _cell_mask = self._prepare(polygons)
         for records in self._micro_batches(stream):
-            m = self._match_mask(records, gb, cell_mask, records[0].timestamp,
-                                 prune_cells=False)
+            m = self._match_mask(records, gb, records[0].timestamp)
             sel = [records[i] for i in np.nonzero(m)[0] if i < len(records)]
             if sel:
-                yield WindowResult(sel[0].timestamp, sel[-1].timestamp, sel)
+                yield WindowResult(records[0].timestamp,
+                                   records[-1].timestamp, sel)
 
 
 class PointTStatsQuery(SpatialOperator):
@@ -168,16 +175,22 @@ class PointTStatsQuery(SpatialOperator):
 
         if self.conf.query_type is QueryType.RealTime:
             store = TrajStateStore()
-            run_ts_base = None  # ONE base for the whole run: the carried
-            # state's last_ts offsets must stay comparable across batches
-            for records in self._micro_batches(stream):
+            # per-batch base, with carried last_ts offsets rebased between
+            # batches — offsets stay comparable AND bounded (no int32 wrap
+            # on unbounded runs). Batches spanning more event time than the
+            # device's int32-offset horizon are split host-side first.
+            ts_base = None
+            for records in self._split_by_span(self._micro_batches(stream)):
                 if allowed:
                     records = [p for p in records if p.obj_id in allowed]
                 if not records:
                     continue
-                if run_ts_base is None:
-                    run_ts_base = records[0].timestamp
-                tuples = self._update(store, records, run_ts_base)
+                if ts_base is None:
+                    ts_base = records[0].timestamp
+                elif records[0].timestamp != ts_base:
+                    store.rebase_ts(records[0].timestamp - ts_base)
+                    ts_base = records[0].timestamp
+                tuples = self._update(store, records, ts_base)
                 if tuples:
                     yield WindowResult(records[0].timestamp,
                                        records[-1].timestamp, tuples)
@@ -193,6 +206,22 @@ class PointTStatsQuery(SpatialOperator):
                     final[t[0]] = t
                 yield WindowResult(start, end, list(final.values()))
 
+    _SPAN_HORIZON_MS = 2**30  # device ts offsets are int32; stay well inside
+
+    def _split_by_span(self, batches) -> Iterator[List[Point]]:
+        for records in batches:
+            cur: List[Point] = []
+            base = None
+            for p in records:
+                if base is None:
+                    base = p.timestamp
+                elif abs(p.timestamp - base) > self._SPAN_HORIZON_MS:
+                    yield cur
+                    cur, base = [], p.timestamp
+                cur.append(p)
+            if cur:
+                yield cur
+
     def _update(self, store, records: List[Point], ts_base: int) -> List[Tuple]:
         from spatialflink_tpu.ops.trajectory import tstats_update
 
@@ -205,7 +234,7 @@ class PointTStatsQuery(SpatialOperator):
         tp = np.asarray(out.temporal)[emit]
         speed = np.asarray(out.speed)[emit]
         return [
-            (self.interner.lookup(int(o)), float(s), int(t), float(v))
+            (self.interner.lookup(int(o)), float(s), int(round(float(t))), float(v))
             for o, s, t, v in zip(oids, sp, tp, speed)
         ]
 
@@ -247,7 +276,11 @@ class PointTAggregateQuery(SpatialOperator):
                 yield WindowResult(start, end, [], extras={"heatmap": np.asarray(hm)})
 
     def _run_realtime(self, stream, agg, eviction_ms) -> Iterator[WindowResult]:
-        # host state: (cell, objID) -> [min_ts, max_ts, last_seen]
+        # host state: (cell, objID) -> [min_ts, max_ts, last_seen].
+        # Like the reference's MapState full-scan-per-output
+        # (TAggregateQuery.java:53-377), state grows with distinct
+        # (cell, trajectory) pairs unless eviction_ms > 0 bounds it —
+        # production streams should set trajDeletionThreshold.
         state: Dict[Tuple[int, str], List[int]] = {}
         for records in self._micro_batches(stream):
             latest = 0

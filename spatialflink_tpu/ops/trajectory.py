@@ -42,7 +42,9 @@ class TrajStatsState(NamedTuple):
     last_y: jnp.ndarray   # f32
     last_ts: jnp.ndarray  # i32; INT32_MIN = uninitialized
     spatial: jnp.ndarray  # f32 running spatial length (degrees)
-    temporal: jnp.ndarray # i32 running temporal length (ms)
+    temporal: jnp.ndarray # f32 running temporal length (ms); f32 so decade-
+                          # scale cumulative spans don't wrap int32 (precision
+                          # ~0.5s at year scale — speed is the consumer)
 
     @staticmethod
     def zeros(m: int) -> "TrajStatsState":
@@ -51,7 +53,7 @@ class TrajStatsState(NamedTuple):
             last_y=jnp.zeros(m, jnp.float32),
             last_ts=jnp.full(m, INT32_MIN, jnp.int32),
             spatial=jnp.zeros(m, jnp.float32),
-            temporal=jnp.zeros(m, jnp.int32),
+            temporal=jnp.zeros(m, jnp.float32),
         )
 
 
@@ -60,7 +62,7 @@ class TStatsOut(NamedTuple):
 
     obj_id: jnp.ndarray    # (N,) i32
     spatial: jnp.ndarray   # (N,) f32 running spatial length
-    temporal: jnp.ndarray  # (N,) i32 running temporal length
+    temporal: jnp.ndarray  # (N,) f32 running temporal length (ms)
     speed: jnp.ndarray     # (N,) f32 spatial/temporal
     emit: jnp.ndarray      # (N,) bool — reference emits only in-order,
                            # state-initialized tuples
@@ -120,18 +122,21 @@ def tstats_update(state: TrajStatsState, batch: PointBatch):
 
     emit = accepted & has_prev
     contrib_d = jnp.where(emit, D.pp_dist(px, py, x_s, y_s), 0.0)
-    contrib_t = jnp.where(emit, ts_s - pts, 0)
+    # time deltas in f32 computed from f32-cast operands: int32 subtraction
+    # could wrap for near-horizon gaps (rebased dormant state clamps near
+    # -2^30). f32 is exact while per-batch ts offsets stay < 2^24 ms (~4.6h
+    # micro-batch/window spans — far above practice).
+    contrib_t = jnp.where(
+        emit, ts_s.astype(jnp.float32) - pts.astype(jnp.float32), 0.0)
 
-    # running totals: carried base + within-run prefix sums. Note: the global
-    # i32 cumsum bounds total in-batch temporal contributions to < 2^31 ms
-    # (~24 days summed across the batch) — ample for any window/micro-batch.
+    # running totals: carried base + within-run prefix sums
     cd = jnp.cumsum(contrib_d)
-    ct = jnp.cumsum(contrib_t.astype(jnp.int32))
+    ct = jnp.cumsum(contrib_t)
     base_d = _propagate_run_value(cd - contrib_d, run_first)
     base_t = _propagate_run_value(ct - contrib_t, run_first)
     run_d = state.spatial[safe_oid] + (cd - base_d).astype(jnp.float32)
     run_t = state.temporal[safe_oid] + (ct - base_t)
-    speed = jnp.where(run_t > 0, run_d / run_t.astype(jnp.float32), 0.0)
+    speed = jnp.where(run_t > 0, run_d / run_t, 0.0)
 
     # ---- state scatter ------------------------------------------------- #
     seg = safe_oid

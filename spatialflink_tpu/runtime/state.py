@@ -67,6 +67,42 @@ class TrajStateStore:
         )
         self.capacity = new_cap
 
+    def rebase_ts(self, delta_ms: int) -> None:
+        """Shift carried ``last_ts`` offsets when the caller moves the batch
+        ``ts_base`` forward by ``delta_ms`` — keeps int32 offsets small over
+        an unbounded realtime run instead of wrapping after ~24.8 days.
+        Entries dormant beyond ~12.4 days clamp to a "very old" floor (any
+        new timestamp still compares newer; the next gap's temporal
+        contribution saturates at the floor); the uninitialized sentinel is
+        kept. The floor is -(2^30) rather than the int32 min so downstream
+        subtraction cannot wrap."""
+        if delta_ms == 0:
+            return
+        import jax.numpy as jnp
+
+        from spatialflink_tpu.ops.trajectory import INT32_MIN
+
+        # int32-safe saturating subtraction (int64 is unavailable without
+        # jax_enable_x64): thresholds are computed host-side so the device
+        # subtraction provably cannot wrap.
+        floor, imax = -(2**30), 2**31 - 1
+        lt = self.state.last_ts
+        if delta_ms >= 2**31:
+            shifted = jnp.full_like(lt, floor)
+        elif delta_ms <= -(2**31):
+            shifted = jnp.full_like(lt, imax)
+        elif delta_ms > 0:
+            thr = jnp.int32(floor + delta_ms)
+            shifted = jnp.where(lt < thr, jnp.int32(floor),
+                                lt - jnp.int32(delta_ms))
+        else:
+            thr = jnp.int32(imax + delta_ms)
+            shifted = jnp.where(lt > thr, jnp.int32(imax),
+                                lt - jnp.int32(delta_ms))
+        self.state = self.state._replace(
+            last_ts=jnp.where(lt != INT32_MIN, shifted, lt)
+        )
+
     def snapshot(self) -> CheckpointableState:
         cp = CheckpointableState()
         cp.meta["capacity"] = self.capacity

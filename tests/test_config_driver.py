@@ -1,0 +1,226 @@
+"""Config system + driver dispatch tests (reference: Params.java /
+StreamingJob.java switch)."""
+
+import io
+import json
+import textwrap
+
+import pytest
+
+from spatialflink_tpu.config import ConfigError, Params
+from spatialflink_tpu.driver import CASES, CaseSpec, main, run_option
+from spatialflink_tpu.index import UniformGrid
+from spatialflink_tpu.models import Point
+from spatialflink_tpu.operators import (
+    PointPointRangeQuery,
+    QueryConfiguration,
+    QueryType,
+    WindowResult,
+)
+from spatialflink_tpu.streams.formats import serialize_spatial
+from spatialflink_tpu.streams.sources import SyntheticPointSource
+
+CONF = "conf/spatialflink-conf.yml"
+
+
+# ------------------------------------------------------------------ config
+
+
+def test_sample_conf_loads():
+    p = Params.from_yaml(CONF)
+    assert p.query.option == 1
+    assert p.input1.format == "GeoJSON"
+    assert p.input1.grid_bbox == (115.5, 39.6, 117.6, 41.1)
+    assert p.window.interval_s == 10 and p.window.step_s == 5
+    g1, g2 = p.grids()
+    assert g1.n == 100 and g2.n == 100
+
+
+def test_reference_conf_compat(tmp_path):
+    """A reference-style file with the java type tag and TSV escapes loads."""
+    y = tmp_path / "ref.yml"
+    y.write_text(textwrap.dedent("""\
+        !!GeoFlink.utils.ConfigType
+        clusterMode: False
+        kafkaBootStrapServers: "localhost:9092"
+        inputStream1:
+          topicName: "t"
+          format: "CSV"
+          dateFormat: "yyyy-MM-dd HH:mm:ss"
+          csvTsvSchemaAttr: [1, 4, 5, 6]
+          gridBBox: [115.5, 39.6, 117.6, 41.1]
+          numGridCells: 50
+          cellLength: 0
+          delimiter: "\\\\t"
+        outputStream: {topicName: "o"}
+        query:
+          option: 51
+          radius: 0.05
+          k: 3
+          thresholds: {trajDeletion: 1000, outOfOrderTuples: 2}
+        window: {type: "TIME", interval: 5, step: 5}
+        """))
+    p = Params.from_yaml(str(y))
+    assert p.input1.delimiter == "\t"
+    assert p.input1.csv_tsv_schema == [1, 4, 5, 6]
+    assert p.input1.date_format == "%Y-%m-%d %H:%M:%S"
+    assert p.query.allowed_lateness_s == 2
+    # inputStream2 defaults to inputStream1
+    assert p.input2.topic_name == "t"
+
+
+@pytest.mark.parametrize("mutate,err_key", [
+    (lambda d: d["inputStream1"].pop("topicName"), "topicName"),
+    (lambda d: d["inputStream1"].update(format="SHP"), "format"),
+    (lambda d: d["inputStream1"].update(numGridCells=0, cellLength=0),
+     "numGridCells"),
+    (lambda d: d["query"].pop("option"), "option"),
+    (lambda d: d["window"].update(interval=0), "interval"),
+    (lambda d: d["query"].update(aggregateFunction="MODE"), "aggregateFunction"),
+])
+def test_validation_errors(mutate, err_key):
+    import yaml
+
+    with open(CONF) as f:
+        d = yaml.safe_load(f)
+    mutate(d)
+    with pytest.raises(ConfigError):
+        Params.from_dict(d)
+
+
+# ------------------------------------------------------------------ dispatch
+
+
+def test_case_table_shape():
+    # 9 pairs x {window, realtime} x {range, knn, join} = 54 core cases
+    core = [s for s in CASES.values()
+            if s.family in ("range", "knn", "join") and not s.latency]
+    assert len(core) == 54
+    assert CASES[1] == CaseSpec("range", "Point", "Point", "window")
+    assert CASES[42].family == "range" and CASES[42].mode == "realtime"
+    assert CASES[51].family == "knn" and CASES[91].query == "LineString"
+    assert CASES[141].family == "join"
+    assert CASES[8].latency and CASES[59].latency and CASES[108].latency
+    assert CASES[2030].naive and CASES[2090].naive and CASES[2011].naive
+    assert CASES[501].fmt == "WKT" and not CASES[501].timestamped
+    assert CASES[906].fmt == "TSV" and CASES[906].timestamped
+
+
+def _params(option: int, **qkw) -> Params:
+    p = Params.from_yaml(CONF)
+    p.query.option = option
+    for k, v in qkw.items():
+        setattr(p.query, k, v)
+    return p
+
+
+def _synth_lines(n_traj=8, steps=6):
+    grid = UniformGrid(115.5, 117.6, 39.6, 41.1, num_grid_partitions=100)
+    pts = list(SyntheticPointSource(grid, num_trajectories=n_traj,
+                                    steps=steps, seed=3))
+    return [serialize_spatial(p, "GeoJSON") for p in pts], pts, grid
+
+
+def test_option1_matches_direct_operator():
+    lines, pts, grid = _synth_lines()
+    p = _params(1, radius=0.5)
+    via_driver = list(run_option(p, lines))
+    conf = QueryConfiguration(QueryType.WindowBased, 10_000, 5_000)
+    direct = list(PointPointRangeQuery(conf, grid).run(
+        iter(pts), Point.create(116.5, 40.5, grid), 0.5))
+    assert len(via_driver) == len(direct) > 0
+    for a, b in zip(via_driver, direct):
+        assert a.window_start == b.window_start
+        assert sorted(r.obj_id for r in a.records) == \
+            sorted(r.obj_id for r in b.records)
+
+
+def test_option2_realtime():
+    lines, _, _ = _synth_lines()
+    out = list(run_option(_params(2, radius=0.5), lines))
+    assert out and all(isinstance(r, WindowResult) for r in out)
+
+
+def test_option51_knn():
+    lines, _, _ = _synth_lines()
+    out = list(run_option(_params(51, radius=0.0, k=3), lines))
+    assert out
+    for r in out:
+        assert r.extras["k"] == 3
+        assert len(r.records) <= 3
+        dists = [d for _, d in r.records]
+        assert dists == sorted(dists)
+
+
+def test_option101_join_needs_stream2():
+    lines, _, _ = _synth_lines()
+    with pytest.raises(ValueError):
+        list(run_option(_params(101), lines))
+    out = list(run_option(_params(101, radius=0.3), lines, lines[:12]))
+    assert any(r.records for r in out)
+
+
+def test_option8_latency_extras():
+    lines, _, _ = _synth_lines()
+    out = list(run_option(_params(8, radius=0.5), lines))
+    assert out
+    assert all("latency_ms" in r.extras for r in out)
+    assert all(l >= 0 for r in out for l in r.extras["latency_ms"])
+
+
+def test_trajectory_options():
+    lines, _, _ = _synth_lines()
+    # TStats realtime (205)
+    out = list(run_option(_params(205), lines))
+    assert out
+    # TFilter windowed (202) with an explicit id set
+    p = _params(202)
+    p.query.traj_ids = ["traj-0", "traj-1"]
+    out = list(run_option(p, lines))
+    ids = {r.obj_id for w in out for r in w.records}
+    assert ids and ids <= {"traj-0", "traj-1"}
+    # TKNN naive twin (2011) agrees with pruned (211) on result object ids
+    pruned = list(run_option(_params(211, radius=0.8, k=4), lines))
+    naive = list(run_option(_params(2011, radius=0.8, k=4), lines))
+    def flat(ws):
+        return sorted({rec[0] if isinstance(rec, tuple) else rec.obj_id
+                       for w in ws for rec in w.records})
+    assert flat(pruned) == flat(naive)
+
+
+def test_deser_roundtrip_options():
+    lines, pts, _ = _synth_lines(n_traj=2, steps=2)
+    # 701: GeoJSON trajectory round-trip
+    out = list(run_option(_params(701), lines))
+    assert len(out) == len(lines)
+    for obj, ser in out:
+        assert obj.obj_id.startswith("traj-")
+        assert json.loads(ser)["geometry"]["type"] == "Point"
+    # 501: WKT CSV point round-trip
+    wkt_lines = [serialize_spatial(p, "WKT") for p in pts]
+    out = list(run_option(_params(501), wkt_lines))
+    assert all("POINT" in ser for _, ser in out)
+
+
+def test_synthetic_harness_option99():
+    out = list(run_option(_params(99), []))
+    assert out
+
+
+def test_unknown_option():
+    with pytest.raises(ValueError):
+        list(run_option(_params(4999), []))
+
+
+# ------------------------------------------------------------------ CLI
+
+
+def test_cli_main(tmp_path, capsys):
+    lines, _, _ = _synth_lines(n_traj=4, steps=4)
+    inp = tmp_path / "in.jsonl"
+    inp.write_text("\n".join(lines) + "\n")
+    rc = main(["--config", CONF, "--input1", str(inp), "--option", "1"])
+    assert rc == 0
+    cap = capsys.readouterr()
+    assert "emitted" in cap.err
+    assert "window" in cap.out

@@ -119,6 +119,25 @@ class TestTStats:
         assert len(got) == len(want) == 2
         np.testing.assert_allclose(got[-1][1], want[-1][1], rtol=1e-4)
 
+    def test_long_horizon_no_int32_wrap(self):
+        """A run whose event time spans >> 2^31 ms (~24.8 days) must not wrap:
+        carried last_ts offsets are rebased per micro-batch and pathological
+        batch spans are split host-side."""
+        day = 86_400_000
+        # continuously active trajectory: one point every 12h for 90 days
+        n = 180
+        pts = [Point.create(116.0 + 0.001 * (i % 50), 40.0, GRID, "a",
+                            BASE + i * (day // 2))
+               for i in range(n + 1)]
+        op = PointTStatsQuery(realtime_conf(realtime_batch_size=16), GRID)
+        got = []
+        for res in op.run(iter(pts)):
+            got.extend(res.records)
+        # every in-order point after the first emits (nothing silently
+        # dropped to a wrapped offset), temporal length = the full span
+        assert len(got) == n
+        assert abs(got[-1][2] - 90 * day) <= 4096  # f32 accumulator rounding
+
     def test_state_carries_across_micro_batches(self):
         pts = [Point.create(116.0 + 0.01 * i, 40.0, GRID, "a", BASE + i * 1000)
                for i in range(10)]

@@ -1,0 +1,6 @@
+"""Applications built on the query layer (reference: ``GeoFlink/apps/``)."""
+
+from spatialflink_tpu.apps.stay_time import StayTime
+from spatialflink_tpu.apps.check_in import CheckIn, parse_checkin_csv
+
+__all__ = ["StayTime", "CheckIn", "parse_checkin_csv"]

@@ -1,0 +1,225 @@
+"""StayTime app: per-cell accumulated stay time of moving objects, normalized
+by sensor coverage (reference: ``apps/StayTime.java:32-485``).
+
+Pipeline parity:
+
+- :meth:`StayTime.cell_stay_time_tuples` ≙ ``CellStayTime`` stage 1
+  (``CellStayTimeWinFunction``, ``StayTime.java:227-396``): per trajectory,
+  per window, time-sorted consecutive point pairs split their time delta
+  across the grid cells traversed.
+- :meth:`StayTime.cell_stay_time` ≙ stage 2 per-cell window sum
+  (``CellStayTimeAggregateWinFunction``, ``StayTime.java:432-448``).
+- :meth:`StayTime.cell_sensor_range_intersection` ≙
+  ``CellSensorRangeIntersection`` (``StayTime.java:397-430``): per cell,
+  count of distinct timestamps whose sensor polygon intersects the cell
+  rectangle.
+- :meth:`StayTime.normalized_cell_stay_time` ≙ the windowed join
+  (``normalizedCellStayTimeWinFunction``, ``StayTime.java:113-212``):
+  ``((stay_ms/1000) / intersections) * window_size_s`` per cell.
+
+Cell-splitting rules for one consecutive pair (last → current), mirroring
+``StayTime.java:270-371``:
+
+- same cell: the whole delta goes to that cell;
+- same x-index: delta split equally across the inclusive y-range of cells;
+- same y-index: split equally across the inclusive x-range;
+- both differ: split equally across {last cell, current cell} ∪ cells of the
+  segment's bbox whose rectangle the segment geometrically intersects.
+
+The per-pair work is vectorized with numpy per window; this is app-layer
+aggregation over already-small per-trajectory groups, not a device kernel.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
+
+import numpy as np
+
+from spatialflink_tpu.index import UniformGrid
+from spatialflink_tpu.models import Point, Polygon
+from spatialflink_tpu.operators.base import (
+    QueryConfiguration,
+    SpatialOperator,
+    WindowResult,
+)
+
+
+def _segment_intersects_rect(x0, y0, x1, y1, rect) -> bool:
+    """Liang–Barsky clip test: does segment (x0,y0)-(x1,y1) hit the rect."""
+    rx0, ry0, rx1, ry1 = rect
+    dx, dy = x1 - x0, y1 - y0
+    t0, t1 = 0.0, 1.0
+    for p, q in ((-dx, x0 - rx0), (dx, rx1 - x0),
+                 (-dy, y0 - ry0), (dy, ry1 - y0)):
+        if p == 0:
+            if q < 0:
+                return False
+            continue
+        r = q / p
+        if p < 0:
+            if r > t1:
+                return False
+            t0 = max(t0, r)
+        else:
+            if r < t0:
+                return False
+            t1 = min(t1, r)
+    return t0 <= t1
+
+
+class StayTime(SpatialOperator):
+    """Windowed stay-time pipeline over a :class:`UniformGrid`."""
+
+    # ------------------------------------------------------------------ #
+    # stage 1: per-(objID, pair) stay-time shares
+
+    def _pair_shares(self, pts: List[Point]) -> Iterator[Tuple[int, int, int, float]]:
+        """-> (t0, t1, cell, share_ms) per traversed cell, for one
+        trajectory's time-sorted window points."""
+        g = self.grid
+        n = g.n
+        for prev, cur in zip(pts[:-1], pts[1:]):
+            dt = float(cur.timestamp - prev.timestamp)
+            c0, c1 = prev.cell, cur.cell
+            if c0 < 0 or c1 < 0:
+                continue
+            cx0, cy0 = divmod(c0, n)
+            cx1, cy1 = divmod(c1, n)
+            if c0 == c1:
+                cells = [c0]
+            elif cx0 == cx1:
+                lo, hi = min(cy0, cy1), max(cy0, cy1)
+                cells = [g.cell_id(cx0, i) for i in range(lo, hi + 1)]
+            elif cy0 == cy1:
+                lo, hi = min(cx0, cx1), max(cx0, cx1)
+                cells = [g.cell_id(i, cy0) for i in range(lo, hi + 1)]
+            else:
+                cand = g.bbox_cells(min(prev.x, cur.x), min(prev.y, cur.y),
+                                    max(prev.x, cur.x), max(prev.y, cur.y))
+                hit: Set[int] = {c0, c1}
+                for c in cand:
+                    if c in hit:
+                        continue
+                    if _segment_intersects_rect(prev.x, prev.y, cur.x, cur.y,
+                                                g.cell_bounds(c)):
+                        hit.add(c)
+                cells = sorted(hit)
+            share = dt / len(cells)
+            for c in cells:
+                yield (prev.timestamp, cur.timestamp, c, share)
+
+    def cell_stay_time_tuples(self, stream: Iterable[Point],
+                              traj_ids: Optional[Set[str]] = None
+                              ) -> Iterator[WindowResult]:
+        """Per window: (objID, t0, t1, cell, stay_share_ms) tuples
+        (``Tuple5``, ``StayTime.java:383-391``)."""
+        allowed = set(traj_ids or ())
+        for start, end, records in self._windows(stream):
+            by_obj: Dict[str, List[Point]] = defaultdict(list)
+            for p in records:
+                if not allowed or p.obj_id in allowed:
+                    by_obj[p.obj_id].append(p)
+            out = []
+            for oid, pts in by_obj.items():
+                pts.sort(key=lambda p: p.timestamp)
+                out.extend((oid, t0, t1, c, s)
+                           for t0, t1, c, s in self._pair_shares(pts))
+            yield WindowResult(start, end, out)
+
+    def cell_stay_time(self, stream: Iterable[Point],
+                       traj_ids: Optional[Set[str]] = None
+                       ) -> Iterator[WindowResult]:
+        """Per window: (cell, summed stay time ms) per touched cell."""
+        for res in self.cell_stay_time_tuples(stream, traj_ids):
+            sums: Dict[int, float] = defaultdict(float)
+            for _oid, _t0, _t1, cell, share in res.records:
+                sums[cell] += share
+            yield WindowResult(res.window_start, res.window_end,
+                               sorted(sums.items()))
+
+    # ------------------------------------------------------------------ #
+    # sensor coverage
+
+    def _polygon_intersects_rect(self, poly: Polygon, rect) -> bool:
+        rx0, ry0, rx1, ry1 = rect
+        bx0, by0, bx1, by1 = poly.bbox
+        if bx1 < rx0 or bx0 > rx1 or by1 < ry0 or by0 > ry1:
+            return False
+        shell = np.asarray(poly.rings[0], np.float64)
+        # vertex inside rect
+        if ((shell[:, 0] >= rx0) & (shell[:, 0] <= rx1)
+                & (shell[:, 1] >= ry0) & (shell[:, 1] <= ry1)).any():
+            return True
+        # any shell edge crosses the rect
+        for (x0, y0), (x1, y1) in zip(shell[:-1], shell[1:]):
+            if _segment_intersects_rect(x0, y0, x1, y1, rect):
+                return True
+        # rect fully inside polygon: ray-cast one corner against the shell
+        x, y = rx0, ry0
+        xs0, ys0 = shell[:-1, 0], shell[:-1, 1]
+        xs1, ys1 = shell[1:, 0], shell[1:, 1]
+        cond = (ys0 > y) != (ys1 > y)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            xint = xs0 + (y - ys0) / (ys1 - ys0) * (xs1 - xs0)
+        return bool((cond & (x < xint)).sum() % 2)
+
+    def cell_sensor_range_intersection(self, polygon_stream: Iterable[Polygon],
+                                       traj_ids: Optional[Set[str]] = None
+                                       ) -> Iterator[WindowResult]:
+        """Per window: (cell, number of distinct timestamps whose polygon
+        intersects the cell rectangle) (``StayTime.java:397-430``)."""
+        allowed = set(traj_ids or ())
+        for start, end, records in self._windows(polygon_stream):
+            ts_per_cell: Dict[int, Set[int]] = defaultdict(set)
+            for poly in records:
+                if allowed and poly.obj_id not in allowed:
+                    continue
+                for c in sorted(poly.cells):
+                    if self._polygon_intersects_rect(
+                            poly, self.grid.cell_bounds(c)):
+                        ts_per_cell[c].add(poly.timestamp)
+            yield WindowResult(
+                start, end,
+                sorted((c, len(ts)) for c, ts in ts_per_cell.items()))
+
+    # ------------------------------------------------------------------ #
+    # normalized join
+
+    def normalized_cell_stay_time(self, point_stream: Iterable[Point],
+                                  polygon_stream: Iterable[Polygon],
+                                  traj_ids_points: Optional[Set[str]] = None,
+                                  traj_ids_sensors: Optional[Set[str]] = None
+                                  ) -> Iterator[WindowResult]:
+        """Windowed cell join of stay time and sensor coverage:
+        ``((stay_ms/1000) / intersections) * window_size_s`` per cell
+        (``normalizedCellStayTimeWinFunction``, ``StayTime.java:195-212``).
+        Result records: (cell, win_start, win_end, normalized_stay_s)."""
+        window_size_s = self.conf.window_size_ms / 1000.0
+        # streaming two-pointer merge on window_start: both sides emit
+        # windows in nondecreasing start order, so state stays bounded and
+        # results flow as soon as both sides have sealed a window (the
+        # reference's windowed join, no full materialization)
+        sit = iter(self.cell_stay_time(point_stream, traj_ids_points))
+        cit = iter(self.cell_sensor_range_intersection(polygon_stream,
+                                                       traj_ids_sensors))
+        s = next(sit, None)
+        c = next(cit, None)
+        while s is not None and c is not None:
+            if s.window_start == c.window_start:
+                start = s.window_start
+                end = start + self.conf.window_size_ms
+                stay, cover = dict(s.records), dict(c.records)
+                out = [
+                    (cell, start, end,
+                     (stay[cell] / 1000.0) / cover[cell] * window_size_s)
+                    for cell in sorted(set(stay) & set(cover))
+                    if cover[cell] > 0
+                ]
+                yield WindowResult(start, end, out)
+                s, c = next(sit, None), next(cit, None)
+            elif s.window_start < c.window_start:
+                s = next(sit, None)
+            else:
+                c = next(cit, None)

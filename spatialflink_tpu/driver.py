@@ -52,6 +52,7 @@ class CaseSpec:
     naive: bool = False
     fmt: Optional[str] = None         # deser cases force a format
     timestamped: bool = False         # deser trajectory variants
+    delim: Optional[str] = None       # deser cases force a delimiter (TSV)
 
 
 def _build_cases() -> dict:
@@ -92,20 +93,26 @@ def _build_cases() -> dict:
     for base, fmt, ts in ((400, "GeoJSON", False), (500, "WKT", False),
                           (600, "WKT", False), (700, "GeoJSON", True),
                           (800, "WKT", True), (900, "WKT", True)):
-        delim_fmt = "TSV" if base in (600, 900) else "CSV"
+        # 600/900 families are the TAB-separated (TSV) variants
+        delim = "\t" if base in (600, 900) else None
         for j, t in enumerate(_types, start=1):
-            c[base + j] = CaseSpec("deser", t, fmt=fmt, timestamped=ts)
+            c[base + j] = CaseSpec("deser", t, fmt=fmt, timestamped=ts,
+                                   delim=delim)
         # x06: plain (non-WKT) CSV/TSV point rows
-        c[base + 6] = CaseSpec("deser", "Point", fmt=delim_fmt, timestamped=ts)
+        c[base + 6] = CaseSpec("deser", "Point",
+                               fmt="TSV" if delim else "CSV",
+                               timestamped=ts, delim=delim)
     # shapefile batch inputs (StreamingJob.java:1546-1569)
     c[1001] = CaseSpec("shapefile", "Point")
     c[1002] = CaseSpec("shapefile", "Polygon")
     c[1003] = CaseSpec("shapefile", "LineString")
     c[99] = CaseSpec("synthetic")
-    # apps
-    c[1010] = CaseSpec("staytime")
-    c[1011] = CaseSpec("staytime", latency=True)
-    c[1012] = CaseSpec("staytime", naive=True)  # sensor-intersection variant
+    # apps (StreamingJob.java:1619-1700): 1010 = CellStayTime over a point
+    # stream, 1011 = CellSensorRangeIntersection over a polygon stream,
+    # 1012 = normalizedCellStayTime over both
+    c[1010] = CaseSpec("staytime", "Point")
+    c[1011] = CaseSpec("staytime", "Polygon")
+    c[1012] = CaseSpec("staytime", "Point", "Polygon")
     c[2000] = CaseSpec("checkin")
     return c
 
@@ -137,9 +144,16 @@ def decode_stream(records: Iterable, cfg: StreamConfig, grid: UniformGrid
 
 def _query_conf(params: Params, spec: CaseSpec) -> QueryConfiguration:
     size_ms, step_ms = params.window_ms()
+    if spec.mode == "realtime":
+        qt = QueryType.RealTime
+    elif params.window.type == "COUNT":
+        # declared-but-unsupported, like the reference (QueryType.java:6;
+        # every operator's else-branch throws "Not yet support")
+        qt = QueryType.CountBased
+    else:
+        qt = QueryType.WindowBased
     return QueryConfiguration(
-        query_type=(QueryType.RealTime if spec.mode == "realtime"
-                    else QueryType.WindowBased),
+        query_type=qt,
         window_size_ms=size_ms,
         slide_ms=step_ms,
         allowed_lateness_ms=params.query.allowed_lateness_s * 1000,
@@ -240,23 +254,24 @@ def run_option(params: Params, stream1: Iterable, stream2: Optional[Iterable]
         from spatialflink_tpu.apps.stay_time import StayTime
 
         app = StayTime(conf, u_grid)
-        s1 = decode_stream(stream1, params.input1, u_grid)
-        if spec.naive:  # 1012: sensor-range intersection stage alone
+        traj_ids = set(params.query.traj_ids) or None
+        if spec.query == "Polygon":  # 1012: point stream + polygon stream
             if stream2 is None:
                 raise ValueError("queryOption 1012 needs a polygon stream2")
-            s2 = decode_stream(stream2, params.input2, q_grid)
-            return app.cell_sensor_range_intersection(s2)
-        if stream2 is not None:
+            s1 = decode_stream(stream1, params.input1, u_grid)
             s2 = decode_stream(stream2, params.input2, q_grid)
             return app.normalized_cell_stay_time(s1, s2)
-        return app.cell_stay_time(s1)
+        s1 = decode_stream(stream1, params.input1, u_grid)
+        if spec.stream == "Polygon":  # 1011: sensor-range intersection
+            return app.cell_sensor_range_intersection(s1, traj_ids)
+        return app.cell_stay_time(s1, traj_ids)
 
     if spec.family == "checkin":
         from spatialflink_tpu.apps.check_in import CheckIn
 
-        app = CheckIn(conf)
-        s1 = decode_stream(stream1, params.input1, u_grid)
-        return app.run(s1)
+        # raw DEIM CSV lines (eventID,deviceID,userID,ts,x,y) are parsed by
+        # the app itself; parsed Points pass through
+        return CheckIn(conf).run(stream1)
 
     raise AssertionError(f"unhandled family {spec.family}")
 
@@ -297,7 +312,7 @@ def _run_deser(params, spec, grid, stream1) -> Iterator:
     re-serialize — the reference's parse→print→produce conformance path
     (``StreamingJob.java:1289-1545``)."""
     fmt = spec.fmt
-    delim = "\t" if fmt == "TSV" else params.input1.delimiter or ","
+    delim = spec.delim or ("\t" if fmt == "TSV" else params.input1.delimiter or ",")
     for rec in stream1:
         obj = rec if isinstance(rec, SpatialObject) else parse_spatial(
             rec, fmt, grid,

@@ -122,12 +122,13 @@ def tstats_update(state: TrajStatsState, batch: PointBatch):
 
     emit = accepted & has_prev
     contrib_d = jnp.where(emit, D.pp_dist(px, py, x_s, y_s), 0.0)
-    # time deltas in f32 computed from f32-cast operands: int32 subtraction
-    # could wrap for near-horizon gaps (rebased dormant state clamps near
-    # -2^30). f32 is exact while per-batch ts offsets stay < 2^24 ms (~4.6h
-    # micro-batch/window spans — far above practice).
-    contrib_t = jnp.where(
-        emit, ts_s.astype(jnp.float32) - pts.astype(jnp.float32), 0.0)
+    # time deltas: exact int32 subtraction, then f32 for accumulation. The
+    # subtraction cannot wrap because batch offsets are split host-side to
+    # |off| <= 2^30 and rebased dormant state clamps at -(2^30)+1 (operator
+    # invariants), so |ts_s - pts| < 2^31. The f32 cast is exact below
+    # 2^24 ms (~4.6h gaps); beyond that the delta rounds by <= 128 ms —
+    # negligible against such gaps.
+    contrib_t = jnp.where(emit, (ts_s - pts).astype(jnp.float32), 0.0)
 
     # running totals: carried base + within-run prefix sums
     cd = jnp.cumsum(contrib_d)

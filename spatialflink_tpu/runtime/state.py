@@ -85,7 +85,10 @@ class TrajStateStore:
         # int32-safe saturating subtraction (int64 is unavailable without
         # jax_enable_x64): thresholds are computed host-side so the device
         # subtraction provably cannot wrap.
-        floor, imax = -(2**30), 2**31 - 1
+        # floor at -(2^30)+1: together with the operators' 2^30 batch-span
+        # cap, |ts - last_ts| stays < 2^31 so the kernel's int32 delta is
+        # exact (see ops.trajectory.tstats_update)
+        floor, imax = -(2**30) + 1, 2**31 - 1
         lt = self.state.last_ts
         if delta_ms >= 2**31:
             shifted = jnp.full_like(lt, floor)

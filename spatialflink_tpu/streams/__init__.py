@@ -14,6 +14,7 @@ from spatialflink_tpu.streams.sources import (
     kafka_source,
 )
 from spatialflink_tpu.streams.sinks import CollectSink, FileSink, LatencySink, StdoutSink
+from spatialflink_tpu.streams.shapefile import iter_shapefile, read_shapefile
 
 __all__ = [
     "parse_spatial",
@@ -26,4 +27,6 @@ __all__ = [
     "FileSink",
     "LatencySink",
     "StdoutSink",
+    "iter_shapefile",
+    "read_shapefile",
 ]

@@ -1,0 +1,231 @@
+"""App tests: StayTime (apps/StayTime.java) and CheckIn (apps/CheckIn.java)."""
+
+import numpy as np
+import pytest
+
+from spatialflink_tpu.apps import CheckIn, StayTime, parse_checkin_csv
+from spatialflink_tpu.index import UniformGrid
+from spatialflink_tpu.models import Point, Polygon
+from spatialflink_tpu.operators import QueryConfiguration, QueryType
+
+# 10x10 unit cells over [0,10]^2
+GRID = UniformGrid(0.0, 10.0, 0.0, 10.0, num_grid_partitions=10)
+BASE = 1_700_000_000_000
+WIN = QueryConfiguration(QueryType.WindowBased, window_size_ms=10_000,
+                         slide_ms=10_000)
+
+
+def pt(x, y, oid, t_off_ms):
+    return Point.create(x, y, GRID, obj_id=oid, timestamp=BASE + t_off_ms)
+
+
+class TestCellStayTime:
+    def test_same_cell_pair(self):
+        # both points in cell (0,0): full 2s to that cell
+        app = StayTime(WIN, GRID)
+        res = list(app.cell_stay_time(iter([pt(0.5, 0.5, "a", 0),
+                                            pt(0.7, 0.7, "a", 2000)])))
+        assert len(res) == 1
+        assert res[0].records == [(GRID.cell_id(0, 0), 2000.0)]
+
+    def test_same_x_index_splits_y_range(self):
+        # (0.5,0.5) -> (0.5,3.5): 4 cells on the y-path share 4s equally
+        app = StayTime(WIN, GRID)
+        res = list(app.cell_stay_time(iter([pt(0.5, 0.5, "a", 0),
+                                            pt(0.5, 3.5, "a", 4000)])))
+        cells = dict(res[0].records)
+        assert set(cells) == {GRID.cell_id(0, i) for i in range(4)}
+        assert all(v == pytest.approx(1000.0) for v in cells.values())
+
+    def test_diagonal_splits_by_segment_intersection(self):
+        # (0.5,0.5) -> (2.5,1.5): crosses cells (0,0),(1,0),(1,1),(2,1)
+        # (avoids exact corner touches, where intersection is inclusive like
+        # JTS intersects in the reference)
+        app = StayTime(WIN, GRID)
+        res = list(app.cell_stay_time(iter([pt(0.5, 0.5, "a", 0),
+                                            pt(2.5, 1.5, "a", 4000)])))
+        cells = dict(res[0].records)
+        assert set(cells) == {GRID.cell_id(0, 0), GRID.cell_id(1, 0),
+                              GRID.cell_id(1, 1), GRID.cell_id(2, 1)}
+        assert sum(cells.values()) == pytest.approx(4000.0)
+
+    def test_total_time_is_conserved(self):
+        rng = np.random.default_rng(0)
+        pts = [pt(float(x), float(y), "a", i * 1000)
+               for i, (x, y) in enumerate(rng.uniform(0.2, 9.8, (20, 2)))]
+        app = StayTime(WIN, GRID)
+        res = list(app.cell_stay_time_tuples(iter(pts)))
+        for r in res:
+            by_pair = {}
+            for _oid, t0, t1, _c, share in r.records:
+                by_pair.setdefault((t0, t1), 0.0)
+                by_pair[(t0, t1)] += share
+            for (t0, t1), total in by_pair.items():
+                assert total == pytest.approx(t1 - t0)
+
+    def test_multiple_trajectories_grouped(self):
+        app = StayTime(WIN, GRID)
+        # arrival in event-time order (late records past the watermark are
+        # dropped, like the reference's bounded out-of-orderness)
+        res = list(app.cell_stay_time_tuples(iter([
+            pt(0.5, 0.5, "a", 0), pt(5.5, 5.5, "b", 0),
+            pt(0.6, 0.6, "a", 1000), pt(5.6, 5.6, "b", 3000),
+        ])))
+        oids = {t[0] for t in res[0].records}
+        assert oids == {"a", "b"}
+
+
+class TestSensorIntersection:
+    def test_counts_distinct_timestamps(self):
+        # one sensor polygon covering cells around (1,1), seen at 2 distinct ts
+        ring = [(0.6, 0.6), (1.9, 0.6), (1.9, 1.9), (0.6, 1.9), (0.6, 0.6)]
+        polys = [
+            Polygon.create([ring], GRID, obj_id="s1", timestamp=BASE + 1000),
+            Polygon.create([ring], GRID, obj_id="s1", timestamp=BASE + 2000),
+            Polygon.create([ring], GRID, obj_id="s2", timestamp=BASE + 2000),
+        ]
+        app = StayTime(WIN, GRID)
+        res = list(app.cell_sensor_range_intersection(iter(polys)))
+        counts = dict(res[0].records)
+        # polygon spans cells (0..1, 0..1); distinct timestamps = 2
+        assert counts[GRID.cell_id(0, 0)] == 2
+        assert counts[GRID.cell_id(1, 1)] == 2
+
+    def test_non_intersecting_cell_excluded(self):
+        # thin L-shaped polygon whose bbox covers (0..1,0..1) but which
+        # misses cell (1,1) entirely
+        ring = [(0.1, 0.1), (1.9, 0.1), (1.9, 0.2), (0.2, 0.2),
+                (0.2, 1.9), (0.1, 1.9), (0.1, 0.1)]
+        poly = Polygon.create([ring], GRID, obj_id="s", timestamp=BASE)
+        app = StayTime(WIN, GRID)
+        res = list(app.cell_sensor_range_intersection(iter([poly])))
+        counts = dict(res[0].records)
+        assert GRID.cell_id(0, 0) in counts
+        assert GRID.cell_id(1, 1) not in counts
+
+
+class TestNormalized:
+    def test_join_normalizes(self):
+        pts = [pt(0.5, 0.5, "a", 0), pt(0.7, 0.7, "a", 4000)]
+        ring = [(0.0, 0.0), (1.0, 0.0), (1.0, 1.0), (0.0, 1.0), (0.0, 0.0)]
+        polys = [Polygon.create([ring], GRID, obj_id="s", timestamp=BASE + 1000)]
+        app = StayTime(WIN, GRID)
+        res = list(app.normalized_cell_stay_time(iter(pts), iter(polys)))
+        assert len(res) == 1
+        (cell, start, end, norm), = res[0].records
+        assert cell == GRID.cell_id(0, 0)
+        # ((4000ms/1000)/1 intersection) * 10s window = 40
+        assert norm == pytest.approx(40.0)
+
+
+def ev(event, device, user, t_off):
+    return Point(obj_id=user, timestamp=BASE + t_off, x=0.0, y=0.0,
+                 event_id=event, device_id=device, user_id=user)
+
+
+class TestCheckIn:
+    def test_occupancy_counting(self):
+        events = [
+            ev("e1", "room1-in", "u1", 0),
+            ev("e2", "room1-in", "u2", 1000),
+            ev("e3", "room1-out", "u1", 2000),
+        ]
+        app = CheckIn(WIN, room_capacities={"room1": 10})
+        out = list(app.run(iter(events)))
+        assert [(r, c) for r, _cap, c, _ts in out] == \
+            [("room1", 1), ("room1", 2), ("room1", 1)]
+        assert all(cap == 10 for _r, cap, _c, _ts in out)
+
+    def test_missing_out_event_synthesized(self):
+        # u1 checks into room1 twice in a row: a synthetic out at the
+        # midpoint is inserted (CheckIn.java:283-307)
+        events = [
+            ev("e1", "room1-in", "u1", 0),
+            ev("e2", "room1-in", "u1", 10_000),
+        ]
+        app = CheckIn(WIN)
+        repaired = list(app.insert_missing_events(iter(events)))
+        assert [p.device_id for p in repaired] == \
+            ["room1-in", "room1-out", "room1-in"]
+        assert repaired[1].timestamp == BASE + 5_000
+        # occupancy never exceeds 1
+        occ = [c for _r, _cap, c, _ts in CheckIn(WIN).run(iter(events))]
+        assert occ == [1, 0, 1]
+
+    def test_missing_in_event_synthesized(self):
+        events = [
+            ev("e1", "room1-out", "u1", 0),
+            ev("e2", "room1-out", "u1", 2000),
+        ]
+        app = CheckIn(WIN)
+        repaired = list(app.insert_missing_events(iter(events)))
+        assert [p.device_id for p in repaired] == \
+            ["room1-out", "room1-in", "room1-out"]
+
+    def test_csv_parsing(self):
+        p = parse_checkin_csv("e7,roomA-in,user9,1700000000000,1.5,2.5")
+        assert p.device_id == "roomA-in" and p.user_id == "user9"
+        assert p.x == 1.5 and p.timestamp == 1700000000000
+
+    def test_driver_option_2000(self):
+        from spatialflink_tpu.config import Params
+        from spatialflink_tpu.driver import run_option
+
+        params = Params.from_yaml("conf/spatialflink-conf.yml")
+        params.query.option = 2000
+        lines = [
+            "e1,room1-in,u1,1700000000000,0,0",
+            "e2,room1-out,u1,1700000001000,0,0",
+        ]
+        out = list(run_option(params, lines))
+        assert [c for _r, _cap, c, _ts in out] == [1, 0]
+
+
+class TestDriverStayTime:
+    def _params(self, option):
+        from spatialflink_tpu.config import Params
+
+        params = Params.from_yaml("conf/spatialflink-conf.yml")
+        params.input1.grid_bbox = (0.0, 0.0, 10.0, 10.0)
+        params.input2.grid_bbox = (0.0, 0.0, 10.0, 10.0)
+        params.query.option = option
+        params.query.traj_ids = []
+        return params
+
+    def test_option_1010_cell_stay_time(self):
+        from spatialflink_tpu.driver import run_option
+        from spatialflink_tpu.streams.formats import serialize_spatial
+
+        lines = [serialize_spatial(p, "GeoJSON")
+                 for p in [pt(0.5, 0.5, "a", 0), pt(0.7, 0.7, "a", 2000)]]
+        out = list(run_option(self._params(1010), lines))
+        # total stay time is conserved across the traversed cells in any
+        # window containing both points (conf grid: 100 cells -> the pair
+        # spans several cells)
+        assert out
+        assert sum(s for _c, s in out[0].records) == pytest.approx(2000.0)
+
+    def test_option_1011_sensor_intersection(self):
+        from spatialflink_tpu.driver import run_option
+        from spatialflink_tpu.streams.formats import serialize_spatial
+
+        ring = [(0.5, 0.5), (1.5, 0.5), (1.5, 1.5), (0.5, 1.5), (0.5, 0.5)]
+        poly = Polygon.create([ring], GRID, obj_id="s", timestamp=BASE)
+        out = list(run_option(self._params(1011),
+                              [serialize_spatial(poly, "GeoJSON")]))
+        assert out and out[0].records  # (cell, count) tuples
+        assert all(cnt == 1 for _c, cnt in out[0].records)
+
+    def test_option_1012_normalized_needs_stream2(self):
+        from spatialflink_tpu.driver import run_option
+        from spatialflink_tpu.streams.formats import serialize_spatial
+
+        lines = [serialize_spatial(p, "GeoJSON")
+                 for p in [pt(0.5, 0.5, "a", 0), pt(0.7, 0.7, "a", 2000)]]
+        with pytest.raises(ValueError):
+            list(run_option(self._params(1012), lines))
+        ring = [(0.0, 0.0), (1.0, 0.0), (1.0, 1.0), (0.0, 1.0), (0.0, 0.0)]
+        poly = Polygon.create([ring], GRID, obj_id="s", timestamp=BASE + 500)
+        out = list(run_option(self._params(1012), lines,
+                              [serialize_spatial(poly, "GeoJSON")]))
+        assert out and all(len(r.records[0]) == 4 for r in out if r.records)

@@ -202,6 +202,27 @@ def test_deser_roundtrip_options():
     assert all("POINT" in ser for _, ser in out)
 
 
+def test_tsv_wkt_deser_uses_tab():
+    """Options 601-605/901-905 are the TAB-separated WKT families: prefix
+    fields must split on TAB regardless of the configured delimiter."""
+    line = "obj7\t1700000000000\tPOINT (116.5 40.5)"
+    out = list(run_option(_params(901), [line]))
+    (obj, ser), = out
+    assert obj.obj_id == "obj7"
+    assert obj.timestamp == 1700000000000
+    assert CASES[601].delim == "\t" and CASES[501].delim is None
+
+
+def test_count_window_type_raises_like_reference():
+    """window.type COUNT maps to the declared-but-unsupported CountBased
+    query type (QueryType.java:6) and raises, not silently TIME windows."""
+    p = _params(1)
+    p.window.type = "COUNT"
+    lines, _, _ = _synth_lines(n_traj=2, steps=2)
+    with pytest.raises(NotImplementedError):
+        list(run_option(p, lines))
+
+
 def test_synthetic_harness_option99():
     out = list(run_option(_params(99), []))
     assert out

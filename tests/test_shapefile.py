@@ -1,0 +1,113 @@
+"""Shapefile reader tests (reference: ShapeFileInputFormat.java).
+
+The test synthesizes well-formed .shp bytes directly from the ESRI spec:
+big-endian file/record headers, little-endian shape payloads.
+"""
+
+import struct
+
+import numpy as np
+import pytest
+
+from spatialflink_tpu.index import UniformGrid
+from spatialflink_tpu.models import MultiLineString, Point, Polygon
+from spatialflink_tpu.streams.shapefile import (
+    FILE_CODE,
+    ShapefileError,
+    read_shapefile,
+)
+
+GRID = UniformGrid(0.0, 10.0, 0.0, 10.0, num_grid_partitions=10)
+
+
+def _point_payload(x, y):
+    return struct.pack("<i", 1) + struct.pack("<dd", x, y)
+
+
+def _poly_payload(shape_type, parts):
+    """Polygon(5)/PolyLine(3) payload from a list of coord-lists."""
+    num_points = sum(len(p) for p in parts)
+    out = struct.pack("<i", shape_type)
+    out += struct.pack("<dddd", 0, 0, 0, 0)  # bbox (unused by reader)
+    out += struct.pack("<ii", len(parts), num_points)
+    start = 0
+    for p in parts:
+        out += struct.pack("<i", start)
+        start += len(p)
+    for p in parts:
+        for x, y in p:
+            out += struct.pack("<dd", x, y)
+    return out
+
+
+def _build_shp(payloads, file_code=FILE_CODE):
+    records = b""
+    for i, payload in enumerate(payloads, start=1):
+        records += struct.pack(">ii", i, len(payload) // 2) + payload
+    total = 100 + len(records)
+    header = struct.pack(">i", file_code) + b"\x00" * 20
+    header += struct.pack(">i", total // 2)
+    header += b"\x00" * (100 - len(header))
+    return header + records
+
+
+@pytest.fixture()
+def shp_path(tmp_path):
+    ring = [(1.0, 1.0), (4.0, 1.0), (4.0, 4.0), (1.0, 4.0), (1.0, 1.0)]
+    hole = [(2.0, 2.0), (3.0, 2.0), (3.0, 3.0), (2.0, 3.0), (2.0, 2.0)]
+    line_a = [(0.0, 0.0), (5.0, 5.0), (9.0, 5.0)]
+    line_b = [(6.0, 6.0), (7.0, 8.0)]
+    payloads = [
+        _point_payload(2.5, 7.5),
+        _poly_payload(5, [ring, hole]),
+        _poly_payload(3, [line_a, line_b]),
+        struct.pack("<i", 0),               # null shape: skipped silently
+        struct.pack("<i", 8) + b"\x00" * 40,  # multipoint: unsupported
+    ]
+    p = tmp_path / "test.shp"
+    p.write_bytes(_build_shp(payloads))
+    return str(p)
+
+
+def test_reads_all_supported_types(shp_path, capsys):
+    objs = read_shapefile(shp_path, GRID)
+    assert len(objs) == 3
+    pt, poly, mls = objs
+    assert isinstance(pt, Point) and (pt.x, pt.y) == (2.5, 7.5)
+    assert pt.cell >= 0  # grid assignment happened
+    assert isinstance(poly, Polygon)
+    assert len(poly.rings) == 2  # shell + hole, split via Parts array
+    assert poly.bbox == (1.0, 1.0, 4.0, 4.0)
+    assert isinstance(mls, MultiLineString)
+    assert [len(l.coords_list) for l in mls.lines] == [3, 2]
+    assert "Unsupported shape type [8]" in capsys.readouterr().err
+
+
+def test_record_ids_are_record_numbers(shp_path):
+    objs = read_shapefile(shp_path, GRID)
+    assert [o.obj_id for o in objs] == ["1", "2", "3"]
+
+
+def test_rejects_non_shapefile(tmp_path):
+    p = tmp_path / "bad.shp"
+    p.write_bytes(_build_shp([], file_code=1234))
+    with pytest.raises(ShapefileError, match="not a shapefile"):
+        read_shapefile(str(p))
+
+
+def test_truncated_header(tmp_path):
+    p = tmp_path / "trunc.shp"
+    p.write_bytes(b"\x00" * 50)
+    with pytest.raises(ShapefileError, match="truncated header"):
+        read_shapefile(str(p))
+
+
+def test_driver_option_1001(shp_path):
+    from spatialflink_tpu.config import Params
+    from spatialflink_tpu.driver import run_option
+
+    params = Params.from_yaml("conf/spatialflink-conf.yml")
+    params.input1.grid_bbox = (0.0, 0.0, 10.0, 10.0)
+    params.query.option = 1001
+    objs = list(run_option(params, shp_path))
+    assert len(objs) == 3

@@ -50,9 +50,9 @@ class CheckIn(SpatialOperator):
 
     def __init__(self, conf: QueryConfiguration, grid=None,
                  room_capacities: Optional[Dict[str, int]] = None):
-        # SpatialOperator wants a grid; CheckIn never touches it
-        self.conf = conf
-        self.grid = grid
+        # grid is unused by CheckIn but the base init keeps the shared
+        # config checks (e.g. CountBased rejection) consistent
+        super().__init__(conf, grid)
         self.room_capacities = dict(room_capacities or {})
 
     # ------------------------------------------------------------------ #

@@ -143,27 +143,36 @@ class StayTime(SpatialOperator):
     # sensor coverage
 
     def _polygon_intersects_rect(self, poly: Polygon, rect) -> bool:
+        """Rect–polygon intersection honoring interior rings: a rect lying
+        strictly inside a hole does NOT intersect (JTS semantics in the
+        reference's ``cellPoly.intersects(p.polygon)``)."""
         rx0, ry0, rx1, ry1 = rect
         bx0, by0, bx1, by1 = poly.bbox
         if bx1 < rx0 or bx0 > rx1 or by1 < ry0 or by0 > ry1:
             return False
-        shell = np.asarray(poly.rings[0], np.float64)
-        # vertex inside rect
-        if ((shell[:, 0] >= rx0) & (shell[:, 0] <= rx1)
-                & (shell[:, 1] >= ry0) & (shell[:, 1] <= ry1)).any():
-            return True
-        # any shell edge crosses the rect
-        for (x0, y0), (x1, y1) in zip(shell[:-1], shell[1:]):
-            if _segment_intersects_rect(x0, y0, x1, y1, rect):
+        rings = [np.asarray(r, np.float64) for r in poly.rings]
+        for ring in rings:
+            # any ring vertex inside the rect → boundary overlaps the rect
+            if ((ring[:, 0] >= rx0) & (ring[:, 0] <= rx1)
+                    & (ring[:, 1] >= ry0) & (ring[:, 1] <= ry1)).any():
                 return True
-        # rect fully inside polygon: ray-cast one corner against the shell
+            # any ring edge (shell OR hole boundary) crossing the rect
+            for (x0, y0), (x1, y1) in zip(ring[:-1], ring[1:]):
+                if _segment_intersects_rect(x0, y0, x1, y1, rect):
+                    return True
+        # no boundary contact: the rect is entirely inside polygon material,
+        # inside a hole, or outside. Even-odd ray cast over ALL rings
+        # classifies one corner (holes flip parity back to outside).
         x, y = rx0, ry0
-        xs0, ys0 = shell[:-1, 0], shell[:-1, 1]
-        xs1, ys1 = shell[1:, 0], shell[1:, 1]
-        cond = (ys0 > y) != (ys1 > y)
-        with np.errstate(divide="ignore", invalid="ignore"):
-            xint = xs0 + (y - ys0) / (ys1 - ys0) * (xs1 - xs0)
-        return bool((cond & (x < xint)).sum() % 2)
+        crossings = 0
+        for ring in rings:
+            xs0, ys0 = ring[:-1, 0], ring[:-1, 1]
+            xs1, ys1 = ring[1:, 0], ring[1:, 1]
+            cond = (ys0 > y) != (ys1 > y)
+            with np.errstate(divide="ignore", invalid="ignore"):
+                xint = xs0 + (y - ys0) / (ys1 - ys0) * (xs1 - xs0)
+            crossings += int((cond & (x < xint)).sum())
+        return bool(crossings % 2)
 
     def cell_sensor_range_intersection(self, polygon_stream: Iterable[Polygon],
                                        traj_ids: Optional[Set[str]] = None

@@ -127,8 +127,13 @@ CASES = _build_cases()
 def decode_stream(records: Iterable, cfg: StreamConfig, grid: UniformGrid
                   ) -> Iterator[SpatialObject]:
     """Raw lines/dicts → spatial objects; already-parsed objects pass through
-    (the reference's per-case ``Deserialization.*Stream`` stage)."""
-    for rec in records:
+    (the reference's per-case ``Deserialization.*Stream`` stage). Marks the
+    ingest throughput meter and honors the control-tuple stop hook
+    (``HelperClass.checkExitControlTuple``)."""
+    from spatialflink_tpu.utils.metrics import REGISTRY, metered
+
+    meter = REGISTRY.meter("ingest-throughput")
+    for rec in metered(records, meter, control_check=True):
         if isinstance(rec, SpatialObject):
             yield rec
             continue
@@ -259,8 +264,11 @@ def run_option(params: Params, stream1: Iterable, stream2: Optional[Iterable]
             if stream2 is None:
                 raise ValueError("queryOption 1012 needs a polygon stream2")
             s1 = decode_stream(stream1, params.input1, u_grid)
-            s2 = decode_stream(stream2, params.input2, q_grid)
-            return app.normalized_cell_stay_time(s1, s2)
+            # both sides must live in the app's grid (the reference passes
+            # ONE uGrid to normalizedCellStayTime, StreamingJob.java:1667)
+            s2 = decode_stream(stream2, params.input2, u_grid)
+            return app.normalized_cell_stay_time(
+                s1, s2, traj_ids_points=traj_ids, traj_ids_sensors=traj_ids)
         s1 = decode_stream(stream1, params.input1, u_grid)
         if spec.stream == "Polygon":  # 1011: sensor-range intersection
             return app.cell_sensor_range_intersection(s1, traj_ids)
@@ -365,6 +373,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="max records to read per stream")
     ap.add_argument("--option", type=int, default=None,
                     help="override query.option")
+    ap.add_argument("--metrics", action="store_true",
+                    help="print a metrics snapshot to stderr at exit")
     args = ap.parse_args(argv)
 
     params = Params.from_yaml(args.config)
@@ -395,6 +405,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         _emit(result, sink)
         n += 1
     print(f"# emitted {n} results", file=sys.stderr)
+    if args.metrics:
+        from spatialflink_tpu.utils.metrics import REGISTRY
+
+        print(f"# metrics: {REGISTRY.snapshot()}", file=sys.stderr)
     return 0
 
 

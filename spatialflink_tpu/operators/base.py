@@ -98,8 +98,14 @@ class SpatialOperator:
 
     def _drive(self, stream: Iterable, eval_batch) -> Iterator["WindowResult"]:
         """Shared window/realtime driver: eval_batch(records, ts_base) -> list."""
+        from spatialflink_tpu.utils.metrics import REGISTRY
+
+        batches = REGISTRY.counter("batches-evaluated")
+        records_c = REGISTRY.counter("records-evaluated")
         if self.conf.query_type is QueryType.RealTime:
             for records in self._micro_batches(stream):
+                batches.inc()
+                records_c.inc(len(records))
                 sel = eval_batch(records, records[0].timestamp if records else 0)
                 if sel:
                     # one convention for every operator: the result bounds are
@@ -108,6 +114,8 @@ class SpatialOperator:
                                        records[-1].timestamp, sel)
         else:
             for start, end, records in self._windows(stream):
+                batches.inc()
+                records_c.inc(len(records))
                 yield WindowResult(start, end, eval_batch(records, start))
 
 

@@ -2,5 +2,29 @@
 
 from spatialflink_tpu.utils.padding import bucket_size, pad_to
 from spatialflink_tpu.utils.interner import IdInterner
+from spatialflink_tpu.utils.metrics import (
+    REGISTRY,
+    ControlTupleExit,
+    Counter,
+    Meter,
+    MetricsRegistry,
+    check_exit_control_tuple,
+    metered,
+    profile_to,
+    trace,
+)
 
-__all__ = ["bucket_size", "pad_to", "IdInterner"]
+__all__ = [
+    "bucket_size",
+    "pad_to",
+    "IdInterner",
+    "REGISTRY",
+    "ControlTupleExit",
+    "Counter",
+    "Meter",
+    "MetricsRegistry",
+    "check_exit_control_tuple",
+    "metered",
+    "profile_to",
+    "trace",
+]

@@ -91,6 +91,19 @@ class TestSensorIntersection:
         assert counts[GRID.cell_id(0, 0)] == 2
         assert counts[GRID.cell_id(1, 1)] == 2
 
+    def test_cell_inside_hole_not_covered(self):
+        # donut sensor: shell spans cells (0..2)^2, hole covers cell (1,1)
+        # entirely -> (1,1) must NOT count as covered (JTS semantics)
+        shell = [(0.1, 0.1), (2.9, 0.1), (2.9, 2.9), (0.1, 2.9), (0.1, 0.1)]
+        hole = [(0.95, 0.95), (2.05, 0.95), (2.05, 2.05), (0.95, 2.05),
+                (0.95, 0.95)]
+        poly = Polygon.create([shell, hole], GRID, obj_id="s", timestamp=BASE)
+        app = StayTime(WIN, GRID)
+        res = list(app.cell_sensor_range_intersection(iter([poly])))
+        counts = dict(res[0].records)
+        assert GRID.cell_id(0, 0) in counts
+        assert GRID.cell_id(1, 1) not in counts
+
     def test_non_intersecting_cell_excluded(self):
         # thin L-shaped polygon whose bbox covers (0..1,0..1) but which
         # misses cell (1,1) entirely

@@ -1,0 +1,121 @@
+"""Metrics/observability tests (reference: Flink metric wrappers in
+Point.java:220-253, control tuple in HelperClass.java:441-453)."""
+
+import json
+
+import pytest
+
+from spatialflink_tpu.utils.metrics import (
+    REGISTRY,
+    ControlTupleExit,
+    Counter,
+    Meter,
+    MetricsRegistry,
+    check_exit_control_tuple,
+    metered,
+    trace,
+)
+
+
+class TestCounterMeter:
+    def test_counter(self):
+        c = Counter("x")
+        c.inc()
+        c.inc(5)
+        assert c.count == 6
+
+    def test_meter_rate(self):
+        m = Meter("tput", window_s=10.0)
+        for i in range(100):
+            m.mark(now=float(i) * 0.01)  # 100 events over 1s
+        assert m.count == 100
+        assert m.rate(now=1.0) == pytest.approx(100.0, rel=0.1)
+
+    def test_meter_window_eviction(self):
+        m = Meter("tput", window_s=1.0)
+        m.mark(now=0.0)
+        m.mark(now=5.0)
+        # the t=0 bucket fell out of the 1s window
+        assert m.rate(now=5.0) > 0
+        assert len(m._buckets) == 1
+
+    def test_meter_memory_is_bounded(self):
+        # one bucket per second regardless of event count (hot-path safety)
+        m = Meter("tput", window_s=60.0)
+        for i in range(10_000):
+            m.mark(now=100.0 + i * 0.0003)  # 10k events over 3s
+        assert m.count == 10_000
+        assert len(m._buckets) <= 4
+
+    def test_non_dict_value_key_passes(self):
+        check_exit_control_tuple({"value": "raw-bytes",
+                                  "geometry": {"type": "Point"}})
+
+    def test_registry_snapshot(self):
+        r = MetricsRegistry()
+        r.counter("a").inc(3)
+        r.meter("b").mark()
+        snap = r.snapshot()
+        assert snap["a"] == 3
+        assert snap["b.count"] == 1
+
+
+class TestControlTuple:
+    def test_geojson_string_control(self):
+        rec = json.dumps({"geometry": {"type": "control"}})
+        with pytest.raises(ControlTupleExit):
+            check_exit_control_tuple(rec)
+
+    def test_kafka_envelope_control(self):
+        rec = {"value": {"geometry": {"type": "control"}}}
+        with pytest.raises(ControlTupleExit):
+            check_exit_control_tuple(rec)
+
+    def test_normal_records_pass(self):
+        check_exit_control_tuple('{"geometry": {"type": "Point"}}')
+        check_exit_control_tuple({"geometry": {"type": "Point"}})
+        check_exit_control_tuple("not json at all")
+
+    def test_metered_stream(self):
+        m = Meter("s")
+        out = list(metered(iter([1, 2, 3]), m))
+        assert out == [1, 2, 3] and m.count == 3
+
+    def test_control_tuple_stops_driver_stream(self):
+        from spatialflink_tpu.config import Params
+        from spatialflink_tpu.driver import run_option
+
+        params = Params.from_yaml("conf/spatialflink-conf.yml")
+        params.query.option = 1
+        lines = [json.dumps({
+            "geometry": {"type": "Point", "coordinates": [116.5, 40.5]},
+            "properties": {"oID": "a", "timestamp": 1700000000000},
+        }), json.dumps({"geometry": {"type": "control"}})]
+        with pytest.raises(ControlTupleExit):
+            list(run_option(params, lines))
+
+
+class TestOperatorMetrics:
+    def test_drive_counts_batches_and_records(self):
+        from spatialflink_tpu.index import UniformGrid
+        from spatialflink_tpu.models import Point
+        from spatialflink_tpu.operators import (
+            PointPointRangeQuery,
+            QueryConfiguration,
+            QueryType,
+        )
+
+        grid = UniformGrid(0.0, 10.0, 0.0, 10.0, num_grid_partitions=10)
+        pts = [Point.create(5.0, 5.0, grid, obj_id=f"o{i}",
+                            timestamp=1_700_000_000_000 + i * 1000)
+               for i in range(8)]
+        before = REGISTRY.counter("records-evaluated").count
+        conf = QueryConfiguration(QueryType.WindowBased, 10_000, 5_000)
+        q = Point.create(5.0, 5.0, grid)
+        list(PointPointRangeQuery(conf, grid).run(iter(pts), q, 1.0))
+        assert REGISTRY.counter("records-evaluated").count > before
+
+
+def test_trace_is_safe_noop_without_profiler():
+    with trace("stage-x"):
+        pass

@@ -267,8 +267,11 @@ def run_option(params: Params, stream1: Iterable, stream2: Optional[Iterable]
             # both sides must live in the app's grid (the reference passes
             # ONE uGrid to normalizedCellStayTime, StreamingJob.java:1667)
             s2 = decode_stream(stream2, params.input2, u_grid)
+            # query.trajIDs names moving-object trajectories; sensor polygon
+            # IDs live in a different namespace, so the sensor side is never
+            # filtered by it (StayTime.java keys sensors by poly id only)
             return app.normalized_cell_stay_time(
-                s1, s2, traj_ids_points=traj_ids, traj_ids_sensors=traj_ids)
+                s1, s2, traj_ids_points=traj_ids, traj_ids_sensors=None)
         s1 = decode_stream(stream1, params.input1, u_grid)
         if spec.stream == "Polygon":  # 1011: sensor-range intersection
             return app.cell_sensor_range_intersection(s1, traj_ids)
@@ -399,12 +402,21 @@ def main(argv: Optional[List[str]] = None) -> int:
         stream1 = FileReplaySource(args.input1, limit=args.limit)
     stream2 = FileReplaySource(args.input2, limit=args.limit) if args.input2 else None
 
+    from spatialflink_tpu.utils.metrics import ControlTupleExit
+
     sink = StdoutSink()
     n = 0
-    for result in run_option(params, stream1, stream2):
-        _emit(result, sink)
-        n += 1
-    print(f"# emitted {n} results", file=sys.stderr)
+    stopped = False
+    try:
+        for result in run_option(params, stream1, stream2):
+            _emit(result, sink)
+            n += 1
+    except ControlTupleExit:
+        # the remote-stop hook (HelperClass.checkExitControlTuple:441-453) is
+        # a graceful shutdown, not an error: finish the summary and exit 0
+        stopped = True
+    print(f"# emitted {n} results" + (" (control-tuple stop)" if stopped else ""),
+          file=sys.stderr)
     if args.metrics:
         from spatialflink_tpu.utils.metrics import REGISTRY
 

@@ -56,21 +56,33 @@ def points_to_geoms_dist(points: PointBatch, geoms: EdgeGeomBatch):
     return jnp.where(inside & geoms.is_areal[None, :], 0.0, bdist)
 
 
-@jax.jit
 def points_to_single_geom_dist(points: PointBatch, edges, edge_mask, is_areal: bool):
     """(N,) distance from every point to ONE query geometry (the common
-    point-stream x polygon-query case)."""
+    point-stream x polygon-query case).
+
+    Delegates to :func:`ops.pallas_kernels.pip_dist`, which self-dispatches:
+    fused pallas kernel on TPU, the jnp twin everywhere else."""
+    from spatialflink_tpu.ops import pallas_kernels as PK
+
+    return PK.pip_dist(points.x, points.y, edges, edge_mask, bool(is_areal))
+
+
+@jax.jit
+def points_to_single_edges_raw(px, py, edges, edge_mask):
+    """(inside, min_dist2) of each point vs ONE edge set — the shared jnp twin
+    of the pallas pip kernel. Empty/fully-masked edge sets yield +inf dist2."""
     d2 = D.point_segment_dist2(
-        points.x[:, None],
-        points.y[:, None],
+        px[:, None],
+        py[:, None],
         edges[None, :, 0],
         edges[None, :, 1],
         edges[None, :, 2],
         edges[None, :, 3],
     )
-    bdist = jnp.sqrt(jnp.min(jnp.where(edge_mask[None], d2, _BIG), axis=-1))
-    inside = D.point_in_rings(points.x[:, None], points.y[:, None], edges[None], edge_mask[None])
-    return jnp.where(inside & is_areal, 0.0, bdist)
+    pad = jnp.full((d2.shape[0], 1), _BIG)  # keeps the reduction non-empty-safe
+    mind2 = jnp.min(jnp.concatenate([jnp.where(edge_mask[None], d2, _BIG), pad], axis=-1), axis=-1)
+    inside = D.point_in_rings(px[:, None], py[:, None], edges[None], edge_mask[None])
+    return inside, mind2
 
 
 @jax.jit

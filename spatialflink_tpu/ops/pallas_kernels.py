@@ -1,0 +1,287 @@
+"""Pallas TPU kernels for the hot window-batch ops.
+
+Two fused kernels, each VMEM-resident and tiled for the VPU:
+
+- :func:`pip_dist` — point -> single-query-geometry distance: even-odd
+  ray-cast containment fused with min point-segment boundary distance in one
+  pass over the edge array. This is the hot loop of every point-stream x
+  polygon/linestring-query operator (reference:
+  ``range/PointPolygonRangeQuery.java:117-``, ``tRange/PointPolygonTRangeQuery
+  .java:53-87`` — there a per-tuple JTS call; here one kernel per window).
+- :func:`join_reduce` — per-left-point reduction over the whole right batch:
+  number of right partners within radius (after Chebyshev cell pruning,
+  ``join/JoinQuery.java:148-162`` semantics) plus the nearest partner's
+  distance and index. Used for nearest-partner joins and join cardinality
+  stats without materializing the (N, M) pair matrix in HBM.
+
+Both have jnp twins (the exact code paths in :mod:`ops.geom` /
+:mod:`ops.join`); dispatch is by backend — pallas on TPU, jnp elsewhere —
+overridable with ``SPATIALFLINK_PALLAS`` = ``off`` | ``interpret`` (CPU
+interpreter, used by the test suite) | ``auto``.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_BIG = jnp.float32(3.4e38)
+_F_BIG = 3.4e38  # plain literals for in-kernel use (pallas
+_I_BIG = 2**31 - 1  # kernels cannot capture traced constants)
+
+# point rows per grid step (sublane dim) and edge/right lanes per inner tile
+_TP = 256
+_TL = 128
+
+
+def pallas_mode() -> str:
+    """'tpu' | 'interpret' | 'off' — how/whether to run the pallas path."""
+    env = os.environ.get("SPATIALFLINK_PALLAS", "auto").lower()
+    if env in ("0", "off", "no"):
+        return "off"
+    if env == "interpret":
+        return "interpret"
+    return "tpu" if jax.default_backend() == "tpu" else "off"
+
+
+def _pad_to(arr: jnp.ndarray, size: int, fill) -> jnp.ndarray:
+    n = arr.shape[0]
+    if n == size:
+        return arr
+    return jnp.concatenate(
+        [arr, jnp.full((size - n,) + arr.shape[1:], fill, arr.dtype)]
+    )
+
+
+def _ceil_to(n: int, m: int) -> int:
+    return max(((n + m - 1) // m) * m, m)
+
+
+# --------------------------------------------------------------------------- #
+# Kernel 1: fused point-in-rings + min boundary distance
+# --------------------------------------------------------------------------- #
+
+
+def _pip_kernel(px_ref, py_ref, x1_ref, y1_ref, x2_ref, y2_ref, m_ref,
+                cross_ref, mind2_ref):
+    px = px_ref[:]  # (TP, 1)
+    py = py_ref[:]
+    n_tiles = m_ref.shape[1] // _TL
+
+    def body(t, carry):
+        cross, mind2 = carry
+        sl = pl.ds(t * _TL, _TL)
+        x1 = x1_ref[:, sl]  # (1, TL)
+        y1 = y1_ref[:, sl]
+        x2 = x2_ref[:, sl]
+        y2 = y2_ref[:, sl]
+        valid = m_ref[:, sl] > 0
+
+        # even-odd ray cast, half-open on y (ops.distances.point_in_rings)
+        straddles = (y1 > py) != (y2 > py)  # (TP, TL)
+        denom = jnp.where(y2 == y1, 1.0, y2 - y1)
+        x_at_y = x1 + (py - y1) / denom * (x2 - x1)
+        crossing = straddles & valid & (px < x_at_y)
+        cross = cross + jnp.sum(crossing.astype(jnp.int32), axis=1, keepdims=True)
+
+        # point-segment squared distance (ops.distances.point_segment_dist2)
+        cx, cy = x2 - x1, y2 - y1
+        len_sq = cx * cx + cy * cy
+        dot = (px - x1) * cx + (py - y1) * cy
+        tt = jnp.where(len_sq > 0, dot / jnp.where(len_sq > 0, len_sq, 1.0), 0.0)
+        tt = jnp.clip(tt, 0.0, 1.0)
+        qx, qy = x1 + tt * cx, y1 + tt * cy
+        d2 = (px - qx) ** 2 + (py - qy) ** 2
+        d2 = jnp.where(valid, d2, _F_BIG)
+        mind2 = jnp.minimum(mind2, jnp.min(d2, axis=1, keepdims=True))
+        return cross, mind2
+
+    cross, mind2 = jax.lax.fori_loop(
+        0, n_tiles, body,
+        (jnp.zeros((_TP, 1), jnp.int32),
+         jnp.full((_TP, 1), _F_BIG, jnp.float32)),
+    )
+    cross_ref[:] = cross
+    mind2_ref[:] = mind2
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _pip_pallas(px, py, edges, edge_mask, *, interpret: bool):
+    n = px.shape[0]
+    e = edges.shape[0]
+    np_pad = _ceil_to(n, _TP)
+    ep_pad = _ceil_to(e, _TL)
+
+    pxp = _pad_to(px.astype(jnp.float32), np_pad, 0.0).reshape(np_pad, 1)
+    pyp = _pad_to(py.astype(jnp.float32), np_pad, 0.0).reshape(np_pad, 1)
+    ed = _pad_to(edges.astype(jnp.float32), ep_pad, 0.0)
+    em = _pad_to(edge_mask.astype(jnp.float32), ep_pad, 0.0).reshape(1, ep_pad)
+    x1, y1 = ed[:, 0].reshape(1, ep_pad), ed[:, 1].reshape(1, ep_pad)
+    x2, y2 = ed[:, 2].reshape(1, ep_pad), ed[:, 3].reshape(1, ep_pad)
+
+    pt_spec = pl.BlockSpec((_TP, 1), lambda i: (i, 0), memory_space=pltpu.VMEM)
+    edge_spec = pl.BlockSpec((1, ep_pad), lambda i: (0, 0), memory_space=pltpu.VMEM)
+
+    cross, mind2 = pl.pallas_call(
+        _pip_kernel,
+        grid=(np_pad // _TP,),
+        in_specs=[pt_spec, pt_spec] + [edge_spec] * 5,
+        out_specs=(
+            pl.BlockSpec((_TP, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((_TP, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((np_pad, 1), jnp.int32),
+            jax.ShapeDtypeStruct((np_pad, 1), jnp.float32),
+        ),
+        interpret=interpret,
+    )(pxp, pyp, x1, y1, x2, y2, em)
+    inside = (cross[:n, 0] % 2) == 1
+    return inside, mind2[:n, 0]
+
+
+def pip_dist(px, py, edges, edge_mask, is_areal: bool):
+    """(N,) JTS-style distance from each point to ONE query geometry.
+
+    Drop-in twin of ``ops.geom.points_to_single_geom_dist`` (same semantics:
+    0 inside areal geometries, else min boundary distance); fused pallas on
+    TPU, jnp elsewhere.
+    """
+    mode = pallas_mode()
+    if mode == "off":
+        from spatialflink_tpu.ops.geom import points_to_single_edges_raw
+
+        inside, mind2 = points_to_single_edges_raw(px, py, edges, edge_mask)
+    else:
+        inside, mind2 = _pip_pallas(px, py, edges, edge_mask,
+                                    interpret=(mode == "interpret"))
+    return jnp.where(inside & is_areal, 0.0, jnp.sqrt(mind2))
+
+
+# --------------------------------------------------------------------------- #
+# Kernel 2: per-left-point join reduction (count + nearest partner)
+# --------------------------------------------------------------------------- #
+
+
+def _join_kernel(r2_ref, lay_ref, ax_ref, ay_ref, acx_ref, acy_ref, av_ref,
+                 bx_ref, by_ref, bcx_ref, bcy_ref, bv_ref,
+                 cnt_ref, mind2_ref, arg_ref):
+    ax = ax_ref[:]  # (TP, 1)
+    ay = ay_ref[:]
+    acx = acx_ref[:]
+    acy = acy_ref[:]
+    av = av_ref[:] > 0
+    r2 = r2_ref[0, 0]
+    lay = lay_ref[0, 0]
+    n_tiles = bv_ref.shape[1] // _TL
+
+    def body(t, carry):
+        cnt, mind2, amin = carry
+        sl = pl.ds(t * _TL, _TL)
+        bx = bx_ref[:, sl]  # (1, TL)
+        by = by_ref[:, sl]
+        bcx = bcx_ref[:, sl]
+        bcy = bcy_ref[:, sl]
+        bv = bv_ref[:, sl] > 0
+
+        cheb = jnp.maximum(jnp.abs(acx - bcx), jnp.abs(acy - bcy))
+        ok = av & bv & (cheb <= lay)
+        d2 = (ax - bx) ** 2 + (ay - by) ** 2
+        hit = ok & (d2 <= r2)
+        cnt = cnt + jnp.sum(hit.astype(jnp.int32), axis=1, keepdims=True)
+
+        d2m = jnp.where(hit, d2, _F_BIG)
+        tile_min = jnp.min(d2m, axis=1, keepdims=True)  # (TP, 1)
+        idx = jax.lax.broadcasted_iota(jnp.int32, d2m.shape, 1) + t * _TL
+        idx_at_min = jnp.min(
+            jnp.where(hit & (d2m == tile_min), idx, _I_BIG), axis=1, keepdims=True
+        )
+        better = tile_min < mind2
+        mind2 = jnp.where(better, tile_min, mind2)
+        amin = jnp.where(better, idx_at_min, amin)
+        return cnt, mind2, amin
+
+    cnt, mind2, amin = jax.lax.fori_loop(
+        0, n_tiles, body,
+        (jnp.zeros((_TP, 1), jnp.int32),
+         jnp.full((_TP, 1), _F_BIG, jnp.float32),
+         jnp.full((_TP, 1), -1, jnp.int32)),
+    )
+    cnt_ref[:] = cnt
+    mind2_ref[:] = mind2
+    arg_ref[:] = amin
+
+
+@functools.partial(jax.jit, static_argnames=("n", "interpret"))
+def _join_reduce_impl(a, b, radius, nb_layers, *, n: int, interpret):
+    """a/b: PointBatch-like namedtuples with .x/.y/.cell/.valid."""
+    acx, acy = a.cell // n, a.cell % n
+    bcx, bcy = b.cell // n, b.cell % n
+    if interpret is None:  # jnp twin — one scan over right tiles, fused by XLA
+        cheb = jnp.maximum(jnp.abs(acx[:, None] - bcx[None, :]),
+                           jnp.abs(acy[:, None] - bcy[None, :]))
+        d2 = (a.x[:, None] - b.x[None, :]) ** 2 + (a.y[:, None] - b.y[None, :]) ** 2
+        hit = (a.valid[:, None] & b.valid[None, :]
+               & (cheb <= nb_layers) & (d2 <= radius * radius))
+        cnt = jnp.sum(hit, axis=1).astype(jnp.int32)
+        d2m = jnp.where(hit, d2, _BIG)
+        amin = jnp.where(jnp.any(hit, axis=1), jnp.argmin(d2m, axis=1), -1)
+        return cnt, jnp.min(d2m, axis=1), amin.astype(jnp.int32)
+
+    na, nb_ = a.x.shape[0], b.x.shape[0]
+    np_pad, mb_pad = _ceil_to(na, _TP), _ceil_to(nb_, _TL)
+
+    def col(v, fill, dt):
+        return _pad_to(v.astype(dt), np_pad, fill).reshape(np_pad, 1)
+
+    def row(v, fill, dt):
+        return _pad_to(v.astype(dt), mb_pad, fill).reshape(1, mb_pad)
+
+    args = (
+        jnp.asarray([[radius * radius]], jnp.float32),
+        jnp.asarray([[nb_layers]], jnp.int32),
+        col(a.x, 0.0, jnp.float32), col(a.y, 0.0, jnp.float32),
+        col(acx, 0, jnp.int32), col(acy, 0, jnp.int32),
+        col(a.valid, 0.0, jnp.float32),
+        row(b.x, 0.0, jnp.float32), row(b.y, 0.0, jnp.float32),
+        row(bcx, 0, jnp.int32), row(bcy, 0, jnp.int32),
+        row(b.valid, 0.0, jnp.float32),
+    )
+    s_spec = pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM)
+    a_spec = pl.BlockSpec((_TP, 1), lambda i: (i, 0), memory_space=pltpu.VMEM)
+    b_spec = pl.BlockSpec((1, mb_pad), lambda i: (0, 0), memory_space=pltpu.VMEM)
+    o_spec = pl.BlockSpec((_TP, 1), lambda i: (i, 0), memory_space=pltpu.VMEM)
+
+    cnt, mind2, amin = pl.pallas_call(
+        _join_kernel,
+        grid=(np_pad // _TP,),
+        in_specs=[s_spec, s_spec] + [a_spec] * 5 + [b_spec] * 5,
+        out_specs=(o_spec, o_spec, o_spec),
+        out_shape=(
+            jax.ShapeDtypeStruct((np_pad, 1), jnp.int32),
+            jax.ShapeDtypeStruct((np_pad, 1), jnp.float32),
+            jax.ShapeDtypeStruct((np_pad, 1), jnp.int32),
+        ),
+        interpret=interpret,
+    )(*args)
+    return cnt[:na, 0], mind2[:na, 0], amin[:na, 0]
+
+
+def join_reduce(a, b, radius, nb_layers, *, n: int):
+    """Per-left-point join reduction against the whole right batch.
+
+    Returns ``(count, min_dist2, argmin)`` each (N,): how many valid right
+    points lie within ``radius`` after Chebyshev cell pruning (the
+    replicate-to-neighboring-cells rule, ``join/JoinQuery.java:72-90``), the
+    squared distance to the nearest such partner (+inf if none) and its index
+    in the right batch (-1 if none).
+    """
+    mode = pallas_mode()
+    interpret = None if mode == "off" else (mode == "interpret")
+    return _join_reduce_impl(a, b, radius, nb_layers, n=n, interpret=interpret)

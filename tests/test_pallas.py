@@ -1,0 +1,131 @@
+"""Pallas kernels vs their jnp twins / NumPy oracles (interpreter mode)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from spatialflink_tpu.index import UniformGrid
+from spatialflink_tpu.models import PointBatch
+from spatialflink_tpu.models.batches import single_query_edges
+from spatialflink_tpu.models.objects import Polygon, LineString
+from spatialflink_tpu.ops import pallas_kernels as PK
+from spatialflink_tpu.ops.geom import points_to_single_geom_dist
+
+
+@pytest.fixture()
+def interpret_mode(monkeypatch):
+    monkeypatch.setenv("SPATIALFLINK_PALLAS", "interpret")
+
+
+@pytest.fixture()
+def grid():
+    return UniformGrid(0.0, 10.0, 0.0, 10.0, num_grid_partitions=10)
+
+
+def _random_batch(grid, n, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.uniform(0, 10, n), rng.uniform(0, 10, n), rng
+
+
+class TestPipDist:
+    def _check(self, grid, query, n=333, seed=1):
+        xs, ys, _ = _random_batch(grid, n, seed)
+        batch = PointBatch.from_arrays(xs, ys, grid=grid)
+        edges, mask = single_query_edges(query)
+        edges, mask = jnp.asarray(edges), jnp.asarray(mask)
+        areal = isinstance(query, Polygon)
+
+        got = PK.pip_dist(batch.x, batch.y, edges, mask, areal)
+        want = points_to_single_geom_dist(batch, edges, mask, areal)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_polygon(self, interpret_mode, grid):
+        poly = Polygon.create([[(2, 2), (6, 2), (6, 6), (2, 6), (2, 2)]], grid=grid)
+        self._check(grid, poly)
+
+    def test_polygon_with_hole(self, interpret_mode, grid):
+        poly = Polygon.create(
+            [[(1, 1), (8, 1), (8, 8), (1, 8), (1, 1)],
+             [(3, 3), (5, 3), (5, 5), (3, 3)]],
+            grid=grid,
+        )
+        self._check(grid, poly, n=257, seed=2)
+
+    def test_linestring(self, interpret_mode, grid):
+        ls = LineString.create([(0.5, 0.5), (4, 7), (9, 3)], grid=grid)
+        self._check(grid, ls, n=130, seed=3)
+
+    def test_matches_off_mode(self, monkeypatch, grid):
+        poly = Polygon.create([[(2, 2), (6, 2), (6, 6), (2, 6), (2, 2)]], grid=grid)
+        xs, ys, _ = _random_batch(grid, 100, 4)
+        batch = PointBatch.from_arrays(xs, ys, grid=grid)
+        edges, mask = single_query_edges(poly)
+        edges, mask = jnp.asarray(edges), jnp.asarray(mask)
+        monkeypatch.setenv("SPATIALFLINK_PALLAS", "off")
+        off = PK.pip_dist(batch.x, batch.y, edges, mask, True)
+        monkeypatch.setenv("SPATIALFLINK_PALLAS", "interpret")
+        on = PK.pip_dist(batch.x, batch.y, edges, mask, True)
+        np.testing.assert_allclose(np.asarray(on), np.asarray(off),
+                                   rtol=1e-5, atol=1e-6)
+
+
+    @pytest.mark.parametrize("mode", ["off", "interpret"])
+    def test_empty_edges(self, monkeypatch, grid, mode):
+        monkeypatch.setenv("SPATIALFLINK_PALLAS", mode)
+        px = jnp.asarray(np.array([1.0, 2.0], np.float32))
+        py = jnp.asarray(np.array([1.0, 2.0], np.float32))
+        edges = jnp.zeros((0, 4), jnp.float32)
+        mask = jnp.zeros((0,), bool)
+        d = PK.pip_dist(px, py, edges, mask, True)
+        assert np.all(np.asarray(d) > 1e18)  # "infinitely far" sentinel
+
+
+class TestJoinReduce:
+    def _oracle(self, a, b, radius, layers, n):
+        acx, acy = np.asarray(a.cell) // n, np.asarray(a.cell) % n
+        bcx, bcy = np.asarray(b.cell) // n, np.asarray(b.cell) % n
+        ax, ay = np.asarray(a.x), np.asarray(a.y)
+        bx, by = np.asarray(b.x), np.asarray(b.y)
+        cheb = np.maximum(np.abs(acx[:, None] - bcx[None, :]),
+                          np.abs(acy[:, None] - bcy[None, :]))
+        d2 = (ax[:, None] - bx[None, :]) ** 2 + (ay[:, None] - by[None, :]) ** 2
+        hit = (np.asarray(a.valid)[:, None] & np.asarray(b.valid)[None, :]
+               & (cheb <= layers) & (d2 <= radius**2))
+        cnt = hit.sum(1)
+        d2m = np.where(hit, d2, np.inf)
+        arg = np.where(cnt > 0, d2m.argmin(1), -1)
+        return cnt, d2m.min(1), arg
+
+    @pytest.mark.parametrize("na,nb", [(100, 80), (257, 300)])
+    def test_vs_oracle(self, interpret_mode, grid, na, nb):
+        ax, ay, _ = _random_batch(grid, na, 5)
+        bx, by, _ = _random_batch(grid, nb, 6)
+        a = PointBatch.from_arrays(ax, ay, grid=grid)
+        b = PointBatch.from_arrays(bx, by, grid=grid)
+        radius, layers = 1.5, grid.candidate_layers(1.5)
+
+        cnt, mind2, amin = PK.join_reduce(a, b, radius, layers, n=grid.n)
+        ocnt, omind2, oamin = self._oracle(a, b, radius, layers, grid.n)
+
+        np.testing.assert_array_equal(np.asarray(cnt), ocnt)
+        has = ocnt > 0
+        np.testing.assert_allclose(np.asarray(mind2)[has], omind2[has], rtol=1e-5)
+        np.testing.assert_array_equal(np.asarray(amin)[has], oamin[has])
+        assert (np.asarray(amin)[~has] == -1).all()
+
+    def test_jnp_twin_matches(self, monkeypatch, grid):
+        ax, ay, _ = _random_batch(grid, 64, 7)
+        bx, by, _ = _random_batch(grid, 96, 8)
+        a = PointBatch.from_arrays(ax, ay, grid=grid)
+        b = PointBatch.from_arrays(bx, by, grid=grid)
+        monkeypatch.setenv("SPATIALFLINK_PALLAS", "off")
+        cnt, mind2, amin = PK.join_reduce(a, b, 2.0, grid.candidate_layers(2.0),
+                                          n=grid.n)
+        ocnt, omind2, oamin = self._oracle(a, b, 2.0, grid.candidate_layers(2.0),
+                                           grid.n)
+        np.testing.assert_array_equal(np.asarray(cnt), ocnt)
+        has = ocnt > 0
+        np.testing.assert_allclose(np.asarray(mind2)[has], omind2[has], rtol=1e-5)
+        np.testing.assert_array_equal(np.asarray(amin)[has], oamin[has])

@@ -62,7 +62,16 @@ def build_inputs():
 
 
 def bench_device(grid, batch) -> float:
-    """-> points/sec/chip on the default JAX device."""
+    """-> steady-state points/sec/chip on the default JAX device.
+
+    Windows are processed in an on-device ``fori_loop`` whose body depends on
+    the loop index (so XLA cannot hoist it); timing the loop at two iteration
+    counts and taking the slope isolates per-window device time from the
+    fixed per-dispatch overhead — the regime a streaming pipeline runs in,
+    where window batches are queued back-to-back ahead of completion.
+    """
+    from functools import partial
+
     import jax
     import jax.numpy as jnp
 
@@ -72,20 +81,34 @@ def bench_device(grid, batch) -> float:
     q_cell, _ = grid.assign_cell(qx, qy)
     nb_layers = grid.candidate_layers(RADIUS)
     batch = jax.device_put(batch)
+    qc = jnp.int32(q_cell)
 
-    def run():
-        return knn_point(
-            batch, qx, qy, jnp.int32(q_cell), RADIUS, nb_layers, n=grid.n, k=K
-        )
+    @partial(jax.jit, static_argnames=("iters",))
+    def run_n(b, *, iters):
+        def body(i, acc):
+            r = knn_point(b, qx + i * 1e-7, qy, qc, RADIUS, nb_layers,
+                          n=grid.n, k=K)
+            return acc + r.dist[0]
+        return jax.lax.fori_loop(0, iters, body, jnp.float32(0))
 
-    res = run()
-    jax.block_until_ready(res)  # compile + warm
-    t0 = time.perf_counter()
-    for _ in range(ITERS):
-        res = run()
-    jax.block_until_ready(res)
-    dt = time.perf_counter() - t0
-    return N_POINTS * ITERS / dt
+    lo, hi = 2, 2 + ITERS
+    times = {}
+    for iters in (lo, hi):
+        jax.block_until_ready(run_n(batch, iters=iters))  # compile + warm
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            jax.block_until_ready(run_n(batch, iters=iters))
+            best = min(best, time.perf_counter() - t0)
+        times[iters] = best
+    per_window = (times[hi] - times[lo]) / (hi - lo)
+    if per_window <= 0:
+        # timing noise swamped the slope; fall back to the conservative
+        # whole-loop average (includes fixed dispatch overhead) and say so.
+        print("warning: non-positive slope; reporting whole-loop average",
+              file=sys.stderr)
+        per_window = times[hi] / hi
+    return N_POINTS / per_window
 
 
 def bench_cpu_numpy(grid, xs, ys, oid) -> float:

@@ -41,23 +41,33 @@ class KnnResult(NamedTuple):
 
 
 def dedup_min_by_id(obj_id, dist, eligible):
-    """Per-object minimum distance via one lexicographic sort.
+    """Per-object minimum distance via one lexicographic sort (last axis).
 
     Returns (obj_id_sorted, dist_sorted, keep) where ``keep`` marks the first
     occurrence of each object id (which, after an ascending (id, dist) sort,
     carries that object's min distance). Ineligible rows get a sentinel id so
-    they sort to the back and are never kept.
+    they sort to the back and are never kept. Works on 1-D windows and on
+    batched (..., C) group layouts alike.
     """
     oid = jnp.where(eligible, obj_id, _OID_SENTINEL)
     d = jnp.where(eligible, dist, _BIG)
-    oid_s, d_s = jax.lax.sort((oid, d), num_keys=2)
-    prev = jnp.concatenate([jnp.full((1,), -1, oid_s.dtype), oid_s[:-1]])
+    axis = oid.ndim - 1
+    oid_s, d_s = jax.lax.sort((oid, d), dimension=axis, num_keys=2)
+    pad_shape = oid_s.shape[:-1] + (1,)
+    prev = jnp.concatenate(
+        [jnp.full(pad_shape, _OID_SENTINEL, oid_s.dtype),
+         jax.lax.slice_in_dim(oid_s, 0, oid_s.shape[-1] - 1, axis=axis)],
+        axis=axis)
     keep = (oid_s != prev) & (oid_s != _OID_SENTINEL)
+    # the sentinel prev-filler can only collide with sentinel rows, which the
+    # second conjunct already drops, so the first slot is always kept.
     return oid_s, d_s, keep
 
 
-def topk_by_distance(obj_id, dist, eligible, k: int) -> KnnResult:
-    """Dedup by object id (keep min dist) then top-k smallest distances."""
+def _topk_full_sort(obj_id, dist, eligible, k: int) -> KnnResult:
+    """Reference algorithm: full lexicographic sort dedup then top-k. Exact
+    for any input, but the O(N log^2 N) bitonic sort dominates on TPU for
+    large windows — prefer the grouped/prefiltered paths below there."""
     oid_s, d_s, keep = dedup_min_by_id(obj_id, dist, eligible)
     d_masked = jnp.where(keep, d_s, _BIG)
     neg_top, idx = jax.lax.top_k(-d_masked, k)
@@ -66,7 +76,95 @@ def topk_by_distance(obj_id, dist, eligible, k: int) -> KnnResult:
     return KnnResult(obj_id=top_oid, dist=top_d, valid=top_d < _BIG)
 
 
-@partial(jax.jit, static_argnames=("n", "k", "enforce_radius"))
+def _topk_grouped(obj_id, dist, eligible, k: int, groups: int) -> KnnResult:
+    """Exact dedup+top-k via per-group sorts (TPU fast path).
+
+    Reshape the window to (G, N/G), sort each group by (objID, dist), keep
+    each group's per-object minima, take the group-local top-k, then run the
+    small full-sort path over the G*k survivors.
+
+    Exactness: a final top-k object's global-min point lies in some group; if
+    it is not among that group's top-k *distinct* minima, then k distinct
+    objects in that group alone have smaller minima, so the global top-k is
+    covered by that group's survivors either way. Per-group bitonic sorts are
+    O(C log^2 C) with C = N/G — asymptotically and practically cheaper than
+    one N-wide sort, and XLA parallelizes the group dimension.
+    """
+    n = obj_id.shape[0]
+    g = max(1, min(groups, n // max(k, 1)))
+    c = -(-n // g)  # ceil div
+    pad = g * c - n
+    oid = jnp.where(eligible, obj_id, _OID_SENTINEL)
+    d = jnp.where(eligible, dist, _BIG)
+    if pad:
+        oid = jnp.concatenate([oid, jnp.full((pad,), _OID_SENTINEL, oid.dtype)])
+        d = jnp.concatenate([d, jnp.full((pad,), _BIG, d.dtype)])
+    oid_s, d_s, keep = dedup_min_by_id(
+        oid.reshape(g, c), d.reshape(g, c), jnp.bool_(True))
+    d_masked = jnp.where(keep, d_s, _BIG)
+    kk = min(k, c)
+    neg_top, idx = jax.lax.top_k(-d_masked, kk)  # batched over groups
+    cand_d = (-neg_top).reshape(-1)
+    cand_oid = jnp.take_along_axis(oid_s, idx, axis=1).reshape(-1)
+    return _topk_full_sort(cand_oid, cand_d, cand_d < _BIG, k)
+
+
+def _topk_prefiltered(obj_id, dist, eligible, k: int, m: int) -> KnnResult:
+    """Exact top-k via a global m-candidate prefilter with verified fallback.
+
+    ``lax.top_k(m)`` selects the m smallest distances (duplicates included),
+    then a tiny dedup+top-k runs over those m. If the m candidates contain at
+    least k distinct objects — or all eligible points — the result is provably
+    exact (any excluded object's min distance exceeds every candidate's, hence
+    exceeds k distinct objects' minima). Otherwise a ``lax.cond`` falls back
+    to the full-sort path; with m >> k that branch needs one object to own
+    m-k+1 of the m nearest points, which real streams do not do.
+    """
+    n = obj_id.shape[0]
+    m = min(m, n)
+    d_all = jnp.where(eligible, dist, _BIG)
+    oid_all = jnp.where(eligible, obj_id, _OID_SENTINEL)
+    neg_m, idx = jax.lax.top_k(-d_all, m)
+    d_m = -neg_m
+    oid_m = oid_all[idx]
+    fast = _topk_full_sort(oid_m, d_m, d_m < _BIG, k)
+    distinct = jnp.sum(fast.valid)
+    n_eligible = jnp.sum(eligible)
+    exact = (distinct >= jnp.minimum(k, n_eligible)) | (n_eligible <= m)
+    return jax.lax.cond(
+        exact,
+        lambda: fast,
+        lambda: _topk_full_sort(obj_id, dist, eligible, k),
+    )
+
+
+# Below this window size the full sort is cheap enough that the grouped
+# path's extra stages don't pay for themselves.
+_GROUPED_MIN_N = 1 << 15
+_DEFAULT_GROUPS = 256
+
+
+def topk_by_distance(obj_id, dist, eligible, k: int,
+                     strategy: str = "auto") -> KnnResult:
+    """Dedup by object id (keep min dist) then top-k smallest distances.
+
+    strategy: "auto" (grouped for large windows, full sort for small),
+    "sort", "grouped", or "prefilter".
+    """
+    n = obj_id.shape[0]
+    if strategy == "auto":
+        strategy = "grouped" if n >= _GROUPED_MIN_N else "sort"
+    if strategy == "grouped":
+        return _topk_grouped(obj_id, dist, eligible, k, _DEFAULT_GROUPS)
+    if strategy == "prefilter":
+        return _topk_prefiltered(obj_id, dist, eligible, k, max(32 * k, 1024))
+    if strategy != "sort":
+        raise ValueError(f"unknown kNN strategy {strategy!r}; "
+                         "expected auto|sort|grouped|prefilter")
+    return _topk_full_sort(obj_id, dist, eligible, k)
+
+
+@partial(jax.jit, static_argnames=("n", "k", "enforce_radius", "strategy"))
 def knn_point(
     points: PointBatch,
     qx,
@@ -78,6 +176,7 @@ def knn_point(
     n: int,
     k: int,
     enforce_radius: bool = False,
+    strategy: str = "auto",
 ) -> KnnResult:
     """kNN of a query point over a window batch.
 
@@ -90,10 +189,10 @@ def knn_point(
     d = D.pp_dist(points.x, points.y, qx, qy)
     if enforce_radius:
         eligible = eligible & (d <= radius)
-    return topk_by_distance(points.obj_id, d, eligible, k)
+    return topk_by_distance(points.obj_id, d, eligible, k, strategy)
 
 
-@partial(jax.jit, static_argnames=("k", "enforce_radius"))
+@partial(jax.jit, static_argnames=("k", "enforce_radius", "strategy"))
 def knn_with_dists(
     obj_id,
     dists,
@@ -104,13 +203,14 @@ def knn_with_dists(
     *,
     k: int,
     enforce_radius: bool = False,
+    strategy: str = "auto",
 ) -> KnnResult:
     """Generic kNN: caller supplies distances (e.g. point->polygon) and a
     dense neighboring-cells mask for the query geometry."""
     eligible = point_stream_eligibility(cell, valid, nb_mask)
     if enforce_radius:
         eligible = eligible & (dists <= radius)
-    return topk_by_distance(obj_id, dists, eligible, k)
+    return topk_by_distance(obj_id, dists, eligible, k, strategy)
 
 
 def merge_knn(results, k: int) -> KnnResult:

@@ -389,3 +389,69 @@ class TestReviewRegressions:
             gb, jnp.asarray(origin_poly_edges), jnp.ones(4, bool), True
         ))
         assert (d[1:] > 1e18).all()  # padded slots stay at the +inf sentinel
+
+
+class TestTopkStrategies:
+    """The three exact selection strategies (full sort / grouped / prefilter)
+    must agree with each other and the oracle on any input — including
+    adversarial duplicate-heavy streams that force the prefilter fallback."""
+
+    def _check(self, obj_id, dist, eligible, k):
+        # exhaustive per-object min oracle
+        best = {}
+        for o, d, e in zip(obj_id, dist, eligible):
+            if e and (int(o) not in best or d < best[int(o)]):
+                best[int(o)] = float(np.float32(d))
+        want_d = sorted(best.values())[:k]
+        for strat in ("sort", "grouped", "prefilter", "auto"):
+            got = K.topk_by_distance(
+                jnp.asarray(obj_id), jnp.asarray(dist), jnp.asarray(eligible),
+                k, strategy=strat)
+            gi = np.asarray(got.obj_id)[np.asarray(got.valid)]
+            gd = np.asarray(got.dist)[np.asarray(got.valid)]
+            np.testing.assert_allclose(gd, want_d, atol=0, err_msg=strat)
+            assert len(set(gi)) == len(gi), strat  # ids distinct
+            for a, d in zip(gi, gd):
+                assert best[int(a)] == d, strat  # each id carries its true min
+
+    @pytest.mark.parametrize("k", [1, 10, 50])
+    @pytest.mark.parametrize("n", [100, 1000, 70000])
+    def test_random(self, n, k):
+        rng = np.random.default_rng(n + k)
+        oid = rng.integers(0, max(4, n // 4), n).astype(np.int32)
+        d = rng.uniform(0, 1, n).astype(np.float32)
+        elig = rng.uniform(0, 1, n) < 0.7
+        self._check(oid, d, elig, k)
+
+    def test_one_object_dominates_forces_fallback(self):
+        # one object owns the 5000 nearest points -> top-m prefilter holds
+        # < k distinct ids -> exactness check fails -> full-sort fallback
+        n, k = 8192, 50
+        rng = np.random.default_rng(0)
+        d = np.concatenate([
+            np.linspace(0.0, 0.1, 5000, dtype=np.float32),
+            rng.uniform(0.5, 1.0, n - 5000).astype(np.float32)])
+        oid = np.concatenate([
+            np.zeros(5000, np.int32),
+            rng.integers(1, 200, n - 5000).astype(np.int32)])
+        self._check(oid, d, np.ones(n, bool), k)
+
+    def test_fewer_eligible_than_k(self):
+        n = 4096
+        oid = np.arange(n, dtype=np.int32)
+        d = np.linspace(0, 1, n, dtype=np.float32)
+        elig = np.zeros(n, bool)
+        elig[[5, 17, 99]] = True
+        self._check(oid, d, elig, 50)
+
+    def test_none_eligible(self):
+        n = 1024
+        self._check(np.arange(n, dtype=np.int32),
+                    np.linspace(0, 1, n, dtype=np.float32),
+                    np.zeros(n, bool), 10)
+
+    def test_all_same_distance_ties(self):
+        n = 2048
+        oid = np.arange(n, dtype=np.int32) % 500
+        d = np.full(n, 0.25, np.float32)
+        self._check(oid, d, np.ones(n, bool), 20)

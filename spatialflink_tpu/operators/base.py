@@ -16,8 +16,9 @@ Reference parity:
 from __future__ import annotations
 
 import enum
+from collections import deque
 from dataclasses import dataclass, field
-from typing import Iterable, Iterator, List, Optional, Tuple
+from typing import Any, Callable, Iterable, Iterator, List, Optional, Tuple
 
 import numpy as np
 
@@ -42,9 +43,33 @@ class QueryConfiguration:
     approximate: bool = False
     realtime_batch_size: int = 512
     k: int = 10  # kNN only
+    # max windows in flight on device before the driver blocks on the oldest;
+    # >=2 overlaps host batch assembly with device compute (SURVEY §7's
+    # host/device-overlap requirement — JAX dispatch is async until read)
+    pipeline_depth: int = 2
 
     def window_spec(self) -> WindowSpec:
         return WindowSpec.sliding(self.window_size_ms, self.slide_ms)
+
+
+@dataclass
+class Deferred:
+    """A window's result that has been *dispatched* to the device but not
+    read back. ``device_result`` holds live jax arrays (computation already
+    enqueued — JAX dispatch is asynchronous); ``collect`` turns them into the
+    final host-side record list, forcing the device→host transfer.
+
+    Operators return this from eval_batch so the window driver can keep
+    ``pipeline_depth`` windows in flight: while the device works on window i,
+    the host assembles and dispatches window i+1 (the double-buffering the
+    reference gets for free from Flink's pipelined operator chains).
+    """
+
+    device_result: Any
+    collect: Callable[[Any], List]
+
+    def finish(self) -> List:
+        return self.collect(self.device_result)
 
 
 @dataclass
@@ -96,27 +121,67 @@ class SpatialOperator:
         return EdgeGeomBatch.from_objects(records, self.grid, self.interner,
                                           ts_base=ts_base)
 
+    def _defer_mask_select(self, mask, records: List) -> Deferred:
+        """Deferred selection of ``records`` by a device boolean mask."""
+        def collect(m):
+            idx = np.nonzero(np.asarray(m))[0]
+            return [records[i] for i in idx if i < len(records)]
+        return Deferred(mask, collect)
+
+    def _defer_knn(self, res) -> Deferred:
+        """Deferred (objID, distance) list from a device KnnResult."""
+        def collect(r):
+            valid = np.asarray(r.valid)
+            oids = np.asarray(r.obj_id)[valid]
+            dists = np.asarray(r.dist)[valid]
+            return [(self.interner.lookup(int(o)), float(d))
+                    for o, d in zip(oids, dists)]
+        return Deferred(res, collect)
+
     def _drive(self, stream: Iterable, eval_batch) -> Iterator["WindowResult"]:
-        """Shared window/realtime driver: eval_batch(records, ts_base) -> list."""
+        """Shared window/realtime driver.
+
+        eval_batch(records, ts_base) returns either the final record list or
+        a :class:`Deferred`; deferred results are pipelined — up to
+        ``conf.pipeline_depth`` windows stay in flight on device while the
+        host assembles the next batch — and emitted in window order.
+        """
         from spatialflink_tpu.utils.metrics import REGISTRY
 
         batches = REGISTRY.counter("batches-evaluated")
         records_c = REGISTRY.counter("records-evaluated")
-        if self.conf.query_type is QueryType.RealTime:
-            for records in self._micro_batches(stream):
-                batches.inc()
-                records_c.inc(len(records))
-                sel = eval_batch(records, records[0].timestamp if records else 0)
-                if sel:
-                    # one convention for every operator: the result bounds are
-                    # the micro-batch's own event-time span
-                    yield WindowResult(records[0].timestamp,
-                                       records[-1].timestamp, sel)
+        depth = max(1, self.conf.pipeline_depth)
+        realtime = self.conf.query_type is QueryType.RealTime
+        pending: deque = deque()  # (start, end, Deferred)
+
+        def emit(start, end, sel) -> Iterator[WindowResult]:
+            # realtime mode only fires on non-empty selections (the
+            # reference's fire-per-element trigger never emits empties);
+            # windowed mode reports every window, selected-or-not
+            if sel or not realtime:
+                yield WindowResult(start, end, sel)
+
+        def drain(n: int) -> Iterator[WindowResult]:
+            while len(pending) > n:
+                start, end, dfd = pending.popleft()
+                yield from emit(start, end, dfd.finish())
+
+        if realtime:
+            batched = ((r[0].timestamp, r[-1].timestamp, r)
+                       for r in self._micro_batches(stream) if r)
         else:
-            for start, end, records in self._windows(stream):
-                batches.inc()
-                records_c.inc(len(records))
-                yield WindowResult(start, end, eval_batch(records, start))
+            batched = self._windows(stream)
+        for start, end, records in batched:
+            batches.inc()
+            records_c.inc(len(records))
+            sel = eval_batch(records, start)
+            if isinstance(sel, Deferred):
+                pending.append((start, end, sel))
+                yield from drain(depth - 1)
+            else:
+                yield from drain(0)  # keep window order
+                yield from emit(start, end, sel)
+        yield from drain(0)
 
 
 class GeomQueryMixin:

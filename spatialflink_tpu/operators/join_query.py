@@ -14,10 +14,12 @@ micro-batch's two sides (the reference's fire-per-element trigger analogue,
 from __future__ import annotations
 
 import heapq
+from collections import deque
 from typing import Dict, Iterable, Iterator, List, Tuple
 
 from spatialflink_tpu.models import Point
 from spatialflink_tpu.operators.base import (
+    Deferred,
     QueryType,
     SpatialOperator,
     WindowResult,
@@ -41,8 +43,33 @@ class PointPointJoinQuery(SpatialOperator):
     def run(self, ordinary: Iterable[Point], query_stream: Iterable[Point],
             radius: float) -> Iterator[WindowResult]:
         if self.conf.query_type is QueryType.RealTime:
-            return self._run_realtime(ordinary, query_stream, radius)
-        return self._run_windowed(ordinary, query_stream, radius)
+            results = self._run_realtime(ordinary, query_stream, radius)
+        else:
+            results = self._run_windowed(ordinary, query_stream, radius)
+        return self._pipeline(results)
+
+    def _pipeline(self, results: Iterator[WindowResult]
+                  ) -> Iterator[WindowResult]:
+        """Keep up to ``conf.pipeline_depth`` join lattices in flight on
+        device (``records`` may arrive as a :class:`Deferred`), materializing
+        in window order — the host seals and dispatches the next window while
+        the device works on the previous one."""
+        depth = max(1, self.conf.pipeline_depth)
+        pending: deque = deque()
+
+        def force(r: WindowResult) -> WindowResult:
+            if isinstance(r.records, Deferred):
+                r.records = r.records.finish()
+            return r
+
+        # same knob semantics as base._drive: depth-1 windows stay in flight
+        # behind the one being assembled
+        for r in results:
+            pending.append(r)
+            while len(pending) > depth - 1:
+                yield force(pending.popleft())
+        while pending:
+            yield force(pending.popleft())
 
     # ---------------------------------------------------------------- #
 
@@ -126,18 +153,21 @@ class _GenericStreamJoin(PointPointJoinQuery):
     def _join_window(self, start, end, recs_a, recs_b, radius) -> WindowResult:
         import numpy as np
 
-        pairs = []
-        if recs_a and recs_b:
-            batch_a = self._batch_a(recs_a, start)
-            batch_b = self._batch_b(recs_b, start)
-            m = np.asarray(self._lattice(batch_a, batch_b, radius))
-            ai, bi = np.nonzero(m)
-            pairs = [
+        if not (recs_a and recs_b):
+            return WindowResult(start, end, [])
+        batch_a = self._batch_a(recs_a, start)
+        batch_b = self._batch_b(recs_b, start)
+        m_dev = self._lattice(batch_a, batch_b, radius)
+
+        def collect(m):
+            ai, bi = np.nonzero(np.asarray(m))
+            return [
                 (recs_a[i], recs_b[j])
                 for i, j in zip(ai.tolist(), bi.tolist())
                 if i < len(recs_a) and j < len(recs_b)
             ]
-        return WindowResult(start, end, pairs)
+
+        return WindowResult(start, end, Deferred(m_dev, collect))
 
     def _nb_layers(self, radius):
         # radius 0 => all cells neighbors (UniformGrid.java:264-266)

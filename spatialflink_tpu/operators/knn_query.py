@@ -16,7 +16,6 @@ from __future__ import annotations
 from typing import Iterable, Iterator, List, Optional, Tuple
 
 import jax.numpy as jnp
-import numpy as np
 
 from spatialflink_tpu.models import Point
 from spatialflink_tpu.operators.base import (
@@ -58,10 +57,7 @@ class PointPointKNNQuery(SpatialOperator):
             n=self.grid.n,
             k=k,
         )
-        valid = np.asarray(res.valid)
-        oids = np.asarray(res.obj_id)[valid]
-        dists = np.asarray(res.dist)[valid]
-        return [(self.interner.lookup(int(o)), float(d)) for o, d in zip(oids, dists)]
+        return self._defer_knn(res)
 
 
 
@@ -86,10 +82,7 @@ class _GenericKnn(SpatialOperator, GeomQueryMixin):
 
             batch, eligible, dists = self._eligibility(records, ts_base, setup)
             res = knn_eligible(batch.obj_id, dists, eligible, k=k)
-            valid = np.asarray(res.valid)
-            oids = np.asarray(res.obj_id)[valid]
-            ds = np.asarray(res.dist)[valid]
-            return [(self.interner.lookup(int(o)), float(d)) for o, d in zip(oids, ds)]
+            return self._defer_knn(res)
 
         for result in self._drive(stream, eval_batch):
             result.extras["k"] = k

@@ -15,6 +15,7 @@ import numpy as np
 
 from spatialflink_tpu.models import Point
 from spatialflink_tpu.operators.base import (
+    Deferred,
     GeomQueryMixin,
     QueryConfiguration,
     QueryType,
@@ -50,8 +51,7 @@ class PointPointRangeQuery(SpatialOperator):
             n=self.grid.n,
             approximate=self.conf.approximate,
         )
-        idx = np.nonzero(np.asarray(mask))[0]
-        return [records[i] for i in idx if i < len(records)]
+        return self._defer_mask_select(mask, records)
 
     # ---------------------------------------------------------------- #
 
@@ -69,7 +69,8 @@ class PointPointRangeQuery(SpatialOperator):
                 cutoff = start + self.conf.window_size_ms - self.conf.slide_ms
                 # records at/after the previous window's end are new
                 fresh = [r for r in records if r.timestamp >= cutoff]
-            selected_new = self._eval(fresh, query_point, radius, start)
+            sel = self._eval(fresh, query_point, radius, start)
+            selected_new = sel.finish() if isinstance(sel, Deferred) else sel
             carried = [
                 r for r in prev.values() if r.timestamp >= start
             ]
@@ -107,8 +108,7 @@ class PointGeomRangeQuery(SpatialOperator, GeomQueryMixin):
             else:
                 dists = points_to_single_geom_dist(batch, q_edges, q_mask, q_areal)
             mask = range_filter_masks(batch, gn, cn, dists, radius)
-            idx = np.nonzero(np.asarray(mask))[0]
-            return [records[i] for i in idx if i < len(records)]
+            return self._defer_mask_select(mask, records)
 
         return self._drive(stream, eval_batch)
 
@@ -144,8 +144,7 @@ class GeomPointRangeQuery(SpatialOperator, GeomQueryMixin):
             else:
                 dists = point_to_geoms_dist(query_point.x, query_point.y, geoms)
             mask = range_filter_geom_stream(all_gn, any_nb, dists, radius, geoms.valid)
-            idx = np.nonzero(np.asarray(mask))[0]
-            return [records[i] for i in idx if i < len(records)]
+            return self._defer_mask_select(mask, records)
 
         return self._drive(stream, eval_batch)
 
@@ -179,8 +178,7 @@ class GeomGeomRangeQuery(SpatialOperator, GeomQueryMixin):
             else:
                 dists = geoms_to_single_geom_dist(geoms, q_edges, q_mask, q_areal)
             mask = range_filter_geom_stream(all_gn, any_nb, dists, radius, geoms.valid)
-            idx = np.nonzero(np.asarray(mask))[0]
-            return [records[i] for i in idx if i < len(records)]
+            return self._defer_mask_select(mask, records)
 
         return self._drive(stream, eval_batch)
 

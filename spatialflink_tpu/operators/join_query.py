@@ -63,11 +63,17 @@ class PointPointJoinQuery(SpatialOperator):
             return r
 
         # same knob semantics as base._drive: depth-1 windows stay in flight
-        # behind the one being assembled
+        # behind the one being assembled; eager (non-Deferred) results pass
+        # straight through once older deferred windows have drained
         for r in results:
-            pending.append(r)
-            while len(pending) > depth - 1:
-                yield force(pending.popleft())
+            if isinstance(r.records, Deferred):
+                pending.append(r)
+                while len(pending) > depth - 1:
+                    yield force(pending.popleft())
+            else:
+                while pending:
+                    yield force(pending.popleft())
+                yield r
         while pending:
             yield force(pending.popleft())
 

@@ -56,8 +56,15 @@ class PointPointKNNQuery(SpatialOperator):
             nb_layers,
             n=self.grid.n,
             k=k,
+            strategy=self._strategy(),
         )
         return self._defer_knn(res)
+
+    def _strategy(self) -> str:
+        # approximate mode trades exactness for speed throughout the
+        # reference (bbox distances); on TPU the selection stage itself has a
+        # partial-reduce fast path with recall < 1, so it rides the same flag
+        return "approx" if self.conf.approximate else "auto"
 
 
 
@@ -81,7 +88,9 @@ class _GenericKnn(SpatialOperator, GeomQueryMixin):
             from spatialflink_tpu.ops.knn import knn_eligible
 
             batch, eligible, dists = self._eligibility(records, ts_base, setup)
-            res = knn_eligible(batch.obj_id, dists, eligible, k=k)
+            strategy = "approx" if self.conf.approximate else "auto"
+            res = knn_eligible(batch.obj_id, dists, eligible, k=k,
+                               strategy=strategy)
             return self._defer_knn(res)
 
         for result in self._drive(stream, eval_batch):

@@ -138,6 +138,25 @@ def _topk_prefiltered(obj_id, dist, eligible, k: int, m: int) -> KnnResult:
     )
 
 
+def _topk_approx(obj_id, dist, eligible, k: int, m: int) -> KnnResult:
+    """Approximate-mode selection via the TPU-native partial-reduce top-k.
+
+    ``lax.approx_min_k`` maps onto the TPU's PartialReduce op and runs at
+    near-HBM-bandwidth, unlike the bitonic networks behind ``sort``/``top_k``.
+    It selects ~m smallest distances at its default recall target, then the
+    tiny exact dedup+top-k runs over those candidates. Results can miss an
+    object whose only near point was dropped by the partial reduce — matching
+    the framework's approximate query mode, which already trades exactness
+    for speed (bbox distances); not for exact-mode pipelines.
+    """
+    n = obj_id.shape[0]
+    m = min(m, n)
+    d_all = jnp.where(eligible, dist, _BIG)
+    oid_all = jnp.where(eligible, obj_id, _OID_SENTINEL)
+    d_m, idx = jax.lax.approx_min_k(d_all, m)
+    return _topk_full_sort(oid_all[idx], d_m, d_m < _BIG, k)
+
+
 # Below this window size the full sort is cheap enough that the grouped
 # path's extra stages don't pay for themselves.
 _GROUPED_MIN_N = 1 << 15
@@ -149,7 +168,8 @@ def topk_by_distance(obj_id, dist, eligible, k: int,
     """Dedup by object id (keep min dist) then top-k smallest distances.
 
     strategy: "auto" (grouped for large windows, full sort for small),
-    "sort", "grouped", or "prefilter".
+    "sort", "grouped", "prefilter" (all exact), or "approx" (recall<1,
+    approximate-mode only).
     """
     n = obj_id.shape[0]
     if strategy == "auto":
@@ -158,9 +178,11 @@ def topk_by_distance(obj_id, dist, eligible, k: int,
         return _topk_grouped(obj_id, dist, eligible, k, _DEFAULT_GROUPS)
     if strategy == "prefilter":
         return _topk_prefiltered(obj_id, dist, eligible, k, max(32 * k, 1024))
+    if strategy == "approx":
+        return _topk_approx(obj_id, dist, eligible, k, max(32 * k, 1024))
     if strategy != "sort":
         raise ValueError(f"unknown kNN strategy {strategy!r}; "
-                         "expected auto|sort|grouped|prefilter")
+                         "expected auto|sort|grouped|prefilter|approx")
     return _topk_full_sort(obj_id, dist, eligible, k)
 
 
@@ -223,11 +245,12 @@ def merge_knn(results, k: int) -> KnnResult:
     return topk_by_distance(obj_id, dist, valid, k)
 
 
-@partial(jax.jit, static_argnames=("k",))
-def knn_eligible(obj_id, dists, eligible, *, k: int) -> KnnResult:
+@partial(jax.jit, static_argnames=("k", "strategy"))
+def knn_eligible(obj_id, dists, eligible, *, k: int,
+                 strategy: str = "auto") -> KnnResult:
     """Jitted dedup+top-k over caller-computed eligibility and distances —
     the generic entry for polygon/linestring streams and geometry queries."""
-    return topk_by_distance(obj_id, dists, eligible, k)
+    return topk_by_distance(obj_id, dists, eligible, k, strategy)
 
 
 def point_stream_eligibility(cell, valid, nb_mask):

@@ -7,17 +7,26 @@ merges become all-gather + re-top-k tree merges on ICI; query objects are
 broadcast (replicated sharding) instead of flatMap-replicated per cell.
 """
 
-from spatialflink_tpu.parallel.mesh import make_mesh, shard_batch
+from spatialflink_tpu.parallel.mesh import (
+    init_distributed,
+    make_mesh,
+    make_mesh_2d,
+    shard_batch,
+)
 from spatialflink_tpu.parallel.ops import (
     distributed_knn,
+    distributed_knn_hierarchical,
     distributed_range_count,
     distributed_join_counts,
 )
 
 __all__ = [
+    "init_distributed",
     "make_mesh",
+    "make_mesh_2d",
     "shard_batch",
     "distributed_knn",
+    "distributed_knn_hierarchical",
     "distributed_range_count",
     "distributed_join_counts",
 ]

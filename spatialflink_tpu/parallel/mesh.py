@@ -11,6 +11,7 @@ correctness requirement like in the reference's per-cell window operators.
 
 from __future__ import annotations
 
+import os
 from typing import Optional
 
 import jax
@@ -18,6 +19,10 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 CELL_AXIS = "cells"
+# Cross-host axis: shards ride DCN between slices, while CELL_AXIS collectives
+# stay on ICI within a slice (SURVEY §2.5 "distributed communication backend";
+# BASELINE config 5's multi-host data-parallel windows).
+DCN_AXIS = "hosts"
 
 
 def make_mesh(n_devices: Optional[int] = None, axis: str = CELL_AXIS) -> Mesh:
@@ -28,11 +33,59 @@ def make_mesh(n_devices: Optional[int] = None, axis: str = CELL_AXIS) -> Mesh:
     return Mesh(np.array(devs[:n]), (axis,))
 
 
-def shard_batch(batch, mesh: Mesh, axis: str = CELL_AXIS):
+def make_mesh_2d(n_outer: Optional[int] = None,
+                 n_inner: Optional[int] = None) -> Mesh:
+    """(DCN_AXIS, CELL_AXIS) mesh: outer axis across hosts/slices, inner axis
+    across the chips of a slice.
+
+    On a real multi-host deployment the outer axis is laid out so its
+    collectives cross DCN and the inner axis stays on ICI
+    (``mesh_utils.create_hybrid_device_mesh``); single-process (tests, the
+    virtual CPU mesh) falls back to a reshape of the local devices, which
+    keeps the same program semantics.
+    """
+    devs = jax.devices()
+    if n_outer is None:
+        n_outer = max(1, jax.process_count())
+    if n_inner is None:
+        n_inner = len(devs) // n_outer
+    if n_inner < 1 or n_outer * n_inner > len(devs):
+        raise ValueError(
+            f"requested {n_outer}x{n_inner} devices, only {len(devs)} available")
+    if jax.process_count() > 1:
+        from jax.experimental import mesh_utils
+
+        arr = mesh_utils.create_hybrid_device_mesh(
+            (1, n_inner), (n_outer, 1), devices=devs[: n_outer * n_inner])
+    else:
+        arr = np.array(devs[: n_outer * n_inner]).reshape(n_outer, n_inner)
+    return Mesh(arr, (DCN_AXIS, CELL_AXIS))
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> None:
+    """Bring up the cross-host runtime (``jax.distributed.initialize``),
+    after which ``jax.devices()`` spans every host and 2-D meshes place the
+    outer axis across DCN. No-op when already initialized or single-process
+    with no coordinator configured (local dev / tests)."""
+    import jax.distributed as jd
+
+    if jd.is_initialized():
+        return
+    if coordinator_address is None and "JAX_COORDINATOR_ADDRESS" not in os.environ:
+        return  # single-process mode
+    jd.initialize(coordinator_address=coordinator_address,
+                  num_processes=num_processes, process_id=process_id)
+
+
+def shard_batch(batch, mesh: Mesh, axis=CELL_AXIS):
     """Place a window batch with its leading (point) dim sharded over the mesh.
 
-    Capacity must divide the mesh size — guaranteed when bucket sizes are
-    powers of two >= the device count.
+    ``axis`` may be one mesh axis name or a tuple of names (2-D meshes shard
+    the point dim over both, e.g. ``("hosts", "cells")``). Capacity must
+    divide the product of the named axes' sizes — guaranteed when bucket
+    sizes are powers of two >= the device count.
     """
     sharding = NamedSharding(mesh, P(axis))
     return jax.device_put(batch, sharding)

@@ -31,7 +31,7 @@ from spatialflink_tpu.models.batches import PointBatch
 from spatialflink_tpu.ops.join import join_mask
 from spatialflink_tpu.ops.knn import KnnResult, knn_point, topk_by_distance
 from spatialflink_tpu.ops.range import range_filter_point
-from spatialflink_tpu.parallel.mesh import CELL_AXIS
+from spatialflink_tpu.parallel.mesh import CELL_AXIS, DCN_AXIS
 
 shard_map = jax.shard_map
 
@@ -67,6 +67,60 @@ def distributed_knn(
         mesh=mesh,
         check_vma=False,
         in_specs=(P(CELL_AXIS),),
+        out_specs=KnnResult(P(), P(), P()),
+    )
+    return fn(points)
+
+
+def distributed_knn_hierarchical(
+    mesh: Mesh,
+    points: PointBatch,
+    qx,
+    qy,
+    q_cell,
+    radius,
+    nb_layers,
+    *,
+    n: int,
+    k: int,
+    enforce_radius: bool = False,
+) -> KnnResult:
+    """kNN over a 2-D (DCN_AXIS, CELL_AXIS) mesh with a two-level merge.
+
+    The window's point dim is sharded over both axes. Each chip computes its
+    local dedup+top-k; the first merge all-gathers k-sized partials *within*
+    a slice (ICI — cheap), the second all-gathers one k-sized partial *per
+    slice* across hosts (DCN — k * n_hosts elements total, independent of
+    window size). This is the multi-host shape of the reference's two-stage
+    local-top-k -> global-merge plan (SURVEY §2.5) without its parallelism-1
+    global stage.
+    """
+
+    def per_shard(pts: PointBatch) -> KnnResult:
+        local = knn_point(
+            pts, qx, qy, q_cell, radius, nb_layers,
+            n=n, k=k, enforce_radius=enforce_radius,
+        )
+        # level 1: merge across the slice (ICI)
+        ici = KnnResult(
+            jax.lax.all_gather(local.obj_id, CELL_AXIS).reshape(-1),
+            jax.lax.all_gather(local.dist, CELL_AXIS).reshape(-1),
+            jax.lax.all_gather(local.valid, CELL_AXIS).reshape(-1),
+        )
+        slice_top = topk_by_distance(ici.obj_id, ici.dist, ici.valid, k)
+        # level 2: merge the per-slice partials across hosts (DCN)
+        dcn = KnnResult(
+            jax.lax.all_gather(slice_top.obj_id, DCN_AXIS).reshape(-1),
+            jax.lax.all_gather(slice_top.dist, DCN_AXIS).reshape(-1),
+            jax.lax.all_gather(slice_top.valid, DCN_AXIS).reshape(-1),
+        )
+        return topk_by_distance(dcn.obj_id, dcn.dist, dcn.valid, k)
+
+    fn = shard_map(
+        per_shard,
+        mesh=mesh,
+        check_vma=False,
+        in_specs=(P((DCN_AXIS, CELL_AXIS)),),
         out_specs=KnnResult(P(), P(), P()),
     )
     return fn(points)

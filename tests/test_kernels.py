@@ -455,3 +455,27 @@ class TestTopkStrategies:
         oid = np.arange(n, dtype=np.int32) % 500
         d = np.full(n, 0.25, np.float32)
         self._check(oid, d, np.ones(n, bool), 20)
+
+    def test_approx_strategy_high_recall_on_random(self):
+        # approx is allowed recall < 1 but must be near-exact on
+        # well-spread random data (and exact on CPU's fallback impl)
+        n, k = 50_000, 50
+        rng = np.random.default_rng(5)
+        oid = rng.integers(0, n // 4, n).astype(np.int32)
+        d = rng.uniform(0, 1, n).astype(np.float32)
+        elig = np.ones(n, bool)
+        want = K.topk_by_distance(jnp.asarray(oid), jnp.asarray(d),
+                                  jnp.asarray(elig), k, strategy="sort")
+        got = K.topk_by_distance(jnp.asarray(oid), jnp.asarray(d),
+                                 jnp.asarray(elig), k, strategy="approx")
+        wd = np.asarray(want.dist)[np.asarray(want.valid)]
+        gd = np.asarray(got.dist)[np.asarray(got.valid)]
+        overlap = len(np.intersect1d(np.asarray(want.obj_id)[np.asarray(want.valid)],
+                                     np.asarray(got.obj_id)[np.asarray(got.valid)]))
+        assert overlap >= int(0.9 * k), overlap
+        assert gd[0] == wd[0]  # nearest object never missed
+
+    def test_unknown_strategy_raises(self):
+        with pytest.raises(ValueError):
+            K.topk_by_distance(jnp.zeros(8, jnp.int32), jnp.zeros(8),
+                               jnp.ones(8, bool), 2, strategy="bogus")

@@ -121,3 +121,48 @@ class TestGraftEntry:
 
         ge.dryrun_multichip(8)
         assert "ok" in capsys.readouterr().out
+
+
+def test_hierarchical_knn_matches_single_device():
+    """2-D (hosts, cells) mesh; two-level ICI->DCN merge must equal the
+    single-device kernel."""
+    from spatialflink_tpu.parallel import (
+        distributed_knn_hierarchical,
+        make_mesh_2d,
+        shard_batch,
+    )
+
+    mesh = make_mesh_2d(2, 4)
+    b = make_batch(512)
+    sharded = shard_batch(b, mesh, axis=mesh.axis_names)
+    qx, qy = 116.5, 40.5
+    got = distributed_knn_hierarchical(
+        mesh, sharded, qx, qy, jnp.int32(0), 0.0, GRID.n, n=GRID.n, k=10)
+    want = knn_point(b, qx, qy, jnp.int32(0), 0.0, GRID.n, n=GRID.n, k=10)
+    np.testing.assert_array_equal(np.asarray(got.valid), np.asarray(want.valid))
+    np.testing.assert_allclose(
+        np.asarray(got.dist)[np.asarray(got.valid)],
+        np.asarray(want.dist)[np.asarray(want.valid)], atol=0)
+
+
+def test_make_mesh_2d_shape_and_axes():
+    from spatialflink_tpu.parallel import make_mesh_2d
+
+    mesh = make_mesh_2d(4, 2)
+    assert mesh.axis_names == ("hosts", "cells")
+    assert mesh.devices.shape == (4, 2)
+
+
+def test_make_mesh_2d_rejects_oversubscription():
+    from spatialflink_tpu.parallel import make_mesh_2d
+
+    with pytest.raises(ValueError):
+        make_mesh_2d(16)  # 16 hosts on an 8-device pool -> inner axis would be 0
+    with pytest.raises(ValueError):
+        make_mesh_2d(4, 4)
+
+
+def test_init_distributed_noop_single_process():
+    from spatialflink_tpu.parallel import init_distributed
+
+    init_distributed()  # no coordinator configured -> must be a silent no-op

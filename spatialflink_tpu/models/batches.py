@@ -49,8 +49,13 @@ class PointBatch(NamedTuple):
         ts=None,
         ts_base: int = 0,
         pad: Optional[int] = None,
+        cell=None,
     ) -> "PointBatch":
-        """Build from host float64 arrays; assigns cells and pads."""
+        """Build from host float64 arrays; assigns cells and pads.
+
+        ``cell`` may carry precomputed cell ids (−1 for out-of-grid), letting
+        bulk/sliding-window callers assign cells once per record instead of
+        once per window membership."""
         x = np.asarray(x, np.float64)
         y = np.asarray(y, np.float64)
         n = x.shape[0]
@@ -59,7 +64,9 @@ class PointBatch(NamedTuple):
             ts32 = np.zeros(n, np.int32)
         else:
             ts32 = (np.asarray(ts, np.int64) - int(ts_base)).astype(np.int32)
-        if grid is not None:
+        if cell is not None:
+            cell = np.asarray(cell, np.int32)
+        elif grid is not None:
             cell, _ = grid.assign_cell(x, y)
         else:
             cell = np.full(n, -1, np.int32)

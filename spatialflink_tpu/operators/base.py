@@ -128,15 +128,39 @@ class SpatialOperator:
             return [records[i] for i in idx if i < len(records)]
         return Deferred(mask, collect)
 
-    def _defer_knn(self, res) -> Deferred:
-        """Deferred (objID, distance) list from a device KnnResult."""
+    def _defer_knn(self, res, interner=None) -> Deferred:
+        """Deferred (objID, distance) list from a device KnnResult; ids
+        resolve through ``interner`` (default: the operator's own — bulk
+        paths pass the parse-time interner)."""
+        interner = interner if interner is not None else self.interner
+
         def collect(r):
             valid = np.asarray(r.valid)
             oids = np.asarray(r.obj_id)[valid]
             dists = np.asarray(r.dist)[valid]
-            return [(self.interner.lookup(int(o)), float(d))
+            return [(interner.lookup(int(o)), float(d))
                     for o, d in zip(oids, dists)]
         return Deferred(res, collect)
+
+    def _knn_strategy(self) -> str:
+        """Top-k selection strategy: approximate mode rides the TPU
+        partial-reduce fast path (recall < 1), exact mode auto-selects."""
+        return "approx" if self.conf.approximate else "auto"
+
+    def _drive_bulk(self, parsed, eval_batch, *, pad: Optional[int] = None
+                    ) -> Iterator["WindowResult"]:
+        """Bulk-replay driver: vectorized window batches
+        (``streams.bulk.bulk_window_batches``) through the pipelined
+        evaluator. eval_batch((idx, PointBatch), ts_base) as in _drive."""
+        from spatialflink_tpu.streams.bulk import bulk_window_batches
+
+        batched = (
+            (start, end, (idx, batch))
+            for start, end, idx, batch in bulk_window_batches(
+                parsed, self.conf.window_spec(), self.grid, pad=pad)
+        )
+        return self._drive_batched(batched, eval_batch,
+                                   count=lambda p: len(p[0]))
 
     def _drive(self, stream: Iterable, eval_batch) -> Iterator["WindowResult"]:
         """Shared window/realtime driver.
@@ -146,12 +170,25 @@ class SpatialOperator:
         ``conf.pipeline_depth`` windows stay in flight on device while the
         host assembles the next batch — and emitted in window order.
         """
+        realtime = self.conf.query_type is QueryType.RealTime
+        if realtime:
+            batched = ((r[0].timestamp, r[-1].timestamp, r)
+                       for r in self._micro_batches(stream) if r)
+        else:
+            batched = self._windows(stream)
+        return self._drive_batched(batched, eval_batch, realtime=realtime)
+
+    def _drive_batched(self, batched: Iterable, eval_batch, *,
+                       realtime: bool = False, count=len
+                       ) -> Iterator["WindowResult"]:
+        """Pipelined evaluation over pre-assembled (start, end, payload)
+        triples (record lists from _drive, or index/batch payloads from the
+        bulk path). ``count(payload)`` feeds the records-evaluated metric."""
         from spatialflink_tpu.utils.metrics import REGISTRY
 
         batches = REGISTRY.counter("batches-evaluated")
         records_c = REGISTRY.counter("records-evaluated")
         depth = max(1, self.conf.pipeline_depth)
-        realtime = self.conf.query_type is QueryType.RealTime
         pending: deque = deque()  # (start, end, Deferred)
 
         def emit(start, end, sel) -> Iterator[WindowResult]:
@@ -166,15 +203,10 @@ class SpatialOperator:
                 start, end, dfd = pending.popleft()
                 yield from emit(start, end, dfd.finish())
 
-        if realtime:
-            batched = ((r[0].timestamp, r[-1].timestamp, r)
-                       for r in self._micro_batches(stream) if r)
-        else:
-            batched = self._windows(stream)
-        for start, end, records in batched:
+        for start, end, payload in batched:
             batches.inc()
-            records_c.inc(len(records))
-            sel = eval_batch(records, start)
+            records_c.inc(count(payload))
+            sel = eval_batch(payload, start)
             if isinstance(sel, Deferred):
                 pending.append((start, end, sel))
                 yield from drain(depth - 1)

@@ -56,15 +56,32 @@ class PointPointKNNQuery(SpatialOperator):
             nb_layers,
             n=self.grid.n,
             k=k,
-            strategy=self._strategy(),
+            strategy=self._knn_strategy(),
         )
         return self._defer_knn(res)
 
-    def _strategy(self) -> str:
-        # approximate mode trades exactness for speed throughout the
-        # reference (bbox distances); on TPU the selection stage itself has a
-        # partial-reduce fast path with recall < 1, so it rides the same flag
-        return "approx" if self.conf.approximate else "auto"
+    def run_bulk(self, parsed, query_point: Point, radius: float,
+                 k: Optional[int] = None, *, pad: Optional[int] = None
+                 ) -> Iterator[WindowResult]:
+        """Bulk-replay fast path over vectorized window batches; records are
+        (objID, distance) pairs resolved through the parse-time interner."""
+        k = k or self.conf.k
+        nb_layers = (
+            self.grid.n if radius == 0 else self.grid.candidate_layers(radius)
+        )
+
+        def eval_batch(payload, ts_base):
+            _idx, batch = payload
+            res = knn_point(
+                batch, query_point.x, query_point.y,
+                jnp.int32(query_point.cell), radius, nb_layers,
+                n=self.grid.n, k=k, strategy=self._knn_strategy(),
+            )
+            return self._defer_knn(res, interner=parsed.interner)
+
+        for result in self._drive_bulk(parsed, eval_batch, pad=pad):
+            result.extras["k"] = k
+            yield result
 
 
 
@@ -88,9 +105,8 @@ class _GenericKnn(SpatialOperator, GeomQueryMixin):
             from spatialflink_tpu.ops.knn import knn_eligible
 
             batch, eligible, dists = self._eligibility(records, ts_base, setup)
-            strategy = "approx" if self.conf.approximate else "auto"
             res = knn_eligible(batch.obj_id, dists, eligible, k=k,
-                               strategy=strategy)
+                               strategy=self._knn_strategy())
             return self._defer_knn(res)
 
         for result in self._drive(stream, eval_batch):

@@ -55,6 +55,31 @@ class PointPointRangeQuery(SpatialOperator):
 
     # ---------------------------------------------------------------- #
 
+    def run_bulk(self, parsed, query_point: Point, radius: float, *,
+                 pad: Optional[int] = None) -> Iterator[WindowResult]:
+        """Bulk-replay fast path: windows come from the vectorized assembler
+        (``streams.bulk.bulk_window_batches``) and results are original-record
+        index lists — no per-record Python objects anywhere.
+
+        Windowed mode only (a bounded replay has no realtime trigger).
+        """
+        gn_layers = self.grid.guaranteed_layers(radius)
+        cn_layers = self.grid.candidate_layers(radius)
+
+        def eval_batch(payload, ts_base):
+            idx, batch = payload
+            mask, _ = range_filter_point(
+                batch, query_point.x, query_point.y,
+                jnp.int32(query_point.cell), radius, gn_layers, cn_layers,
+                n=self.grid.n, approximate=self.conf.approximate,
+            )
+            return Deferred(
+                mask,
+                lambda m: idx[np.asarray(m)[: len(idx)]].tolist(),
+            )
+
+        return self._drive_bulk(parsed, eval_batch, pad=pad)
+
     def run_incremental(self, stream: Iterable[Point], query_point: Point,
                         radius: float) -> Iterator[WindowResult]:
         """Incremental sliding windows: carry the previous window's survivors

@@ -42,6 +42,30 @@ class WindowSpec:
             start -= self.slide_ms
         return starts
 
+    def assign_bulk(self, ts_ms) -> "Tuple[object, object]":
+        """Vectorized :meth:`assign` over an array of event times.
+
+        Returns ``(win_start, rec_idx)`` sorted by (window, original record
+        order): every (window, record) membership pair, grouped by window.
+        This is the replay/bulk-ingest fast path — no per-record Python loop,
+        no watermark bookkeeping (a bounded replay has complete data, so no
+        record is ever late).
+        """
+        import numpy as np
+
+        ts = np.asarray(ts_ms, np.int64)
+        n_max = -(-self.size_ms // self.slide_ms)  # ceil
+        last = ts - (ts % self.slide_ms)
+        offs = np.arange(n_max, dtype=np.int64) * self.slide_ms
+        starts = last[:, None] - offs[None, :]         # (N, n_max)
+        valid = starts > (ts[:, None] - self.size_ms)
+        rec = np.broadcast_to(
+            np.arange(ts.shape[0], dtype=np.int64)[:, None], starts.shape)
+        win_start = starts[valid]
+        rec_idx = rec[valid]
+        order = np.lexsort((rec_idx, win_start))
+        return win_start[order], rec_idx[order]
+
 
 class WindowAssembler:
     """Buffers records into event-time windows; yields sealed windows.

@@ -249,6 +249,40 @@ def bulk_parse_geojson(
     return _merge_rejects(n, accepted, reparsed, interner)
 
 
+def bulk_window_batches(parsed: ParsedPoints, spec, grid=None, *,
+                        pad: Optional[int] = None):
+    """Vectorized window assembly: ParsedPoints -> per-window device batches.
+
+    Yields ``(start, end, idx, PointBatch)`` in window order, where ``idx``
+    is the original-record index array for the window. The whole assignment
+    is numpy (``WindowSpec.assign_bulk``); batches are built straight from
+    the SoA slices, so no per-record Python objects exist anywhere on this
+    path — the high-throughput twin of ``WindowAssembler`` for bounded
+    replays, mirroring how ``bulk_parse_*`` twins ``formats.parse_spatial``.
+    """
+    if not len(parsed):
+        return
+    win, rec = spec.assign_bulk(parsed.ts)
+    # cells once per record, not once per window membership (sliding windows
+    # revisit each record size/slide times)
+    if grid is not None:
+        cells, _ = grid.assign_cell(parsed.x, parsed.y)
+        cells = np.asarray(cells, np.int32)
+    else:
+        cells = np.full(len(parsed), -1, np.int32)
+    bounds = np.flatnonzero(np.r_[True, win[1:] != win[:-1], True])
+    for i in range(len(bounds) - 1):
+        lo, hi = int(bounds[i]), int(bounds[i + 1])
+        start = int(win[lo])
+        idx = rec[lo:hi]
+        batch = PointBatch.from_arrays(
+            parsed.x[idx], parsed.y[idx], grid=grid,
+            obj_id=parsed.obj_id[idx], ts=parsed.ts[idx],
+            ts_base=start, pad=pad, cell=cells[idx],
+        )
+        yield start, start + spec.size_ms, idx, batch
+
+
 def bulk_parse_file(path: str, fmt: str, **kw) -> ParsedPoints:
     """Bulk-parse a whole replay file of points."""
     with open(path, "rb") as f:

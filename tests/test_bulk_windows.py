@@ -1,0 +1,117 @@
+"""Vectorized window assembly (bulk replay path) vs the per-record path."""
+
+import numpy as np
+import pytest
+
+from spatialflink_tpu.index import UniformGrid
+from spatialflink_tpu.models import Point
+from spatialflink_tpu.operators.base import QueryConfiguration
+from spatialflink_tpu.operators.knn_query import PointPointKNNQuery
+from spatialflink_tpu.operators.range_query import PointPointRangeQuery
+from spatialflink_tpu.runtime.windows import WindowAssembler, WindowSpec
+from spatialflink_tpu.streams.bulk import ParsedPoints, bulk_window_batches
+from spatialflink_tpu.utils import IdInterner
+
+GRID = UniformGrid(115.50, 117.60, 39.60, 41.10, num_grid_partitions=100)
+T0 = 1_700_000_000_000
+
+
+def parsed_points(n=600, seed=0, ordered=True):
+    rng = np.random.default_rng(seed)
+    interner = IdInterner()
+    ts = T0 + np.sort(rng.integers(0, 60_000, n)) if ordered else \
+        T0 + rng.integers(0, 60_000, n)
+    oid = np.array([interner.intern(str(i % 40)) for i in range(n)], np.int32)
+    return ParsedPoints(
+        x=rng.uniform(115.6, 117.5, n),
+        y=rng.uniform(39.7, 41.0, n),
+        ts=np.asarray(ts, np.int64),
+        obj_id=oid,
+        interner=interner,
+    )
+
+
+class TestAssignBulk:
+    @pytest.mark.parametrize("size,slide", [(10_000, 5_000), (10_000, 3_000),
+                                            (7_000, 7_000), (5_000, 1_000)])
+    def test_matches_scalar_assign(self, size, slide):
+        spec = WindowSpec(size, slide)
+        rng = np.random.default_rng(size + slide)
+        ts = T0 + rng.integers(0, 100_000, 500)
+        win, rec = spec.assign_bulk(ts)
+        got = {}
+        for w, r in zip(win, rec):
+            got.setdefault(int(w), []).append(int(r))
+        want = {}
+        for i, t in enumerate(ts):
+            for w in spec.assign(int(t)):
+                want.setdefault(w, []).append(i)
+        assert set(got) == set(want)
+        for w in want:
+            assert sorted(want[w]) == got[w]  # grouped, record order preserved
+
+    def test_empty(self):
+        win, rec = WindowSpec(10_000, 5_000).assign_bulk(np.empty(0, np.int64))
+        assert len(win) == 0 and len(rec) == 0
+
+
+class TestBulkWindowBatches:
+    def test_membership_matches_window_assembler(self):
+        p = parsed_points()
+        spec = WindowSpec.sliding(10_000, 5_000)
+        bulk = {start: set(np.asarray(idx))
+                for start, _end, idx, _b in bulk_window_batches(p, spec, GRID)}
+        wa = WindowAssembler(spec)
+        ref = {}
+        sealed = []
+        for i in range(len(p)):
+            sealed.extend(wa.add(int(p.ts[i]), i))
+        sealed.extend(wa.flush())
+        for start, _end, recs in sealed:
+            ref[start] = set(recs)
+        assert bulk == ref
+
+    def test_batch_contents_align(self):
+        p = parsed_points(100, seed=3)
+        spec = WindowSpec.tumbling(10_000)
+        for start, end, idx, batch in bulk_window_batches(p, spec, GRID):
+            n = len(idx)
+            assert int(batch.valid.sum()) == n
+            np.testing.assert_allclose(np.asarray(batch.x)[:n],
+                                       p.x[idx].astype(np.float32))
+            np.testing.assert_array_equal(np.asarray(batch.obj_id)[:n],
+                                          p.obj_id[idx])
+
+
+class TestRunBulkEquivalence:
+    def _record_stream(self, p: ParsedPoints):
+        return [
+            Point.create(float(p.x[i]), float(p.y[i]), GRID,
+                         p.interner.lookup(int(p.obj_id[i])), int(p.ts[i]))
+            for i in range(len(p))
+        ]
+
+    def test_range_bulk_matches_record_path(self):
+        p = parsed_points(500, seed=7)
+        q = Point.create(116.5, 40.5, GRID)
+        conf = QueryConfiguration(window_size_ms=10_000, slide_ms=5_000)
+        rec_out = list(PointPointRangeQuery(conf, GRID).run(
+            iter(self._record_stream(p)), q, 0.4))
+        bulk_out = list(PointPointRangeQuery(conf, GRID).run_bulk(p, q, 0.4))
+        rec_map = {w.window_start: sorted(r.obj_id for r in w.records)
+                   for w in rec_out}
+        bulk_map = {w.window_start:
+                    sorted(p.interner.lookup(int(p.obj_id[i]))
+                           for i in w.records)
+                    for w in bulk_out}
+        assert rec_map == bulk_map
+
+    def test_knn_bulk_matches_record_path(self):
+        p = parsed_points(500, seed=8)
+        q = Point.create(116.5, 40.5, GRID)
+        conf = QueryConfiguration(window_size_ms=10_000, slide_ms=5_000, k=5)
+        rec_out = list(PointPointKNNQuery(conf, GRID).run(
+            iter(self._record_stream(p)), q, 0.0))
+        bulk_out = list(PointPointKNNQuery(conf, GRID).run_bulk(p, q, 0.0))
+        assert [(w.window_start, sorted(w.records)) for w in rec_out] == \
+               [(w.window_start, sorted(w.records)) for w in bulk_out]

@@ -353,6 +353,48 @@ def _run_synthetic(params: Params, conf, grid) -> Iterator[WindowResult]:
 # CLI
 
 
+def run_option_bulk(params: Params, input_path: str) -> Optional[Iterator]:
+    """Vectorized replay fast path for windowed Point/Point range & kNN cases
+    over CSV/TSV/GeoJSON point files: native ingest -> bulk window batches ->
+    pipelined kernels, no per-record Python objects. Returns None when the
+    case/format cannot ride it (caller falls back to the record path)."""
+    from spatialflink_tpu.streams.bulk import bulk_parse_file
+
+    spec = CASES.get(params.query.option)
+    if (spec is None or spec.family not in ("range", "knn")
+            or (spec.stream, spec.query) != ("Point", "Point")
+            or spec.mode != "window" or spec.latency):
+        return None
+    if params.query.allowed_lateness_s:
+        # the bulk assembler treats a replay as complete data (no watermark
+        # dropping), so a config that asks for lateness semantics must take
+        # the record path to keep --bulk a pure fast path
+        return None
+    cfg = params.input1
+    fmt = cfg.format.lower()
+    if fmt not in ("csv", "tsv", "geojson"):
+        return None
+    if fmt in ("csv", "tsv"):
+        schema = list(cfg.csv_tsv_schema) + [None] * (4 - len(cfg.csv_tsv_schema))
+        delim = "\t" if fmt == "tsv" else cfg.delimiter
+        parsed = bulk_parse_file(
+            input_path, fmt, delimiter=delim, schema=schema[:4],
+            date_format=cfg.date_format)
+    else:
+        parsed = bulk_parse_file(
+            input_path, fmt, property_obj_id=cfg.geojson_obj_id_attr,
+            property_timestamp=cfg.geojson_timestamp_attr,
+            date_format=cfg.date_format)
+    u_grid, _ = params.grids()
+    conf = _query_conf(params, spec)
+    q = _query_object(params, u_grid, "Point")
+    if spec.family == "range":
+        return ops.PointPointRangeQuery(conf, u_grid).run_bulk(
+            parsed, q, params.query.radius)
+    return ops.PointPointKNNQuery(conf, u_grid).run_bulk(
+        parsed, q, params.query.radius, params.query.k)
+
+
 def _emit(result, sink) -> None:
     if isinstance(result, WindowResult):
         sink.emit({
@@ -378,6 +420,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="override query.option")
     ap.add_argument("--metrics", action="store_true",
                     help="print a metrics snapshot to stderr at exit")
+    ap.add_argument("--bulk", action="store_true",
+                    help="vectorized replay fast path (native ingest + bulk "
+                         "windows) for windowed Point/Point range & kNN "
+                         "cases; treats the file as complete data (no "
+                         "late-record dropping or control-tuple stop)")
     args = ap.parse_args(argv)
 
     params = Params.from_yaml(args.config)
@@ -404,11 +451,22 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     from spatialflink_tpu.utils.metrics import ControlTupleExit
 
+    results = None
+    if args.bulk:
+        if args.limit is not None:
+            print("--bulk ignores --limit (whole-file replay)", file=sys.stderr)
+        results = run_option_bulk(params, args.input1)
+        if results is None:
+            print("--bulk not applicable to this case/format; "
+                  "using the record path", file=sys.stderr)
+    if results is None:
+        results = run_option(params, stream1, stream2)
+
     sink = StdoutSink()
     n = 0
     stopped = False
     try:
-        for result in run_option(params, stream1, stream2):
+        for result in results:
             _emit(result, sink)
             n += 1
     except ControlTupleExit:

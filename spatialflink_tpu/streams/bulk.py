@@ -263,6 +263,8 @@ def bulk_window_batches(parsed: ParsedPoints, spec, grid=None, *,
     if not len(parsed):
         return
     win, rec = spec.assign_bulk(parsed.ts)
+    if not len(win):  # sampling specs (slide > size) can assign nothing
+        return
     # cells once per record, not once per window membership (sliding windows
     # revisit each record size/slide times)
     if grid is not None:

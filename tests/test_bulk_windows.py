@@ -115,3 +115,93 @@ class TestRunBulkEquivalence:
         bulk_out = list(PointPointKNNQuery(conf, GRID).run_bulk(p, q, 0.0))
         assert [(w.window_start, sorted(w.records)) for w in rec_out] == \
                [(w.window_start, sorted(w.records)) for w in bulk_out]
+
+
+class TestDriverBulk:
+    def _write_csv(self, tmp_path, n=300):
+        rng = np.random.default_rng(12)
+        rows = [f"o{i % 30},{T0 + i * 40},{rng.uniform(115.6, 117.5):.6f},"
+                f"{rng.uniform(39.7, 41.0):.6f}" for i in range(n)]
+        f = tmp_path / "pts.csv"
+        f.write_text("\n".join(rows))
+        return f, rows
+
+    def _params(self, option):
+        import dataclasses
+        from spatialflink_tpu.config import Params
+        p = Params.from_yaml("conf/spatialflink-conf.yml")
+        # the canonical conf allows 1s lateness; --bulk declines then, so the
+        # eligibility tests pin it to 0 (complete-replay semantics)
+        q = dataclasses.replace(p.query, option=option, radius=0.4, k=5,
+                                allowed_lateness_s=0)
+        i1 = dataclasses.replace(p.input1, format="CSV", date_format=None)
+        return dataclasses.replace(p, query=q, input1=i1)
+
+    def test_bulk_matches_record_path_via_driver(self, tmp_path):
+        from spatialflink_tpu.driver import run_option, run_option_bulk
+        f, rows = self._write_csv(tmp_path)
+        p = self._params(1)  # windowed Point/Point range
+        bulk = list(run_option_bulk(p, str(f)))
+        rec = list(run_option(p, iter(rows)))
+        assert [(w.window_start, len(w.records)) for w in bulk] == \
+               [(w.window_start, len(w.records)) for w in rec]
+
+    def test_bulk_declines_unsupported_case(self, tmp_path):
+        from spatialflink_tpu.driver import run_option_bulk
+        f, _ = self._write_csv(tmp_path)
+        p = self._params(2)  # realtime -> not bulk-eligible
+        assert run_option_bulk(p, str(f)) is None
+
+    def test_driver_cli_bulk(self, tmp_path, capsys):
+        from spatialflink_tpu.driver import main
+        f, _ = self._write_csv(tmp_path)
+        import dataclasses, yaml
+        # write a CSV-format config variant next to the canonical one
+        cfg = yaml.safe_load(open("conf/spatialflink-conf.yml").read().split("\n", 1)[1]
+                             if open("conf/spatialflink-conf.yml").read().startswith("!!")
+                             else open("conf/spatialflink-conf.yml").read())
+        cfg["inputStream1"]["format"] = "CSV"
+        cfg.setdefault("query", {})["option"] = 51
+        cfg["query"].setdefault("thresholds", {})["outOfOrderTuples"] = 0
+        cfgp = tmp_path / "conf.yml"
+        cfgp.write_text(yaml.safe_dump(cfg))
+        rc = main(["--config", str(cfgp), "--input1", str(f), "--bulk"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert out.strip()  # emitted window summaries
+
+    def test_bulk_declines_when_lateness_configured(self, tmp_path):
+        import dataclasses
+        from spatialflink_tpu.driver import run_option_bulk
+        f, _ = self._write_csv(tmp_path)
+        p = self._params(1)
+        p = dataclasses.replace(
+            p, query=dataclasses.replace(p.query, allowed_lateness_s=2))
+        assert run_option_bulk(p, str(f)) is None
+
+    def test_bulk_tsv_forces_tab_delimiter(self, tmp_path):
+        import dataclasses
+        from spatialflink_tpu.driver import run_option_bulk
+        rng = np.random.default_rng(13)
+        rows = [f"o{i % 30}\t{T0 + i * 40}\t{rng.uniform(115.6, 117.5):.6f}\t"
+                f"{rng.uniform(39.7, 41.0):.6f}" for i in range(200)]
+        f = tmp_path / "pts.tsv"
+        f.write_text("\n".join(rows))
+        p = self._params(1)
+        p = dataclasses.replace(
+            p, input1=dataclasses.replace(p.input1, format="TSV"))
+        out = list(run_option_bulk(p, str(f)))
+        assert out and sum(len(w.records) for w in out) > 0
+
+
+def test_bulk_window_batches_sampling_spec_empty():
+    # slide > size: records in the gap belong to no window; must not crash
+    p = parsed_points(50, seed=9)
+    spec = WindowSpec(1_000, 60_000)
+    out = list(bulk_window_batches(p, spec, GRID))
+    # equivalence with the scalar path
+    want = set()
+    for i in range(len(p)):
+        for w in spec.assign(int(p.ts[i])):
+            want.add(w)
+    assert {s for s, *_ in out} == want

@@ -83,11 +83,13 @@ def bench_device(grid, batch) -> float:
     batch = jax.device_put(batch)
     qc = jnp.int32(q_cell)
 
+    strategy = os.environ.get("SPATIALFLINK_BENCH_STRATEGY", "auto")
+
     @partial(jax.jit, static_argnames=("iters",))
     def run_n(b, *, iters):
         def body(i, acc):
             r = knn_point(b, qx + i * 1e-7, qy, qc, RADIUS, nb_layers,
-                          n=grid.n, k=K)
+                          n=grid.n, k=K, strategy=strategy)
             return acc + r.dist[0]
         return jax.lax.fori_loop(0, iters, body, jnp.float32(0))
 

@@ -356,19 +356,19 @@ def _run_synthetic(params: Params, conf, grid) -> Iterator[WindowResult]:
 def run_option_bulk(params: Params, input_path: str) -> Optional[Iterator]:
     """Vectorized replay fast path for windowed Point/Point range & kNN cases
     over CSV/TSV/GeoJSON point files: native ingest -> bulk window batches ->
-    pipelined kernels, no per-record Python objects. Returns None when the
-    case/format cannot ride it (caller falls back to the record path)."""
+    pipelined kernels, no per-record Python objects. Lateness semantics match
+    the record path exactly: records the watermark would have dropped are
+    filtered vectorized before windowing. Returns None when the case/format
+    cannot ride it (caller falls back to the record path)."""
+    import dataclasses
+
+    from spatialflink_tpu.runtime.watermarks import BoundedOutOfOrderness
     from spatialflink_tpu.streams.bulk import bulk_parse_file
 
     spec = CASES.get(params.query.option)
     if (spec is None or spec.family not in ("range", "knn")
             or (spec.stream, spec.query) != ("Point", "Point")
             or spec.mode != "window" or spec.latency):
-        return None
-    if params.query.allowed_lateness_s:
-        # the bulk assembler treats a replay as complete data (no watermark
-        # dropping), so a config that asks for lateness semantics must take
-        # the record path to keep --bulk a pure fast path
         return None
     cfg = params.input1
     fmt = cfg.format.lower()
@@ -385,6 +385,14 @@ def run_option_bulk(params: Params, input_path: str) -> Optional[Iterator]:
             input_path, fmt, property_obj_id=cfg.geojson_obj_id_attr,
             property_timestamp=cfg.geojson_timestamp_attr,
             date_format=cfg.date_format)
+    # reproduce the record path's watermark dropping (same keep/late rule,
+    # computed in one vectorized pass over the timestamp array)
+    keep = BoundedOutOfOrderness.bulk_keep_mask(
+        parsed.ts, params.query.allowed_lateness_s * 1000)
+    if not keep.all():
+        parsed = dataclasses.replace(
+            parsed, x=parsed.x[keep], y=parsed.y[keep], ts=parsed.ts[keep],
+            obj_id=parsed.obj_id[keep])
     u_grid, _ = params.grids()
     conf = _query_conf(params, spec)
     q = _query_object(params, u_grid, "Point")
@@ -418,18 +426,26 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="max records to read per stream")
     ap.add_argument("--option", type=int, default=None,
                     help="override query.option")
+    ap.add_argument("--format", default=None,
+                    help="override inputStream1.format (GeoJSON/WKT/CSV/TSV)")
     ap.add_argument("--metrics", action="store_true",
                     help="print a metrics snapshot to stderr at exit")
     ap.add_argument("--bulk", action="store_true",
                     help="vectorized replay fast path (native ingest + bulk "
                          "windows) for windowed Point/Point range & kNN "
-                         "cases; treats the file as complete data (no "
-                         "late-record dropping or control-tuple stop)")
+                         "cases; record-path lateness semantics, but no "
+                         "control-tuple stop hook")
     args = ap.parse_args(argv)
 
     params = Params.from_yaml(args.config)
     if args.option is not None:
         params.query.option = args.option
+    if args.format is not None:
+        import dataclasses
+
+        params = dataclasses.replace(
+            params, input1=dataclasses.replace(params.input1,
+                                               format=args.format))
 
     from spatialflink_tpu.streams.sinks import StdoutSink
     from spatialflink_tpu.streams.sources import FileReplaySource
@@ -453,12 +469,12 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     results = None
     if args.bulk:
-        if args.limit is not None:
-            print("--bulk ignores --limit (whole-file replay)", file=sys.stderr)
         results = run_option_bulk(params, args.input1)
         if results is None:
             print("--bulk not applicable to this case/format; "
                   "using the record path", file=sys.stderr)
+        elif args.limit is not None:
+            print("--bulk ignores --limit (whole-file replay)", file=sys.stderr)
     if results is None:
         results = run_option(params, stream1, stream2)
 

@@ -173,7 +173,15 @@ def topk_by_distance(obj_id, dist, eligible, k: int,
     """
     n = obj_id.shape[0]
     if strategy == "auto":
-        strategy = "grouped" if n >= _GROUPED_MIN_N else "sort"
+        if n < _GROUPED_MIN_N:
+            strategy = "sort"
+        elif jax.default_backend() == "cpu":
+            # measured (benchmarks/sweep_knn.py): CPU top_k is a linear-time
+            # partial selection, so the m-candidate prefilter beats every
+            # sort-based path by ~30-50x at 1M points
+            strategy = "prefilter"
+        else:
+            strategy = "grouped"
     if strategy == "grouped":
         return _topk_grouped(obj_id, dist, eligible, k, _DEFAULT_GROUPS)
     if strategy == "prefilter":

@@ -126,14 +126,12 @@ class TestDriverBulk:
         f.write_text("\n".join(rows))
         return f, rows
 
-    def _params(self, option):
+    def _params(self, option, lateness_s=0):
         import dataclasses
         from spatialflink_tpu.config import Params
         p = Params.from_yaml("conf/spatialflink-conf.yml")
-        # the canonical conf allows 1s lateness; --bulk declines then, so the
-        # eligibility tests pin it to 0 (complete-replay semantics)
         q = dataclasses.replace(p.query, option=option, radius=0.4, k=5,
-                                allowed_lateness_s=0)
+                                allowed_lateness_s=lateness_s)
         i1 = dataclasses.replace(p.input1, format="CSV", date_format=None)
         return dataclasses.replace(p, query=q, input1=i1)
 
@@ -153,31 +151,31 @@ class TestDriverBulk:
         assert run_option_bulk(p, str(f)) is None
 
     def test_driver_cli_bulk(self, tmp_path, capsys):
+        # the README quickstart shape: canonical config + CLI overrides
         from spatialflink_tpu.driver import main
         f, _ = self._write_csv(tmp_path)
-        import dataclasses, yaml
-        # write a CSV-format config variant next to the canonical one
-        cfg = yaml.safe_load(open("conf/spatialflink-conf.yml").read().split("\n", 1)[1]
-                             if open("conf/spatialflink-conf.yml").read().startswith("!!")
-                             else open("conf/spatialflink-conf.yml").read())
-        cfg["inputStream1"]["format"] = "CSV"
-        cfg.setdefault("query", {})["option"] = 51
-        cfg["query"].setdefault("thresholds", {})["outOfOrderTuples"] = 0
-        cfgp = tmp_path / "conf.yml"
-        cfgp.write_text(yaml.safe_dump(cfg))
-        rc = main(["--config", str(cfgp), "--input1", str(f), "--bulk"])
+        rc = main(["--config", "conf/spatialflink-conf.yml", "--option", "51",
+                   "--format", "CSV", "--input1", str(f), "--bulk"])
         assert rc == 0
         out = capsys.readouterr().out
         assert out.strip()  # emitted window summaries
 
-    def test_bulk_declines_when_lateness_configured(self, tmp_path):
-        import dataclasses
-        from spatialflink_tpu.driver import run_option_bulk
-        f, _ = self._write_csv(tmp_path)
-        p = self._params(1)
-        p = dataclasses.replace(
-            p, query=dataclasses.replace(p.query, allowed_lateness_s=2))
-        assert run_option_bulk(p, str(f)) is None
+    def test_bulk_matches_record_path_out_of_order_with_lateness(self, tmp_path):
+        # shuffled timestamps: the record path's watermark drops stragglers;
+        # the bulk path must drop exactly the same ones
+        from spatialflink_tpu.driver import run_option, run_option_bulk
+        rng = np.random.default_rng(21)
+        ts = T0 + rng.integers(0, 30_000, 400)
+        rows = [f"o{i % 30},{int(t)},{rng.uniform(115.6, 117.5):.6f},"
+                f"{rng.uniform(39.7, 41.0):.6f}" for i, t in enumerate(ts)]
+        f = tmp_path / "ooo.csv"
+        f.write_text("\n".join(rows))
+        for lateness in (0, 2, 1000):
+            p = self._params(1, lateness_s=lateness)
+            bulk = list(run_option_bulk(p, str(f)))
+            rec = list(run_option(p, iter(rows)))
+            assert [(w.window_start, len(w.records)) for w in bulk] == \
+                   [(w.window_start, len(w.records)) for w in rec], lateness
 
     def test_bulk_tsv_forces_tab_delimiter(self, tmp_path):
         import dataclasses

@@ -211,6 +211,10 @@ class Params:
     output: OutputStreamConfig = field(default_factory=OutputStreamConfig)
     query: QueryConfig = field(default_factory=QueryConfig)
     window: WindowConfig = field(default_factory=WindowConfig)
+    # CLI-only knobs (no YAML field in the reference schema): state
+    # checkpointing for stateful realtime queries
+    checkpoint_path: Optional[str] = None
+    checkpoint_every: int = 16
 
     # ------------------------------------------------------------------ #
 

@@ -298,7 +298,9 @@ def _run_trajectory(params, spec, conf, u_grid, q_grid, stream1, stream2):
         return op.run_naive(s1, polys) if spec.naive else op.run(s1, polys)
     if spec.family == "tstats":
         return ops.PointTStatsQuery(conf, u_grid).run(
-            s1, set(q.traj_ids) or None)
+            s1, set(q.traj_ids) or None,
+            checkpoint_path=params.checkpoint_path,
+            checkpoint_every=params.checkpoint_every)
     if spec.family == "taggregate":
         return ops.PointTAggregateQuery(conf, u_grid).run(
             s1, q.aggregate_function,
@@ -428,6 +430,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="override query.option")
     ap.add_argument("--format", default=None,
                     help="override inputStream1.format (GeoJSON/WKT/CSV/TSV)")
+    ap.add_argument("--checkpoint", default=None,
+                    help="state checkpoint file for stateful realtime queries "
+                         "(tStats): saved periodically, restored at startup")
+    ap.add_argument("--checkpoint-every", type=int, default=16,
+                    help="micro-batches between checkpoints (default 16)")
     ap.add_argument("--metrics", action="store_true",
                     help="print a metrics snapshot to stderr at exit")
     ap.add_argument("--bulk", action="store_true",
@@ -446,6 +453,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         params = dataclasses.replace(
             params, input1=dataclasses.replace(params.input1,
                                                format=args.format))
+    if args.checkpoint:
+        params.checkpoint_path = args.checkpoint
+        params.checkpoint_every = args.checkpoint_every
+        cp_spec = CASES.get(params.query.option)
+        if cp_spec and not (cp_spec.family == "tstats"
+                            and cp_spec.mode == "realtime"):
+            print("--checkpoint only applies to stateful realtime queries "
+                  "(tStats, queryOption 205); ignored for this case",
+                  file=sys.stderr)
 
     from spatialflink_tpu.streams.sinks import StdoutSink
     from spatialflink_tpu.streams.sources import FileReplaySource

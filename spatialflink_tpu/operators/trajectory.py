@@ -26,6 +26,7 @@ time-sorted sub-trajectory LineStrings, as the reference does.
 
 from __future__ import annotations
 
+import os
 from collections import defaultdict
 from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
 
@@ -167,8 +168,18 @@ class PointTStatsQuery(SpatialOperator):
     state (``tStats/TStatsQuery.java:153-197``).
     """
 
-    def run(self, stream: Iterable[Point], traj_ids: Optional[Set[str]] = None
+    def run(self, stream: Iterable[Point], traj_ids: Optional[Set[str]] = None,
+            *, checkpoint_path: Optional[str] = None,
+            checkpoint_every: int = 16, resume: bool = True
             ) -> Iterator[WindowResult]:
+        """``checkpoint_path`` makes the realtime run durable: every
+        ``checkpoint_every`` micro-batches the device state, the interner, and
+        the timestamp base are snapshotted atomically; ``resume`` restores
+        them at startup, so a restarted process continues accumulating where
+        the previous one stopped (the source replays from its own offset —
+        e.g. a Kafka consumer group — this restores the operator state the
+        reference would have gotten from Flink checkpointing, were it
+        configured; SURVEY §5)."""
         from spatialflink_tpu.runtime.state import TrajStateStore
 
         allowed = set(traj_ids or ())
@@ -180,6 +191,9 @@ class PointTStatsQuery(SpatialOperator):
             # on unbounded runs). Batches spanning more event time than the
             # device's int32-offset horizon are split host-side first.
             ts_base = None
+            if checkpoint_path and resume and os.path.exists(checkpoint_path):
+                store, ts_base = self._restore_checkpoint(checkpoint_path)
+            n_batches = 0
             for records in self._split_by_span(self._micro_batches(stream)):
                 if allowed:
                     records = [p for p in records if p.obj_id in allowed]
@@ -191,9 +205,14 @@ class PointTStatsQuery(SpatialOperator):
                     store.rebase_ts(records[0].timestamp - ts_base)
                     ts_base = records[0].timestamp
                 tuples = self._update(store, records, ts_base)
+                n_batches += 1
+                if checkpoint_path and n_batches % max(1, checkpoint_every) == 0:
+                    self._save_checkpoint(store, ts_base, checkpoint_path)
                 if tuples:
                     yield WindowResult(records[0].timestamp,
                                        records[-1].timestamp, tuples)
+            if checkpoint_path and n_batches:
+                self._save_checkpoint(store, ts_base, checkpoint_path)
         else:
             for start, end, records in self._windows(stream):
                 if allowed:
@@ -205,6 +224,20 @@ class PointTStatsQuery(SpatialOperator):
                 for t in tuples:
                     final[t[0]] = t
                 yield WindowResult(start, end, list(final.values()))
+
+    def _save_checkpoint(self, store, ts_base: int, path: str) -> None:
+        cp = store.snapshot()
+        cp.meta["ts_base"] = int(ts_base)
+        cp.meta["interner"] = self.interner.to_list()
+        cp.save(path)
+
+    def _restore_checkpoint(self, path: str):
+        from spatialflink_tpu.runtime.state import CheckpointableState, TrajStateStore
+        from spatialflink_tpu.utils import IdInterner
+
+        cp = CheckpointableState.load(path)
+        self.interner = IdInterner.from_list(cp.meta["interner"])
+        return TrajStateStore.restore(cp), int(cp.meta["ts_base"])
 
     _SPAN_HORIZON_MS = 2**30  # device ts offsets are int32; stay well inside
 

@@ -25,8 +25,21 @@ class CheckpointableState:
         self.meta: Dict[str, Any] = {}
 
     def save(self, path: str) -> None:
+        """Atomic write: a crash mid-save never corrupts the previous
+        checkpoint (tmp file + rename)."""
         host = {k: np.asarray(v) for k, v in self.arrays.items()}
-        np.savez(path, __meta__=json.dumps(self.meta), **host)
+        tmp = f"{path}.tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, __meta__=json.dumps(self.meta), **host)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        # make the rename itself durable across power loss
+        dir_fd = os.open(os.path.dirname(os.path.abspath(path)), os.O_RDONLY)
+        try:
+            os.fsync(dir_fd)
+        finally:
+            os.close(dir_fd)
 
     @classmethod
     def load(cls, path: str) -> "CheckpointableState":

@@ -26,3 +26,14 @@ class IdInterner:
 
     def __len__(self) -> int:
         return len(self._to_str)
+
+    def to_list(self) -> List[str]:
+        """Id-ordered strings for checkpointing (index == interned id)."""
+        return list(self._to_str)
+
+    @classmethod
+    def from_list(cls, ids: List[str]) -> "IdInterner":
+        out = cls()
+        for s in ids:
+            out.intern(str(s))
+        return out

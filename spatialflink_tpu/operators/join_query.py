@@ -37,6 +37,24 @@ def _merge_by_time(a: Iterable[Point], b: Iterable[Point]) -> Iterator[Tuple[int
     )
 
 
+def _merge_sorted_windows(gen_a, gen_b):
+    """Outer-merge two window-start-sorted (start, end, idx, batch) streams
+    into (start, end, a_win|None, b_win|None)."""
+    a = next(gen_a, None)
+    b = next(gen_b, None)
+    while a is not None or b is not None:
+        if b is None or (a is not None and a[0] < b[0]):
+            yield a[0], a[1], (a[2], a[3]), None
+            a = next(gen_a, None)
+        elif a is None or b[0] < a[0]:
+            yield b[0], b[1], None, (b[2], b[3])
+            b = next(gen_b, None)
+        else:
+            yield a[0], a[1], (a[2], a[3]), (b[2], b[3])
+            a = next(gen_a, None)
+            b = next(gen_b, None)
+
+
 class PointPointJoinQuery(SpatialOperator):
     prune_cells = True  # naive twins disable grid pruning (exact filter only)
 
@@ -134,6 +152,33 @@ class PointPointJoinQuery(SpatialOperator):
                 start, start + spec.size_ms,
                 sealed_a.pop(start, []), sealed_b.pop(start, []), radius,
             )
+
+    def run_bulk(self, parsed_a, parsed_b, radius: float, *,
+                 pad: int = None) -> Iterator[WindowResult]:
+        """Bulk-replay fast path: both sides go through the vectorized window
+        assembler; records are (index_a, index_b) pairs into the two
+        ParsedPoints. Windowed mode only."""
+        from spatialflink_tpu.streams.bulk import bulk_window_batches
+
+        if self.conf.query_type is QueryType.RealTime:
+            raise ValueError("run_bulk supports windowed mode only")
+        spec = self.conf.window_spec()
+        gen_a = bulk_window_batches(parsed_a, spec, self.grid, pad=pad)
+        gen_b = bulk_window_batches(parsed_b, spec, self.grid2, pad=pad)
+        nb_layers = None if self.prune_cells else self.grid.n
+        for start, end, a_win, b_win in _merge_sorted_windows(gen_a, gen_b):
+            pairs: List[Tuple[int, int]] = []
+            if a_win is not None and b_win is not None:
+                idx_a, batch_a = a_win
+                idx_b, batch_b = b_win
+                for ai, bi in join_pairs_host(batch_a, batch_b, radius,
+                                              self.grid, nb_layers=nb_layers):
+                    pairs.extend(
+                        (int(idx_a[i]), int(idx_b[j]))
+                        for i, j in zip(ai.tolist(), bi.tolist())
+                        if i < len(idx_a) and j < len(idx_b)
+                    )
+            yield WindowResult(start, end, pairs)
 
     def _join_window(self, start, end, recs_a: List[Point], recs_b: List[Point],
                      radius) -> WindowResult:

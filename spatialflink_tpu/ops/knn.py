@@ -185,7 +185,10 @@ def topk_by_distance(obj_id, dist, eligible, k: int,
     if strategy == "grouped":
         return _topk_grouped(obj_id, dist, eligible, k, _DEFAULT_GROUPS)
     if strategy == "prefilter":
-        return _topk_prefiltered(obj_id, dist, eligible, k, max(32 * k, 1024))
+        # m = 8k keeps the exactness fallback (< k distinct among the m
+        # nearest) vanishingly rare while minimizing the partial-selection
+        # cost (benchmarks/sweep_knn.py: smaller m wins monotonically)
+        return _topk_prefiltered(obj_id, dist, eligible, k, max(8 * k, 256))
     if strategy == "approx":
         return _topk_approx(obj_id, dist, eligible, k, max(32 * k, 1024))
     if strategy != "sort":

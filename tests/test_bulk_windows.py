@@ -5,7 +5,7 @@ import pytest
 
 from spatialflink_tpu.index import UniformGrid
 from spatialflink_tpu.models import Point
-from spatialflink_tpu.operators.base import QueryConfiguration
+from spatialflink_tpu.operators.base import QueryConfiguration, QueryType
 from spatialflink_tpu.operators.knn_query import PointPointKNNQuery
 from spatialflink_tpu.operators.range_query import PointPointRangeQuery
 from spatialflink_tpu.runtime.windows import WindowAssembler, WindowSpec
@@ -203,3 +203,41 @@ def test_bulk_window_batches_sampling_spec_empty():
         for w in spec.assign(int(p.ts[i])):
             want.add(w)
     assert {s for s, *_ in out} == want
+
+
+class TestJoinBulk:
+    def test_join_bulk_matches_record_path(self):
+        from spatialflink_tpu.operators.join_query import PointPointJoinQuery
+
+        pa = parsed_points(400, seed=31)
+        pb = parsed_points(120, seed=32)
+        conf = QueryConfiguration(window_size_ms=10_000, slide_ms=5_000)
+
+        def to_points(p):
+            return [Point.create(float(p.x[i]), float(p.y[i]), GRID,
+                                 p.interner.lookup(int(p.obj_id[i])),
+                                 int(p.ts[i])) for i in range(len(p))]
+
+        rec = list(PointPointJoinQuery(conf, GRID, GRID).run(
+            iter(to_points(pa)), iter(to_points(pb)), 0.25))
+        bulk = list(PointPointJoinQuery(conf, GRID, GRID).run_bulk(
+            pa, pb, 0.25))
+        rec_map = {w.window_start:
+                   sorted((a.obj_id, b.obj_id) for a, b in w.records)
+                   for w in rec}
+        bulk_map = {w.window_start:
+                    sorted((pa.interner.lookup(int(pa.obj_id[i])),
+                            pb.interner.lookup(int(pb.obj_id[j])))
+                           for i, j in w.records)
+                    for w in bulk}
+        # every window the record path emitted must match; bulk may also
+        # report windows where one side was empty (empty pair list)
+        for s, want in rec_map.items():
+            assert bulk_map.get(s, []) == want, s
+
+    def test_join_bulk_rejects_realtime(self):
+        from spatialflink_tpu.operators.join_query import PointPointJoinQuery
+        conf = QueryConfiguration(QueryType.RealTime)
+        with pytest.raises(ValueError):
+            list(PointPointJoinQuery(conf, GRID, GRID).run_bulk(
+                parsed_points(10), parsed_points(10), 0.1))

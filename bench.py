@@ -25,18 +25,31 @@ RADIUS = 0.5
 ITERS = 10
 
 
-def _probe_default_backend_ok(timeout_s: int = 240) -> bool:
+def _probe_default_backend_ok(attempts: int = 3) -> bool:
     """The axon TPU tunnel can wedge at backend init; probe it in a
-    subprocess so a hang downgrades to CPU instead of stalling the bench."""
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=timeout_s,
-            capture_output=True,
-        )
-        return r.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
+    subprocess so a hang downgrades to CPU instead of stalling the bench.
+
+    Probes with bounded retries + backoff (the tunnel sometimes recovers
+    within minutes) instead of a single long attempt.
+    """
+    timeouts = (60, 90, 120)
+    for i in range(attempts):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                timeout=timeouts[min(i, len(timeouts) - 1)],
+                capture_output=True,
+            )
+            if r.returncode == 0:
+                return True
+            print(f"warning: backend probe attempt {i + 1} failed "
+                  f"(rc={r.returncode})", file=sys.stderr)
+        except subprocess.TimeoutExpired:
+            print(f"warning: backend probe attempt {i + 1} timed out",
+                  file=sys.stderr)
+        if i + 1 < attempts:
+            time.sleep(15 * (i + 1))
+    return False
 
 
 def _force_cpu():
@@ -153,10 +166,14 @@ def main():
     if os.environ.get("SPATIALFLINK_BENCH_PLATFORM") == "cpu":
         _force_cpu()
     elif not _probe_default_backend_ok():
-        print("warning: default backend probe hung; falling back to CPU",
+        print("warning: default backend probe failed after retries; "
+              "falling back to CPU — result NOT valid for the TPU target",
               file=sys.stderr)
         _force_cpu()
 
+    import jax
+
+    backend = jax.default_backend()
     grid, batch, xs, ys, oid = build_inputs()
     device_tput = bench_device(grid, batch)
     cpu_tput = bench_cpu_numpy(grid, xs, ys, oid)
@@ -168,6 +185,10 @@ def main():
                 "value": round(device_tput),
                 "unit": "points/s",
                 "vs_baseline": round(device_tput / cpu_tput, 2),
+                # The north-star target (BASELINE.md) is a TPU number; a CPU
+                # fallback is reported, but flagged invalid for that target.
+                "backend": backend,
+                "valid_for_target": backend == "tpu",
             }
         )
     )

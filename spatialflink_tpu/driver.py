@@ -473,12 +473,23 @@ def main(argv: Optional[List[str]] = None) -> int:
     if not args.input1 and spec.family not in ("synthetic",):
         print("--input1 is required for this queryOption", file=sys.stderr)
         return 2
+    # a resumed checkpointed run must not re-apply records the saved state
+    # already reflects: the checkpoint records a consumed-record offset and
+    # the file replay skips that many (a Kafka consumer group would seek)
+    skip1 = 0
+    if (args.checkpoint and spec.family == "tstats"
+            and spec.mode == "realtime"):
+        skip1 = ops.PointTStatsQuery.checkpoint_consumed(args.checkpoint)
+        if skip1:
+            print(f"# resuming from checkpoint: skipping {skip1} "
+                  "already-consumed records", file=sys.stderr)
+
     if spec.family == "shapefile":
         stream1 = args.input1
     elif spec.family == "synthetic":
         stream1 = []
     else:
-        stream1 = FileReplaySource(args.input1, limit=args.limit)
+        stream1 = FileReplaySource(args.input1, limit=args.limit, skip=skip1)
     stream2 = FileReplaySource(args.input2, limit=args.limit) if args.input2 else None
 
     from spatialflink_tpu.utils.metrics import ControlTupleExit

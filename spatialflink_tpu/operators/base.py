@@ -40,6 +40,9 @@ class QueryConfiguration:
     window_size_ms: int = 10_000
     slide_ms: int = 5_000
     allowed_lateness_ms: int = 0
+    # approximate mode: range queries skip the CN distance check (reference
+    # parity); kNN uses lax.approx_min_k, trading RECALL (< 1, neighbors may
+    # drop) where the reference traded ranking accuracy — see _knn_strategy
     approximate: bool = False
     realtime_batch_size: int = 512
     k: int = 10  # kNN only
@@ -144,7 +147,19 @@ class SpatialOperator:
 
     def _knn_strategy(self) -> str:
         """Top-k selection strategy: approximate mode rides the TPU
-        partial-reduce fast path (recall < 1), exact mode auto-selects."""
+        partial-reduce fast path (``lax.approx_min_k``), exact mode
+        auto-selects.
+
+        Documented deviation from the reference: its approximate kNN only
+        substitutes cheaper bbox distances and still runs an *exact* top-k
+        (``knn/PointPolygonKNNQuery.java:124-139``), so every true neighbor
+        appears, just possibly mis-ranked. Here approximate mode trades
+        *recall* instead (``approx_min_k`` recall < 1 — some true neighbors
+        may be dropped entirely) because on TPU the distance computation is
+        effectively free next to the selection; the selection itself is the
+        cost worth approximating. Set ``approximate=False`` (default) for
+        exact results.
+        """
         return "approx" if self.conf.approximate else "auto"
 
     def _drive_bulk(self, parsed, eval_batch, *, pad: Optional[int] = None

@@ -98,19 +98,58 @@ class PointPointJoinQuery(SpatialOperator):
     # ---------------------------------------------------------------- #
 
     def _run_realtime(self, ordinary, query_stream, radius) -> Iterator[WindowResult]:
+        """Micro-batched realtime join over a *rolling* window.
+
+        The reference's realtime joins buffer a full small window per stream
+        with fire-per-element triggers (``tJoin/TJoinQuery.java:216-268``), so
+        any pair co-resident within the window is found regardless of arrival
+        interleaving. Mirroring that: both sides keep a rolling buffer of the
+        last ``window_size_ms`` of records across micro-batches; each batch
+        joins (old ∪ new) × (old ∪ new) but suppresses old×old pairs (already
+        emitted by an earlier fire), so a pair straddling a micro-batch
+        boundary is emitted exactly once — when its later point arrives.
+        """
+        win = self.conf.window_size_ms
         buf_a: List[Point] = []
         buf_b: List[Point] = []
+        new_a: List[Point] = []
+        new_b: List[Point] = []
         seen = 0
+        last_ts = 0
+
+        def fire(end_ts):
+            nonlocal buf_a, buf_b, new_a, new_b, seen
+            # evict only points that cannot pair with ANY new arrival: the
+            # earliest new record sets the horizon (evicting against end_ts
+            # would drop a buffered point still within win of a new one);
+            # the max_dt filter below enforces |ta - tb| <= win exactly
+            first_new = min(p.timestamp for p in new_a + new_b)
+            cutoff = first_new - win
+            buf_a = [p for p in buf_a if p.timestamp >= cutoff]
+            buf_b = [p for p in buf_b if p.timestamp >= cutoff]
+            all_a = buf_a + new_a
+            all_b = buf_b + new_b
+            res = None
+            if all_a and all_b:
+                res = self._join_window(end_ts - win, end_ts, all_a, all_b,
+                                        radius, old_a=len(buf_a),
+                                        old_b=len(buf_b), max_dt=win)
+            buf_a, buf_b = all_a, all_b
+            new_a, new_b, seen = [], [], 0
+            return res
+
         for ts, side, rec in _merge_by_time(ordinary, query_stream):
-            (buf_a if side == 0 else buf_b).append(rec)
+            (new_a if side == 0 else new_b).append(rec)
+            last_ts = ts
             seen += 1
             if seen >= self.conf.realtime_batch_size:
-                if buf_a and buf_b:
-                    yield self._join_window(buf_a[0].timestamp, ts, buf_a, buf_b, radius)
-                buf_a, buf_b, seen = [], [], 0
-        if buf_a and buf_b:
-            yield self._join_window(buf_a[0].timestamp, buf_a[-1].timestamp,
-                                    buf_a, buf_b, radius)
+                res = fire(ts)
+                if res is not None:
+                    yield res
+        if new_a or new_b:
+            res = fire(last_ts)
+            if res is not None:
+                yield res
 
     # ---------------------------------------------------------------- #
 
@@ -164,7 +203,11 @@ class PointPointJoinQuery(SpatialOperator):
             raise ValueError("run_bulk supports windowed mode only")
         spec = self.conf.window_spec()
         gen_a = bulk_window_batches(parsed_a, spec, self.grid, pad=pad)
-        gen_b = bulk_window_batches(parsed_b, spec, self.grid2, pad=pad)
+        # both sides must carry cell ids from the SAME grid: join_pairs_host
+        # evaluates the Chebyshev cell predicate in self.grid (as _join_window
+        # does via _point_batch); windowing side b in grid2 would compare cell
+        # ids across different grids and misprune pairs
+        gen_b = bulk_window_batches(parsed_b, spec, self.grid, pad=pad)
         nb_layers = None if self.prune_cells else self.grid.n
         for start, end, a_win, b_win in _merge_sorted_windows(gen_a, gen_b):
             pairs: List[Tuple[int, int]] = []
@@ -181,7 +224,12 @@ class PointPointJoinQuery(SpatialOperator):
             yield WindowResult(start, end, pairs)
 
     def _join_window(self, start, end, recs_a: List[Point], recs_b: List[Point],
-                     radius) -> WindowResult:
+                     radius, *, old_a: int = 0, old_b: int = 0,
+                     max_dt: int = None) -> WindowResult:
+        # old_a/old_b: realtime rolling-buffer prefix lengths — pairs with
+        # BOTH members in the prefix were emitted by an earlier fire.
+        # max_dt: realtime co-residence bound — only pairs whose event times
+        # lie within one realtime window of each other are emitted
         pairs: List[Tuple[Point, Point]] = []
         if recs_a and recs_b:
             batch_a = self._point_batch(recs_a, start)
@@ -193,6 +241,9 @@ class PointPointJoinQuery(SpatialOperator):
                     (recs_a[i], recs_b[j])
                     for i, j in zip(ai.tolist(), bi.tolist())
                     if i < len(recs_a) and j < len(recs_b)
+                    and not (i < old_a and j < old_b)
+                    and (max_dt is None
+                         or abs(recs_a[i].timestamp - recs_b[j].timestamp) <= max_dt)
                 )
         return WindowResult(start, end, pairs)
 
@@ -201,7 +252,9 @@ class _GenericStreamJoin(PointPointJoinQuery):
     """Shared two-stream windowed/realtime join driver; subclasses override
     batch construction and the pair-lattice kernel."""
 
-    def _join_window(self, start, end, recs_a, recs_b, radius) -> WindowResult:
+    def _join_window(self, start, end, recs_a, recs_b, radius, *,
+                     old_a: int = 0, old_b: int = 0,
+                     max_dt: int = None) -> WindowResult:
         import numpy as np
 
         if not (recs_a and recs_b):
@@ -216,6 +269,9 @@ class _GenericStreamJoin(PointPointJoinQuery):
                 (recs_a[i], recs_b[j])
                 for i, j in zip(ai.tolist(), bi.tolist())
                 if i < len(recs_a) and j < len(recs_b)
+                and not (i < old_a and j < old_b)
+                and (max_dt is None
+                     or abs(recs_a[i].timestamp - recs_b[j].timestamp) <= max_dt)
             ]
 
         return WindowResult(start, end, Deferred(m_dev, collect))

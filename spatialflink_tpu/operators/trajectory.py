@@ -191,10 +191,12 @@ class PointTStatsQuery(SpatialOperator):
             # on unbounded runs). Batches spanning more event time than the
             # device's int32-offset horizon are split host-side first.
             ts_base = None
+            consumed = 0  # source records fully processed (the resume offset)
             if checkpoint_path and resume and os.path.exists(checkpoint_path):
-                store, ts_base = self._restore_checkpoint(checkpoint_path)
+                store, ts_base, consumed = self._restore_checkpoint(checkpoint_path)
             n_batches = 0
             for records in self._split_by_span(self._micro_batches(stream)):
+                consumed += len(records)
                 if allowed:
                     records = [p for p in records if p.obj_id in allowed]
                 if not records:
@@ -207,12 +209,12 @@ class PointTStatsQuery(SpatialOperator):
                 tuples = self._update(store, records, ts_base)
                 n_batches += 1
                 if checkpoint_path and n_batches % max(1, checkpoint_every) == 0:
-                    self._save_checkpoint(store, ts_base, checkpoint_path)
+                    self._save_checkpoint(store, ts_base, checkpoint_path, consumed)
                 if tuples:
                     yield WindowResult(records[0].timestamp,
                                        records[-1].timestamp, tuples)
             if checkpoint_path and n_batches:
-                self._save_checkpoint(store, ts_base, checkpoint_path)
+                self._save_checkpoint(store, ts_base, checkpoint_path, consumed)
         else:
             for start, end, records in self._windows(stream):
                 if allowed:
@@ -225,10 +227,16 @@ class PointTStatsQuery(SpatialOperator):
                     final[t[0]] = t
                 yield WindowResult(start, end, list(final.values()))
 
-    def _save_checkpoint(self, store, ts_base: int, path: str) -> None:
+    def _save_checkpoint(self, store, ts_base: int, path: str,
+                         consumed: int = 0) -> None:
         cp = store.snapshot()
         cp.meta["ts_base"] = int(ts_base)
         cp.meta["interner"] = self.interner.to_list()
+        # number of source records the checkpointed state reflects; a
+        # replaying source (file) must skip this many on resume or
+        # already-applied records double-count (offset-managed sources such
+        # as a Kafka consumer group seek instead and can ignore it)
+        cp.meta["consumed"] = int(consumed)
         cp.save(path)
 
     def _restore_checkpoint(self, path: str):
@@ -237,7 +245,24 @@ class PointTStatsQuery(SpatialOperator):
 
         cp = CheckpointableState.load(path)
         self.interner = IdInterner.from_list(cp.meta["interner"])
-        return TrajStateStore.restore(cp), int(cp.meta["ts_base"])
+        return (TrajStateStore.restore(cp), int(cp.meta["ts_base"]),
+                int(cp.meta.get("consumed", 0)))
+
+    @staticmethod
+    def checkpoint_consumed(path: str) -> int:
+        """Resume offset recorded in a checkpoint (0 if none/absent) — the
+        number of source records already reflected in the saved state. Reads
+        only the meta entry (np.load on an npz is lazy per-array), not the
+        full state arrays."""
+        import json
+
+        if not os.path.exists(path):
+            return 0
+        with np.load(path, allow_pickle=False) as z:
+            if "__meta__" not in z.files:
+                return 0
+            meta = json.loads(str(z["__meta__"]))
+        return int(meta.get("consumed", 0))
 
     _SPAN_HORIZON_MS = 2**30  # device ts offsets are int32; stay well inside
 

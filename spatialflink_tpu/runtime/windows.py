@@ -49,20 +49,32 @@ class WindowSpec:
         order): every (window, record) membership pair, grouped by window.
         This is the replay/bulk-ingest fast path — no per-record Python loop,
         no watermark bookkeeping (a bounded replay has complete data, so no
-        record is ever late).
+        record is ever late). Assignment runs in record chunks so the dense
+        (chunk, size/slide) intermediates stay bounded even for huge replays
+        with high window overlap; the final global sort merges the chunks.
         """
         import numpy as np
 
         ts = np.asarray(ts_ms, np.int64)
         n_max = -(-self.size_ms // self.slide_ms)  # ceil
-        last = ts - (ts % self.slide_ms)
         offs = np.arange(n_max, dtype=np.int64) * self.slide_ms
-        starts = last[:, None] - offs[None, :]         # (N, n_max)
-        valid = starts > (ts[:, None] - self.size_ms)
-        rec = np.broadcast_to(
-            np.arange(ts.shape[0], dtype=np.int64)[:, None], starts.shape)
-        win_start = starts[valid]
-        rec_idx = rec[valid]
+        # chunk size targets ~64M int64 intermediate elements max
+        chunk = max(1, (1 << 26) // max(1, n_max))
+        ws_parts, ri_parts = [], []
+        for lo in range(0, ts.shape[0], chunk):
+            t = ts[lo:lo + chunk]
+            last = t - (t % self.slide_ms)
+            starts = last[:, None] - offs[None, :]     # (chunk, n_max)
+            valid = starts > (t[:, None] - self.size_ms)
+            rec = np.broadcast_to(
+                np.arange(lo, lo + t.shape[0], dtype=np.int64)[:, None],
+                starts.shape)
+            ws_parts.append(starts[valid])
+            ri_parts.append(rec[valid])
+        win_start = np.concatenate(ws_parts) if ws_parts else \
+            np.empty(0, np.int64)
+        rec_idx = np.concatenate(ri_parts) if ri_parts else \
+            np.empty(0, np.int64)
         order = np.lexsort((rec_idx, win_start))
         return win_start[order], rec_idx[order]
 

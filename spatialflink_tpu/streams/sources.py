@@ -35,10 +35,14 @@ class ListSource:
 class FileReplaySource:
     """Replays a file of newline-delimited records (GeoJSON lines, CSV, ...)."""
 
-    def __init__(self, path: str, limit: Optional[int] = None, cycle: bool = False):
+    def __init__(self, path: str, limit: Optional[int] = None, cycle: bool = False,
+                 skip: int = 0):
+        # ``skip`` drops the first N records — the resume offset for
+        # checkpointed runs (a Kafka consumer group would seek instead)
         self.path = path
         self.limit = limit
         self.cycle = cycle
+        self.skip = skip
 
     def __iter__(self) -> Iterator[str]:
         def lines():
@@ -52,6 +56,8 @@ class FileReplaySource:
                     return
 
         it = lines()
+        if self.skip:
+            it = itertools.islice(it, self.skip, None)
         return itertools.islice(it, self.limit) if self.limit else it
 
 

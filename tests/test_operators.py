@@ -153,6 +153,70 @@ class TestJoinRegressions:
         results = list(op.run(iter(ordinary), iter(queries), 0.5))
         assert results, "realtime join must emit per micro-batch"
 
+    def test_realtime_join_finds_cross_batch_pairs(self):
+        """A pair whose two points straddle a micro-batch boundary must be
+        found: both sides keep a rolling window_size_ms buffer across batches
+        (reference realtime joins buffer a full small window per stream,
+        tJoin/TJoinQuery.java:216-268)."""
+        conf = QueryConfiguration(query_type=QueryType.RealTime,
+                                  realtime_batch_size=4, window_size_ms=60_000)
+        op = PointPointJoinQuery(conf, GRID)
+        t0 = 1_700_000_000_000
+        far = [Point.create(115.6 + 0.01 * i, 39.7, GRID, obj_id=f"f{i}",
+                            timestamp=t0 + i * 100) for i in range(4)]
+        # batch 1 = far[0:3] + a; batch 2 = far[3] + b: the (a, b) pair
+        # straddles the boundary
+        a = Point.create(116.5, 40.5, GRID, obj_id="a", timestamp=t0 + 150)
+        b = Point.create(116.5001, 40.5001, GRID, obj_id="b", timestamp=t0 + 500)
+        ordinary = [far[0], far[1], far[2], a, far[3]]
+        queries = [b]
+        results = list(op.run(iter(ordinary), iter(queries), 0.05))
+        pairs = {(pa.obj_id, pb.obj_id) for r in results for pa, pb in r.records}
+        assert ("a", "b") in pairs
+
+    def test_realtime_join_eviction_spares_in_window_pairs(self):
+        """A later filler in the same micro-batch must not evict a buffered
+        point that is still within window_size_ms of a new arrival: eviction
+        is horizon-ed on the earliest NEW record, and pair co-residence is
+        |ta - tb| <= window_size_ms."""
+        conf = QueryConfiguration(query_type=QueryType.RealTime,
+                                  realtime_batch_size=2, window_size_ms=1_000)
+        op = PointPointJoinQuery(conf, GRID)
+        t0 = 1_700_000_000_000
+        a = Point.create(116.5, 40.5, GRID, obj_id="a", timestamp=t0)
+        f0 = Point.create(115.6, 39.7, GRID, obj_id="x", timestamp=t0 + 50)
+        b = Point.create(116.5001, 40.5001, GRID, obj_id="b",
+                         timestamp=t0 + 900)
+        f1 = Point.create(115.7, 39.7, GRID, obj_id="y", timestamp=t0 + 1_100)
+        results = list(op.run(iter([a, f0, f1]), iter([b]), 0.05))
+        pairs = {(pa.obj_id, pb.obj_id) for r in results for pa, pb in r.records}
+        assert ("a", "b") in pairs
+
+    def test_realtime_join_no_duplicate_pairs(self):
+        conf = QueryConfiguration(query_type=QueryType.RealTime,
+                                  realtime_batch_size=8, window_size_ms=60_000)
+        op = PointPointJoinQuery(conf, GRID)
+        ordinary = list(source(seed=24, num_trajectories=10, steps=8))
+        queries = list(source(seed=25, num_trajectories=4, steps=8))
+        results = list(op.run(iter(ordinary), iter(queries), 0.5))
+        emitted = [((pa.obj_id, pa.timestamp), (pb.obj_id, pb.timestamp))
+                   for r in results for pa, pb in r.records]
+        assert len(emitted) == len(set(emitted)), "pair emitted twice"
+
+    def test_realtime_join_expires_old_buffer(self):
+        """Points older than window_size_ms must not pair with new arrivals."""
+        conf = QueryConfiguration(query_type=QueryType.RealTime,
+                                  realtime_batch_size=2, window_size_ms=1_000)
+        op = PointPointJoinQuery(conf, GRID)
+        t0 = 1_700_000_000_000
+        a_old = Point.create(116.5, 40.5, GRID, obj_id="a", timestamp=t0)
+        filler = Point.create(115.6, 39.7, GRID, obj_id="x", timestamp=t0 + 100)
+        b_new = Point.create(116.5, 40.5, GRID, obj_id="b", timestamp=t0 + 5_000)
+        filler2 = Point.create(115.7, 39.7, GRID, obj_id="y", timestamp=t0 + 5_100)
+        results = list(op.run(iter([a_old, filler, filler2]), iter([b_new]), 0.05))
+        pairs = {(pa.obj_id, pb.obj_id) for r in results for pa, pb in r.records}
+        assert ("a", "b") not in pairs
+
     def test_one_sided_windows_are_emitted_and_freed(self):
         conf = window_conf()
         op = PointPointJoinQuery(conf, GRID)

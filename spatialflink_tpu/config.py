@@ -142,6 +142,10 @@ class QueryConfig:
 
     option: int = 1
     approximate: bool = False
+    # device-mesh width for distributed window evaluation — the TPU analogue
+    # of the reference's task parallelism (``env.setParallelism(30)``,
+    # StreamingJob.java:221). 0/1 = single device.
+    parallelism: int = 0
     radius: float = 0.0
     aggregate_function: str = "SUM"
     k: int = 10
@@ -160,9 +164,16 @@ class QueryConfig:
             raise ConfigError(
                 f"query.aggregateFunction: {agg!r} not in {SUPPORTED_AGGREGATES}")
         th = _opt(d, "thresholds", {})
+        parallelism = int(_opt(d, "parallelism", 0))
+        if parallelism < 0 or (parallelism & (parallelism - 1)):
+            raise ConfigError(
+                "query.parallelism: must be 0 (off) or a power of two "
+                "(window batch capacities are power-of-two buckets; the "
+                "point dim must divide evenly across the mesh)")
         return cls(
             option=int(_req(d, "option", "query")),
             approximate=bool(_opt(d, "approximate", False)),
+            parallelism=parallelism,
             radius=float(_opt(d, "radius", 0.0)),
             aggregate_function=agg,
             k=int(_opt(d, "k", 10)),

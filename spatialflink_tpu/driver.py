@@ -164,6 +164,9 @@ def _query_conf(params: Params, spec: CaseSpec) -> QueryConfiguration:
         allowed_lateness_ms=params.query.allowed_lateness_s * 1000,
         approximate=params.query.approximate,
         k=params.query.k,
+        # query.parallelism ≙ env.setParallelism(30) (StreamingJob.java:221):
+        # shard PointPoint window batches across a device mesh
+        devices=params.query.parallelism or None,
     )
 
 
@@ -435,6 +438,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "(tStats): saved periodically, restored at startup")
     ap.add_argument("--checkpoint-every", type=int, default=16,
                     help="micro-batches between checkpoints (default 16)")
+    ap.add_argument("--devices", type=int, default=None,
+                    help="shard window batches across this many devices "
+                         "(power of two; overrides query.parallelism)")
     ap.add_argument("--metrics", action="store_true",
                     help="print a metrics snapshot to stderr at exit")
     ap.add_argument("--bulk", action="store_true",
@@ -447,6 +453,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     params = Params.from_yaml(args.config)
     if args.option is not None:
         params.query.option = args.option
+    if args.devices is not None:
+        params.query.parallelism = args.devices
     if args.format is not None:
         import dataclasses
 

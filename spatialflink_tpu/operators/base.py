@@ -50,6 +50,12 @@ class QueryConfiguration:
     # >=2 overlaps host batch assembly with device compute (SURVEY §7's
     # host/device-overlap requirement — JAX dispatch is async until read)
     pipeline_depth: int = 2
+    # device-mesh width: when > 1, PointPoint range/kNN/join window batches
+    # are sharded across a 1-D mesh on the point dim and merged with XLA
+    # collectives (parallel.ops) — the keyBy(gridID) data parallelism of
+    # SURVEY §2.5, minus the reference's parallelism-1 windowAll merge.
+    # Must be a power of two (batch capacities are power-of-two buckets).
+    devices: Optional[int] = None
 
     def window_spec(self) -> WindowSpec:
         return WindowSpec.sliding(self.window_size_ms, self.slide_ms)
@@ -92,10 +98,33 @@ class SpatialOperator:
                  grid2: Optional[UniformGrid] = None):
         if conf.query_type is QueryType.CountBased:
             raise NotImplementedError("CountBased queries are not yet supported")
+        if conf.devices and (conf.devices & (conf.devices - 1)):
+            raise ValueError(
+                f"conf.devices={conf.devices}: must be a power of two")
         self.conf = conf
         self.grid = grid
         self.grid2 = grid2 or grid
         self.interner = IdInterner()
+        self._mesh_obj = None
+
+    @property
+    def distributed(self) -> bool:
+        return bool(self.conf.devices and self.conf.devices > 1)
+
+    def _mesh(self):
+        """Lazy 1-D device mesh for ``conf.devices`` (device access is
+        deferred until the first window actually evaluates)."""
+        if self._mesh_obj is None:
+            from spatialflink_tpu.parallel.mesh import make_mesh
+
+            self._mesh_obj = make_mesh(self.conf.devices)
+        return self._mesh_obj
+
+    def _shard(self, batch):
+        """Place a window batch with its point dim sharded over the mesh."""
+        from spatialflink_tpu.parallel.mesh import shard_batch
+
+        return shard_batch(batch, self._mesh())
 
     # ---------------------------------------------------------------- #
 

@@ -208,20 +208,48 @@ class PointPointJoinQuery(SpatialOperator):
         # does via _point_batch); windowing side b in grid2 would compare cell
         # ids across different grids and misprune pairs
         gen_b = bulk_window_batches(parsed_b, spec, self.grid, pad=pad)
-        nb_layers = None if self.prune_cells else self.grid.n
         for start, end, a_win, b_win in _merge_sorted_windows(gen_a, gen_b):
             pairs: List[Tuple[int, int]] = []
             if a_win is not None and b_win is not None:
                 idx_a, batch_a = a_win
                 idx_b, batch_b = b_win
-                for ai, bi in join_pairs_host(batch_a, batch_b, radius,
-                                              self.grid, nb_layers=nb_layers):
+                for ai, bi in self._join_pairs(batch_a, batch_b, radius):
                     pairs.extend(
                         (int(idx_a[i]), int(idx_b[j]))
                         for i, j in zip(ai.tolist(), bi.tolist())
                         if i < len(idx_a) and j < len(idx_b)
                     )
             yield WindowResult(start, end, pairs)
+
+    def _join_pairs(self, batch_a, batch_b, radius):
+        """(a_index, b_index) survivor arrays for one window's pair lattice.
+
+        Single-device: b-tiled host extraction (``ops.join.join_pairs_host``).
+        With ``conf.devices``: the a side is sharded over the mesh and the
+        query side replicated — the broadcast-join layout of SURVEY §2.5
+        (``join/JoinQuery.java:72-90``'s replication without materialized
+        copies) via ``parallel.ops.distributed_join_mask``.
+        """
+        nb_layers = None if self.prune_cells else self.grid.n
+        if self.distributed:
+            import numpy as np
+
+            from spatialflink_tpu.parallel.ops import distributed_join_mask
+
+            if nb_layers is None:
+                nb_layers = (self.grid.n if radius == 0
+                             else self.grid.candidate_layers(radius))
+            cx = self.grid.min_x + self.grid.cell_length * self.grid.n / 2
+            cy = self.grid.min_y + self.grid.cell_length * self.grid.n / 2
+            m = distributed_join_mask(
+                self._mesh(), self._shard(batch_a), batch_b, radius,
+                nb_layers, cx, cy, n=self.grid.n)
+            ai, bi = np.nonzero(np.asarray(m))
+            if ai.size:
+                yield ai, bi
+            return
+        yield from join_pairs_host(batch_a, batch_b, radius, self.grid,
+                                   nb_layers=nb_layers)
 
     def _join_window(self, start, end, recs_a: List[Point], recs_b: List[Point],
                      radius, *, old_a: int = 0, old_b: int = 0,
@@ -234,9 +262,7 @@ class PointPointJoinQuery(SpatialOperator):
         if recs_a and recs_b:
             batch_a = self._point_batch(recs_a, start)
             batch_b = self._point_batch(recs_b, start)
-            nb_layers = None if self.prune_cells else self.grid.n
-            for ai, bi in join_pairs_host(batch_a, batch_b, radius, self.grid,
-                                          nb_layers=nb_layers):
+            for ai, bi in self._join_pairs(batch_a, batch_b, radius):
                 pairs.extend(
                     (recs_a[i], recs_b[j])
                     for i, j in zip(ai.tolist(), bi.tolist())

@@ -44,10 +44,26 @@ class PointPointKNNQuery(SpatialOperator):
         if not records:
             return []
         batch = self._point_batch(records, ts_base)
+        res = self._knn_result(batch, query_point, radius, k)
+        return self._defer_knn(res)
+
+    def _knn_result(self, batch, query_point: Point, radius: float, k: int):
+        """kNN over one window batch; with ``conf.devices`` the point dim is
+        sharded and per-device dedup+top-k partials are all-gathered and
+        re-merged (parallel.ops.distributed_knn) — the two-stage merge of
+        SURVEY §2.5 without the reference's parallelism-1 windowAll stage."""
         nb_layers = (
             self.grid.n if radius == 0 else self.grid.candidate_layers(radius)
         )
-        res = knn_point(
+        if self.distributed:
+            from spatialflink_tpu.parallel.ops import distributed_knn
+
+            return distributed_knn(
+                self._mesh(), self._shard(batch),
+                query_point.x, query_point.y, jnp.int32(query_point.cell),
+                radius, nb_layers, n=self.grid.n, k=k,
+            )
+        return knn_point(
             batch,
             query_point.x,
             query_point.y,
@@ -58,7 +74,6 @@ class PointPointKNNQuery(SpatialOperator):
             k=k,
             strategy=self._knn_strategy(),
         )
-        return self._defer_knn(res)
 
     def run_bulk(self, parsed, query_point: Point, radius: float,
                  k: Optional[int] = None, *, pad: Optional[int] = None
@@ -66,17 +81,10 @@ class PointPointKNNQuery(SpatialOperator):
         """Bulk-replay fast path over vectorized window batches; records are
         (objID, distance) pairs resolved through the parse-time interner."""
         k = k or self.conf.k
-        nb_layers = (
-            self.grid.n if radius == 0 else self.grid.candidate_layers(radius)
-        )
 
         def eval_batch(payload, ts_base):
             _idx, batch = payload
-            res = knn_point(
-                batch, query_point.x, query_point.y,
-                jnp.int32(query_point.cell), radius, nb_layers,
-                n=self.grid.n, k=k, strategy=self._knn_strategy(),
-            )
+            res = self._knn_result(batch, query_point, radius, k)
             return self._defer_knn(res, interner=parsed.interner)
 
         for result in self._drive_bulk(parsed, eval_batch, pad=pad):

@@ -40,18 +40,31 @@ class PointPointRangeQuery(SpatialOperator):
         if not records:
             return []
         batch = self._point_batch(records, ts_base)
-        mask, _ = range_filter_point(
-            batch,
-            query_point.x,
-            query_point.y,
-            jnp.int32(query_point.cell),
-            radius,
+        mask = self._range_mask(batch, query_point, radius)
+        return self._defer_mask_select(mask, records)
+
+    def _range_mask(self, batch, query_point: Point, radius: float):
+        """Selection mask for one window batch; with ``conf.devices`` the
+        batch point dim is sharded over the mesh and each device filters its
+        shard (parallel.ops.distributed_range_count) — results are identical
+        to the single-device kernel, which runs per shard."""
+        args = (
+            query_point.x, query_point.y, jnp.int32(query_point.cell), radius,
             self.grid.guaranteed_layers(radius),
             self.grid.candidate_layers(radius),
-            n=self.grid.n,
-            approximate=self.conf.approximate,
         )
-        return self._defer_mask_select(mask, records)
+        if self.distributed:
+            from spatialflink_tpu.parallel.ops import distributed_range_count
+
+            _count, mask = distributed_range_count(
+                self._mesh(), self._shard(batch), *args,
+                n=self.grid.n, approximate=self.conf.approximate,
+            )
+            return mask
+        mask, _ = range_filter_point(
+            batch, *args, n=self.grid.n, approximate=self.conf.approximate,
+        )
+        return mask
 
     # ---------------------------------------------------------------- #
 
@@ -63,16 +76,9 @@ class PointPointRangeQuery(SpatialOperator):
 
         Windowed mode only (a bounded replay has no realtime trigger).
         """
-        gn_layers = self.grid.guaranteed_layers(radius)
-        cn_layers = self.grid.candidate_layers(radius)
-
         def eval_batch(payload, ts_base):
             idx, batch = payload
-            mask, _ = range_filter_point(
-                batch, query_point.x, query_point.y,
-                jnp.int32(query_point.cell), radius, gn_layers, cn_layers,
-                n=self.grid.n, approximate=self.conf.approximate,
-            )
+            mask = self._range_mask(batch, query_point, radius)
             return Deferred(
                 mask,
                 lambda m: idx[np.asarray(m)[: len(idx)]].tolist(),

@@ -159,6 +159,37 @@ def distributed_range_count(
     return fn(points)
 
 
+def distributed_join_mask(
+    mesh: Mesh,
+    a: PointBatch,
+    b: PointBatch,
+    radius,
+    nb_layers,
+    center_x,
+    center_y,
+    *,
+    n: int,
+):
+    """Broadcast join returning the full (Na, Nb) boolean pair lattice,
+    sharded on the a (point) dim — the record-output form operators need
+    (``distributed_join_counts`` is the count-only reduction). The a side is
+    sharded, the (smaller) query side replicated; no collective is required
+    for the lattice itself, so each device owns its row block."""
+
+    def per_shard(a_shard: PointBatch, b_rep: PointBatch):
+        return join_mask(a_shard, b_rep, radius, nb_layers,
+                         center_x, center_y, n=n)
+
+    fn = shard_map(
+        per_shard,
+        mesh=mesh,
+        check_vma=False,
+        in_specs=(P(CELL_AXIS), P()),
+        out_specs=P(CELL_AXIS),
+    )
+    return fn(a, b)
+
+
 def distributed_join_counts(
     mesh: Mesh,
     a: PointBatch,

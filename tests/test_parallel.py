@@ -166,3 +166,112 @@ def test_init_distributed_noop_single_process():
     from spatialflink_tpu.parallel import init_distributed
 
     init_distributed()  # no coordinator configured -> must be a silent no-op
+
+
+class TestOperatorDistributedDispatch:
+    """Mesh-aware operator mode (conf.devices): the driver-reachable path
+    must match the single-device path bit-for-bit on the 8-device mesh."""
+
+    def _points(self, n, seed):
+        from spatialflink_tpu.models import Point
+
+        rng = np.random.default_rng(seed)
+        t0 = 1_700_000_000_000
+        return [
+            Point.create(float(rng.uniform(115.6, 117.5)),
+                         float(rng.uniform(39.7, 41.0)), GRID,
+                         obj_id=f"o{i % 97}", timestamp=t0 + i * 10)
+            for i in range(n)
+        ]
+
+    def _conf(self, devices=None):
+        from spatialflink_tpu.operators import QueryConfiguration, QueryType
+
+        return QueryConfiguration(QueryType.WindowBased, window_size_ms=10_000,
+                                  slide_ms=5_000, devices=devices)
+
+    def test_range_matches_single_device(self):
+        from spatialflink_tpu.models import Point
+        from spatialflink_tpu.operators import PointPointRangeQuery
+
+        pts = self._points(3000, 31)
+        q = Point.create(QX, QY, GRID)
+        r1 = list(PointPointRangeQuery(self._conf(), GRID).run(
+            iter(pts), q, 0.4))
+        r8 = list(PointPointRangeQuery(self._conf(8), GRID).run(
+            iter(pts), q, 0.4))
+        assert [w.window_start for w in r1] == [w.window_start for w in r8]
+        for a, b in zip(r1, r8):
+            assert [(p.obj_id, p.timestamp) for p in a.records] == \
+                   [(p.obj_id, p.timestamp) for p in b.records]
+
+    def test_knn_matches_single_device(self):
+        from spatialflink_tpu.models import Point
+        from spatialflink_tpu.operators import PointPointKNNQuery
+
+        pts = self._points(3000, 32)
+        q = Point.create(QX, QY, GRID)
+        r1 = list(PointPointKNNQuery(self._conf(), GRID).run(
+            iter(pts), q, 0.5, 15))
+        r8 = list(PointPointKNNQuery(self._conf(8), GRID).run(
+            iter(pts), q, 0.5, 15))
+        assert len(r1) == len(r8)
+        for a, b in zip(r1, r8):
+            assert [o for o, _ in a.records] == [o for o, _ in b.records]
+            np.testing.assert_array_equal(
+                np.array([d for _, d in a.records]),
+                np.array([d for _, d in b.records]))
+
+    def test_join_matches_single_device(self):
+        from spatialflink_tpu.operators import PointPointJoinQuery
+
+        a = self._points(1500, 33)
+        b = self._points(300, 34)
+        r1 = list(PointPointJoinQuery(self._conf(), GRID).run(
+            iter(a), iter(b), 0.2))
+        r8 = list(PointPointJoinQuery(self._conf(8), GRID).run(
+            iter(a), iter(b), 0.2))
+        assert len(r1) == len(r8)
+        for wa, wb in zip(r1, r8):
+            pa = sorted((x.obj_id, x.timestamp, y.obj_id, y.timestamp)
+                        for x, y in wa.records)
+            pb = sorted((x.obj_id, x.timestamp, y.obj_id, y.timestamp)
+                        for x, y in wb.records)
+            assert pa == pb
+
+    def test_driver_parallelism_dispatches_distributed(self, tmp_path):
+        """End-to-end: query.parallelism in the YAML drives the mesh path
+        through run_option and matches the single-device driver run."""
+        import yaml
+
+        from spatialflink_tpu.config import Params
+        from spatialflink_tpu.driver import run_option
+
+        with open("conf/spatialflink-conf.yml") as f:
+            y = yaml.safe_load(f)
+        y["query"]["option"] = 1
+        y["query"]["radius"] = 0.4
+        y["inputStream1"]["format"] = "CSV"
+        y["inputStream1"]["csvTsvSchemaAttr"] = [0, 1, 2, 3]
+        y["inputStream1"]["dateFormat"] = None
+        pts = self._points(2000, 35)
+        lines = [f"{p.obj_id},{p.timestamp},{p.x},{p.y}" for p in pts]
+        single = list(run_option(Params.from_dict(y), iter(lines)))
+        y["query"]["parallelism"] = 8
+        dist = list(run_option(Params.from_dict(y), iter(lines)))
+        assert [w.window_start for w in single] == [w.window_start for w in dist]
+        for a, b in zip(single, dist):
+            assert [(p.obj_id, p.timestamp) for p in a.records] == \
+                   [(p.obj_id, p.timestamp) for p in b.records]
+
+    def test_non_power_of_two_devices_rejected(self):
+        from spatialflink_tpu.operators import PointPointRangeQuery
+
+        with pytest.raises(ValueError):
+            PointPointRangeQuery(self._conf(3), GRID)
+
+    def test_config_rejects_bad_parallelism(self):
+        from spatialflink_tpu.config import ConfigError, QueryConfig
+
+        with pytest.raises(ConfigError):
+            QueryConfig.from_dict({"option": 1, "parallelism": 3})

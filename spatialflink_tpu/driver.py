@@ -152,9 +152,13 @@ def _query_conf(params: Params, spec: CaseSpec) -> QueryConfiguration:
     if spec.mode == "realtime":
         qt = QueryType.RealTime
     elif params.window.type == "COUNT":
-        # declared-but-unsupported, like the reference (QueryType.java:6;
-        # every operator's else-branch throws "Not yet support")
+        # supported for tAggregate only, like the reference
+        # (``TAggregateQuery.java:381-494``); every other operator raises
+        # "Not yet support" at construction (QueryType.java:6)
         qt = QueryType.CountBased
+        # count windows interpret interval/step as raw element COUNTS — the
+        # reference hands the same config values to countWindow un-scaled
+        size_ms, step_ms = int(params.window.interval_s), int(params.window.step_s)
     else:
         qt = QueryType.WindowBased
     return QueryConfiguration(

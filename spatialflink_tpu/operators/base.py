@@ -94,9 +94,15 @@ class WindowResult:
 class SpatialOperator:
     """Shared driver: turns a record stream into point-window batches."""
 
+    # CountBased is declared-but-unsupported in the reference for every
+    # operator EXCEPT tAggregate, which implements count windows
+    # (``tAggregate/TAggregateQuery.java:381-494``); operators opt in.
+    supports_count_windows = False
+
     def __init__(self, conf: QueryConfiguration, grid: UniformGrid,
                  grid2: Optional[UniformGrid] = None):
-        if conf.query_type is QueryType.CountBased:
+        if (conf.query_type is QueryType.CountBased
+                and not self.supports_count_windows):
             raise NotImplementedError("CountBased queries are not yet supported")
         if conf.devices and (conf.devices & (conf.devices - 1)):
             raise ValueError(

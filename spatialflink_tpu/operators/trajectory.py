@@ -303,8 +303,15 @@ class PointTAggregateQuery(SpatialOperator):
     ``aggregate`` in {SUM, AVG, MIN, MAX, COUNT, ALL}. Realtime mode merges
     (cell, objID) group extents into host state with stale-trajectory
     eviction after ``traj_deletion_threshold_ms``
-    (``tAggregate/TAggregateQuery.java:367-376``).
+    (``tAggregate/TAggregateQuery.java:367-376``). CountBased mode runs
+    per-cell count windows — the ONE operator family where the reference
+    implements them (``TAggregateQuery.java:381-494``,
+    ``countWindow(size, slide)`` over a ``GlobalWindow``): for each cell, a
+    window of the last ``window_size_ms``-as-count points fires every
+    ``slide_ms``-as-count arrivals.
     """
+
+    supports_count_windows = True
 
     def run(self, stream: Iterable[Point], aggregate: str = "SUM",
             traj_deletion_threshold_ms: int = 0) -> Iterator[WindowResult]:
@@ -313,6 +320,9 @@ class PointTAggregateQuery(SpatialOperator):
         agg = aggregate.upper()
         if self.conf.query_type is QueryType.RealTime:
             yield from self._run_realtime(stream, agg, traj_deletion_threshold_ms)
+            return
+        if self.conf.query_type is QueryType.CountBased:
+            yield from self._run_count_windows(stream, agg)
             return
         for start, end, records in self._windows(stream):
             if not records:
@@ -332,6 +342,64 @@ class PointTAggregateQuery(SpatialOperator):
             else:
                 hm = taggregate_heatmap(groups, num_cells=self.grid.num_cells, agg=agg)
                 yield WindowResult(start, end, [], extras={"heatmap": np.asarray(hm)})
+
+    def _run_count_windows(self, stream, agg) -> Iterator[WindowResult]:
+        """Per-cell sliding COUNT windows (Flink ``countWindow(size, slide)``
+        semantics): keyed by cell, the trigger fires every ``slide`` arrivals
+        in that cell and evaluates the last ``size`` points. Aggregation body
+        matches the time-window process function: per-object trajLength =
+        max - min timestamp within the window's points for that cell
+        (``TAggregateQuery.java:381-494``).
+
+        In count mode ``window_size_ms``/``slide_ms`` are COUNTS, mirroring
+        the reference passing the same windowSize/windowSlideStep config
+        values to ``countWindow``.
+        """
+        from collections import deque
+
+        size = max(1, int(self.conf.window_size_ms))
+        slide = max(1, int(self.conf.slide_ms))
+        buffers: Dict[int, deque] = {}
+        arrivals: Dict[int, int] = {}
+        for p in stream:
+            if p.cell < 0:
+                continue  # reference filters null-gridID points first
+            buf = buffers.setdefault(p.cell, deque(maxlen=size))
+            buf.append(p)
+            arrivals[p.cell] = arrivals.get(p.cell, 0) + 1
+            if arrivals[p.cell] % slide == 0:
+                yield self._count_window_result(p.cell, list(buf), agg)
+
+    def _count_window_result(self, cell: int, pts: List[Point], agg: str
+                             ) -> WindowResult:
+        extents: Dict[str, Tuple[int, int]] = {}
+        for p in pts:
+            mn, mx = extents.get(p.obj_id, (p.timestamp, p.timestamp))
+            extents[p.obj_id] = (min(mn, p.timestamp), max(mx, p.timestamp))
+        lengths = {oid: mx - mn for oid, (mn, mx) in extents.items()}
+        n_objs = len(lengths)
+        start = min(p.timestamp for p in pts)
+        end = max(p.timestamp for p in pts)
+        extras = {"cell": cell, "num_objects": n_objs, "aggregate": agg}
+        if agg == "ALL":
+            records = [(cell, lengths)]
+        elif agg == "SUM":
+            s = sum(lengths.values())
+            records = [(cell, s)] if s > 0 else []
+        elif agg == "AVG":
+            s = sum(lengths.values())
+            records = [(cell, round(s / n_objs))] if s > 0 else []
+        elif agg == "MIN":
+            oid = min(lengths, key=lambda o: lengths[o])
+            records = [(cell, oid, lengths[oid])]
+        elif agg == "MAX":
+            oid = max(lengths, key=lambda o: lengths[o])
+            records = [(cell, oid, lengths[oid])]
+        elif agg == "COUNT":
+            records = [(cell, n_objs)]
+        else:
+            records = [(cell, lengths)]
+        return WindowResult(start, end, records, extras)
 
     def _run_realtime(self, stream, agg, eviction_ms) -> Iterator[WindowResult]:
         # host state: (cell, objID) -> [min_ts, max_ts, last_seen].
@@ -391,42 +459,68 @@ class PointTAggregateQuery(SpatialOperator):
 class PointPointTJoinQuery(SpatialOperator):
     """Trajectory-trajectory proximity join: one output per
     (trajectory, partner) pair per window, keeping the LATEST co-located
-    timestamp (``tJoin/PointPointTJoinQuery.java:133-177``)."""
+    timestamp (``tJoin/PointPointTJoinQuery.java:133-177``).
+
+    Windowed mode joins the deduped pairs back to both streams' windowed
+    trajectories and emits *sub-trajectory LineString pairs* — a pair appears
+    only when BOTH trajectories have >= 2 points in the window, exactly like
+    the reference's joins against ``GenerateWindowedTrajectory`` output
+    (``PointPointTJoinQuery.java:183-338``; the >=2-point rule is
+    ``TJoinQuery.java:184``). Realtime mode emits point pairs.
+    """
+
+    def _inner(self, prune_cells: bool = True):
+        from spatialflink_tpu.operators.join_query import PointPointJoinQuery
+
+        windowed = self.conf.query_type is not QueryType.RealTime
+        outer = self
+
+        class _CapturingJoin(PointPointJoinQuery):
+            # windowed tJoin needs each window's full per-side record lists
+            # to rebuild the trajectories the pairs join back to
+            def _join_window(self, start, end, recs_a, recs_b, radius, **kw):
+                res = super()._join_window(start, end, recs_a, recs_b,
+                                           radius, **kw)
+                if windowed:
+                    res.extras["_recs_a"] = recs_a
+                    res.extras["_recs_b"] = recs_b
+                return res
+
+        inner = _CapturingJoin(self.conf, self.grid)
+        inner.interner = self.interner
+        inner.prune_cells = prune_cells
+        return inner, windowed
 
     def run(self, ordinary: Iterable[Point], query_stream: Iterable[Point],
             radius: float) -> Iterator[WindowResult]:
-        from spatialflink_tpu.operators.join_query import PointPointJoinQuery
-
-        inner = PointPointJoinQuery(self.conf, self.grid)
-        inner.interner = self.interner
+        inner, windowed = self._inner()
         for res in inner.run(ordinary, query_stream, radius):
-            yield self._dedup(res)
+            yield self._post(res, windowed)
 
     def run_single(self, stream: Iterable[Point], radius: float
                    ) -> Iterator[WindowResult]:
         """Self-join variant skipping identical objIDs
         (``tJoin/PointPointTJoinQuery.java:341-435``)."""
         records = list(stream)
-        from spatialflink_tpu.operators.join_query import PointPointJoinQuery
-
-        inner = PointPointJoinQuery(self.conf, self.grid)
-        inner.interner = self.interner
+        inner, windowed = self._inner()
         for res in inner.run(iter(records), iter(list(records)), radius):
             res.records = [(a, b) for a, b in res.records if a.obj_id != b.obj_id]
-            yield self._dedup(res)
+            yield self._post(res, windowed)
 
     def run_naive(self, ordinary: Iterable[Point], query_stream: Iterable[Point],
                   radius: float) -> Iterator[WindowResult]:
         """All-pairs twin without cell pruning
         (``tJoin/TJoinQuery.java:61-155``); the exact distance filter still
         applies."""
-        from spatialflink_tpu.operators.join_query import PointPointJoinQuery
-
-        inner = PointPointJoinQuery(self.conf, self.grid)
-        inner.interner = self.interner
-        inner.prune_cells = False
+        inner, windowed = self._inner(prune_cells=False)
         for res in inner.run(ordinary, query_stream, radius):
-            yield self._dedup(res)
+            yield self._post(res, windowed)
+
+    def _post(self, res: WindowResult, windowed: bool) -> WindowResult:
+        res = self._dedup(res)
+        if windowed:
+            res = self._to_trajectory_pairs(res)
+        return res
 
     @staticmethod
     def _dedup(res: WindowResult) -> WindowResult:
@@ -440,6 +534,29 @@ class PointPointTJoinQuery(SpatialOperator):
                 best[key] = (a, b)
         return WindowResult(res.window_start, res.window_end,
                             list(best.values()), res.extras)
+
+    @staticmethod
+    def _to_trajectory_pairs(res: WindowResult) -> WindowResult:
+        """Deduped point pairs -> (LineString, LineString) sub-trajectory
+        pairs over the window's full per-side records; pairs whose side has
+        fewer than 2 window points are dropped (no LineString exists to join
+        against, ``TJoinQuery.java:184``)."""
+        recs_a = res.extras.pop("_recs_a", None) or []
+        recs_b = res.extras.pop("_recs_b", None) or []
+        a_ids = {a.obj_id for a, _ in res.records}
+        b_ids = {b.obj_id for _, b in res.records}
+        subs_a = assemble_subtrajectories(
+            [p for p in recs_a if p.obj_id in a_ids])
+        subs_b = assemble_subtrajectories(
+            [p for p in recs_b if p.obj_id in b_ids])
+        pairs = []
+        for a, b in res.records:
+            la = subs_a.get(a.obj_id)
+            lb = subs_b.get(b.obj_id)
+            if isinstance(la, LineString) and isinstance(lb, LineString):
+                pairs.append((la, lb))
+        return WindowResult(res.window_start, res.window_end, pairs,
+                            res.extras)
 
 
 class PointPointTKNNQuery(SpatialOperator):

@@ -202,6 +202,81 @@ class TestTAggregate:
         assert hm[int(cell_a)] == 0  # trajectory a evicted after 60s gap
 
 
+class TestTAggregateCountWindows:
+    """Per-cell COUNT windows (TAggregateQuery.java:381-494): keyed by cell,
+    fire every `slide` arrivals over the last `size` points of that cell."""
+
+    def _conf(self, size, slide):
+        from spatialflink_tpu.operators import QueryConfiguration, QueryType
+
+        return QueryConfiguration(QueryType.CountBased, window_size_ms=size,
+                                  slide_ms=slide)
+
+    def test_fires_every_slide_per_cell(self):
+        # 6 points in one cell, size=4 slide=2 -> fires at arrivals 2, 4, 6
+        pts = [Point.create(116.05, 40.05, GRID, f"t{i % 2}", BASE + i * 1000)
+               for i in range(6)]
+        op = PointTAggregateQuery(self._conf(4, 2), GRID)
+        results = list(op.run(iter(pts), "ALL"))
+        assert len(results) == 3
+        # third fire sees the LAST 4 points (arrivals 3..6)
+        cell, lengths = results[2].records[0]
+        # t0 points in window: ts 2000, 4000 -> length 2000; t1: 3000, 5000
+        assert lengths == {"t0": 2000, "t1": 2000}
+
+    def test_cells_fire_independently(self):
+        a = [Point.create(116.05, 40.05, GRID, "a", BASE + i * 1000)
+             for i in range(2)]
+        b = [Point.create(117.05, 41.05, GRID, "b", BASE + i * 1000)
+             for i in range(2)]
+        # interleave: each cell reaches its slide=2 exactly once
+        pts = [a[0], b[0], a[1], b[1]]
+        op = PointTAggregateQuery(self._conf(2, 2), GRID)
+        results = list(op.run(iter(pts), "COUNT"))
+        assert len(results) == 2
+        cells = {r.extras["cell"] for r in results}
+        assert len(cells) == 2
+
+    def test_sum_and_avg(self):
+        pts = [Point.create(116.05, 40.05, GRID, "x", BASE),
+               Point.create(116.05, 40.05, GRID, "x", BASE + 3000),
+               Point.create(116.05, 40.05, GRID, "y", BASE + 1000),
+               Point.create(116.05, 40.05, GRID, "y", BASE + 2000)]
+        op = PointTAggregateQuery(self._conf(4, 4), GRID)
+        (res,) = list(op.run(iter(pts), "SUM"))
+        assert res.records == [(pts[0].cell, 4000)]  # 3000 + 1000
+        op = PointTAggregateQuery(self._conf(4, 4), GRID)
+        (res,) = list(op.run(iter(pts), "AVG"))
+        assert res.records == [(pts[0].cell, 2000)]
+
+    def test_count_mode_rejected_for_other_operators(self):
+        import pytest as _pytest
+
+        from spatialflink_tpu.operators import PointPointRangeQuery
+
+        with _pytest.raises(NotImplementedError):
+            PointPointRangeQuery(self._conf(4, 2), GRID)
+
+    def test_driver_count_window_option_208(self):
+        """window.type COUNT + option 208 runs count-window tAggregate with
+        interval/step as raw counts."""
+        from spatialflink_tpu.config import Params
+        from spatialflink_tpu.driver import run_option
+
+        d = dict(
+            inputStream1=dict(
+                topicName="t", format="CSV", csvTsvSchemaAttr=[0, 1, 2, 3],
+                dateFormat=None, gridBBox=[115.5, 39.6, 117.6, 41.1],
+                numGridCells=100),
+            outputStream=dict(topicName="o"),
+            query=dict(option=208, radius=0.5, aggregateFunction="ALL"),
+            window=dict(type="COUNT", interval=4, step=2),
+        )
+        lines = [f"t{i % 2},{BASE + i * 1000},116.05,40.05" for i in range(6)]
+        results = list(run_option(Params.from_dict(d), iter(lines)))
+        assert len(results) == 3
+
+
 class TestTJoin:
     def test_dedup_keeps_latest(self):
         a = [Point.create(116.5, 40.5, GRID, "A", BASE + i * 1000) for i in range(3)]
@@ -210,8 +285,50 @@ class TestTJoin:
         results = [r for r in op.run(iter(a), iter(b), 0.05) if r.records]
         assert results
         assert len(results[0].records) == 1  # one output per (A, B)
+        la, lb = results[0].records[0]
+        assert (la.obj_id, lb.obj_id) == ("A", "B")
+
+    def test_windowed_emits_subtrajectory_linestrings(self):
+        """Windowed mode joins deduped pairs back to both sides' windowed
+        trajectories (PointPointTJoinQuery.java:183-338): records are
+        (LineString, LineString) pairs carrying each trajectory's full
+        window points in time order."""
+        from spatialflink_tpu.models import LineString
+
+        a = [Point.create(116.5 + i * 1e-4, 40.5, GRID, "A", BASE + i * 1000)
+             for i in range(4)]
+        b = [Point.create(116.5001, 40.5, GRID, "B", BASE + i * 1000)
+             for i in range(4)]
+        op = PointPointTJoinQuery(window_conf(), GRID)
+        results = [r for r in op.run(iter(a), iter(b), 0.05) if r.records]
+        assert results
+        la, lb = results[0].records[0]
+        assert isinstance(la, LineString) and isinstance(lb, LineString)
+        # side a's LineString carries ALL of A's window points, sorted
+        first = results[0]
+        in_window = [p for p in a
+                     if first.window_start <= p.timestamp < first.window_end]
+        assert [tuple(np.round(c, 6)) for c in la.coords_list] == \
+               [(round(p.x, 6), round(p.y, 6)) for p in in_window]
+
+    def test_windowed_drops_single_point_trajectories(self):
+        """A trajectory with < 2 points in the window has no LineString to
+        join against (TJoinQuery.java:184) — its pairs are dropped."""
+        a = [Point.create(116.5, 40.5, GRID, "A", BASE)]  # one point only
+        b = [Point.create(116.5001, 40.5, GRID, "B", BASE + i * 1000)
+             for i in range(3)]
+        op = PointPointTJoinQuery(window_conf(), GRID)
+        results = [r for r in op.run(iter(a), iter(b), 0.05) if r.records]
+        assert not results
+
+    def test_realtime_still_emits_point_pairs(self):
+        a = [Point.create(116.5, 40.5, GRID, "A", BASE + i * 100) for i in range(4)]
+        b = [Point.create(116.5001, 40.5, GRID, "B", BASE + i * 100) for i in range(4)]
+        op = PointPointTJoinQuery(realtime_conf(realtime_batch_size=4), GRID)
+        results = [r for r in op.run(iter(a), iter(b), 0.05) if r.records]
+        assert results
         pa, pb = results[0].records[0]
-        assert max(pa.timestamp, pb.timestamp) == BASE + 2000
+        assert isinstance(pa, Point) and isinstance(pb, Point)
 
     def test_self_join_skips_same_object(self):
         pts = [Point.create(116.5 + i * 1e-4, 40.5, GRID, f"t{i % 2}", BASE + i * 500)

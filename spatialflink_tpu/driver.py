@@ -311,7 +311,9 @@ def _run_trajectory(params, spec, conf, u_grid, q_grid, stream1, stream2):
     if spec.family == "taggregate":
         return ops.PointTAggregateQuery(conf, u_grid).run(
             s1, q.aggregate_function,
-            traj_deletion_threshold_ms=q.traj_deletion_threshold_s * 1000)
+            traj_deletion_threshold_ms=q.traj_deletion_threshold_s * 1000,
+            checkpoint_path=params.checkpoint_path,
+            checkpoint_every=params.checkpoint_every)
     if spec.family == "tjoin":
         if stream2 is None:
             raise ValueError("trajectory join needs stream2")
@@ -469,10 +471,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         params.checkpoint_path = args.checkpoint
         params.checkpoint_every = args.checkpoint_every
         cp_spec = CASES.get(params.query.option)
-        if cp_spec and not (cp_spec.family == "tstats"
+        if cp_spec and not (cp_spec.family in ("tstats", "taggregate")
                             and cp_spec.mode == "realtime"):
             print("--checkpoint only applies to stateful realtime queries "
-                  "(tStats, queryOption 205); ignored for this case",
+                  "(tStats 205 / tAggregate 207); ignored for this case",
                   file=sys.stderr)
 
     from spatialflink_tpu.streams.sinks import StdoutSink
@@ -489,9 +491,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     # already reflects: the checkpoint records a consumed-record offset and
     # the file replay skips that many (a Kafka consumer group would seek)
     skip1 = 0
-    if (args.checkpoint and spec.family == "tstats"
+    if (args.checkpoint and spec.family in ("tstats", "taggregate")
             and spec.mode == "realtime"):
-        skip1 = ops.PointTStatsQuery.checkpoint_consumed(args.checkpoint)
+        from spatialflink_tpu.runtime.state import checkpoint_consumed
+
+        skip1 = checkpoint_consumed(args.checkpoint)
         if skip1:
             print(f"# resuming from checkpoint: skipping {skip1} "
                   "already-consumed records", file=sys.stderr)

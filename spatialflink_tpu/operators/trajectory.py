@@ -250,19 +250,10 @@ class PointTStatsQuery(SpatialOperator):
 
     @staticmethod
     def checkpoint_consumed(path: str) -> int:
-        """Resume offset recorded in a checkpoint (0 if none/absent) — the
-        number of source records already reflected in the saved state. Reads
-        only the meta entry (np.load on an npz is lazy per-array), not the
-        full state arrays."""
-        import json
+        """Resume offset recorded in a checkpoint (0 if none/absent)."""
+        from spatialflink_tpu.runtime.state import checkpoint_consumed
 
-        if not os.path.exists(path):
-            return 0
-        with np.load(path, allow_pickle=False) as z:
-            if "__meta__" not in z.files:
-                return 0
-            meta = json.loads(str(z["__meta__"]))
-        return int(meta.get("consumed", 0))
+        return checkpoint_consumed(path)
 
     _SPAN_HORIZON_MS = 2**30  # device ts offsets are int32; stay well inside
 
@@ -314,12 +305,18 @@ class PointTAggregateQuery(SpatialOperator):
     supports_count_windows = True
 
     def run(self, stream: Iterable[Point], aggregate: str = "SUM",
-            traj_deletion_threshold_ms: int = 0) -> Iterator[WindowResult]:
+            traj_deletion_threshold_ms: int = 0, *,
+            checkpoint_path: Optional[str] = None,
+            checkpoint_every: int = 16, resume: bool = True
+            ) -> Iterator[WindowResult]:
         from spatialflink_tpu.ops.trajectory import taggregate_groups, taggregate_heatmap
 
         agg = aggregate.upper()
         if self.conf.query_type is QueryType.RealTime:
-            yield from self._run_realtime(stream, agg, traj_deletion_threshold_ms)
+            yield from self._run_realtime(
+                stream, agg, traj_deletion_threshold_ms,
+                checkpoint_path=checkpoint_path,
+                checkpoint_every=checkpoint_every, resume=resume)
             return
         if self.conf.query_type is QueryType.CountBased:
             yield from self._run_count_windows(stream, agg)
@@ -401,14 +398,25 @@ class PointTAggregateQuery(SpatialOperator):
             records = [(cell, lengths)]
         return WindowResult(start, end, records, extras)
 
-    def _run_realtime(self, stream, agg, eviction_ms) -> Iterator[WindowResult]:
+    def _run_realtime(self, stream, agg, eviction_ms, *,
+                      checkpoint_path=None, checkpoint_every=16, resume=True
+                      ) -> Iterator[WindowResult]:
         # host state: (cell, objID) -> [min_ts, max_ts, last_seen].
         # Like the reference's MapState full-scan-per-output
         # (TAggregateQuery.java:53-377), state grows with distinct
         # (cell, trajectory) pairs unless eviction_ms > 0 bounds it —
-        # production streams should set trajDeletionThreshold.
+        # production streams should set trajDeletionThreshold. This is
+        # exactly the unbounded state most in need of checkpointing:
+        # checkpoint_path snapshots the extent map (+ consumed offset)
+        # every checkpoint_every micro-batches, like tStats.
         state: Dict[Tuple[int, str], List[int]] = {}
+        consumed = 0
+        if checkpoint_path and resume and os.path.exists(checkpoint_path):
+            state, consumed = self._restore_checkpoint(checkpoint_path)
+        n_batches = 0
         for records in self._micro_batches(stream):
+            consumed += len(records)
+            n_batches += 1
             latest = 0
             for p in records:
                 if p.cell < 0:
@@ -426,11 +434,49 @@ class PointTAggregateQuery(SpatialOperator):
                 stale = [k for k, v in state.items() if latest - v[2] > eviction_ms]
                 for k in stale:
                     del state[k]
+            if checkpoint_path and n_batches % max(1, checkpoint_every) == 0:
+                self._save_checkpoint(state, checkpoint_path, consumed)
             heatmap = self._aggregate_state(state, agg)
             yield WindowResult(
                 records[0].timestamp, records[-1].timestamp, [],
                 extras={"heatmap": heatmap},
             )
+        if checkpoint_path and n_batches:
+            self._save_checkpoint(state, checkpoint_path, consumed)
+
+    @staticmethod
+    def _save_checkpoint(state: Dict[Tuple[int, str], List[int]], path: str,
+                         consumed: int) -> None:
+        from spatialflink_tpu.runtime.state import CheckpointableState
+
+        cp = CheckpointableState()
+        cp.arrays["cell"] = np.array([c for c, _ in state], np.int64)
+        cp.arrays["extent"] = (
+            np.array(list(state.values()), np.int64).reshape(len(state), 3))
+        cp.meta["obj_id"] = [o for _, o in state]
+        cp.meta["consumed"] = int(consumed)
+        cp.save(path)
+
+    @staticmethod
+    def _restore_checkpoint(path: str):
+        from spatialflink_tpu.runtime.state import CheckpointableState
+
+        cp = CheckpointableState.load(path)
+        cells = cp.arrays.get("cell", np.empty(0, np.int64))
+        extents = cp.arrays.get("extent", np.empty((0, 3), np.int64))
+        oids = cp.meta.get("obj_id", [])
+        state = {
+            (int(c), str(o)): [int(e[0]), int(e[1]), int(e[2])]
+            for c, o, e in zip(cells, oids, extents)
+        }
+        return state, int(cp.meta.get("consumed", 0))
+
+    @staticmethod
+    def checkpoint_consumed(path: str) -> int:
+        """Resume offset recorded in a checkpoint (0 if none/absent)."""
+        from spatialflink_tpu.runtime.state import checkpoint_consumed
+
+        return checkpoint_consumed(path)
 
     def _aggregate_state(self, state, agg) -> np.ndarray:
         hm = np.zeros(self.grid.num_cells, np.float64)

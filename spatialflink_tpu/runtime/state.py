@@ -53,6 +53,19 @@ class CheckpointableState:
         return out
 
 
+def checkpoint_consumed(path: str) -> int:
+    """Resume offset recorded in a checkpoint (0 if none/absent) — the number
+    of source records already reflected in the saved state. Reads only the
+    meta entry (np.load on an npz is lazy per-array), not the state arrays."""
+    if not os.path.exists(path):
+        return 0
+    with np.load(path, allow_pickle=False) as z:
+        if "__meta__" not in z.files:
+            return 0
+        meta = json.loads(str(z["__meta__"]))
+    return int(meta.get("consumed", 0))
+
+
 class TrajStateStore:
     """Host wrapper around a device :class:`TrajStatsState` that grows with
     the interner and snapshots to disk."""

@@ -33,10 +33,11 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 EARTH_RADIUS_M = 6371008.7714  # HelperClass.java:50
 
-_BIG = jnp.float32(3.4e38)  # sentinel "infinity" that survives f32 math
+_BIG = np.float32(3.4e38)  # sentinel "infinity" that survives f32 math
 
 
 def pp_dist(x1, y1, x2, y2):

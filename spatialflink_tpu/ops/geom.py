@@ -19,11 +19,12 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from spatialflink_tpu.models.batches import EdgeGeomBatch, PointBatch
 from spatialflink_tpu.ops import distances as D
 
-_BIG = jnp.float32(3.4e38)
+_BIG = np.float32(3.4e38)
 
 
 @jax.jit

@@ -26,11 +26,12 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from spatialflink_tpu.models.batches import PointBatch
 from spatialflink_tpu.ops.range import cheb_layers
 
-_BIG = jnp.float32(3.4e38)
+_BIG = np.float32(3.4e38)
 
 
 def pairwise_dist2(ax, ay, bx, by, center_x=0.0, center_y=0.0):

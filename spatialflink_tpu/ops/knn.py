@@ -25,13 +25,14 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from spatialflink_tpu.models.batches import PointBatch
 from spatialflink_tpu.ops import distances as D
 from spatialflink_tpu.ops.range import cheb_layers
 
-_BIG = jnp.float32(3.4e38)
-_OID_SENTINEL = jnp.int32(2**31 - 1)
+_BIG = np.float32(3.4e38)
+_OID_SENTINEL = np.int32(2**31 - 1)
 
 
 class KnnResult(NamedTuple):

@@ -31,7 +31,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-_BIG = jnp.float32(3.4e38)
+_BIG = np.float32(3.4e38)
 _F_BIG = 3.4e38  # plain literals for in-kernel use (pallas
 _I_BIG = 2**31 - 1  # kernels cannot capture traced constants)
 

@@ -27,12 +27,13 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from spatialflink_tpu.models.batches import PointBatch
 from spatialflink_tpu.ops import distances as D
 
-INT32_MIN = jnp.int32(-(2**31))
-_OID_SENTINEL = jnp.int32(2**31 - 1)
+INT32_MIN = np.int32(-(2**31))
+_OID_SENTINEL = np.int32(2**31 - 1)
 
 
 class TrajStatsState(NamedTuple):

@@ -55,8 +55,14 @@ def make_mesh_2d(n_outer: Optional[int] = None,
     if jax.process_count() > 1:
         from jax.experimental import mesh_utils
 
+        # granule choice: real TPU multi-host has per-slice slice_index; a
+        # multi-process CPU run (the DCN test harness) has one slice, so the
+        # process is the DCN granule instead
+        slice_ids = {getattr(d, "slice_index", 0)
+                     for d in devs[: n_outer * n_inner]}
         arr = mesh_utils.create_hybrid_device_mesh(
-            (1, n_inner), (n_outer, 1), devices=devs[: n_outer * n_inner])
+            (1, n_inner), (n_outer, 1), devices=devs[: n_outer * n_inner],
+            process_is_granule=len(slice_ids) <= 1)
     else:
         arr = np.array(devs[: n_outer * n_inner]).reshape(n_outer, n_inner)
     return Mesh(arr, (DCN_AXIS, CELL_AXIS))

@@ -15,8 +15,20 @@ from spatialflink_tpu.streams.sources import (
 )
 from spatialflink_tpu.streams.sinks import CollectSink, FileSink, LatencySink, StdoutSink
 from spatialflink_tpu.streams.shapefile import iter_shapefile, read_shapefile
+from spatialflink_tpu.streams.kafka import (
+    IdempotentWindowSink,
+    InMemoryBroker,
+    KafkaLatencySink,
+    KafkaSink,
+    KafkaSource,
+)
 
 __all__ = [
+    "IdempotentWindowSink",
+    "InMemoryBroker",
+    "KafkaLatencySink",
+    "KafkaSink",
+    "KafkaSource",
     "parse_spatial",
     "serialize_spatial",
     "FileReplaySource",

@@ -1,0 +1,84 @@
+"""Two-process DCN worker (spawned by test_parallel_multiprocess.py).
+
+Each process contributes 2 virtual CPU devices; together they form the 2x2
+(hosts, cells) mesh through ``make_mesh_2d``'s multi-process branch
+(``parallel/mesh.py`` -> ``mesh_utils.create_hybrid_device_mesh``), the same
+code path a real multi-host TPU deployment takes, with Gloo collectives
+standing in for DCN.
+
+Usage: python dcn_worker.py <process_id> <coordinator_port>
+Prints "DCN_OK <pid> <n_valid>" when the hierarchical kNN result matches the
+single-device oracle.
+"""
+
+import os
+import sys
+
+
+def main() -> int:
+    pid, port = int(sys.argv[1]), sys.argv[2]
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=2").strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from spatialflink_tpu.parallel.mesh import init_distributed
+
+    init_distributed(coordinator_address=f"localhost:{port}",
+                     num_processes=2, process_id=pid)
+    assert jax.process_count() == 2, "distributed runtime did not come up"
+    assert len(jax.devices()) == 4
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from spatialflink_tpu.index import UniformGrid
+    from spatialflink_tpu.models import PointBatch
+    from spatialflink_tpu.ops.knn import knn_point
+    from spatialflink_tpu.parallel.mesh import make_mesh_2d, shard_batch
+    from spatialflink_tpu.parallel.ops import distributed_knn_hierarchical
+
+    # must route through create_hybrid_device_mesh (process_count() > 1)
+    mesh = make_mesh_2d(2, 2)
+    assert mesh.devices.shape == (2, 2)
+    assert mesh.axis_names == ("hosts", "cells")
+
+    grid = UniformGrid(115.50, 117.60, 39.60, 41.10, num_grid_partitions=100)
+    rng = np.random.default_rng(7)  # same seed in both processes
+    n = 512
+    batch = PointBatch.from_arrays(
+        rng.uniform(grid.min_x, grid.max_x, n),
+        rng.uniform(grid.min_y, grid.max_y, n),
+        grid=grid,
+        obj_id=rng.integers(0, 100, n).astype(np.int32),
+    )
+    qx, qy = 116.5, 40.5
+    q_cell, _ = grid.assign_cell(qx, qy)
+    radius = 0.5
+    layers = grid.candidate_layers(radius)
+
+    sharded = shard_batch(batch, mesh, axis=mesh.axis_names)
+    got = distributed_knn_hierarchical(
+        mesh, sharded, qx, qy, jnp.int32(int(q_cell)), radius, layers,
+        n=grid.n, k=10,
+    )
+    got = jax.tree.map(lambda a: np.asarray(jax.device_get(a)), got)
+
+    # single-device oracle computed independently in each process
+    want = knn_point(batch, qx, qy, jnp.int32(int(q_cell)), radius, layers,
+                     n=grid.n, k=10)
+    np.testing.assert_array_equal(got.obj_id, np.asarray(want.obj_id))
+    np.testing.assert_allclose(
+        got.dist[got.valid], np.asarray(want.dist)[np.asarray(want.valid)],
+        atol=1e-6)
+    print(f"DCN_OK {pid} {int(got.valid.sum())}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

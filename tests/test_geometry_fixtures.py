@@ -1,0 +1,173 @@
+"""Hand-computed geometry fixtures for containment/intersection edge cases.
+
+Unlike tests/oracles.py (which re-derives semantics in NumPy and could share
+a misreading with the kernels), every expected value here is a literal
+computed by hand from the definition of JTS ``Geometry.distance`` semantics:
+0 iff the geometries intersect (boundary crossing OR containment), else the
+minimum boundary-boundary Euclidean distance. Exercises
+``ops/geom.py`` — in particular the vertex-based containment resolution of
+``geoms_to_single_geom_dist``.
+"""
+
+import numpy as np
+import pytest
+
+from spatialflink_tpu.index import UniformGrid
+from spatialflink_tpu.models import LineString, Point, Polygon
+from spatialflink_tpu.models.batches import EdgeGeomBatch, PointBatch, single_query_edges
+from spatialflink_tpu.ops.geom import (
+    geoms_to_single_geom_dist,
+    points_in_geoms,
+    points_to_geoms_dist,
+)
+
+GRID = UniformGrid(0.0, 20.0, 0.0, 20.0, num_grid_partitions=20)
+
+
+def square(x0, y0, x1, y1):
+    return [(x0, y0), (x1, y0), (x1, y1), (x0, y1), (x0, y0)]
+
+
+def poly(*rings):
+    return Polygon.create([list(r) for r in rings], GRID)
+
+
+def batch(geoms):
+    return EdgeGeomBatch.from_objects(list(geoms), GRID)
+
+
+def dist_to_query(geoms, query):
+    gb = batch(geoms)
+    q_edges, q_mask = single_query_edges(query)
+    q_areal = isinstance(query, Polygon)
+    d = np.asarray(geoms_to_single_geom_dist(gb, q_edges, q_mask, q_areal))
+    return d[: len(geoms)]
+
+
+class TestPolygonPolygonFixtures:
+    def test_disjoint_axis_gap(self):
+        # [0,1]^2 vs [3,4]x[0,1]: closest edges x=1 and x=3 -> gap exactly 2
+        d = dist_to_query([poly(square(0, 0, 1, 1))], poly(square(3, 0, 4, 1)))
+        np.testing.assert_allclose(d, [2.0], atol=1e-6)
+
+    def test_disjoint_diagonal_gap(self):
+        # corners (1,1) and (2,2): gap sqrt(2)
+        d = dist_to_query([poly(square(0, 0, 1, 1))], poly(square(2, 2, 3, 3)))
+        np.testing.assert_allclose(d, [np.sqrt(2.0)], atol=1e-6)
+
+    def test_corner_touch_is_zero(self):
+        d = dist_to_query([poly(square(0, 0, 1, 1))], poly(square(1, 1, 2, 2)))
+        np.testing.assert_allclose(d, [0.0], atol=1e-7)
+
+    def test_edge_touch_is_zero(self):
+        d = dist_to_query([poly(square(0, 0, 1, 1))], poly(square(1, 0, 2, 1)))
+        np.testing.assert_allclose(d, [0.0], atol=1e-7)
+
+    def test_boundary_crossing_is_zero(self):
+        # plus-shape: A = [0,3]x[1,2], B = [1,2]x[0,3]; boundaries cross but
+        # NO vertex of either lies inside the other — the seg-seg kernel must
+        # see the crossing, not the vertex tests
+        d = dist_to_query([poly(square(0, 1, 3, 2))], poly(square(1, 0, 2, 3)))
+        np.testing.assert_allclose(d, [0.0], atol=1e-7)
+
+    def test_containment_disjoint_boundaries_both_ways(self):
+        # containment with no boundary contact: distance 0 both directions
+        inner, outer = poly(square(4, 4, 5, 5)), poly(square(3, 3, 6, 6))
+        np.testing.assert_allclose(dist_to_query([inner], outer), [0.0], atol=1e-7)
+        np.testing.assert_allclose(dist_to_query([outer], inner), [0.0], atol=1e-7)
+
+    def test_query_in_hole_is_positive(self):
+        # outer [0,10]^2 with hole [4,6]^2; query [4.5,5.5]^2 sits inside the
+        # hole -> NOT contained; nearest boundaries are the hole ring and the
+        # query ring, 0.5 apart on every side
+        holed = poly(square(0, 0, 10, 10), square(4, 4, 6, 6))
+        d = dist_to_query([holed], poly(square(4.5, 4.5, 5.5, 5.5)))
+        np.testing.assert_allclose(d, [0.5], atol=1e-6)
+
+    def test_query_overlapping_hole_boundary_is_zero(self):
+        holed = poly(square(0, 0, 10, 10), square(4, 4, 6, 6))
+        # query crosses the hole ring: intersects the solid part -> 0
+        d = dist_to_query([holed], poly(square(5, 5, 7, 7)))
+        np.testing.assert_allclose(d, [0.0], atol=1e-7)
+
+    def test_concave_notch_distance(self):
+        # C-shape open to the left; query square in the notch, 0.5 from the
+        # inner arms: [0,4]^2 minus notch [0,3]x[1,3] => ring below, right
+        # arm, ring above. Query [0.5,1.5]x[1.5,2.5] inside the notch:
+        # nearest inner edges y=1 (0.5 below), y=3 (0.5 above), x=3 (1.5
+        # right) -> 0.5
+        c_shape = poly([(0, 0), (4, 0), (4, 4), (0, 4), (0, 3), (3, 3),
+                        (3, 1), (0, 1), (0, 0)])
+        d = dist_to_query([c_shape], poly(square(0.5, 1.5, 1.5, 2.5)))
+        np.testing.assert_allclose(d, [0.5], atol=1e-6)
+
+    def test_multi_component_batch(self):
+        # one contained, one 2 away, one crossing — all in one batch call
+        geoms = [poly(square(4, 4, 5, 5)),      # inside query
+                 poly(square(13, 3, 14, 6)),    # 3 right of query x=10... gap 3
+                 poly(square(9, 9, 12, 12))]    # crosses query corner
+        d = dist_to_query(geoms, poly(square(3, 3, 10, 10)))
+        np.testing.assert_allclose(d, [0.0, 3.0, 0.0], atol=1e-6)
+
+
+class TestLineStringPolygonFixtures:
+    def test_linestring_inside_polygon_is_zero(self):
+        ls = LineString.create([(1, 1), (2, 2)], GRID)
+        d = dist_to_query([ls], poly(square(0, 0, 3, 3)))
+        np.testing.assert_allclose(d, [0.0], atol=1e-7)
+
+    def test_linestring_crossing_is_zero(self):
+        ls = LineString.create([(-1, 1.5), (4, 1.5)], GRID)
+        d = dist_to_query([ls], poly(square(0, 0, 3, 3)))
+        np.testing.assert_allclose(d, [0.0], atol=1e-7)
+
+    def test_linestring_outside_gap(self):
+        # vertical segment x=5, y in [0,3] vs square [0,3]^2: gap 2
+        ls = LineString.create([(5, 0), (5, 3)], GRID)
+        d = dist_to_query([ls], poly(square(0, 0, 3, 3)))
+        np.testing.assert_allclose(d, [2.0], atol=1e-6)
+
+    def test_polygon_not_inside_linestring_query(self):
+        # a linestring query is NOT areal: a polygon "containing" it scores
+        # 0 only because the polygon is areal and the ls vertices are inside
+        ls_query = LineString.create([(1, 1), (2, 2)], GRID)
+        d = dist_to_query([poly(square(0, 0, 3, 3))], ls_query)
+        np.testing.assert_allclose(d, [0.0], atol=1e-7)
+        # and a DISJOINT polygon keeps its boundary gap: ls (5,0)-(5,3)
+        ls_far = LineString.create([(5, 0), (5, 3)], GRID)
+        d = dist_to_query([poly(square(0, 0, 3, 3))], ls_far)
+        np.testing.assert_allclose(d, [2.0], atol=1e-6)
+
+
+class TestPointPolygonFixtures:
+    def _pts(self, coords):
+        xs = np.array([c[0] for c in coords], float)
+        ys = np.array([c[1] for c in coords], float)
+        return PointBatch.from_arrays(xs, ys, grid=GRID)
+
+    def test_point_fixture_matrix(self):
+        holed = poly(square(0, 0, 10, 10), square(4, 4, 6, 6))
+        gb = batch([holed])
+        pts = self._pts([
+            (2, 2),      # solid part -> inside, distance 0
+            (5, 5),      # in the hole -> outside, 1 from hole ring
+            (12, 5),     # right of outer ring -> 2
+            (5, 10),     # exactly on outer boundary -> 0
+        ])
+        d = np.asarray(points_to_geoms_dist(pts, gb))[:4, 0]
+        np.testing.assert_allclose(d, [0.0, 1.0, 2.0, 0.0], atol=1e-6)
+        inside = np.asarray(points_in_geoms(pts.x, pts.y, gb.edges,
+                                            gb.edge_mask))[:4, 0]
+        assert inside[0] and not inside[1] and not inside[2]
+
+    def test_concave_notch_point(self):
+        c_shape = poly([(0, 0), (4, 0), (4, 4), (0, 4), (0, 3), (3, 3),
+                        (3, 1), (0, 1), (0, 0)])
+        gb = batch([c_shape])
+        pts = self._pts([(1, 2),    # in the notch: outside, min(1, sqrt 2)=1
+                         (3.5, 2)])  # in the right arm: inside
+        d = np.asarray(points_to_geoms_dist(pts, gb))[:2, 0]
+        np.testing.assert_allclose(d, [1.0, 0.0], atol=1e-6)
+        inside = np.asarray(points_in_geoms(pts.x, pts.y, gb.edges,
+                                            gb.edge_mask))[:2, 0]
+        assert not inside[0] and inside[1]

@@ -37,6 +37,21 @@ def _merge_by_time(a: Iterable[Point], b: Iterable[Point]) -> Iterator[Tuple[int
     )
 
 
+def _combine_windows(r1: WindowResult, r2: WindowResult) -> WindowResult:
+    """One WindowResult whose records are r1's followed by r2's; deferred
+    inputs stay deferred (both lattices remain in flight on device)."""
+    rec1, rec2 = r1.records, r2.records
+    if not isinstance(rec1, Deferred) and not isinstance(rec2, Deferred):
+        return WindowResult(r1.window_start, r1.window_end, rec1 + rec2)
+
+    def collect(_):
+        out = rec1.finish() if isinstance(rec1, Deferred) else list(rec1)
+        out += rec2.finish() if isinstance(rec2, Deferred) else list(rec2)
+        return out
+
+    return WindowResult(r1.window_start, r1.window_end, Deferred(None, collect))
+
+
 def _merge_sorted_windows(gen_a, gen_b):
     """Outer-merge two window-start-sorted (start, end, idx, batch) streams
     into (start, end, a_win|None, b_win|None)."""
@@ -127,14 +142,20 @@ class PointPointJoinQuery(SpatialOperator):
             cutoff = first_new - win
             buf_a = [p for p in buf_a if p.timestamp >= cutoff]
             buf_b = [p for p in buf_b if p.timestamp >= cutoff]
-            all_a = buf_a + new_a
             all_b = buf_b + new_b
-            res = None
-            if all_a and all_b:
-                res = self._join_window(end_ts - win, end_ts, all_a, all_b,
-                                        radius, old_a=len(buf_a),
-                                        old_b=len(buf_b), max_dt=win)
-            buf_a, buf_b = all_a, all_b
+            # two lattices instead of (old+new)^2: new_a x (old_b + new_b)
+            # and old_a x new_b cover every pair with a new member exactly
+            # once and never recompute the old x old block an earlier fire
+            # already evaluated
+            start = end_ts - win
+            r1 = self._join_window(start, end_ts, new_a, all_b, radius,
+                                   max_dt=win)
+            r2 = self._join_window(start, end_ts, buf_a, new_b, radius,
+                                   max_dt=win)
+            res = _combine_windows(r1, r2)
+            if not isinstance(res.records, Deferred) and not res.records:
+                res = None  # realtime fires never emit known-empty results
+            buf_a, buf_b = buf_a + new_a, all_b
             new_a, new_b, seen = [], [], 0
             return res
 

@@ -110,19 +110,29 @@ class SyntheticPointSource:
                 )
 
 
-def kafka_source(topic: str, bootstrap_servers: str, **consumer_kwargs) -> Iterable[str]:
+def kafka_source(topic: str, bootstrap_servers: str = "", *, broker=None,
+                 group: str = "spatialflink", **consumer_kwargs) -> Iterable[str]:
     """Kafka consumer yielding record values as strings.
 
-    Gated on an available client library; the bare image has none, so this
-    raises with instructions rather than failing deep in a pipeline.
+    ``broker``: a :class:`spatialflink_tpu.streams.kafka.InMemoryBroker`
+    rides the in-process shim (tests, local replays — the full delivery
+    semantics story lives in ``streams/kafka.py``). Without one, a real
+    client library is required; the bare image has none, so this raises with
+    instructions rather than failing deep in a pipeline.
     """
+    if broker is not None:
+        from spatialflink_tpu.streams.kafka import KafkaSource
+
+        yield from KafkaSource(broker, topic, group, **consumer_kwargs)
+        return
     try:
         from kafka import KafkaConsumer  # type: ignore
     except ImportError as e:
         raise RuntimeError(
             "kafka_source requires the kafka-python package, which is not "
-            "installed in this environment. Use FileReplaySource/ListSource, "
-            "or install kafka-python where networking is available."
+            "installed in this environment. Use the InMemoryBroker shim "
+            "(broker=...), FileReplaySource/ListSource, or install "
+            "kafka-python where networking is available."
         ) from e
     consumer = KafkaConsumer(topic, bootstrap_servers=bootstrap_servers, **consumer_kwargs)
     for msg in consumer:

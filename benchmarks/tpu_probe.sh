@@ -1,0 +1,31 @@
+#!/bin/sh
+# TPU tunnel probe: bounded jax.devices() in a subprocess, outcome appended to
+# benchmarks/TPU_ATTEMPTS.log (referenced from BASELINE.md). The axon tunnel
+# can wedge for hours at backend init (any blocking default-backend call hangs
+# the whole process), so a timeout is the only safe probe. A reachable backend
+# that is NOT a TPU (JAX's silent CPU fallback) is a failure: the log must
+# never record "ok" for a CPU — downstream benches key off it.
+# Usage: benchmarks/tpu_probe.sh [timeout_s]   — exit 0 iff a real TPU answers.
+T=${1:-60}
+LOG="$(dirname "$0")/TPU_ATTEMPTS.log"
+TS=$(date -u +%Y-%m-%dT%H:%M:%SZ)
+OUT=$(timeout "$T" env -u JAX_PLATFORMS python -c \
+  "import jax; d=jax.devices(); print(d[0].platform.lower(), len(d))" 2>/dev/null)
+RC=$?
+case "$OUT" in
+  tpu\ *|axon\ *)
+    echo "$TS ok $OUT" >> "$LOG"
+    echo "TPU OK: $OUT"
+    exit 0
+    ;;
+  "")
+    echo "$TS timeout rc=$RC t=${T}s" >> "$LOG"
+    echo "TPU unreachable (rc=$RC after ${T}s)"
+    exit 1
+    ;;
+  *)
+    echo "$TS non-tpu-backend '$OUT' rc=$RC" >> "$LOG"
+    echo "TPU unreachable (backend: $OUT)"
+    exit 1
+    ;;
+esac

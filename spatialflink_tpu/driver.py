@@ -500,12 +500,17 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"# resuming from checkpoint: skipping {skip1} "
                   "already-consumed records", file=sys.stderr)
 
+    # --limit bounds the *original* record range: a resumed run covers the
+    # remainder of that range, not N additional records past the checkpoint
+    limit1 = args.limit
+    if skip1 and limit1 is not None:
+        limit1 = max(0, limit1 - skip1)
     if spec.family == "shapefile":
         stream1 = args.input1
     elif spec.family == "synthetic":
         stream1 = []
     else:
-        stream1 = FileReplaySource(args.input1, limit=args.limit, skip=skip1)
+        stream1 = FileReplaySource(args.input1, limit=limit1, skip=skip1)
     stream2 = FileReplaySource(args.input2, limit=args.limit) if args.input2 else None
 
     from spatialflink_tpu.utils.metrics import ControlTupleExit

@@ -62,6 +62,7 @@ class PointPointKNNQuery(SpatialOperator):
                 self._mesh(), self._shard(batch),
                 query_point.x, query_point.y, jnp.int32(query_point.cell),
                 radius, nb_layers, n=self.grid.n, k=k,
+                strategy=self._knn_strategy(),
             )
         return knn_point(
             batch,

@@ -365,14 +365,35 @@ class PointTAggregateQuery(SpatialOperator):
             buf.append(p)
             arrivals[p.cell] = arrivals.get(p.cell, 0) + 1
             if arrivals[p.cell] % slide == 0:
-                yield self._count_window_result(p.cell, list(buf), agg)
+                result = self._count_window_result(p.cell, list(buf), agg)
+                # SUM/AVG require sum > 0 and MIN/MAX a multi-point object;
+                # the reference collects nothing otherwise (ALL/COUNT records
+                # are never empty)
+                if result.records:
+                    yield result
 
     def _count_window_result(self, cell: int, pts: List[Point], agg: str
                              ) -> WindowResult:
+        # MIN/MAX replicate CountWindowProcessFunction's per-point tracker
+        # scan (TAggregateQuery.java:438-494): a length updates the trackers
+        # only when an object is *re-sighted* (>= 2 points in the window), and
+        # MIN is the minimum over intermediate lengths at each re-sighting —
+        # an object's length at its 2nd point can undercut every final
+        # length. No multi-point object => the reference emits nothing.
         extents: Dict[str, Tuple[int, int]] = {}
+        min_len = min_oid = max_len = max_oid = None
         for p in pts:
-            mn, mx = extents.get(p.obj_id, (p.timestamp, p.timestamp))
-            extents[p.obj_id] = (min(mn, p.timestamp), max(mx, p.timestamp))
+            if p.obj_id in extents:
+                mn, mx = extents[p.obj_id]
+                mn, mx = min(mn, p.timestamp), max(mx, p.timestamp)
+                extents[p.obj_id] = (mn, mx)
+                length = mx - mn
+                if max_len is None or length > max_len:
+                    max_len, max_oid = length, p.obj_id
+                if min_len is None or length < min_len:
+                    min_len, min_oid = length, p.obj_id
+            else:
+                extents[p.obj_id] = (p.timestamp, p.timestamp)
         lengths = {oid: mx - mn for oid, (mn, mx) in extents.items()}
         n_objs = len(lengths)
         start = min(p.timestamp for p in pts)
@@ -387,11 +408,9 @@ class PointTAggregateQuery(SpatialOperator):
             s = sum(lengths.values())
             records = [(cell, round(s / n_objs))] if s > 0 else []
         elif agg == "MIN":
-            oid = min(lengths, key=lambda o: lengths[o])
-            records = [(cell, oid, lengths[oid])]
+            records = [(cell, min_oid, min_len)] if min_len is not None else []
         elif agg == "MAX":
-            oid = max(lengths, key=lambda o: lengths[o])
-            records = [(cell, oid, lengths[oid])]
+            records = [(cell, max_oid, max_len)] if max_len is not None else []
         elif agg == "COUNT":
             records = [(cell, n_objs)]
         else:

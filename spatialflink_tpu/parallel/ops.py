@@ -48,13 +48,18 @@ def distributed_knn(
     n: int,
     k: int,
     enforce_radius: bool = False,
+    strategy: str = "auto",
 ) -> KnnResult:
-    """kNN over a batch sharded on the point dim; result replicated."""
+    """kNN over a batch sharded on the point dim; result replicated.
+
+    ``strategy`` is threaded to the per-shard ``knn_point`` so approximate
+    mode (``approx``) behaves the same at any parallelism; the re-merge is
+    exact top-k over the k-sized partials either way."""
 
     def per_shard(pts: PointBatch) -> KnnResult:
         local = knn_point(
             pts, qx, qy, q_cell, radius, nb_layers,
-            n=n, k=k, enforce_radius=enforce_radius,
+            n=n, k=k, enforce_radius=enforce_radius, strategy=strategy,
         )
         # gather the k-sized partials from every device and re-merge
         all_oid = jax.lax.all_gather(local.obj_id, CELL_AXIS).reshape(-1)
@@ -84,6 +89,7 @@ def distributed_knn_hierarchical(
     n: int,
     k: int,
     enforce_radius: bool = False,
+    strategy: str = "auto",
 ) -> KnnResult:
     """kNN over a 2-D (DCN_AXIS, CELL_AXIS) mesh with a two-level merge.
 
@@ -99,7 +105,7 @@ def distributed_knn_hierarchical(
     def per_shard(pts: PointBatch) -> KnnResult:
         local = knn_point(
             pts, qx, qy, q_cell, radius, nb_layers,
-            n=n, k=k, enforce_radius=enforce_radius,
+            n=n, k=k, enforce_radius=enforce_radius, strategy=strategy,
         )
         # level 1: merge across the slice (ICI)
         ici = KnnResult(

@@ -172,13 +172,43 @@ class KafkaSink:
         pass
 
 
+def _values_equal(a, b) -> bool:
+    """Structural equality that tolerates ndarray-valued extras (a
+    tAggregate heatmap WindowResult would make plain ``==`` raise
+    "truth value of an array is ambiguous")."""
+    import dataclasses
+
+    import numpy as _np
+
+    if isinstance(a, _np.ndarray) or isinstance(b, _np.ndarray):
+        return _np.array_equal(a, b)
+    if dataclasses.is_dataclass(a) and not isinstance(a, type):
+        if type(a) is not type(b):
+            return False
+        return all(_values_equal(getattr(a, f.name), getattr(b, f.name))
+                   for f in dataclasses.fields(a))
+    if isinstance(a, dict) and isinstance(b, dict):
+        return a.keys() == b.keys() and all(
+            _values_equal(v, b[k]) for k, v in a.items())
+    if isinstance(a, (list, tuple)) and isinstance(b, (list, tuple)):
+        return len(a) == len(b) and all(
+            _values_equal(x, y) for x, y in zip(a, b))
+    try:
+        return bool(a == b)
+    except Exception:
+        return False
+
+
 class IdempotentWindowSink:
     """At-least-once → effective exactly-once for windowed results.
 
-    Results are keyed by (window_start, window_end, key); a re-delivered
-    duplicate (same key) overwrites its previous value instead of appending,
-    so downstream consumers of :meth:`snapshot` see each window's final
-    state exactly once no matter how many times the upstream retried.
+    Results are keyed by (window_start, window_end, key); re-deliveries of a
+    key are dropped entirely — first delivery wins in BOTH the snapshot
+    table and the inner sink, so the two exposed outputs can never disagree.
+    A re-delivery whose value differs from the recorded one (a recomputed
+    window producing a different result — a determinism bug upstream, not
+    normal retry noise) is counted separately in
+    ``duplicates_value_differing`` so divergence is observable.
     ``key_fn`` extracts the idempotency key from a result (default: the
     window bounds plus a ``cell`` extra when present — SURVEY §7's
     "(window, cell)" plan).
@@ -190,6 +220,7 @@ class IdempotentWindowSink:
         self.key_fn = key_fn or self._default_key
         self._delivered: Dict[Tuple, Any] = {}
         self.duplicates_suppressed = 0
+        self.duplicates_value_differing = 0
 
     @staticmethod
     def _default_key(result) -> Tuple:
@@ -201,15 +232,14 @@ class IdempotentWindowSink:
 
     def emit(self, result) -> None:
         key = self.key_fn(result)
-        fresh = key not in self._delivered
-        self._delivered[key] = result
-        if fresh:
+        if key not in self._delivered:
+            self._delivered[key] = result
             if self.inner is not None:
-                # only first delivery propagates; duplicates update the
-                # table silently (the table is the source of truth)
                 self.inner.emit(result)
         else:
             self.duplicates_suppressed += 1
+            if not _values_equal(self._delivered[key], result):
+                self.duplicates_value_differing += 1
 
     def snapshot(self) -> Dict[Tuple, Any]:
         return dict(self._delivered)

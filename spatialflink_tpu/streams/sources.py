@@ -58,7 +58,8 @@ class FileReplaySource:
         it = lines()
         if self.skip:
             it = itertools.islice(it, self.skip, None)
-        return itertools.islice(it, self.limit) if self.limit else it
+        # limit=0 is a real bound (a fully-consumed resumed range), not "all"
+        return itertools.islice(it, self.limit) if self.limit is not None else it
 
 
 class SyntheticPointSource:

@@ -119,6 +119,48 @@ class TestIdempotentDelivery:
         assert sink.duplicates_suppressed == 2
         assert len(sink.snapshot()) == 2
 
+    def test_value_differing_duplicates_observable(self):
+        """First delivery wins in BOTH the table and the inner sink (they can
+        never disagree); a re-delivery with a different value — upstream
+        nondeterminism, not retry noise — is counted separately."""
+        from spatialflink_tpu.operators import WindowResult
+
+        inner = []
+
+        class L:
+            def emit(self, r):
+                inner.append(r)
+
+            def close(self):
+                pass
+
+        sink = IdempotentWindowSink(L())
+        w = WindowResult(0, 10, ["a"])
+        w_same = WindowResult(0, 10, ["a"])
+        w_diff = WindowResult(0, 10, ["b"])
+        for r in (w, w_same, w_diff):
+            sink.emit(r)
+        assert inner == [w]
+        assert sink.snapshot() == {(0, 10, None): w}
+        assert sink.duplicates_suppressed == 2
+        assert sink.duplicates_value_differing == 1
+
+    def test_ndarray_extras_compare_structurally(self):
+        """A byte-identical heatmap re-delivery is NOT value-differing —
+        plain == on ndarray-valued extras would raise and false-positive."""
+        import numpy as np
+
+        from spatialflink_tpu.operators import WindowResult
+
+        hm = np.arange(6).reshape(2, 3)
+        sink = IdempotentWindowSink()
+        sink.emit(WindowResult(0, 10, [], extras={"heatmap": hm.copy()}))
+        sink.emit(WindowResult(0, 10, [], extras={"heatmap": hm.copy()}))
+        assert sink.duplicates_suppressed == 1
+        assert sink.duplicates_value_differing == 0
+        sink.emit(WindowResult(0, 10, [], extras={"heatmap": hm + 1}))
+        assert sink.duplicates_value_differing == 1
+
     def test_replayed_pipeline_is_effectively_exactly_once(self):
         """Crash-and-replay: the consumer re-delivers uncommitted input, the
         pipeline recomputes the same windows, and the idempotent sink keyed

@@ -56,6 +56,28 @@ class TestDistributedKnn:
         )
         assert (np.asarray(dist.obj_id) == np.asarray(single.obj_id)).all()
 
+    def test_strategy_threads_to_shards(self, mesh):
+        """conf.approximate must behave the same at any parallelism: the
+        per-shard strategy kwarg reaches knn_point (ADVICE round-2
+        knn_query.py:58). On CPU approx_min_k is exact, so the distributed
+        approx result must match the single-device approx result."""
+        b = make_batch(2048)
+        r = 0.3
+        q_cell, _ = GRID.assign_cell(QX, QY)
+        L = GRID.candidate_layers(r)
+        single = knn_point(b, QX, QY, jnp.int32(q_cell), r, L,
+                           n=GRID.n, k=20, strategy="approx")
+        dist = distributed_knn(
+            mesh, shard_batch(b, mesh), QX, QY, jnp.int32(int(q_cell)), r, L,
+            n=GRID.n, k=20, strategy="approx",
+        )
+        assert np.asarray(dist.valid).sum() == np.asarray(single.valid).sum()
+        np.testing.assert_allclose(
+            np.sort(np.asarray(dist.dist)[np.asarray(dist.valid)]),
+            np.sort(np.asarray(single.dist)[np.asarray(single.valid)]),
+            atol=1e-5,
+        )
+
     def test_cell_hash_order_preserves_results(self, mesh):
         b = make_batch(1024)
         idx = cell_hash_order(np.asarray(b.cell), 8)

@@ -297,6 +297,34 @@ class TestTAggregateCountWindows:
         (res,) = list(op.run(iter(pts), "AVG"))
         assert res.records == [(pts[0].cell, 2000)]
 
+    def test_min_max_require_multipoint_objects(self):
+        """All-singleton window: the reference's min/max trackers only update
+        on a re-sighting, so MIN stays Long.MAX_VALUE and nothing is emitted
+        (TAggregateQuery.java:476-489 guards)."""
+        pts = [Point.create(116.05, 40.05, GRID, f"s{i}", BASE + i * 1000)
+               for i in range(4)]
+        for agg in ("MIN", "MAX"):
+            op = PointTAggregateQuery(self._conf(4, 4), GRID)
+            assert list(op.run(iter(pts), agg)) == []
+
+    def test_min_tracks_intermediate_lengths(self):
+        """The reference's MIN is the minimum over lengths at each
+        re-sighting: B's length at its 2nd point (1000) undercuts every
+        FINAL length (A=10000, B=100000) and wins."""
+        pts = [
+            Point.create(116.05, 40.05, GRID, "A", BASE),
+            Point.create(116.05, 40.05, GRID, "B", BASE),
+            Point.create(116.05, 40.05, GRID, "B", BASE + 1000),
+            Point.create(116.05, 40.05, GRID, "A", BASE + 10_000),
+            Point.create(116.05, 40.05, GRID, "B", BASE + 100_000),
+        ]
+        op = PointTAggregateQuery(self._conf(5, 5), GRID)
+        (res,) = list(op.run(iter(pts), "MIN"))
+        assert res.records == [(pts[0].cell, "B", 1000)]
+        op = PointTAggregateQuery(self._conf(5, 5), GRID)
+        (res,) = list(op.run(iter(pts), "MAX"))
+        assert res.records == [(pts[0].cell, "B", 100_000)]
+
     def test_count_mode_rejected_for_other_operators(self):
         import pytest as _pytest
 
@@ -575,6 +603,42 @@ class TestTStatsCheckpointResume:
         # second run over the same file: every record is skipped as consumed
         assert cli_main(args) == 0
         assert PointTStatsQuery.checkpoint_consumed(cp) == 200
+
+    def test_cli_resume_respects_limit(self, tmp_path):
+        """--limit N bounds the ORIGINAL record range: resume covers the
+        remainder of the first N records, not N more past the checkpoint
+        (ADVICE round-2 driver.py:508)."""
+        from spatialflink_tpu.driver import main as cli_main
+
+        pts = self._stream(0, 200)
+        inp = tmp_path / "pts.csv"
+        with open(inp, "w") as f:
+            for p in pts:
+                f.write(f"{p.obj_id},{p.timestamp},{p.x},{p.y}\n")
+        conf = tmp_path / "conf.yml"
+        import shutil
+
+        import yaml
+
+        shutil.copy("conf/spatialflink-conf.yml", conf)
+        with open(conf) as f:
+            y = yaml.safe_load(f)
+        y["query"]["option"] = 205
+        y["inputStream1"]["format"] = "CSV"
+        y["inputStream1"]["csvTsvSchemaAttr"] = [0, 1, 2, 3]
+        y["inputStream1"]["dateFormat"] = None
+        with open(conf, "w") as f:
+            yaml.safe_dump(y, f)
+        cp = str(tmp_path / "cli.npz")
+        args = ["--config", str(conf), "--input1", str(inp),
+                "--checkpoint", cp, "--checkpoint-every", "1",
+                "--limit", "100"]
+        assert cli_main(args) == 0
+        assert PointTStatsQuery.checkpoint_consumed(cp) == 100
+        # re-run with identical args: all 100 are consumed; the effective
+        # limit shrinks to 0 instead of pulling 100 MORE records
+        assert cli_main(args) == 0
+        assert PointTStatsQuery.checkpoint_consumed(cp) == 100
 
     def test_no_resume_without_flag(self, tmp_path):
         cp = str(tmp_path / "tstats.npz")

@@ -44,6 +44,14 @@ def main() -> int:
     qc = jnp.int32(grid.assign_cell(qx, qy)[0])
     layers = grid.candidate_layers(0.5)
 
+    # the slope window must dwarf per-dispatch noise: over the axon tunnel a
+    # single dispatch→readback round trip is tens of ms, so hi-lo=10 windows
+    # (~1-3ms device time each on TPU) drowned in it — the round-3 bench's
+    # "non-positive slope" failure. 2→42 puts ≥40 windows of device time
+    # between the two timings; override via SPATIALFLINK_SWEEP_ITERS=lo,hi.
+    lo, hi = (int(v) for v in os.environ.get(
+        "SPATIALFLINK_SWEEP_ITERS", "2,42").split(","))
+
     def slope_ms(select) -> float:
         @partial(jax.jit, static_argnames=("iters",))
         def run_n(b, *, iters):
@@ -55,7 +63,6 @@ def main() -> int:
                 return acc + r.dist[0]
             return jax.lax.fori_loop(0, iters, body, jnp.float32(0))
 
-        lo, hi = 2, 12
         times = {}
         for iters in (lo, hi):
             jax.block_until_ready(run_n(batch, iters=iters))

@@ -124,8 +124,8 @@ CASES = _build_cases()
 # stream decoding
 
 
-def decode_stream(records: Iterable, cfg: StreamConfig, grid: UniformGrid
-                  ) -> Iterator[SpatialObject]:
+def decode_stream(records: Iterable, cfg: StreamConfig, grid: UniformGrid,
+                  geometry: str = "Point") -> Iterator[SpatialObject]:
     """Raw lines/dicts → spatial objects; already-parsed objects pass through
     (the reference's per-case ``Deserialization.*Stream`` stage). Marks the
     ingest throughput meter and honors the control-tuple stop hook
@@ -144,6 +144,9 @@ def decode_stream(records: Iterable, cfg: StreamConfig, grid: UniformGrid
             date_format=cfg.date_format,
             property_obj_id=cfg.geojson_obj_id_attr,
             property_timestamp=cfg.geojson_timestamp_attr,
+            # only CSV/TSV needs the hint (coordinate-string rows,
+            # CSVTSVToSpatialPolygon); GeoJSON/WKT are self-describing
+            geometry=geometry,
         )
 
 
@@ -228,12 +231,12 @@ def run_option(params: Params, stream1: Iterable, stream2: Optional[Iterable]
     if spec.family in ("range", "knn", "join"):
         cls = getattr(ops, f"{spec.stream}{spec.query}"
                            f"{ {'range': 'Range', 'knn': 'KNN', 'join': 'Join'}[spec.family] }Query")
-        s1 = decode_stream(stream1, params.input1, u_grid)
+        s1 = decode_stream(stream1, params.input1, u_grid, spec.stream)
         if spec.family == "join":
             op = cls(conf, u_grid, q_grid)
             if stream2 is None:
                 raise ValueError(f"queryOption {opt} (join) needs stream2")
-            s2 = decode_stream(stream2, params.input2, q_grid)
+            s2 = decode_stream(stream2, params.input2, q_grid, spec.query)
             out = op.run(s1, s2, radius)
         else:
             op = cls(conf, u_grid)
@@ -273,13 +276,13 @@ def run_option(params: Params, stream1: Iterable, stream2: Optional[Iterable]
             s1 = decode_stream(stream1, params.input1, u_grid)
             # both sides must live in the app's grid (the reference passes
             # ONE uGrid to normalizedCellStayTime, StreamingJob.java:1667)
-            s2 = decode_stream(stream2, params.input2, u_grid)
+            s2 = decode_stream(stream2, params.input2, u_grid, "Polygon")
             # query.trajIDs names moving-object trajectories; sensor polygon
             # IDs live in a different namespace, so the sensor side is never
             # filtered by it (StayTime.java keys sensors by poly id only)
             return app.normalized_cell_stay_time(
                 s1, s2, traj_ids_points=traj_ids, traj_ids_sensors=None)
-        s1 = decode_stream(stream1, params.input1, u_grid)
+        s1 = decode_stream(stream1, params.input1, u_grid, spec.stream)
         if spec.stream == "Polygon":  # 1011: sensor-range intersection
             return app.cell_sensor_range_intersection(s1, traj_ids)
         return app.cell_stay_time(s1, traj_ids)
@@ -348,16 +351,52 @@ def _run_deser(params, spec, grid, stream1) -> Iterator:
 
 
 def _run_synthetic(params: Params, conf, grid) -> Iterator[WindowResult]:
-    """queryOption 99: run the trajectory queries over deterministic synthetic
-    trajectories (reference harness ``StreamingJob.java:1571-1618``)."""
+    """queryOption 99: run ALL SIX trajectory query families over
+    deterministic synthetic trajectories — the reference harness sketched
+    every one against ``env.fromCollection`` (``StreamingJob.java:1571-1618``).
+    Results are tagged with the family via ``extras['family']`` so a smoke
+    run can assert each family actually fired."""
+    from spatialflink_tpu.models import Polygon
     from spatialflink_tpu.streams.sources import SyntheticPointSource
 
     def src():
         return SyntheticPointSource(grid, num_trajectories=16, steps=8, seed=7)
 
-    yield from ops.PointTStatsQuery(conf, grid).run(src())
-    yield from ops.PointTAggregateQuery(conf, grid).run(
-        src(), params.query.aggregate_function)
+    def tagged(family, it):
+        for r in it:
+            if hasattr(r, "extras"):
+                r.extras.setdefault("family", family)
+            yield r
+
+    first = list(src())
+    traj_ids = {p.obj_id for p in first[:4]}
+    qp = first[0]
+    # a query polygon covering the middle of the grid (the reference built
+    # synthetic query geometry with HelperClass.generateQueryPolygons)
+    cx = (grid.min_x + grid.max_x) / 2
+    cy = (grid.min_y + grid.max_y) / 2
+    dx = (grid.max_x - grid.min_x) / 4
+    dy = (grid.max_y - grid.min_y) / 4
+    qpoly = Polygon.create(
+        [[(cx - dx, cy - dy), (cx + dx, cy - dy), (cx + dx, cy + dy),
+          (cx - dx, cy + dy)]], grid)
+
+    yield from tagged("tfilter",
+                      ops.PointTFilterQuery(conf, grid).run(src(), traj_ids))
+    yield from tagged("trange",
+                      ops.PointPolygonTRangeQuery(conf, grid).run(src(), [qpoly]))
+    yield from tagged("tstats", ops.PointTStatsQuery(conf, grid).run(src()))
+    yield from tagged("taggregate", ops.PointTAggregateQuery(conf, grid).run(
+        src(), params.query.aggregate_function))
+    # query.radius defaults to 0.0 in the config schema (= unset); the
+    # harness needs a working radius — tJoin's proximity test and tKnn's
+    # enforced radius filter both emit nothing at 0 — so 0 falls back to a
+    # half-degree probe. A deliberately tiny radius still passes through.
+    radius = params.query.radius if params.query.radius > 0 else 0.5
+    yield from tagged("tjoin", ops.PointPointTJoinQuery(conf, grid, grid).run(
+        src(), src(), radius))
+    yield from tagged("tknn", ops.PointPointTKNNQuery(conf, grid).run(
+        src(), qp, radius, params.query.k))
 
 
 # --------------------------------------------------------------------- #

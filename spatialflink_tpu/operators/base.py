@@ -159,26 +159,55 @@ class SpatialOperator:
         return EdgeGeomBatch.from_objects(records, self.grid, self.interner,
                                           ts_base=ts_base)
 
-    def _defer_mask_select(self, mask, records: List) -> Deferred:
+    @staticmethod
+    def _record_pruning_stats(gn_bypassed, dist_evals) -> None:
+        """Pruning-effectiveness counters (the reference's "Distance
+        Computation Count", ``spatialObjects/Point.java:220-235``, plus its
+        complement): read device scalars and bump the registry."""
+        from spatialflink_tpu.utils.metrics import REGISTRY
+
+        REGISTRY.counter("gn-bypassed").inc(int(gn_bypassed))
+        REGISTRY.counter("distance-computations").inc(int(dist_evals))
+
+    def _defer_with_stats(self, dev, stats, rows) -> Deferred:
+        """Single owner of the stats-payload protocol: ``stats`` is None or a
+        (gn_bypassed, dist_evals) device-scalar pair; it rides the Deferred
+        payload (no extra host sync — same readback as the main result) and
+        bumps the pruning counters at collect time. ``rows(main_result)``
+        turns the non-stats part into host rows."""
+        def collect(payload):
+            if stats is not None:
+                main, gn, evals = payload
+                self._record_pruning_stats(gn, evals)
+            else:
+                main = payload
+            return rows(main)
+        return Deferred((dev, *stats) if stats is not None else dev, collect)
+
+    def _defer_mask_select(self, mask, records: List, stats=None) -> Deferred:
         """Deferred selection of ``records`` by a device boolean mask."""
-        def collect(m):
+        def rows(m):
             idx = np.nonzero(np.asarray(m))[0]
             return [records[i] for i in idx if i < len(records)]
-        return Deferred(mask, collect)
+        return self._defer_with_stats(mask, stats, rows)
 
-    def _defer_knn(self, res, interner=None) -> Deferred:
+    def _defer_knn(self, res, interner=None, dist_evals=None) -> Deferred:
         """Deferred (objID, distance) list from a device KnnResult; ids
         resolve through ``interner`` (default: the operator's own — bulk
-        paths pass the parse-time interner)."""
+        paths pass the parse-time interner). ``dist_evals`` (device scalar)
+        feeds the distance-computation counter — kNN has no GN bypass
+        (``knn/PointPointKNNQuery.java:152-183`` computes a distance for
+        every candidate-cell point)."""
         interner = interner if interner is not None else self.interner
 
-        def collect(r):
+        def rows(r):
             valid = np.asarray(r.valid)
             oids = np.asarray(r.obj_id)[valid]
             dists = np.asarray(r.dist)[valid]
             return [(interner.lookup(int(o)), float(d))
                     for o, d in zip(oids, dists)]
-        return Deferred(res, collect)
+        stats = None if dist_evals is None else (0, dist_evals)
+        return self._defer_with_stats(res, stats, rows)
 
     def _knn_strategy(self) -> str:
         """Top-k selection strategy: approximate mode rides the TPU

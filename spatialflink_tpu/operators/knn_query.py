@@ -25,7 +25,7 @@ from spatialflink_tpu.operators.base import (
     SpatialOperator,
     WindowResult,
 )
-from spatialflink_tpu.ops.knn import knn_point
+from spatialflink_tpu.ops.knn import knn_point_stats
 
 
 class PointPointKNNQuery(SpatialOperator):
@@ -44,14 +44,17 @@ class PointPointKNNQuery(SpatialOperator):
         if not records:
             return []
         batch = self._point_batch(records, ts_base)
-        res = self._knn_result(batch, query_point, radius, k)
-        return self._defer_knn(res)
+        res, dist_evals = self._knn_result(batch, query_point, radius, k)
+        return self._defer_knn(res, dist_evals=dist_evals)
 
     def _knn_result(self, batch, query_point: Point, radius: float, k: int):
-        """kNN over one window batch; with ``conf.devices`` the point dim is
-        sharded and per-device dedup+top-k partials are all-gathered and
-        re-merged (parallel.ops.distributed_knn) — the two-stage merge of
-        SURVEY §2.5 without the reference's parallelism-1 windowAll stage."""
+        """(KnnResult, dist_evals) over one window batch — the count rides the
+        same dispatch (ops.knn.knn_point_stats) and feeds the pruning counter;
+        it is None when sharded (per-shard counts would need an extra
+        collective). With ``conf.devices`` the point dim is sharded and
+        per-device dedup+top-k partials are all-gathered and re-merged
+        (parallel.ops.distributed_knn) — the two-stage merge of SURVEY §2.5
+        without the reference's parallelism-1 windowAll stage."""
         nb_layers = (
             self.grid.n if radius == 0 else self.grid.candidate_layers(radius)
         )
@@ -63,8 +66,8 @@ class PointPointKNNQuery(SpatialOperator):
                 query_point.x, query_point.y, jnp.int32(query_point.cell),
                 radius, nb_layers, n=self.grid.n, k=k,
                 strategy=self._knn_strategy(),
-            )
-        return knn_point(
+            ), None
+        return knn_point_stats(
             batch,
             query_point.x,
             query_point.y,
@@ -85,8 +88,9 @@ class PointPointKNNQuery(SpatialOperator):
 
         def eval_batch(payload, ts_base):
             _idx, batch = payload
-            res = self._knn_result(batch, query_point, radius, k)
-            return self._defer_knn(res, interner=parsed.interner)
+            res, dist_evals = self._knn_result(batch, query_point, radius, k)
+            return self._defer_knn(res, interner=parsed.interner,
+                                   dist_evals=dist_evals)
 
         for result in self._drive_bulk(parsed, eval_batch, pad=pad):
             result.extras["k"] = k
@@ -111,12 +115,13 @@ class _GenericKnn(SpatialOperator, GeomQueryMixin):
         def eval_batch(records, ts_base):
             if not records:
                 return []
-            from spatialflink_tpu.ops.knn import knn_eligible
+            from spatialflink_tpu.ops.knn import knn_eligible_stats
 
             batch, eligible, dists = self._eligibility(records, ts_base, setup)
-            res = knn_eligible(batch.obj_id, dists, eligible, k=k,
-                               strategy=self._knn_strategy())
-            return self._defer_knn(res)
+            res, dist_evals = knn_eligible_stats(
+                batch.obj_id, dists, eligible, k=k,
+                strategy=self._knn_strategy())
+            return self._defer_knn(res, dist_evals=dist_evals)
 
         for result in self._drive(stream, eval_batch):
             result.extras["k"] = k

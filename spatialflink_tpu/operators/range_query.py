@@ -22,7 +22,7 @@ from spatialflink_tpu.operators.base import (
     SpatialOperator,
     WindowResult,
 )
-from spatialflink_tpu.ops.range import range_filter_point
+from spatialflink_tpu.ops.range import range_filter_point_stats
 
 
 class PointPointRangeQuery(SpatialOperator):
@@ -40,14 +40,17 @@ class PointPointRangeQuery(SpatialOperator):
         if not records:
             return []
         batch = self._point_batch(records, ts_base)
-        mask = self._range_mask(batch, query_point, radius)
-        return self._defer_mask_select(mask, records)
+        mask, stats = self._range_mask(batch, query_point, radius)
+        return self._defer_mask_select(mask, records, stats)
 
     def _range_mask(self, batch, query_point: Point, radius: float):
-        """Selection mask for one window batch; with ``conf.devices`` the
-        batch point dim is sharded over the mesh and each device filters its
-        shard (parallel.ops.distributed_range_count) — results are identical
-        to the single-device kernel, which runs per shard."""
+        """(mask, stats) for one window batch; ``stats`` is the
+        (gn_bypassed, dist_evals) device-scalar pair feeding the pruning
+        counters, or None on the distributed path (per-shard stats would need
+        an extra collective; the single-device kernel covers the metric).
+        With ``conf.devices`` the batch point dim is sharded over the mesh and
+        each device filters its shard (parallel.ops.distributed_range_count) —
+        results are identical to the single-device kernel."""
         args = (
             query_point.x, query_point.y, jnp.int32(query_point.cell), radius,
             self.grid.guaranteed_layers(radius),
@@ -60,11 +63,11 @@ class PointPointRangeQuery(SpatialOperator):
                 self._mesh(), self._shard(batch), *args,
                 n=self.grid.n, approximate=self.conf.approximate,
             )
-            return mask
-        mask, _ = range_filter_point(
+            return mask, None
+        mask, _, gn_bypassed, dist_evals = range_filter_point_stats(
             batch, *args, n=self.grid.n, approximate=self.conf.approximate,
         )
-        return mask
+        return mask, (gn_bypassed, dist_evals)
 
     # ---------------------------------------------------------------- #
 
@@ -78,11 +81,9 @@ class PointPointRangeQuery(SpatialOperator):
         """
         def eval_batch(payload, ts_base):
             idx, batch = payload
-            mask = self._range_mask(batch, query_point, radius)
-            return Deferred(
-                mask,
-                lambda m: idx[np.asarray(m)[: len(idx)]].tolist(),
-            )
+            mask, stats = self._range_mask(batch, query_point, radius)
+            return self._defer_with_stats(
+                mask, stats, lambda m: idx[np.asarray(m)[: len(idx)]].tolist())
 
         return self._drive_bulk(parsed, eval_batch, pad=pad)
 
@@ -130,7 +131,7 @@ class PointGeomRangeQuery(SpatialOperator, GeomQueryMixin):
                 return []
             from spatialflink_tpu.ops.distances import point_bbox_dist
             from spatialflink_tpu.ops.geom import points_to_single_geom_dist
-            from spatialflink_tpu.ops.range import range_filter_masks
+            from spatialflink_tpu.ops.range import range_filter_masks_stats
 
             batch = self._point_batch(records, ts_base)
             if self.conf.approximate:
@@ -138,8 +139,8 @@ class PointGeomRangeQuery(SpatialOperator, GeomQueryMixin):
                                         q_bbox[0], q_bbox[1], q_bbox[2], q_bbox[3])
             else:
                 dists = points_to_single_geom_dist(batch, q_edges, q_mask, q_areal)
-            mask = range_filter_masks(batch, gn, cn, dists, radius)
-            return self._defer_mask_select(mask, records)
+            mask, gn_c, evals = range_filter_masks_stats(batch, gn, cn, dists, radius)
+            return self._defer_mask_select(mask, records, (gn_c, evals))
 
         return self._drive(stream, eval_batch)
 
@@ -163,7 +164,7 @@ class GeomPointRangeQuery(SpatialOperator, GeomQueryMixin):
                 geom_cells_any_within,
                 point_to_geoms_dist,
             )
-            from spatialflink_tpu.ops.range import range_filter_geom_stream
+            from spatialflink_tpu.ops.range import range_filter_geom_stream_stats
 
             geoms = self._geom_batch(records, ts_base)
             all_gn = geom_cells_all_within(geoms.cells, geoms.cells_mask, gn)
@@ -174,8 +175,9 @@ class GeomPointRangeQuery(SpatialOperator, GeomQueryMixin):
                                         geoms.bbox[:, 2], geoms.bbox[:, 3])
             else:
                 dists = point_to_geoms_dist(query_point.x, query_point.y, geoms)
-            mask = range_filter_geom_stream(all_gn, any_nb, dists, radius, geoms.valid)
-            return self._defer_mask_select(mask, records)
+            mask, gn_c, evals = range_filter_geom_stream_stats(
+                all_gn, any_nb, dists, radius, geoms.valid)
+            return self._defer_mask_select(mask, records, (gn_c, evals))
 
         return self._drive(stream, eval_batch)
 
@@ -199,7 +201,7 @@ class GeomGeomRangeQuery(SpatialOperator, GeomQueryMixin):
                 geoms_bbox_dist,
                 geoms_to_single_geom_dist,
             )
-            from spatialflink_tpu.ops.range import range_filter_geom_stream
+            from spatialflink_tpu.ops.range import range_filter_geom_stream_stats
 
             geoms = self._geom_batch(records, ts_base)
             all_gn = geom_cells_all_within(geoms.cells, geoms.cells_mask, gn)
@@ -208,8 +210,9 @@ class GeomGeomRangeQuery(SpatialOperator, GeomQueryMixin):
                 dists = geoms_bbox_dist(geoms, q_bbox)
             else:
                 dists = geoms_to_single_geom_dist(geoms, q_edges, q_mask, q_areal)
-            mask = range_filter_geom_stream(all_gn, any_nb, dists, radius, geoms.valid)
-            return self._defer_mask_select(mask, records)
+            mask, gn_c, evals = range_filter_geom_stream_stats(
+                all_gn, any_nb, dists, radius, geoms.valid)
+            return self._defer_mask_select(mask, records, (gn_c, evals))
 
         return self._drive(stream, eval_batch)
 

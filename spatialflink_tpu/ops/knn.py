@@ -198,6 +198,20 @@ def topk_by_distance(obj_id, dist, eligible, k: int,
     return _topk_full_sort(obj_id, dist, eligible, k)
 
 
+def _knn_point_parts(points, qx, qy, q_cell, radius, nb_layers, n,
+                     enforce_radius):
+    """-> (d, eligible, cell_eligible): ``cell_eligible`` is the pre-radius
+    candidate set — the slots whose distance was actually evaluated —
+    which the radius filter (tKnn semantics) then narrows into ``eligible``."""
+    layers = cheb_layers(points.cell, q_cell, n)
+    cell_eligible = points.valid & (layers <= nb_layers)
+    d = D.pp_dist(points.x, points.y, qx, qy)
+    eligible = cell_eligible
+    if enforce_radius:
+        eligible = eligible & (d <= radius)
+    return d, eligible, cell_eligible
+
+
 @partial(jax.jit, static_argnames=("n", "k", "enforce_radius", "strategy"))
 def knn_point(
     points: PointBatch,
@@ -218,12 +232,37 @@ def knn_point(
     pass ``n`` (the grid size) to disable cell pruning (radius 0 semantics:
     all cells are neighbors, ``UniformGrid.java:264-266``).
     """
-    layers = cheb_layers(points.cell, q_cell, n)
-    eligible = points.valid & (layers <= nb_layers)
-    d = D.pp_dist(points.x, points.y, qx, qy)
-    if enforce_radius:
-        eligible = eligible & (d <= radius)
+    d, eligible, _ = _knn_point_parts(points, qx, qy, q_cell, radius,
+                                      nb_layers, n, enforce_radius)
     return topk_by_distance(points.obj_id, d, eligible, k, strategy)
+
+
+@partial(jax.jit, static_argnames=("n", "k", "enforce_radius", "strategy"))
+def knn_point_stats(
+    points: PointBatch,
+    qx,
+    qy,
+    q_cell,
+    radius,
+    nb_layers,
+    *,
+    n: int,
+    k: int,
+    enforce_radius: bool = False,
+    strategy: str = "auto",
+):
+    """knn_point + the candidate count in the SAME dispatch — every candidate
+    costs one distance evaluation (kNN has no GN bypass,
+    ``knn/PointPointKNNQuery.java:152-183``), so the count feeds the
+    pruning-effectiveness counter (``spatialObjects/Point.java:220-235``)
+    without a second kernel launch re-deriving eligibility. The count is the
+    PRE-radius candidate set: with ``enforce_radius`` (tKnn semantics) the
+    radius filter narrows the result set but the distances were evaluated
+    for every cell-eligible slot regardless."""
+    d, eligible, cell_eligible = _knn_point_parts(
+        points, qx, qy, q_cell, radius, nb_layers, n, enforce_radius)
+    res = topk_by_distance(points.obj_id, d, eligible, k, strategy)
+    return res, jnp.sum(cell_eligible, dtype=jnp.int32)
 
 
 @partial(jax.jit, static_argnames=("k", "enforce_radius", "strategy"))
@@ -263,6 +302,15 @@ def knn_eligible(obj_id, dists, eligible, *, k: int,
     """Jitted dedup+top-k over caller-computed eligibility and distances —
     the generic entry for polygon/linestring streams and geometry queries."""
     return topk_by_distance(obj_id, dists, eligible, k, strategy)
+
+
+@partial(jax.jit, static_argnames=("k", "strategy"))
+def knn_eligible_stats(obj_id, dists, eligible, *, k: int,
+                       strategy: str = "auto"):
+    """knn_eligible + the candidate count in the same dispatch (the generic
+    streams' analogue of knn_point_stats — one kernel launch per window)."""
+    res = topk_by_distance(obj_id, dists, eligible, k, strategy)
+    return res, jnp.sum(eligible, dtype=jnp.int32)
 
 
 def point_stream_eligibility(cell, valid, nb_mask):

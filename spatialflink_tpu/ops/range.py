@@ -25,6 +25,21 @@ from spatialflink_tpu.models.batches import PointBatch
 from spatialflink_tpu.ops import distances as D
 
 
+def _range_point_parts(points, qx, qy, q_cell, radius, gn_layers, cn_layers,
+                       n, approximate):
+    layers = cheb_layers(points.cell, q_cell, n)
+    in_gn = layers <= gn_layers  # gn_layers == -1 -> all False
+    in_cn = (layers <= cn_layers) & ~in_gn
+    if approximate:
+        mask = points.valid & (in_gn | in_cn)
+        dists = jnp.full_like(points.x, jnp.inf)
+    else:
+        d = D.pp_dist(points.x, points.y, qx, qy)
+        mask = points.valid & (in_gn | (in_cn & (d <= radius)))
+        dists = jnp.where(in_cn, d, jnp.inf)
+    return mask, dists, in_gn, in_cn
+
+
 @partial(jax.jit, static_argnames=("n", "approximate"))
 def range_filter_point(
     points: PointBatch,
@@ -47,17 +62,49 @@ def range_filter_point(
     +inf where the GN bypass skipped it (parity with the reference, which
     never computes distances for guaranteed points).
     """
-    layers = cheb_layers(points.cell, q_cell, n)
-    in_gn = layers <= gn_layers  # gn_layers == -1 -> all False
-    in_cn = (layers <= cn_layers) & ~in_gn
+    mask, dists, _, _ = _range_point_parts(
+        points, qx, qy, q_cell, radius, gn_layers, cn_layers, n, approximate)
+    return mask, dists
+
+
+@partial(jax.jit, static_argnames=("n", "approximate"))
+def range_filter_point_stats(
+    points: PointBatch,
+    qx,
+    qy,
+    q_cell,
+    radius,
+    gn_layers,
+    cn_layers,
+    *,
+    n: int,
+    approximate: bool = False,
+):
+    """range_filter_point + pruning-effectiveness counts: returns
+    (mask, dists, gn_bypassed, dist_evals) where ``gn_bypassed`` counts valid
+    slots emitted without a distance evaluation and ``dist_evals`` counts
+    valid candidate slots whose result consulted a distance — the rebuild's
+    "Distance Computation Count" (``spatialObjects/Point.java:220-235``)."""
+    mask, dists, in_gn, in_cn = _range_point_parts(
+        points, qx, qy, q_cell, radius, gn_layers, cn_layers, n, approximate)
+    gn_bypassed = jnp.sum(points.valid & in_gn, dtype=jnp.int32)
+    if approximate:
+        dist_evals = jnp.int32(0)  # CN emitted without any distance check
+    else:
+        dist_evals = jnp.sum(points.valid & in_cn, dtype=jnp.int32)
+    return mask, dists, gn_bypassed, dist_evals
+
+
+def _range_masks_parts(points, gn_mask, cn_mask, dists, radius, approximate):
+    cell = jnp.maximum(points.cell, 0)  # guard the -1 pad; gated by cell_ok
+    cell_ok = points.cell >= 0
+    in_gn = gn_mask[cell] & cell_ok
+    in_cn = cn_mask[cell] & cell_ok & ~in_gn
     if approximate:
         mask = points.valid & (in_gn | in_cn)
-        dists = jnp.full_like(points.x, jnp.inf)
     else:
-        d = D.pp_dist(points.x, points.y, qx, qy)
-        mask = points.valid & (in_gn | (in_cn & (d <= radius)))
-        dists = jnp.where(in_cn, d, jnp.inf)
-    return mask, dists
+        mask = points.valid & (in_gn | (in_cn & (dists <= radius)))
+    return mask, in_gn, in_cn
 
 
 @partial(jax.jit, static_argnames=("approximate",))
@@ -77,13 +124,33 @@ def range_filter_masks(
     ``dists`` must hold the exact point->query distance per slot (only
     consulted for candidate cells).
     """
-    cell = jnp.maximum(points.cell, 0)  # guard the -1 pad; gated by cell_ok
-    cell_ok = points.cell >= 0
-    in_gn = gn_mask[cell] & cell_ok
-    in_cn = cn_mask[cell] & cell_ok & ~in_gn
+    mask, _, _ = _range_masks_parts(
+        points, gn_mask, cn_mask, dists, radius, approximate)
+    return mask
+
+
+@partial(jax.jit, static_argnames=("approximate",))
+def range_filter_masks_stats(
+    points: PointBatch,
+    gn_mask,
+    cn_mask,
+    dists,
+    radius,
+    *,
+    approximate: bool = False,
+):
+    """range_filter_masks + (gn_bypassed, dist_evals) counts. ``dist_evals``
+    counts valid candidate slots whose emission consulted ``dists`` (in the
+    operator's approximate mode that is the bbox distance — still a distance
+    evaluation, matching the reference's per-getDistance counter)."""
+    mask, in_gn, in_cn = _range_masks_parts(
+        points, gn_mask, cn_mask, dists, radius, approximate)
     if approximate:
-        return points.valid & (in_gn | in_cn)
-    return points.valid & (in_gn | (in_cn & (dists <= radius)))
+        dist_evals = jnp.int32(0)
+    else:
+        dist_evals = jnp.sum(points.valid & in_cn, dtype=jnp.int32)
+    gn_bypassed = jnp.sum(points.valid & in_gn, dtype=jnp.int32)
+    return mask, gn_bypassed, dist_evals
 
 
 @jax.jit
@@ -99,4 +166,20 @@ def range_filter_geom_stream(all_gn, any_nb, dists, radius, valid):
     all_gn / any_nb: (G,) cell predicates (see ops.geom.geom_cells_all_within
     / geom_cells_any_within).
     """
+    return _geom_stream_mask(all_gn, any_nb, dists, radius, valid)
+
+
+def _geom_stream_mask(all_gn, any_nb, dists, radius, valid):
     return valid & (all_gn | (any_nb & ~all_gn & (dists <= radius)))
+
+
+@jax.jit
+def range_filter_geom_stream_stats(all_gn, any_nb, dists, radius, valid):
+    """range_filter_geom_stream + (gn_bypassed, dist_evals) counts: geometries
+    passing on the all-GN rule never consult a distance; every other
+    neighboring-cell geometry does (bbox distance in approximate mode counts —
+    the reference increments its counter per getDistance call either way)."""
+    mask = _geom_stream_mask(all_gn, any_nb, dists, radius, valid)
+    gn_bypassed = jnp.sum(valid & all_gn, dtype=jnp.int32)
+    dist_evals = jnp.sum(valid & any_nb & ~all_gn, dtype=jnp.int32)
+    return mask, gn_bypassed, dist_evals

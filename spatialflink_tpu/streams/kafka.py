@@ -272,18 +272,174 @@ class KafkaLatencySink:
         pass
 
 
-def connect_kafka(bootstrap_servers: str):
-    """Real-broker adapter, gated on a client library (not in this image).
+class RealKafkaBroker:
+    """kafka-python-backed implementation of the broker surface
+    (produce/fetch/commit/committed/end_offset) consumed by
+    :class:`KafkaSource`/:class:`KafkaSink` — the adapter that swaps a real
+    cluster in for :class:`InMemoryBroker` without touching the pipelines
+    (reference consumers at ``StreamingJob.java:473``, producer at ``:512``).
 
-    Returns an object with the same produce/poll/commit surface as
-    :class:`InMemoryBroker`, backed by kafka-python.
+    Topic-as-one-log mapping: the shim models a topic as a single ordered
+    log, so the adapter pins every topic to **partition 0** (the reference's
+    driver likewise treats each topic as one stream; scale-out happens in the
+    operator mesh, not the partition count). Offsets commit through the
+    consumer-group API, so a restarted group resumes where
+    :class:`KafkaSource` committed — the same at-least-once contract the shim
+    provides, with :class:`IdempotentWindowSink` upgrading it to effective
+    exactly-once downstream.
     """
-    try:
-        import kafka  # type: ignore  # noqa: F401
-    except ImportError as e:
-        raise RuntimeError(
-            "connect_kafka requires the kafka-python package, which is not "
-            "installed in this environment; use InMemoryBroker for local "
-            "pipelines and tests.") from e
-    raise NotImplementedError(
-        "real-broker adapter requires a reachable Kafka cluster")
+
+    def __init__(self, kafka_module, bootstrap_servers: str, *,
+                 produce_timeout_s: float = 30.0, poll_timeout_ms: int = 500,
+                 fetch_retries: int = 20):
+        self._kafka = kafka_module
+        self.bootstrap = bootstrap_servers
+        self.produce_timeout_s = produce_timeout_s
+        self.poll_timeout_ms = poll_timeout_ms
+        self.fetch_retries = fetch_retries
+        self._producer = None
+        self._fetch_c = None                      # group-less, for fetch/end
+        self._group_c: Dict[str, Any] = {}        # group id -> consumer
+        self._commit_hwm: Dict[Tuple[str, str], int] = {}  # (topic, group)
+
+    # ------------------------------ helpers -------------------------- #
+
+    @staticmethod
+    def _to_bytes(v) -> Optional[bytes]:
+        if v is None:
+            return None
+        if isinstance(v, bytes):
+            return v
+        return str(v).encode("utf-8")
+
+    @staticmethod
+    def _to_str(v):
+        return v.decode("utf-8", errors="replace") if isinstance(v, bytes) else v
+
+    def _tp(self, topic: str):
+        return self._kafka.TopicPartition(topic, 0)
+
+    def _oam(self, offset: int):
+        cls = getattr(self._kafka, "OffsetAndMetadata", None)
+        if cls is None:
+            cls = self._kafka.structs.OffsetAndMetadata
+        try:
+            return cls(offset, "")
+        except TypeError:  # newer kafka-python adds leader_epoch
+            return cls(offset, "", -1)
+
+    def _get_producer(self):
+        if self._producer is None:
+            self._producer = self._kafka.KafkaProducer(
+                bootstrap_servers=self.bootstrap)
+        return self._producer
+
+    def _fetch_consumer(self):
+        if self._fetch_c is None:
+            self._fetch_c = self._kafka.KafkaConsumer(
+                bootstrap_servers=self.bootstrap, enable_auto_commit=False)
+        return self._fetch_c
+
+    def _group_consumer(self, group: str):
+        if group not in self._group_c:
+            self._group_c[group] = self._kafka.KafkaConsumer(
+                bootstrap_servers=self.bootstrap, group_id=group,
+                enable_auto_commit=False)
+        return self._group_c[group]
+
+    # ------------------------------ broker surface ------------------- #
+
+    def produce(self, topic: str, value, key: Optional[str] = None,
+                timestamp_ms: Optional[int] = None) -> int:
+        # partition=0 pins the producer to the same partition the consumer
+        # side reads — without it a multi-partition topic would scatter
+        # records where fetch()/end_offset() never look
+        fut = self._get_producer().send(
+            topic, value=self._to_bytes(value), key=self._to_bytes(key),
+            partition=0, timestamp_ms=timestamp_ms)
+        # blocking .get() = acknowledged write, the adapter's at-least-once
+        # half (re-raise on broker error instead of dropping silently)
+        return fut.get(timeout=self.produce_timeout_s).offset
+
+    def fetch(self, topic: str, offset: int, max_records: int = 500
+              ) -> List[BrokerRecord]:
+        """An empty return means END OF TOPIC (``offset >= end_offset``),
+        matching the shim contract KafkaSource relies on for ``stop_at_end``.
+        A real consumer's poll() legitimately returns nothing while fetch
+        sessions warm up or the broker hiccups, so empty polls are retried
+        (up to ``fetch_retries``) as long as records exist past ``offset`` —
+        otherwise a cold first poll would masquerade as stream end and the
+        source would silently drop the topic's tail."""
+        c = self._fetch_consumer()
+        tp = self._tp(topic)
+        c.assign([tp])
+        c.seek(tp, offset)
+        out: List[BrokerRecord] = []
+        for _ in range(max(1, self.fetch_retries)):
+            polled = c.poll(timeout_ms=self.poll_timeout_ms,
+                            max_records=max_records)
+            for recs in polled.values():
+                for r in recs:
+                    out.append(BrokerRecord(
+                        offset=r.offset, key=self._to_str(r.key),
+                        value=self._to_str(r.value),
+                        timestamp_ms=getattr(r, "timestamp", 0) or 0))
+            if out or offset >= self.end_offset(topic):
+                return out
+        raise TimeoutError(
+            f"kafka fetch: {topic}@{offset} < end_offset but "
+            f"{self.fetch_retries} polls returned no records")
+
+    def commit(self, topic: str, group: str, next_offset: int) -> None:
+        # monotonic like the shim: a slow replica must not rewind the group.
+        # The high-water mark is cached locally (seeded from the broker on
+        # first touch) — this adapter owns its group consumers, so one
+        # committed() RPC per (topic, group) suffices instead of one per
+        # commit on the hot path
+        if next_offset <= self.committed(topic, group):
+            return
+        self._group_consumer(group).commit(
+            {self._tp(topic): self._oam(next_offset)})
+        self._commit_hwm[(topic, group)] = next_offset
+
+    def committed(self, topic: str, group: str) -> int:
+        hwm = self._commit_hwm.get((topic, group))
+        if hwm is not None:
+            return hwm
+        off = self._group_consumer(group).committed(self._tp(topic))
+        hwm = 0 if off is None else int(getattr(off, "offset", off))
+        self._commit_hwm[(topic, group)] = hwm
+        return hwm
+
+    def end_offset(self, topic: str) -> int:
+        c = self._fetch_consumer()
+        tp = self._tp(topic)
+        return int(c.end_offsets([tp])[tp])
+
+    def close(self) -> None:
+        if self._producer is not None:
+            self._producer.flush()
+            self._producer.close()
+        for c in ([self._fetch_c] if self._fetch_c else []) + list(
+                self._group_c.values()):
+            c.close()
+
+
+def connect_kafka(bootstrap_servers: str, kafka_module=None) -> RealKafkaBroker:
+    """Real-broker adapter against the kafka-python client API.
+
+    ``kafka_module`` is the injection seam: tests pass a fake implementing
+    the same surface (KafkaProducer/KafkaConsumer/TopicPartition/
+    OffsetAndMetadata); production leaves it None to import kafka-python,
+    raising RuntimeError when the package is absent (it is not installed in
+    this image — use :class:`InMemoryBroker` for local pipelines).
+    """
+    if kafka_module is None:
+        try:
+            import kafka as kafka_module  # type: ignore
+        except ImportError as e:
+            raise RuntimeError(
+                "connect_kafka requires the kafka-python package, which is "
+                "not installed in this environment; use InMemoryBroker for "
+                "local pipelines and tests.") from e
+    return RealKafkaBroker(kafka_module, bootstrap_servers)

@@ -202,6 +202,25 @@ def test_deser_roundtrip_options():
     assert all("POINT" in ser for _, ser in out)
 
 
+def test_deser_geometrycollection_options():
+    """Driver cases 504/604/804/904: WKT GeometryCollection round-trips
+    (plain + trajectory, comma + TAB), Deserialization.java:836,854."""
+    gc_wkt = ("GEOMETRYCOLLECTION (POINT (116.5 40.5), "
+              "LINESTRING (116.0 40.0, 116.1 40.1))")
+    for option, line in [
+        (504, gc_wkt),
+        (604, gc_wkt),
+        (804, f"t9, 1700000000000, {gc_wkt}"),
+        (904, f"t9\t1700000000000\t{gc_wkt}"),
+    ]:
+        (obj, ser), = run_option(_params(option), [line])
+        assert type(obj).__name__ == "GeometryCollection", option
+        assert len(obj.geometries) == 2, option
+        assert ser.startswith("GEOMETRYCOLLECTION ("), option
+        if option in (804, 904):
+            assert obj.obj_id == "t9" and obj.timestamp == 1700000000000
+
+
 def test_tsv_wkt_deser_uses_tab():
     """Options 601-605/901-905 are the TAB-separated WKT families: prefix
     fields must split on TAB regardless of the configured delimiter."""
@@ -224,8 +243,13 @@ def test_count_window_type_raises_like_reference():
 
 
 def test_synthetic_harness_option99():
+    """One smoke run exercises every trajectory family, like the reference
+    harness sketch (StreamingJob.java:1571-1618)."""
     out = list(run_option(_params(99), []))
     assert out
+    fams = {r.extras.get("family") for r in out if hasattr(r, "extras")}
+    assert fams == {"tfilter", "trange", "tstats", "taggregate",
+                    "tjoin", "tknn"}
 
 
 def test_unknown_option():

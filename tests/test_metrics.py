@@ -116,6 +116,68 @@ class TestOperatorMetrics:
         assert REGISTRY.counter("records-evaluated").count > before
 
 
+class TestPruningCounters:
+    """Distance-computation / GN-bypass counters (pruning effectiveness,
+    ``spatialObjects/Point.java:220-235``)."""
+
+    def _grid_pts(self):
+        from spatialflink_tpu.index import UniformGrid
+        from spatialflink_tpu.models import Point
+
+        grid = UniformGrid(0.0, 10.0, 0.0, 10.0, num_grid_partitions=10)
+        pts = [Point.create(5.0 + 0.01 * i, 5.0, grid, obj_id=f"o{i}",
+                            timestamp=1_700_000_000_000 + i)
+               for i in range(16)]
+        return grid, pts
+
+    def test_gn_window_reports_zero_distance_evals(self):
+        # radius big enough that every cell is a guaranteed neighbor of the
+        # query's cell: all points ride the GN bypass, no distances consulted
+        from spatialflink_tpu.models import Point
+        from spatialflink_tpu.operators import (
+            PointPointRangeQuery, QueryConfiguration, QueryType)
+
+        grid, pts = self._grid_pts()
+        q = Point.create(5.0, 5.0, grid)
+        radius = 50.0  # guaranteed_layers covers the whole 10x10 grid
+        assert grid.guaranteed_layers(radius) >= grid.n
+        d0 = REGISTRY.counter("distance-computations").count
+        g0 = REGISTRY.counter("gn-bypassed").count
+        conf = QueryConfiguration(QueryType.WindowBased, 10_000, 10_000)
+        out = list(PointPointRangeQuery(conf, grid).run(iter(pts), q, radius))
+        assert sum(len(w.records) for w in out) == len(pts)
+        assert REGISTRY.counter("distance-computations").count == d0
+        assert REGISTRY.counter("gn-bypassed").count - g0 == len(pts)
+
+    def test_cn_window_counts_distance_evals(self):
+        from spatialflink_tpu.models import Point
+        from spatialflink_tpu.operators import (
+            PointPointRangeQuery, QueryConfiguration, QueryType)
+
+        grid, pts = self._grid_pts()
+        q = Point.create(5.0, 5.0, grid)
+        radius = 0.5  # no guaranteed layer at this radius/grid (gn = -1)
+        assert grid.guaranteed_layers(radius) < 0
+        d0 = REGISTRY.counter("distance-computations").count
+        conf = QueryConfiguration(QueryType.WindowBased, 10_000, 10_000)
+        list(PointPointRangeQuery(conf, grid).run(iter(pts), q, radius))
+        assert REGISTRY.counter("distance-computations").count - d0 == len(pts)
+
+    def test_knn_counts_eligible_distance_evals(self):
+        from spatialflink_tpu.models import Point
+        from spatialflink_tpu.operators import (
+            PointPointKNNQuery, QueryConfiguration, QueryType)
+
+        grid, pts = self._grid_pts()
+        q = Point.create(5.0, 5.0, grid)
+        d0 = REGISTRY.counter("distance-computations").count
+        conf = QueryConfiguration(QueryType.WindowBased, 10_000, 10_000, k=4)
+        out = list(PointPointKNNQuery(conf, grid).run(iter(pts), q, 0.0))
+        assert out  # sanity: windows emitted
+        # radius 0 disables pruning -> every valid point is a candidate
+        assert REGISTRY.counter("distance-computations").count - d0 == len(pts)
+
+
 def test_trace_is_safe_noop_without_profiler():
     with trace("stage-x"):
         pass

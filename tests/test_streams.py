@@ -106,6 +106,112 @@ class TestWKT:
         assert isinstance(poly, Polygon)
         assert len(poly.rings) == 2
 
+    def test_geometrycollection_roundtrip(self):
+        # Deserialization.java:836 (parse) / Serialization.java:682-774
+        wkt = ("GEOMETRYCOLLECTION (POINT (116.5 40.5), "
+               "LINESTRING (116.0 40.0, 116.1 40.1), "
+               "POLYGON ((116.0 40.0, 116.1 40.0, 116.1 40.1, 116.0 40.0)))")
+        gc = parse_spatial(wkt, "WKT", GRID)
+        assert isinstance(gc, GeometryCollection)
+        assert len(gc.geometries) == 3
+        assert isinstance(gc.geometries[0], Point)
+        assert gc.geometries[0].x == pytest.approx(116.5)
+        assert isinstance(gc.geometries[1], LineString)
+        assert isinstance(gc.geometries[2], Polygon)
+        back = parse_spatial(serialize_spatial(gc, "WKT"), "WKT", GRID)
+        assert isinstance(back, GeometryCollection)
+        assert len(back.geometries) == 3
+        assert back.geometries[0].x == pytest.approx(116.5)
+
+    def test_geometrycollection_trajectory_fields(self):
+        # trajectory variant (Deserialization.java:854): oID/time prefix fields
+        gc = parse_spatial(
+            "7, 1700000000123, GEOMETRYCOLLECTION (POINT (116.5 40.5))",
+            "WKT", GRID)
+        assert isinstance(gc, GeometryCollection)
+        assert gc.obj_id == "7" and gc.timestamp == 1700000000123
+        assert gc.geometries[0].obj_id == "7"
+
+    def test_unknown_outer_keyword_raises(self):
+        # round-3 silent-corruption repro: a misspelled collection keyword
+        # must NOT parse its embedded POINT as a record
+        with pytest.raises(ValueError):
+            parse_spatial("GEOMETRYCOLECTION (POINT (116.5 40.5))", "WKT", GRID)
+
+    def test_nested_geometrycollection(self):
+        gc = parse_spatial(
+            "GEOMETRYCOLLECTION (GEOMETRYCOLLECTION (POINT (1 2)), POINT (3 4))",
+            "WKT")
+        assert isinstance(gc, GeometryCollection)
+        assert isinstance(gc.geometries[0], GeometryCollection)
+        assert gc.geometries[1].x == pytest.approx(3)
+
+
+class TestCoordinateStrings:
+    """CSV/TSV coordinate-string geometry rows (Deserialization.java:1367-1565,
+    CSVTSVToSpatialPolygon :487-516) and bracket-style CLI coordinate strings
+    (HelperClass.java:145-221)."""
+
+    def test_csv_polygon_no_keyword(self):
+        line = "((116.0 40.0, 116.1 40.0, 116.1 40.1, 116.0 40.0))"
+        poly = parse_spatial(line, "CSV", GRID, geometry="Polygon")
+        assert isinstance(poly, Polygon)
+        assert len(poly.rings) == 1 and len(poly.rings[0]) >= 3
+
+    def test_csv_polygon_with_hole_and_prefix_fields(self):
+        line = ("p1, 1700000000000, ((0 0, 4 0, 4 4, 0 4, 0 0), "
+                "(1 1, 2 1, 2 2, 1 2, 1 1))")
+        poly = parse_spatial(line, "CSV", geometry="Polygon")
+        assert isinstance(poly, Polygon)
+        assert poly.obj_id == "p1" and poly.timestamp == 1700000000000
+        assert len(poly.rings) == 2
+
+    def test_csv_multipolygon_keyword_sniff(self):
+        # keyword present overrides like str.contains("MULTIPOLYGON")
+        line = 'MULTIPOLYGON (((-74.15 40.62, -74.16 40.62, -74.15 40.63, -74.15 40.62)))'
+        mp = parse_spatial(line, "CSV", geometry="Polygon")
+        assert isinstance(mp, MultiPolygon)
+        # keyword-less triple nesting promotes to multi too
+        mp2 = parse_spatial("(((1 1, 2 1, 2 2, 1 1)), ((5 5, 6 5, 6 6, 5 5)))",
+                            "CSV", geometry="Polygon")
+        assert isinstance(mp2, MultiPolygon) and len(mp2.polygons) == 2
+
+    def test_csv_linestring_rows(self):
+        ls = parse_spatial("(116.0 40.0, 116.2 40.2)", "CSV", GRID,
+                           geometry="LineString")
+        assert isinstance(ls, LineString) and len(ls.coords_list) == 2
+        ml = parse_spatial("((1 1, 2 2), (3 3, 4 4))", "CSV",
+                           geometry="LineString")
+        assert isinstance(ml, MultiLineString) and len(ml.lines) == 2
+
+    def test_tsv_polygon_row(self):
+        line = "p7\t1700000000000\t((116.0 40.0, 116.1 40.0, 116.1 40.1, 116.0 40.0))"
+        poly = parse_spatial(line, "TSV", GRID, geometry="Polygon")
+        assert isinstance(poly, Polygon) and poly.obj_id == "p7"
+
+    def test_bracket_coords(self):
+        from spatialflink_tpu.streams.formats import parse_bracket_coords
+        pts = parse_bracket_coords("[100.0, 0.0], [103.0, 0.0], [103.0, 1.0]")
+        assert pts == [(100.0, 0.0), (103.0, 0.0), (103.0, 1.0)]
+        assert parse_bracket_coords(None) == []
+        # malformed pairs skipped like the reference's swallowed exceptions
+        assert parse_bracket_coords("[1.0, 2.0], [oops], [3.0, 4.0]") == \
+            [(1.0, 2.0), (3.0, 4.0)]
+
+    def test_bracket_rings(self):
+        from spatialflink_tpu.streams.formats import parse_bracket_rings
+        rings = parse_bracket_rings(
+            "[[116.5, 40.5], [117.6, 40.5], [117.6, 41.4]], "
+            "[[117.5, 40.5], [118.6, 40.5], [118.6, 41.4]]")
+        assert len(rings) == 2 and rings[0][0] == (116.5, 40.5)
+
+    def test_bracket_polygons(self):
+        from spatialflink_tpu.streams.formats import parse_bracket_polygons
+        polys = parse_bracket_polygons(
+            "[[[116.5, 40.5], [117.6, 40.5], [117.6, 41.4]]] , "
+            "[[[117.5, 40.5], [118.6, 40.5], [118.6, 41.4]]]")
+        assert len(polys) == 2 and polys[1][0][0] == (117.5, 40.5)
+
 
 class TestCSV:
     def test_schema_indices(self):

@@ -49,6 +49,29 @@ def _normalize_delimiter(v: str) -> str:
     return v
 
 
+def _coord_pairs(v) -> List[Tuple[float, float]]:
+    """queryPoints: YAML list of [x, y] pairs, or the reference's CLI
+    bracket-string form '"[116.5, 40.5], [117.0, 40.7]"'
+    (``HelperClass.getCoordinates``, :145-161)."""
+    if isinstance(v, str):
+        from spatialflink_tpu.streams.formats import parse_bracket_coords
+
+        return parse_bracket_coords(v)
+    return [tuple(map(float, p)) for p in v]
+
+
+def _coord_lists(v) -> List[List[Tuple[float, float]]]:
+    """queryPolygons/queryLineStrings: YAML nested lists, or the CLI
+    bracket-string form '"[[x, y], ...], [[x, y], ...]"'
+    (``HelperClass.getListCoordinates``, :163-179) — each group is one
+    polygon ring / linestring."""
+    if isinstance(v, str):
+        from spatialflink_tpu.streams.formats import parse_bracket_rings
+
+        return parse_bracket_rings(v)
+    return [[tuple(map(float, c)) for c in grp] for grp in v]
+
+
 @dataclass
 class StreamConfig:
     """One ``inputStream{1,2}`` block (``utils/ConfigType.java:20-40``)."""
@@ -179,12 +202,9 @@ class QueryConfig:
             k=int(_opt(d, "k", 10)),
             omega_duration_s=int(_opt(d, "omegaDuration", 10)),
             traj_ids=[str(t) for t in _opt(d, "trajIDs", [])],
-            query_points=[tuple(map(float, p))
-                          for p in _opt(d, "queryPoints", [])],
-            query_polygons=[[tuple(map(float, c)) for c in poly]
-                            for poly in _opt(d, "queryPolygons", [])],
-            query_linestrings=[[tuple(map(float, c)) for c in ls]
-                               for ls in _opt(d, "queryLineStrings", [])],
+            query_points=_coord_pairs(_opt(d, "queryPoints", [])),
+            query_polygons=_coord_lists(_opt(d, "queryPolygons", [])),
+            query_linestrings=_coord_lists(_opt(d, "queryLineStrings", [])),
             traj_deletion_threshold_s=int(_opt(th, "trajDeletion", 0)),
             allowed_lateness_s=int(_opt(th, "outOfOrderTuples", 0)),
         )

@@ -156,8 +156,29 @@ class SpatialOperator:
     def _geom_batch(self, records: List, ts_base: int):
         from spatialflink_tpu.models.batches import EdgeGeomBatch
 
+        pad = None
+        if self.distributed:
+            # shard-ready capacity: the geometry dim must divide across the
+            # mesh (point batches already bucket at >= 256)
+            from spatialflink_tpu.utils.padding import bucket_size
+
+            pad = bucket_size(len(records), max(8, self.conf.devices))
         return EdgeGeomBatch.from_objects(records, self.grid, self.interner,
-                                          ts_base=ts_base)
+                                          ts_base=ts_base, pad=pad)
+
+    def _filter_stream(self, batch, mask_stats_fn):
+        """(mask, gn_bypassed, dist_evals) for a stream batch: the
+        single-device path calls ``mask_stats_fn(batch)`` directly; with
+        ``conf.devices`` the batch is sharded and the SAME closure runs per
+        shard with psum-merged stats (parallel.ops.distributed_stream_filter)
+        — the mesh dispatch every reference pipeline gets from
+        ``env.setParallelism(30)`` (``StreamingJob.java:221``)."""
+        if self.distributed:
+            from spatialflink_tpu.parallel.ops import distributed_stream_filter
+
+            return distributed_stream_filter(
+                self._mesh(), self._shard(batch), mask_stats_fn)
+        return mask_stats_fn(batch)
 
     @staticmethod
     def _record_pruning_stats(gn_bypassed, dist_evals) -> None:

@@ -308,7 +308,18 @@ class _GenericStreamJoin(PointPointJoinQuery):
             return WindowResult(start, end, [])
         batch_a = self._batch_a(recs_a, start)
         batch_b = self._batch_b(recs_b, start)
-        m_dev = self._lattice(batch_a, batch_b, radius)
+        if self.distributed:
+            # broadcast-join layout for the geometry pairs too: a sharded on
+            # the mesh, query side replicated, same lattice kernel per shard
+            from spatialflink_tpu.parallel.ops import (
+                distributed_stream_join_lattice,
+            )
+
+            m_dev = distributed_stream_join_lattice(
+                self._mesh(), self._shard(batch_a), batch_b,
+                lambda a_s, b_r: self._lattice(a_s, b_r, radius))
+        else:
+            m_dev = self._lattice(batch_a, batch_b, radius)
 
         def collect(m):
             ai, bi = np.nonzero(np.asarray(m))

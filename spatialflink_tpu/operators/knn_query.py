@@ -48,25 +48,30 @@ class PointPointKNNQuery(SpatialOperator):
         return self._defer_knn(res, dist_evals=dist_evals)
 
     def _knn_result(self, batch, query_point: Point, radius: float, k: int):
-        """(KnnResult, dist_evals) over one window batch — the count rides the
-        same dispatch (ops.knn.knn_point_stats) and feeds the pruning counter;
-        it is None when sharded (per-shard counts would need an extra
-        collective). With ``conf.devices`` the point dim is sharded and
-        per-device dedup+top-k partials are all-gathered and re-merged
-        (parallel.ops.distributed_knn) — the two-stage merge of SURVEY §2.5
-        without the reference's parallelism-1 windowAll stage."""
+        """(KnnResult, dist_evals) over one window batch — the count rides
+        the same dispatch (ops.knn.knn_point_stats single-device; a psum on
+        the mesh) and feeds the pruning counter. With ``conf.devices`` the
+        point dim is sharded and per-device dedup+top-k partials are
+        all-gathered and re-merged (parallel.ops.distributed_stream_knn) —
+        the two-stage merge of SURVEY §2.5 without the reference's
+        parallelism-1 windowAll stage."""
         nb_layers = (
             self.grid.n if radius == 0 else self.grid.candidate_layers(radius)
         )
         if self.distributed:
-            from spatialflink_tpu.parallel.ops import distributed_knn
+            from spatialflink_tpu.parallel.ops import distributed_stream_knn
 
-            return distributed_knn(
-                self._mesh(), self._shard(batch),
-                query_point.x, query_point.y, jnp.int32(query_point.cell),
-                radius, nb_layers, n=self.grid.n, k=k,
-                strategy=self._knn_strategy(),
-            ), None
+            def local(b):
+                # the SAME module-jitted kernel as the single-device branch,
+                # per shard — identical fusion, bit-for-bit 8-dev ≡ 1-dev
+                return knn_point_stats(
+                    b, query_point.x, query_point.y,
+                    jnp.int32(query_point.cell), radius, nb_layers,
+                    n=self.grid.n, k=k, strategy=self._knn_strategy())
+
+            return distributed_stream_knn(
+                self._mesh(), self._shard(batch), k=k,
+                strategy=self._knn_strategy(), local_fn=local)
         return knn_point_stats(
             batch,
             query_point.x,
@@ -99,12 +104,17 @@ class PointPointKNNQuery(SpatialOperator):
 
 
 class _GenericKnn(SpatialOperator, GeomQueryMixin):
-    """Shared kNN driver: subclasses provide (eligible, dists) per batch.
+    """Shared kNN driver: subclasses provide the batch builder and the
+    per-batch (eligible, dists) closure.
 
     Reference semantics for every pair (e.g.
     ``knn/PointPolygonKNNQuery.java:100-183``): radius prunes cells only;
     approximate mode substitutes bbox distance; global merge dedups objID
-    keeping min distance (here: one dedup+top-k kernel).
+    keeping min distance (here: one dedup+top-k kernel). With
+    ``conf.devices`` the stream batch is sharded and per-shard partials are
+    all-gathered + re-merged (parallel.ops.distributed_stream_knn) — the same
+    closure computes eligibility/distances per shard, so the two paths cannot
+    fork semantically.
     """
 
     def run(self, stream, query, radius: float, k: Optional[int] = None
@@ -112,15 +122,26 @@ class _GenericKnn(SpatialOperator, GeomQueryMixin):
         k = k or self.conf.k
         setup = self._setup(query, radius)
 
+        def elig_dists(batch):
+            return self._elig_dists(batch, setup)
+
         def eval_batch(records, ts_base):
             if not records:
                 return []
-            from spatialflink_tpu.ops.knn import knn_eligible_stats
+            batch = self._batch(records, ts_base)
+            if self.distributed:
+                from spatialflink_tpu.parallel.ops import distributed_stream_knn
 
-            batch, eligible, dists = self._eligibility(records, ts_base, setup)
-            res, dist_evals = knn_eligible_stats(
-                batch.obj_id, dists, eligible, k=k,
-                strategy=self._knn_strategy())
+                res, dist_evals = distributed_stream_knn(
+                    self._mesh(), self._shard(batch), elig_dists, k=k,
+                    strategy=self._knn_strategy())
+            else:
+                from spatialflink_tpu.ops.knn import knn_eligible_stats
+
+                eligible, dists = elig_dists(batch)
+                res, dist_evals = knn_eligible_stats(
+                    batch.obj_id, dists, eligible, k=k,
+                    strategy=self._knn_strategy())
             return self._defer_knn(res, dist_evals=dist_evals)
 
         for result in self._drive(stream, eval_batch):
@@ -136,12 +157,14 @@ class PointGeomKNNQuery(_GenericKnn):
         return dict(nb=self._query_nb(query, radius),
                     edges=self._query_edges(query), bbox=self._query_bbox(query))
 
-    def _eligibility(self, records, ts_base, setup):
+    def _batch(self, records, ts_base):
+        return self._point_batch(records, ts_base)
+
+    def _elig_dists(self, batch, setup):
         from spatialflink_tpu.ops.distances import point_bbox_dist
         from spatialflink_tpu.ops.geom import points_to_single_geom_dist
         from spatialflink_tpu.ops.knn import point_stream_eligibility
 
-        batch = self._point_batch(records, ts_base)
         eligible = point_stream_eligibility(batch.cell, batch.valid, setup["nb"])
         q_edges, q_mask, q_areal = setup["edges"]
         if self.conf.approximate:
@@ -149,7 +172,7 @@ class PointGeomKNNQuery(_GenericKnn):
             dists = point_bbox_dist(batch.x, batch.y, b[0], b[1], b[2], b[3])
         else:
             dists = points_to_single_geom_dist(batch, q_edges, q_mask, q_areal)
-        return batch, eligible, dists
+        return eligible, dists
 
 
 class GeomPointKNNQuery(_GenericKnn):
@@ -159,12 +182,14 @@ class GeomPointKNNQuery(_GenericKnn):
     def _setup(self, query, radius):
         return dict(nb=self._query_nb(query, radius), query=query)
 
-    def _eligibility(self, records, ts_base, setup):
+    def _batch(self, records, ts_base):
+        return self._geom_batch(records, ts_base)
+
+    def _elig_dists(self, geoms, setup):
         from spatialflink_tpu.ops.distances import point_bbox_dist
         from spatialflink_tpu.ops.geom import geom_cells_any_within, point_to_geoms_dist
 
         q = setup["query"]
-        geoms = self._geom_batch(records, ts_base)
         eligible = geoms.valid & geom_cells_any_within(geoms.cells, geoms.cells_mask,
                                                        setup["nb"])
         if self.conf.approximate:
@@ -172,7 +197,7 @@ class GeomPointKNNQuery(_GenericKnn):
                                     geoms.bbox[:, 2], geoms.bbox[:, 3])
         else:
             dists = point_to_geoms_dist(q.x, q.y, geoms)
-        return geoms, eligible, dists
+        return eligible, dists
 
 
 class GeomGeomKNNQuery(_GenericKnn):
@@ -183,14 +208,16 @@ class GeomGeomKNNQuery(_GenericKnn):
         return dict(nb=self._query_nb(query, radius),
                     edges=self._query_edges(query), bbox=self._query_bbox(query))
 
-    def _eligibility(self, records, ts_base, setup):
+    def _batch(self, records, ts_base):
+        return self._geom_batch(records, ts_base)
+
+    def _elig_dists(self, geoms, setup):
         from spatialflink_tpu.ops.geom import geoms_bbox_dist
         from spatialflink_tpu.ops.geom import (
             geom_cells_any_within,
             geoms_to_single_geom_dist,
         )
 
-        geoms = self._geom_batch(records, ts_base)
         eligible = geoms.valid & geom_cells_any_within(geoms.cells, geoms.cells_mask,
                                                        setup["nb"])
         q_edges, q_mask, q_areal = setup["edges"]
@@ -198,7 +225,7 @@ class GeomGeomKNNQuery(_GenericKnn):
             dists = geoms_bbox_dist(geoms, setup["bbox"])
         else:
             dists = geoms_to_single_geom_dist(geoms, q_edges, q_mask, q_areal)
-        return geoms, eligible, dists
+        return eligible, dists
 
 
 # Reference-named aliases

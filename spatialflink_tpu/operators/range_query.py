@@ -44,29 +44,25 @@ class PointPointRangeQuery(SpatialOperator):
         return self._defer_mask_select(mask, records, stats)
 
     def _range_mask(self, batch, query_point: Point, radius: float):
-        """(mask, stats) for one window batch; ``stats`` is the
-        (gn_bypassed, dist_evals) device-scalar pair feeding the pruning
-        counters, or None on the distributed path (per-shard stats would need
-        an extra collective; the single-device kernel covers the metric).
-        With ``conf.devices`` the batch point dim is sharded over the mesh and
-        each device filters its shard (parallel.ops.distributed_range_count) —
+        """(mask, (gn_bypassed, dist_evals)) for one window batch — the
+        pruning-counter scalars are psum-merged on the distributed path like
+        every other operator family. With ``conf.devices`` the batch point
+        dim is sharded over the mesh and each device filters its shard via
+        the SAME stats kernel (parallel.ops.distributed_stream_filter) —
         results are identical to the single-device kernel."""
         args = (
             query_point.x, query_point.y, jnp.int32(query_point.cell), radius,
             self.grid.guaranteed_layers(radius),
             self.grid.candidate_layers(radius),
         )
-        if self.distributed:
-            from spatialflink_tpu.parallel.ops import distributed_range_count
 
-            _count, mask = distributed_range_count(
-                self._mesh(), self._shard(batch), *args,
-                n=self.grid.n, approximate=self.conf.approximate,
+        def mask_stats(b):
+            mask, _, gn_c, evals = range_filter_point_stats(
+                b, *args, n=self.grid.n, approximate=self.conf.approximate,
             )
-            return mask, None
-        mask, _, gn_bypassed, dist_evals = range_filter_point_stats(
-            batch, *args, n=self.grid.n, approximate=self.conf.approximate,
-        )
+            return mask, gn_c, evals
+
+        mask, gn_bypassed, dist_evals = self._filter_stream(batch, mask_stats)
         return mask, (gn_bypassed, dist_evals)
 
     # ---------------------------------------------------------------- #
@@ -120,26 +116,37 @@ class PointGeomRangeQuery(SpatialOperator, GeomQueryMixin):
     Approximate mode filters on the bbox distance instead of the exact
     geometry distance (the reference's approximateQuery flag)."""
 
-    def run(self, stream: Iterable[Point], query_geom, radius: float
-            ) -> Iterator[WindowResult]:
+    def _mask_stats_fn(self, query_geom, radius: float):
+        """Per-batch (mask, gn_bypassed, dist_evals) closure over the
+        precomputed query-side arrays — the single source for both the
+        single-device and mesh paths (and the bench harness)."""
         gn, cn, _nb = self._query_masks(query_geom, radius)
         q_edges, q_mask, q_areal = self._query_edges(query_geom)
         q_bbox = self._query_bbox(query_geom)
 
-        def eval_batch(records, ts_base):
-            if not records:
-                return []
+        def mask_stats(batch):
             from spatialflink_tpu.ops.distances import point_bbox_dist
             from spatialflink_tpu.ops.geom import points_to_single_geom_dist
             from spatialflink_tpu.ops.range import range_filter_masks_stats
 
-            batch = self._point_batch(records, ts_base)
             if self.conf.approximate:
                 dists = point_bbox_dist(batch.x, batch.y,
                                         q_bbox[0], q_bbox[1], q_bbox[2], q_bbox[3])
             else:
                 dists = points_to_single_geom_dist(batch, q_edges, q_mask, q_areal)
-            mask, gn_c, evals = range_filter_masks_stats(batch, gn, cn, dists, radius)
+            return range_filter_masks_stats(batch, gn, cn, dists, radius)
+
+        return mask_stats
+
+    def run(self, stream: Iterable[Point], query_geom, radius: float
+            ) -> Iterator[WindowResult]:
+        mask_stats = self._mask_stats_fn(query_geom, radius)
+
+        def eval_batch(records, ts_base):
+            if not records:
+                return []
+            batch = self._point_batch(records, ts_base)
+            mask, gn_c, evals = self._filter_stream(batch, mask_stats)
             return self._defer_mask_select(mask, records, (gn_c, evals))
 
         return self._drive(stream, eval_batch)
@@ -151,13 +158,10 @@ class GeomPointRangeQuery(SpatialOperator, GeomQueryMixin):
     GN-subset rule: a geometry passes without distance math only if ALL its
     cells are guaranteed neighbors (``:54-87``)."""
 
-    def run(self, stream: Iterable, query_point: Point, radius: float
-            ) -> Iterator[WindowResult]:
+    def _mask_stats_fn(self, query_point: Point, radius: float):
         gn, _cn, nb = self._query_masks(query_point, radius)
 
-        def eval_batch(records, ts_base):
-            if not records:
-                return []
+        def mask_stats(geoms):
             from spatialflink_tpu.ops.distances import point_bbox_dist
             from spatialflink_tpu.ops.geom import (
                 geom_cells_all_within,
@@ -166,7 +170,6 @@ class GeomPointRangeQuery(SpatialOperator, GeomQueryMixin):
             )
             from spatialflink_tpu.ops.range import range_filter_geom_stream_stats
 
-            geoms = self._geom_batch(records, ts_base)
             all_gn = geom_cells_all_within(geoms.cells, geoms.cells_mask, gn)
             any_nb = geom_cells_any_within(geoms.cells, geoms.cells_mask, nb)
             if self.conf.approximate:
@@ -175,8 +178,20 @@ class GeomPointRangeQuery(SpatialOperator, GeomQueryMixin):
                                         geoms.bbox[:, 2], geoms.bbox[:, 3])
             else:
                 dists = point_to_geoms_dist(query_point.x, query_point.y, geoms)
-            mask, gn_c, evals = range_filter_geom_stream_stats(
+            return range_filter_geom_stream_stats(
                 all_gn, any_nb, dists, radius, geoms.valid)
+
+        return mask_stats
+
+    def run(self, stream: Iterable, query_point: Point, radius: float
+            ) -> Iterator[WindowResult]:
+        mask_stats = self._mask_stats_fn(query_point, radius)
+
+        def eval_batch(records, ts_base):
+            if not records:
+                return []
+            geoms = self._geom_batch(records, ts_base)
+            mask, gn_c, evals = self._filter_stream(geoms, mask_stats)
             return self._defer_mask_select(mask, records, (gn_c, evals))
 
         return self._drive(stream, eval_batch)
@@ -186,15 +201,12 @@ class GeomGeomRangeQuery(SpatialOperator, GeomQueryMixin):
     """Polygon/linestring stream x polygon/linestring query
     (``range/PolygonPolygonRangeQuery.java`` and the 3 sibling pairs)."""
 
-    def run(self, stream: Iterable, query_geom, radius: float
-            ) -> Iterator[WindowResult]:
+    def _mask_stats_fn(self, query_geom, radius: float):
         gn, _cn, nb = self._query_masks(query_geom, radius)
         q_edges, q_mask, q_areal = self._query_edges(query_geom)
         q_bbox = self._query_bbox(query_geom)
 
-        def eval_batch(records, ts_base):
-            if not records:
-                return []
+        def mask_stats(geoms):
             from spatialflink_tpu.ops.geom import (
                 geom_cells_all_within,
                 geom_cells_any_within,
@@ -203,15 +215,26 @@ class GeomGeomRangeQuery(SpatialOperator, GeomQueryMixin):
             )
             from spatialflink_tpu.ops.range import range_filter_geom_stream_stats
 
-            geoms = self._geom_batch(records, ts_base)
             all_gn = geom_cells_all_within(geoms.cells, geoms.cells_mask, gn)
             any_nb = geom_cells_any_within(geoms.cells, geoms.cells_mask, nb)
             if self.conf.approximate:
                 dists = geoms_bbox_dist(geoms, q_bbox)
             else:
                 dists = geoms_to_single_geom_dist(geoms, q_edges, q_mask, q_areal)
-            mask, gn_c, evals = range_filter_geom_stream_stats(
+            return range_filter_geom_stream_stats(
                 all_gn, any_nb, dists, radius, geoms.valid)
+
+        return mask_stats
+
+    def run(self, stream: Iterable, query_geom, radius: float
+            ) -> Iterator[WindowResult]:
+        mask_stats = self._mask_stats_fn(query_geom, radius)
+
+        def eval_batch(records, ts_base):
+            if not records:
+                return []
+            geoms = self._geom_batch(records, ts_base)
+            mask, gn_c, evals = self._filter_stream(geoms, mask_stats)
             return self._defer_mask_select(mask, records, (gn_c, evals))
 
         return self._drive(stream, eval_batch)

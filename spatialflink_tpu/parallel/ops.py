@@ -52,29 +52,21 @@ def distributed_knn(
 ) -> KnnResult:
     """kNN over a batch sharded on the point dim; result replicated.
 
-    ``strategy`` is threaded to the per-shard ``knn_point`` so approximate
-    mode (``approx``) behaves the same at any parallelism; the re-merge is
-    exact top-k over the k-sized partials either way."""
+    ``strategy`` is threaded to the per-shard ``knn_point_stats`` so
+    approximate mode (``approx``) behaves the same at any parallelism; the
+    re-merge is exact top-k over the k-sized partials either way. Thin facade
+    over :func:`distributed_stream_knn` (one implementation of the
+    gather+re-merge for every stream type)."""
+    from spatialflink_tpu.ops.knn import knn_point_stats
 
-    def per_shard(pts: PointBatch) -> KnnResult:
-        local = knn_point(
+    def local(pts: PointBatch):
+        return knn_point_stats(
             pts, qx, qy, q_cell, radius, nb_layers,
-            n=n, k=k, enforce_radius=enforce_radius, strategy=strategy,
-        )
-        # gather the k-sized partials from every device and re-merge
-        all_oid = jax.lax.all_gather(local.obj_id, CELL_AXIS).reshape(-1)
-        all_d = jax.lax.all_gather(local.dist, CELL_AXIS).reshape(-1)
-        all_v = jax.lax.all_gather(local.valid, CELL_AXIS).reshape(-1)
-        return topk_by_distance(all_oid, all_d, all_v, k)
+            n=n, k=k, enforce_radius=enforce_radius, strategy=strategy)
 
-    fn = shard_map(
-        per_shard,
-        mesh=mesh,
-        check_vma=False,
-        in_specs=(P(CELL_AXIS),),
-        out_specs=KnnResult(P(), P(), P()),
-    )
-    return fn(points)
+    res, _evals = distributed_stream_knn(
+        mesh, points, k=k, strategy=strategy, local_fn=local)
+    return res
 
 
 def distributed_knn_hierarchical(
@@ -182,9 +174,95 @@ def distributed_join_mask(
     sharded, the (smaller) query side replicated; no collective is required
     for the lattice itself, so each device owns its row block."""
 
-    def per_shard(a_shard: PointBatch, b_rep: PointBatch):
-        return join_mask(a_shard, b_rep, radius, nb_layers,
-                         center_x, center_y, n=n)
+    return distributed_stream_join_lattice(
+        mesh, a, b,
+        lambda a_s, b_r: join_mask(a_s, b_r, radius, nb_layers,
+                                   center_x, center_y, n=n))
+
+
+def distributed_stream_filter(mesh: Mesh, batch, mask_stats_fn):
+    """Geometry/point STREAM filter over the mesh (the missing mesh dispatch
+    for PointGeom/GeomPoint/GeomGeom range — every reference pipeline runs at
+    parallelism 30, ``StreamingJob.java:221``).
+
+    ``batch`` (any pytree whose leaves share the sharded leading dim) is
+    sharded on that dim; ``mask_stats_fn(shard) -> (mask, gn_bypassed,
+    dist_evals)`` runs the SAME single-device kernels per shard (closure over
+    replicated query-side arrays), so semantics cannot fork between the two
+    paths; the pruning stats are psum-merged. Returns (mask_sharded,
+    gn_total, evals_total) — embarrassingly parallel on the mask, one scalar
+    collective for the counters.
+    """
+
+    def per_shard(b):
+        mask, gn, evals = mask_stats_fn(b)
+        return (mask, jax.lax.psum(gn, CELL_AXIS),
+                jax.lax.psum(evals, CELL_AXIS))
+
+    fn = shard_map(
+        per_shard,
+        mesh=mesh,
+        check_vma=False,
+        in_specs=(P(CELL_AXIS),),
+        out_specs=(P(CELL_AXIS), P(), P()),
+    )
+    return fn(batch)
+
+
+def distributed_stream_knn(mesh: Mesh, batch, elig_dist_fn=None, *, k: int,
+                           strategy: str = "auto", local_fn=None):
+    """Geometry/point STREAM kNN over the mesh: per-shard local dedup+top-k,
+    all-gather of the k-sized partials, re-top-k — the generic-stream twin of
+    :func:`distributed_knn` (kills the reference's parallelism-1 ``windowAll``
+    for the polygon/linestring pairs too). Returns (KnnResult replicated,
+    dist_evals total) with the candidate count psum-merged for the pruning
+    counter.
+
+    Per-shard compute goes through the SAME module-level jitted kernels the
+    single-device paths use — ``local_fn(shard) -> (KnnResult, count)``
+    (e.g. a ``knn_point_stats`` closure) or ``elig_dist_fn(shard) ->
+    (eligible, dists)`` fed into ``knn_eligible_stats`` — so XLA fuses the
+    distance math identically in both paths and the 8-dev ≡ 1-dev parity is
+    bit-for-bit, not just approximate. The re-merge is value-preserving
+    (top-k selects, never recomputes), so merged distances are exact copies
+    of per-shard results.
+    """
+    from spatialflink_tpu.ops.knn import knn_eligible_stats
+
+    def per_shard(b):
+        if local_fn is not None:
+            local, n_elig = local_fn(b)
+        else:
+            eligible, dists = elig_dist_fn(b)
+            local, n_elig = knn_eligible_stats(b.obj_id, dists, eligible,
+                                               k=k, strategy=strategy)
+        all_oid = jax.lax.all_gather(local.obj_id, CELL_AXIS).reshape(-1)
+        all_d = jax.lax.all_gather(local.dist, CELL_AXIS).reshape(-1)
+        all_v = jax.lax.all_gather(local.valid, CELL_AXIS).reshape(-1)
+        merged = topk_by_distance(all_oid, all_d, all_v, k)
+        evals = jax.lax.psum(n_elig, CELL_AXIS)
+        return merged, evals
+
+    fn = shard_map(
+        per_shard,
+        mesh=mesh,
+        check_vma=False,
+        in_specs=(P(CELL_AXIS),),
+        out_specs=(KnnResult(P(), P(), P()), P()),
+    )
+    return fn(batch)
+
+
+def distributed_stream_join_lattice(mesh: Mesh, a, b, lattice_fn):
+    """Generic broadcast join for the geometry pairs: the a side (any batch
+    pytree) sharded on its leading dim, the query side replicated;
+    ``lattice_fn(a_shard, b) -> (rows, Nb) bool`` runs the same pair-lattice
+    kernel as single-device (``join_point_geom_mask`` /
+    ``join_geom_geom_mask``). No collective — each device owns its row
+    block, mirroring :func:`distributed_join_mask` for PointPoint."""
+
+    def per_shard(a_shard, b_rep):
+        return lattice_fn(a_shard, b_rep)
 
     fn = shard_map(
         per_shard,

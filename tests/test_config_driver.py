@@ -257,6 +257,22 @@ def test_unknown_option():
         list(run_option(_params(4999), []))
 
 
+def test_query_geometry_bracket_string_forms():
+    """queryPoints/queryPolygons accept the reference's CLI bracket-string
+    form (HelperClass.java:145-179) as well as YAML lists."""
+    from spatialflink_tpu.config import QueryConfig
+
+    q = QueryConfig.from_dict({
+        "option": 1,
+        "queryPoints": "[116.5, 40.5], [117.0, 40.7]",
+        "queryPolygons": "[[116.5, 40.5], [117.6, 40.5], [117.6, 41.4]], "
+                         "[[117.5, 40.5], [118.6, 40.5], [118.6, 41.4]]",
+    })
+    assert q.query_points == [(116.5, 40.5), (117.0, 40.7)]
+    assert len(q.query_polygons) == 2
+    assert q.query_polygons[0][0] == (116.5, 40.5)
+
+
 # ------------------------------------------------------------------ CLI
 
 

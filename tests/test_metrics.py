@@ -163,6 +163,30 @@ class TestPruningCounters:
         list(PointPointRangeQuery(conf, grid).run(iter(pts), q, radius))
         assert REGISTRY.counter("distance-computations").count - d0 == len(pts)
 
+    def test_distributed_paths_report_counters_too(self):
+        # parallelism>1 must not silently zero the pruning metrics (the
+        # per-shard scalars psum-merge); counts equal the 1-device run
+        from spatialflink_tpu.models import Point
+        from spatialflink_tpu.operators import (
+            PointPointKNNQuery, PointPointRangeQuery,
+            QueryConfiguration, QueryType)
+
+        grid, pts = self._grid_pts()
+        q = Point.create(5.0, 5.0, grid)
+        d0 = REGISTRY.counter("distance-computations").count
+        g0 = REGISTRY.counter("gn-bypassed").count
+        conf = QueryConfiguration(QueryType.WindowBased, 10_000, 10_000,
+                                  devices=8, k=4)
+        list(PointPointRangeQuery(conf, grid).run(iter(pts), q, 0.5))
+        assert REGISTRY.counter("distance-computations").count - d0 == len(pts)
+        d1 = REGISTRY.counter("distance-computations").count
+        list(PointPointRangeQuery(conf, grid).run(iter(pts), q, 50.0))
+        assert REGISTRY.counter("distance-computations").count == d1  # all GN
+        assert REGISTRY.counter("gn-bypassed").count - g0 == len(pts)
+        d2 = REGISTRY.counter("distance-computations").count
+        list(PointPointKNNQuery(conf, grid).run(iter(pts), q, 0.0))
+        assert REGISTRY.counter("distance-computations").count - d2 == len(pts)
+
     def test_knn_counts_eligible_distance_evals(self):
         from spatialflink_tpu.models import Point
         from spatialflink_tpu.operators import (

@@ -100,13 +100,27 @@ def join_counts(
     return per_a, jnp.sum(per_a)
 
 
+# Above this many lattice cells per window, join_pairs_host prefilters the
+# a side with the pallas join_reduce reduction (O(Na) memory) before
+# materializing any lattice tile — sparse joins then only pay for rows that
+# actually have partners.
+_LATTICE_BUDGET = 1 << 26
+
+
 def join_pairs_host(a: PointBatch, b: PointBatch, radius, grid, tile: int = 4096,
-                    nb_layers=None):
+                    nb_layers=None, lattice_budget=None):
     """Host-side sparse pair extraction (the actual joined output stream).
 
     Iterates b tiles, pulls each tile's boolean lattice, and yields
     (a_index, b_index) integer arrays. Device does the O(Na*Nb) math; the
     host only touches the (sparse) survivors.
+
+    When ``Na * Nb`` exceeds ``lattice_budget``, a :func:`ops.pallas_kernels.
+    join_reduce` pre-pass computes per-a partner counts WITHOUT materializing
+    the lattice (its docstring's whole argument), the a side is compacted to
+    the rows with partners, and only the compacted lattice is extracted —
+    for sparse joins this shrinks the materialized lattice by the selectivity
+    factor.
     """
     import numpy as np
 
@@ -115,12 +129,54 @@ def join_pairs_host(a: PointBatch, b: PointBatch, radius, grid, tile: int = 4096
         nb_layers = grid.n if radius == 0 else grid.candidate_layers(radius)
     cx = grid.min_x + grid.cell_length * grid.n / 2
     cy = grid.min_y + grid.cell_length * grid.n / 2
+    na, nb = a.x.shape[0], b.x.shape[0]
+    if lattice_budget is None:  # read at call time so tests can patch it
+        lattice_budget = _LATTICE_BUDGET
+
+    if na * nb > lattice_budget:
+        from spatialflink_tpu.ops.pallas_kernels import join_reduce
+        from spatialflink_tpu.utils.padding import bucket_size
+
+        # conservative pre-radius: join_reduce computes exact squared
+        # distances while join_mask uses the centered MXU expansion, whose
+        # error is ABSOLUTE in d2 (~1e-6 on the O(1) centered operands, and
+        # it can round tiny d2 all the way to 0) — so the slack must be
+        # absolute in squared space, not relative in r (a relative bump
+        # vanishes for small/zero radii). No row the lattice would keep is
+        # dropped; the final pairs still come from join_mask.
+        pre_r = float(np.sqrt(radius * radius + 1e-5))
+        cnt, _, _ = join_reduce(a, b, pre_r, nb_layers, n=grid.n)
+        rows = np.nonzero(np.asarray(cnt) > 0)[0]
+        if rows.size == 0:
+            return
+        size = bucket_size(rows.size)
+        idx = np.concatenate(
+            [rows, np.zeros(size - rows.size, rows.dtype)])
+        sub = jax.tree.map(lambda v: np.asarray(v)[idx], a)
+        # pad slots replay row 0 — mask them out via valid
+        pad_valid = np.asarray(a.valid)[idx]
+        pad_valid[rows.size:] = False
+        sub = sub._replace(valid=pad_valid)
+        for ai, bi in _tiled_pairs(sub, b, radius, nb_layers, cx, cy,
+                                   grid.n, tile):
+            keep = ai < rows.size
+            if keep.any():
+                yield rows[ai[keep]], bi[keep]
+        return
+
+    yield from _tiled_pairs(a, b, radius, nb_layers, cx, cy, grid.n, tile)
+
+
+def _tiled_pairs(a: PointBatch, b: PointBatch, radius, nb_layers, cx, cy,
+                 n: int, tile: int):
+    import numpy as np
+
     nb = b.x.shape[0]
     tile = min(tile, nb)
     for start in range(0, nb, tile):
         b_tile = jax.tree.map(lambda v: v[start : start + tile], b)
         m = np.asarray(
-            join_mask(a, b_tile, radius, nb_layers, cx, cy, n=grid.n)
+            join_mask(a, b_tile, radius, nb_layers, cx, cy, n=n)
         )
         ai, bi = np.nonzero(m)
         if ai.size:

@@ -68,12 +68,23 @@ def dedup_min_by_id(obj_id, dist, eligible):
 def _topk_full_sort(obj_id, dist, eligible, k: int) -> KnnResult:
     """Reference algorithm: full lexicographic sort dedup then top-k. Exact
     for any input, but the O(N log^2 N) bitonic sort dominates on TPU for
-    large windows — prefer the grouped/prefiltered paths below there."""
+    large windows — prefer the grouped/prefiltered paths below there.
+
+    Result is always (k,): when the input holds fewer than k slots (small
+    geometry shards, tiny windows) the selection clamps to the input size
+    and pads with sentinels — ``lax.top_k`` would otherwise reject
+    k > input length at trace time."""
+    kk = min(k, obj_id.shape[0])
     oid_s, d_s, keep = dedup_min_by_id(obj_id, dist, eligible)
     d_masked = jnp.where(keep, d_s, _BIG)
-    neg_top, idx = jax.lax.top_k(-d_masked, k)
+    neg_top, idx = jax.lax.top_k(-d_masked, kk)
     top_d = -neg_top
     top_oid = jnp.where(top_d < _BIG, oid_s[idx], _OID_SENTINEL)
+    if kk < k:
+        pad = k - kk
+        top_d = jnp.concatenate([top_d, jnp.full((pad,), _BIG, top_d.dtype)])
+        top_oid = jnp.concatenate(
+            [top_oid, jnp.full((pad,), _OID_SENTINEL, top_oid.dtype)])
     return KnnResult(obj_id=top_oid, dist=top_d, valid=top_d < _BIG)
 
 

@@ -11,8 +11,11 @@ Two fused kernels, each VMEM-resident and tiled for the VPU:
 - :func:`join_reduce` — per-left-point reduction over the whole right batch:
   number of right partners within radius (after Chebyshev cell pruning,
   ``join/JoinQuery.java:148-162`` semantics) plus the nearest partner's
-  distance and index. Used for nearest-partner joins and join cardinality
-  stats without materializing the (N, M) pair matrix in HBM.
+  distance and index, without materializing the (N, M) pair matrix in HBM.
+  Reachable path: ``ops.join.join_pairs_host`` (every join operator's pair
+  extraction) uses it to prefilter the a side when the window's lattice
+  exceeds the budget, so sparse big-window joins only materialize rows that
+  have partners.
 
 Both have jnp twins (the exact code paths in :mod:`ops.geom` /
 :mod:`ops.join`); dispatch is by backend — pallas on TPU, jnp elsewhere —
@@ -223,16 +226,51 @@ def _join_reduce_impl(a, b, radius, nb_layers, *, n: int, interpret):
     """a/b: PointBatch-like namedtuples with .x/.y/.cell/.valid."""
     acx, acy = a.cell // n, a.cell % n
     bcx, bcy = b.cell // n, b.cell % n
-    if interpret is None:  # jnp twin — one scan over right tiles, fused by XLA
-        cheb = jnp.maximum(jnp.abs(acx[:, None] - bcx[None, :]),
-                           jnp.abs(acy[:, None] - bcy[None, :]))
-        d2 = (a.x[:, None] - b.x[None, :]) ** 2 + (a.y[:, None] - b.y[None, :]) ** 2
-        hit = (a.valid[:, None] & b.valid[None, :]
-               & (cheb <= nb_layers) & (d2 <= radius * radius))
-        cnt = jnp.sum(hit, axis=1).astype(jnp.int32)
-        d2m = jnp.where(hit, d2, _BIG)
-        amin = jnp.where(jnp.any(hit, axis=1), jnp.argmin(d2m, axis=1), -1)
-        return cnt, jnp.min(d2m, axis=1), amin.astype(jnp.int32)
+    if interpret is None:
+        # jnp twin — a lax.scan over right-side tiles so peak memory is
+        # (Na, tile) regardless of Nb (the whole point of this reduction;
+        # a single broadcast would materialize the (Na, Nb) lattice on
+        # backends where XLA does not fuse every reduction)
+        nb_ = b.x.shape[0]
+        tile = min(4096, nb_)
+        assert nb_ % tile == 0, \
+            f"b capacity {nb_} not a multiple of tile {tile}"
+        n_tiles = nb_ // tile
+
+        def resh(v):
+            return v.reshape(n_tiles, tile, *v.shape[1:])
+
+        bx_t, by_t = resh(b.x), resh(b.y)
+        bcx_t, bcy_t, bv_t = resh(bcx), resh(bcy), resh(b.valid)
+        offsets = jnp.arange(n_tiles, dtype=jnp.int32) * tile
+
+        def step(carry, xs):
+            cnt, mind2, amin = carry
+            bx, by, bcx_, bcy_, bv, off = xs
+            cheb = jnp.maximum(jnp.abs(acx[:, None] - bcx_[None, :]),
+                               jnp.abs(acy[:, None] - bcy_[None, :]))
+            d2 = ((a.x[:, None] - bx[None, :]) ** 2
+                  + (a.y[:, None] - by[None, :]) ** 2)
+            hit = (a.valid[:, None] & bv[None, :]
+                   & (cheb <= nb_layers) & (d2 <= radius * radius))
+            cnt = cnt + jnp.sum(hit, axis=1, dtype=jnp.int32)
+            d2m = jnp.where(hit, d2, _BIG)
+            tmin = jnp.min(d2m, axis=1)
+            targ = jnp.where(jnp.any(hit, axis=1),
+                             jnp.argmin(d2m, axis=1).astype(jnp.int32) + off,
+                             jnp.int32(-1))
+            # strict < keeps the earliest tile's index on ties, matching the
+            # one-pass argmin (and the pallas kernel's tie rule)
+            better = tmin < mind2
+            return (cnt, jnp.where(better, tmin, mind2),
+                    jnp.where(better, targ, amin)), None
+
+        na_ = a.x.shape[0]
+        init = (jnp.zeros(na_, jnp.int32), jnp.full(na_, _BIG, jnp.float32),
+                jnp.full(na_, -1, jnp.int32))
+        (cnt, mind2, amin), _ = jax.lax.scan(
+            step, init, (bx_t, by_t, bcx_t, bcy_t, bv_t, offsets))
+        return cnt, mind2, amin
 
     na, nb_ = a.x.shape[0], b.x.shape[0]
     np_pad, mb_pad = _ceil_to(na, _TP), _ceil_to(nb_, _TL)

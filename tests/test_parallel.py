@@ -470,3 +470,21 @@ class TestGeomStreamDistributedDispatch:
         for a, b in zip(single, dist):
             assert [(g.obj_id, g.timestamp) for g in a.records] == \
                    [(g.obj_id, g.timestamp) for g in b.records]
+
+    def test_knn_small_window_shards_smaller_than_k(self):
+        """Shard capacity < k must clamp+pad, not crash at trace time:
+        20 polygons over 8 devices (pad 32, shard 4) with k=10."""
+        from spatialflink_tpu.operators import PolygonPolygonKNNQuery
+
+        polys = self._polys(20, 51)
+        q = self._qpoly()
+        r1 = list(PolygonPolygonKNNQuery(self._conf(), GRID).run(
+            iter(polys), q, 5.0, 10))
+        r8 = list(PolygonPolygonKNNQuery(self._conf(8), GRID).run(
+            iter(polys), q, 5.0, 10))
+        assert any(w.records for w in r1)
+        for a, b in zip(r1, r8):
+            assert [o for o, _ in a.records] == [o for o, _ in b.records]
+            np.testing.assert_array_equal(
+                np.array([d for _, d in a.records]),
+                np.array([d for _, d in b.records]))

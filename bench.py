@@ -22,7 +22,17 @@ import time
 N_POINTS = 1_000_000
 K = 50
 RADIUS = 0.5
-ITERS = 10
+# slope window: the high count must put MANY windows of device time between
+# the two timings — over the axon tunnel a single dispatch→readback RTT is
+# tens of ms, which drowned the old 10-window gap and produced the round-3
+# "non-positive slope" fallback
+SLOPE_LO = 2
+SLOPE_HI = int(os.environ.get("SPATIALFLINK_BENCH_ITERS", "42"))
+# candidate strategies the bench times briefly and picks from when no
+# explicit SPATIALFLINK_BENCH_STRATEGY is set: the TPU-optimal choice has
+# never been measured interactively (the tunnel wedges for hours), so the
+# bench tunes itself at run time instead of trusting CPU-derived constants
+TPU_CANDIDATES = ("grouped", "prefilter", "approx_verified")
 
 
 def _probe_default_backend_ok(attempts: int = 3) -> bool:
@@ -74,14 +84,20 @@ def build_inputs():
     return grid, batch, xs, ys, oid
 
 
-def bench_device(grid, batch) -> float:
-    """-> steady-state points/sec/chip on the default JAX device.
+def bench_device(grid, batch):
+    """-> (points/sec/chip, p50_ms, strategy, pick_info) on the default device.
 
     Windows are processed in an on-device ``fori_loop`` whose body depends on
     the loop index (so XLA cannot hoist it); timing the loop at two iteration
     counts and taking the slope isolates per-window device time from the
     fixed per-dispatch overhead — the regime a streaming pipeline runs in,
     where window batches are queued back-to-back ahead of completion.
+
+    Strategy selection: an explicit ``SPATIALFLINK_BENCH_STRATEGY`` wins;
+    otherwise on TPU the bench briefly times each exact candidate and runs
+    the full slope measurement on the winner (self-tuning — the constants in
+    ops.knn's "auto" were derived on CPU and round 3 showed they don't
+    transfer). CPU keeps "auto" (measured: prefilter).
     """
     from functools import partial
 
@@ -96,26 +112,49 @@ def bench_device(grid, batch) -> float:
     batch = jax.device_put(batch)
     qc = jnp.int32(q_cell)
 
-    strategy = os.environ.get("SPATIALFLINK_BENCH_STRATEGY", "auto")
-
-    @partial(jax.jit, static_argnames=("iters",))
-    def run_n(b, *, iters):
+    @partial(jax.jit, static_argnames=("iters", "strategy"))
+    def run_n(b, *, iters, strategy):
         def body(i, acc):
             r = knn_point(b, qx + i * 1e-7, qy, qc, RADIUS, nb_layers,
                           n=grid.n, k=K, strategy=strategy)
             return acc + r.dist[0]
         return jax.lax.fori_loop(0, iters, body, jnp.float32(0))
 
-    lo, hi = 2, 2 + ITERS
-    times = {}
-    for iters in (lo, hi):
-        jax.block_until_ready(run_n(batch, iters=iters))  # compile + warm
+    def timed(strategy, iters, reps=3) -> float:
+        jax.block_until_ready(run_n(batch, iters=iters, strategy=strategy))
         best = float("inf")
-        for _ in range(3):
+        for _ in range(reps):
             t0 = time.perf_counter()
-            jax.block_until_ready(run_n(batch, iters=iters))
+            jax.block_until_ready(run_n(batch, iters=iters, strategy=strategy))
             best = min(best, time.perf_counter() - t0)
-        times[iters] = best
+        return best
+
+    env_strat = os.environ.get("SPATIALFLINK_BENCH_STRATEGY", "")
+    pick_info = {}
+    if env_strat and env_strat != "auto-pick":
+        strategy = env_strat
+    elif jax.default_backend() != "tpu":
+        strategy = "auto"
+    else:
+        quick_iters = 8
+        for s in TPU_CANDIDATES:
+            try:
+                pick_info[s] = timed(s, quick_iters, reps=2)
+            except Exception as e:  # a strategy failing must not kill the run
+                print(f"warning: strategy {s} failed quick probe: {e}",
+                      file=sys.stderr)
+        if pick_info:
+            strategy = min(pick_info, key=pick_info.get)
+        else:  # every probe failed; don't let the pick kill the run
+            strategy = "grouped"
+            print("warning: all strategy probes failed; using 'grouped'",
+                  file=sys.stderr)
+        print(f"# strategy pick (best of {quick_iters}-window loop, s): "
+              + ", ".join(f"{s}={t:.3f}" for s, t in pick_info.items())
+              + f" -> {strategy}", file=sys.stderr)
+
+    lo, hi = SLOPE_LO, SLOPE_HI
+    times = {iters: timed(strategy, iters) for iters in (lo, hi)}
     per_window = (times[hi] - times[lo]) / (hi - lo)
     if per_window <= 0:
         # timing noise swamped the slope; fall back to the conservative
@@ -136,7 +175,8 @@ def bench_device(grid, batch) -> float:
         lats.append((time.perf_counter() - t0) * 1000)
     import numpy as _np
 
-    return N_POINTS / per_window, float(_np.percentile(lats, 50))
+    return (N_POINTS / per_window, float(_np.percentile(lats, 50)),
+            strategy, pick_info)
 
 
 def bench_cpu_numpy(grid, xs, ys, oid) -> float:
@@ -188,7 +228,7 @@ def main():
 
     backend = jax.default_backend()
     grid, batch, xs, ys, oid = build_inputs()
-    device_tput, p50_ms = bench_device(grid, batch)
+    device_tput, p50_ms, strategy, _pick = bench_device(grid, batch)
     cpu_tput = bench_cpu_numpy(grid, xs, ys, oid)
 
     print(
@@ -203,6 +243,7 @@ def main():
                 "backend": backend,
                 "valid_for_target": backend == "tpu",
                 "p50_window_latency_ms": round(p50_ms, 3),
+                "strategy": strategy,
             }
         )
     )

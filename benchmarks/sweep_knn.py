@@ -81,6 +81,9 @@ def main() -> int:
     for m in (512, 1024, 2048, 4096):
         rows.append((f"prefilter m={m}",
                      lambda o, d, e, m=m: Kn._topk_prefiltered(o, d, e, k, m)))
+    for m in (800, 1600, 3200):
+        rows.append((f"approx_ver m={m}",
+                     lambda o, d, e, m=m: Kn._topk_approx_verified(o, d, e, k, m)))
     rows.append(("approx m=1600",
                  lambda o, d, e: Kn._topk_approx(o, d, e, k, 1600)))
 

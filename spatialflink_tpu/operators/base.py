@@ -50,10 +50,11 @@ class QueryConfiguration:
     # >=2 overlaps host batch assembly with device compute (SURVEY §7's
     # host/device-overlap requirement — JAX dispatch is async until read)
     pipeline_depth: int = 2
-    # device-mesh width: when > 1, PointPoint range/kNN/join window batches
-    # are sharded across a 1-D mesh on the point dim and merged with XLA
-    # collectives (parallel.ops) — the keyBy(gridID) data parallelism of
-    # SURVEY §2.5, minus the reference's parallelism-1 windowAll merge.
+    # device-mesh width: when > 1, EVERY operator family's window batches
+    # are sharded (contiguously — see parallel.mesh on why not cell-bucketed)
+    # across a 1-D mesh on the stream dim and merged with XLA collectives
+    # (parallel.ops) — the keyBy(gridID) data parallelism of SURVEY §2.5,
+    # minus the reference's parallelism-1 windowAll merge.
     # Must be a power of two (batch capacities are power-of-two buckets).
     devices: Optional[int] = None
 

@@ -44,7 +44,11 @@ def pairwise_dist2(ax, ay, bx, by, center_x=0.0, center_y=0.0):
     b = jnp.stack([bx - center_x, by - center_y], axis=1)  # (Nb, 2)
     a2 = jnp.sum(a * a, axis=1, keepdims=True)             # (Na, 1)
     b2 = jnp.sum(b * b, axis=1, keepdims=True).T           # (1, Nb)
-    cross = jnp.dot(a, b.T, preferred_element_type=jnp.float32)
+    # HIGHEST keeps the MXU at full f32 (default TPU matmul precision is
+    # bf16 inputs, ~1e-2 absolute error on O(1) operands — enough to flip
+    # radius comparisons); K=2 makes the extra passes free
+    cross = jnp.dot(a, b.T, preferred_element_type=jnp.float32,
+                    precision=jax.lax.Precision.HIGHEST)
     return jnp.maximum(a2 + b2 - 2.0 * cross, 0.0)
 
 
@@ -138,12 +142,13 @@ def join_pairs_host(a: PointBatch, b: PointBatch, radius, grid, tile: int = 4096
         from spatialflink_tpu.utils.padding import bucket_size
 
         # conservative pre-radius: join_reduce computes exact squared
-        # distances while join_mask uses the centered MXU expansion, whose
-        # error is ABSOLUTE in d2 (~1e-6 on the O(1) centered operands, and
-        # it can round tiny d2 all the way to 0) — so the slack must be
-        # absolute in squared space, not relative in r (a relative bump
-        # vanishes for small/zero radii). No row the lattice would keep is
-        # dropped; the final pairs still come from join_mask.
+        # distances while join_mask uses the centered f32-precision MXU
+        # expansion (pairwise_dist2 pins Precision.HIGHEST), whose error is
+        # ABSOLUTE in d2 (~1e-6 on the O(1) centered operands, and it can
+        # round tiny d2 all the way to 0) — so the slack must be absolute in
+        # squared space, not relative in r (a relative bump vanishes for
+        # small/zero radii). No row the lattice would keep is dropped; the
+        # final pairs still come from join_mask.
         pre_r = float(np.sqrt(radius * radius + 1e-5))
         cnt, _, _ = join_reduce(a, b, pre_r, nb_layers, n=grid.n)
         rows = np.nonzero(np.asarray(cnt) > 0)[0]

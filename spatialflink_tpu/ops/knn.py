@@ -150,6 +150,56 @@ def _topk_prefiltered(obj_id, dist, eligible, k: int, m: int) -> KnnResult:
     )
 
 
+def _topk_approx_verified(obj_id, dist, eligible, k: int, m: int) -> KnnResult:
+    """EXACT top-k riding the TPU-native partial-reduce selection.
+
+    ``lax.approx_min_k`` (the PartialReduce op, near-HBM-bandwidth on TPU)
+    proposes m candidates; a tiny dedup+top-k runs over them; then a one-pass
+    exactness certificate decides whether to keep the fast result or fall
+    back to the provably exact full sort:
+
+    - with >= k distinct candidate objects and T = the kth distinct min, the
+      result is exact iff every eligible point at distance <= T is among the
+      candidates (a missed point below T would belong to some object whose
+      true min beats the kth result; conversely if none is missed the
+      candidate set contains every point that could influence the top-k) —
+      checked by comparing element counts at threshold T over the full
+      window vs over the candidates (ties at exactly T conservatively force
+      the fallback);
+    - with < k distinct candidates, exact iff EVERY eligible point is a
+      candidate.
+
+    The certificate costs one fused elementwise reduction over the window —
+    bandwidth-bound, like the distance computation itself. With m >> k the
+    fallback fires only on adversarial distributions; recall misses cost a
+    recompute, never a wrong answer.
+    """
+    d_all, d_m, oid_m = _approx_candidates(obj_id, dist, eligible, m)
+    fast = _topk_full_sort(oid_m, d_m, d_m < _BIG, k)
+    distinct = jnp.sum(fast.valid)
+    t = jnp.max(jnp.where(fast.valid, fast.dist, -_BIG))
+    cnt_all = jnp.sum(d_all <= t)
+    cnt_cand = jnp.sum(d_m <= t)
+    n_elig = jnp.sum(eligible)
+    cand_elig = jnp.sum(d_m < _BIG)
+    exact = ((distinct >= k) & (cnt_all == cnt_cand)) | (cand_elig == n_elig)
+    return jax.lax.cond(
+        exact,
+        lambda: fast,
+        lambda: _topk_full_sort(obj_id, dist, eligible, k),
+    )
+
+
+def _approx_candidates(obj_id, dist, eligible, m: int):
+    """Shared approx_min_k prologue: (d_all, candidate dists, candidate ids)
+    with ineligible slots sentineled out."""
+    m = min(m, obj_id.shape[0])
+    d_all = jnp.where(eligible, dist, _BIG)
+    oid_all = jnp.where(eligible, obj_id, _OID_SENTINEL)
+    d_m, idx = jax.lax.approx_min_k(d_all, m)
+    return d_all, d_m, oid_all[idx]
+
+
 def _topk_approx(obj_id, dist, eligible, k: int, m: int) -> KnnResult:
     """Approximate-mode selection via the TPU-native partial-reduce top-k.
 
@@ -161,12 +211,8 @@ def _topk_approx(obj_id, dist, eligible, k: int, m: int) -> KnnResult:
     the framework's approximate query mode, which already trades exactness
     for speed (bbox distances); not for exact-mode pipelines.
     """
-    n = obj_id.shape[0]
-    m = min(m, n)
-    d_all = jnp.where(eligible, dist, _BIG)
-    oid_all = jnp.where(eligible, obj_id, _OID_SENTINEL)
-    d_m, idx = jax.lax.approx_min_k(d_all, m)
-    return _topk_full_sort(oid_all[idx], d_m, d_m < _BIG, k)
+    _d_all, d_m, oid_m = _approx_candidates(obj_id, dist, eligible, m)
+    return _topk_full_sort(oid_m, d_m, d_m < _BIG, k)
 
 
 # Below this window size the full sort is cheap enough that the grouped
@@ -180,8 +226,8 @@ def topk_by_distance(obj_id, dist, eligible, k: int,
     """Dedup by object id (keep min dist) then top-k smallest distances.
 
     strategy: "auto" (grouped for large windows, full sort for small),
-    "sort", "grouped", "prefilter" (all exact), or "approx" (recall<1,
-    approximate-mode only).
+    "sort", "grouped", "prefilter", "approx_verified" (all exact), or
+    "approx" (recall<1, approximate-mode only).
     """
     n = obj_id.shape[0]
     if strategy == "auto":
@@ -201,11 +247,16 @@ def topk_by_distance(obj_id, dist, eligible, k: int,
         # nearest) vanishingly rare while minimizing the partial-selection
         # cost (benchmarks/sweep_knn.py: smaller m wins monotonically)
         return _topk_prefiltered(obj_id, dist, eligible, k, max(8 * k, 256))
+    if strategy == "approx_verified":
+        # m >> k keeps both the recall misses and the <k-distinct case rare,
+        # so the certificate almost never triggers the full-sort fallback
+        return _topk_approx_verified(obj_id, dist, eligible, k,
+                                     max(32 * k, 1024))
     if strategy == "approx":
         return _topk_approx(obj_id, dist, eligible, k, max(32 * k, 1024))
     if strategy != "sort":
-        raise ValueError(f"unknown kNN strategy {strategy!r}; "
-                         "expected auto|sort|grouped|prefilter|approx")
+        raise ValueError(f"unknown kNN strategy {strategy!r}; expected "
+                         "auto|sort|grouped|prefilter|approx_verified|approx")
     return _topk_full_sort(obj_id, dist, eligible, k)
 
 

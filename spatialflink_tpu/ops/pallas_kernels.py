@@ -172,9 +172,23 @@ def pip_dist(px, py, edges, edge_mask, is_areal: bool):
 # --------------------------------------------------------------------------- #
 
 
+# right-side lanes staged into VMEM per (a-tile, b-tile) grid step; the b
+# grid dimension is sequential ("arbitrary") and accumulates into the
+# output block, so VMEM holds only (TP x _NBT) operands however big Nb is
+_NBT = 2048
+
+
 def _join_kernel(r2_ref, lay_ref, ax_ref, ay_ref, acx_ref, acy_ref, av_ref,
                  bx_ref, by_ref, bcx_ref, bcy_ref, bv_ref,
                  cnt_ref, mind2_ref, arg_ref):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        cnt_ref[:] = jnp.zeros((_TP, 1), jnp.int32)
+        mind2_ref[:] = jnp.full((_TP, 1), _F_BIG, jnp.float32)
+        arg_ref[:] = jnp.full((_TP, 1), -1, jnp.int32)
+
     ax = ax_ref[:]  # (TP, 1)
     ay = ay_ref[:]
     acx = acx_ref[:]
@@ -182,7 +196,6 @@ def _join_kernel(r2_ref, lay_ref, ax_ref, ay_ref, acx_ref, acy_ref, av_ref,
     av = av_ref[:] > 0
     r2 = r2_ref[0, 0]
     lay = lay_ref[0, 0]
-    n_tiles = bv_ref.shape[1] // _TL
 
     def body(t, carry):
         cnt, mind2, amin = carry
@@ -201,7 +214,8 @@ def _join_kernel(r2_ref, lay_ref, ax_ref, ay_ref, acx_ref, acy_ref, av_ref,
 
         d2m = jnp.where(hit, d2, _F_BIG)
         tile_min = jnp.min(d2m, axis=1, keepdims=True)  # (TP, 1)
-        idx = jax.lax.broadcasted_iota(jnp.int32, d2m.shape, 1) + t * _TL
+        idx = (jax.lax.broadcasted_iota(jnp.int32, d2m.shape, 1)
+               + t * _TL + j * _NBT)
         idx_at_min = jnp.min(
             jnp.where(hit & (d2m == tile_min), idx, _I_BIG), axis=1, keepdims=True
         )
@@ -211,10 +225,8 @@ def _join_kernel(r2_ref, lay_ref, ax_ref, ay_ref, acx_ref, acy_ref, av_ref,
         return cnt, mind2, amin
 
     cnt, mind2, amin = jax.lax.fori_loop(
-        0, n_tiles, body,
-        (jnp.zeros((_TP, 1), jnp.int32),
-         jnp.full((_TP, 1), _F_BIG, jnp.float32),
-         jnp.full((_TP, 1), -1, jnp.int32)),
+        0, _NBT // _TL, body,
+        (cnt_ref[:], mind2_ref[:], arg_ref[:]),
     )
     cnt_ref[:] = cnt
     mind2_ref[:] = mind2
@@ -233,15 +245,16 @@ def _join_reduce_impl(a, b, radius, nb_layers, *, n: int, interpret):
         # backends where XLA does not fuse every reduction)
         nb_ = b.x.shape[0]
         tile = min(4096, nb_)
-        assert nb_ % tile == 0, \
-            f"b capacity {nb_} not a multiple of tile {tile}"
-        n_tiles = nb_ // tile
+        pad = (-nb_) % tile  # arbitrary capacities pad up, masked via valid
+        n_tiles = (nb_ + pad) // tile
 
-        def resh(v):
-            return v.reshape(n_tiles, tile, *v.shape[1:])
+        def resh(v, fill=0):
+            return _pad_to(v, nb_ + pad, fill).reshape(
+                n_tiles, tile, *v.shape[1:])
 
         bx_t, by_t = resh(b.x), resh(b.y)
-        bcx_t, bcy_t, bv_t = resh(bcx), resh(bcy), resh(b.valid)
+        bcx_t, bcy_t = resh(bcx), resh(bcy)
+        bv_t = resh(b.valid, False)
         offsets = jnp.arange(n_tiles, dtype=jnp.int32) * tile
 
         def step(carry, xs):
@@ -273,7 +286,7 @@ def _join_reduce_impl(a, b, radius, nb_layers, *, n: int, interpret):
         return cnt, mind2, amin
 
     na, nb_ = a.x.shape[0], b.x.shape[0]
-    np_pad, mb_pad = _ceil_to(na, _TP), _ceil_to(nb_, _TL)
+    np_pad, mb_pad = _ceil_to(na, _TP), _ceil_to(nb_, _NBT)
 
     def col(v, fill, dt):
         return _pad_to(v.astype(dt), np_pad, fill).reshape(np_pad, 1)
@@ -291,14 +304,14 @@ def _join_reduce_impl(a, b, radius, nb_layers, *, n: int, interpret):
         row(bcx, 0, jnp.int32), row(bcy, 0, jnp.int32),
         row(b.valid, 0.0, jnp.float32),
     )
-    s_spec = pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM)
-    a_spec = pl.BlockSpec((_TP, 1), lambda i: (i, 0), memory_space=pltpu.VMEM)
-    b_spec = pl.BlockSpec((1, mb_pad), lambda i: (0, 0), memory_space=pltpu.VMEM)
-    o_spec = pl.BlockSpec((_TP, 1), lambda i: (i, 0), memory_space=pltpu.VMEM)
+    s_spec = pl.BlockSpec((1, 1), lambda i, j: (0, 0), memory_space=pltpu.SMEM)
+    a_spec = pl.BlockSpec((_TP, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM)
+    b_spec = pl.BlockSpec((1, _NBT), lambda i, j: (0, j), memory_space=pltpu.VMEM)
+    o_spec = pl.BlockSpec((_TP, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM)
 
     cnt, mind2, amin = pl.pallas_call(
         _join_kernel,
-        grid=(np_pad // _TP,),
+        grid=(np_pad // _TP, mb_pad // _NBT),
         in_specs=[s_spec, s_spec] + [a_spec] * 5 + [b_spec] * 5,
         out_specs=(o_spec, o_spec, o_spec),
         out_shape=(
@@ -306,6 +319,10 @@ def _join_reduce_impl(a, b, radius, nb_layers, *, n: int, interpret):
             jax.ShapeDtypeStruct((np_pad, 1), jnp.float32),
             jax.ShapeDtypeStruct((np_pad, 1), jnp.int32),
         ),
+        # the b grid dim accumulates into the (i-indexed) output blocks, so
+        # it must iterate sequentially; the a dim is embarrassingly parallel
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(*args)
     return cnt[:na, 0], mind2[:na, 0], amin[:na, 0]

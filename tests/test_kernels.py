@@ -403,7 +403,8 @@ class TestTopkStrategies:
             if e and (int(o) not in best or d < best[int(o)]):
                 best[int(o)] = float(np.float32(d))
         want_d = sorted(best.values())[:k]
-        for strat in ("sort", "grouped", "prefilter", "auto"):
+        for strat in ("sort", "grouped", "prefilter", "approx_verified",
+                      "auto"):
             got = K.topk_by_distance(
                 jnp.asarray(obj_id), jnp.asarray(dist), jnp.asarray(eligible),
                 k, strategy=strat)
@@ -481,6 +482,27 @@ class TestTopkStrategies:
         else:
             assert overlap >= int(0.5 * k), overlap
         assert len(gd) <= k and (np.diff(gd) >= 0).all()
+
+    def test_approx_verified_small_m_falls_back_exact(self):
+        # m smaller than the duplicate-heavy head -> certificate fails ->
+        # full-sort fallback -> still exact (recall misses cost a recompute,
+        # never a wrong answer)
+        n, k = 8192, 50
+        rng = np.random.default_rng(9)
+        d = np.concatenate([
+            np.linspace(0.0, 0.1, 4000, dtype=np.float32),
+            rng.uniform(0.5, 1.0, n - 4000).astype(np.float32)])
+        oid = np.concatenate([
+            np.zeros(4000, np.int32),
+            rng.integers(1, 300, n - 4000).astype(np.int32)])
+        want = K.topk_by_distance(jnp.asarray(oid), jnp.asarray(d),
+                                  jnp.ones(n, bool), k, strategy="sort")
+        got = K._topk_approx_verified(jnp.asarray(oid), jnp.asarray(d),
+                                      jnp.ones(n, bool), k, m=64)
+        np.testing.assert_array_equal(np.asarray(got.obj_id),
+                                      np.asarray(want.obj_id))
+        np.testing.assert_array_equal(np.asarray(got.dist),
+                                      np.asarray(want.dist))
 
     def test_unknown_strategy_raises(self):
         with pytest.raises(ValueError):

@@ -27,7 +27,8 @@ RADIUS = 0.5
 # tens of ms, which drowned the old 10-window gap and produced the round-3
 # "non-positive slope" fallback
 SLOPE_LO = 2
-SLOPE_HI = int(os.environ.get("SPATIALFLINK_BENCH_ITERS", "42"))
+SLOPE_HI = max(SLOPE_LO + 1,
+               int(os.environ.get("SPATIALFLINK_BENCH_ITERS", "42")))
 # candidate strategies the bench times briefly and picks from when no
 # explicit SPATIALFLINK_BENCH_STRATEGY is set: the TPU-optimal choice has
 # never been measured interactively (the tunnel wedges for hours), so the

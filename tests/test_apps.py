@@ -242,3 +242,78 @@ class TestDriverStayTime:
         out = list(run_option(self._params(1012), lines,
                               [serialize_spatial(poly, "GeoJSON")]))
         assert out and all(len(r.records[0]) == 4 for r in out if r.records)
+
+
+class TestPairSharesVectorizedParity:
+    """The vectorized _pair_shares must match the scalar per-pair rule
+    (StayTime.java:270-371) on random trajectories."""
+
+    def _scalar_pair_shares(self, app, pts):
+        from spatialflink_tpu.apps.stay_time import _segment_intersects_rect
+
+        g = app.grid
+        n = g.n
+        out = []
+        for prev, cur in zip(pts[:-1], pts[1:]):
+            dt = float(cur.timestamp - prev.timestamp)
+            c0, c1 = prev.cell, cur.cell
+            if c0 < 0 or c1 < 0:
+                continue
+            cx0, cy0 = divmod(c0, n)
+            cx1, cy1 = divmod(c1, n)
+            if c0 == c1:
+                cells = [c0]
+            elif cx0 == cx1:
+                lo, hi = min(cy0, cy1), max(cy0, cy1)
+                cells = [g.cell_id(cx0, i) for i in range(lo, hi + 1)]
+            elif cy0 == cy1:
+                lo, hi = min(cx0, cx1), max(cx0, cx1)
+                cells = [g.cell_id(i, cy0) for i in range(lo, hi + 1)]
+            else:
+                cand = g.bbox_cells(min(prev.x, cur.x), min(prev.y, cur.y),
+                                    max(prev.x, cur.x), max(prev.y, cur.y))
+                hit = {c0, c1}
+                for c in cand:
+                    if c not in hit and _segment_intersects_rect(
+                            prev.x, prev.y, cur.x, cur.y, g.cell_bounds(c)):
+                        hit.add(c)
+                cells = sorted(hit)
+            share = dt / len(cells)
+            out.extend((prev.timestamp, cur.timestamp, c, share)
+                       for c in cells)
+        return out
+
+    def test_random_trajectories(self):
+        import numpy as np
+
+        from spatialflink_tpu.operators import QueryConfiguration
+
+        grid = UniformGrid(0.0, 10.0, 0.0, 10.0, num_grid_partitions=10)
+        app = StayTime(QueryConfiguration(), grid)
+        rng = np.random.default_rng(77)
+        t0 = 1_700_000_000_000
+        for trial in range(5):
+            pts = [Point.create(float(rng.uniform(0.2, 9.8)),
+                                float(rng.uniform(0.2, 9.8)), grid,
+                                obj_id="t", timestamp=t0 + i * 1000)
+                   for i in range(40)]
+            want = self._scalar_pair_shares(app, pts)
+            got = list(app._pair_shares(pts))
+            assert len(got) == len(want), trial
+            for a, b in zip(got, want):
+                assert a[:3] == b[:3], trial
+                assert abs(a[3] - b[3]) < 1e-9, trial
+
+    def test_axis_aligned_and_same_cell(self):
+        grid = UniformGrid(0.0, 10.0, 0.0, 10.0, num_grid_partitions=10)
+        from spatialflink_tpu.operators import QueryConfiguration
+
+        app = StayTime(QueryConfiguration(), grid)
+        t0 = 1_700_000_000_000
+        pts = [Point.create(0.5, 0.5, grid, "t", t0),
+               Point.create(0.6, 0.6, grid, "t", t0 + 1000),   # same cell
+               Point.create(0.6, 4.5, grid, "t", t0 + 3000),   # same column
+               Point.create(7.5, 4.5, grid, "t", t0 + 6000)]   # same row
+        want = self._scalar_pair_shares(app, pts)
+        got = list(app._pair_shares(pts))
+        assert got == want

@@ -108,14 +108,25 @@ class PointPolygonTRangeQuery(SpatialOperator, GeomQueryMixin):
         return [p for p in records if p.cell >= 0 and cell_mask[p.cell]]
 
     def _match_mask(self, records: List[Point], gb, ts_base: int) -> np.ndarray:
-        """Per-record bool: inside any query polygon."""
+        """Per-record bool: inside any query polygon. ONE containment
+        closure for both paths: ``_filter_stream`` runs it on the whole
+        batch single-device or per shard over the mesh (the trajectory
+        layer's spatial data parallelism, SURVEY §2.5) — the predicate
+        cannot fork between parallelism levels."""
+        import jax.numpy as jnp
+
         from spatialflink_tpu.ops.geom import points_in_geoms
 
         batch = self._point_batch(records, ts_base)
-        inside = np.asarray(
-            points_in_geoms(batch.x, batch.y, gb.edges, gb.edge_mask)
-        ) & np.asarray(gb.valid)[None, :]
-        return inside.any(axis=1) & np.asarray(batch.valid)
+        g_valid = jnp.asarray(np.asarray(gb.valid))
+
+        def mask_stats(b):
+            inside = points_in_geoms(b.x, b.y, gb.edges, gb.edge_mask)
+            m = jnp.any(inside & g_valid[None, :], axis=1) & b.valid
+            return m, jnp.int32(0), jnp.int32(0)
+
+        mask, _, _ = self._filter_stream(batch, mask_stats)
+        return np.asarray(mask)
 
     def run(self, stream: Iterable[Point], polygons: Sequence[Polygon]
             ) -> Iterator[WindowResult]:
@@ -751,11 +762,23 @@ class PointPointTKNNQuery(SpatialOperator):
             if not records:
                 return []
             batch = self._point_batch(records, ts_base)
-            res = knn_point(
-                batch, query_point.x, query_point.y,
-                jnp.int32(query_point.cell), radius, nb_layers,
-                n=self.grid.n, k=k, enforce_radius=radius > 0,
-            )
+            if self.distributed:
+                # sharded per-device top-k + gather re-merge, same kernel
+                # per shard (enforce_radius threads through)
+                from spatialflink_tpu.parallel.ops import distributed_knn
+
+                res = distributed_knn(
+                    self._mesh(), self._shard(batch),
+                    query_point.x, query_point.y,
+                    jnp.int32(query_point.cell), radius, nb_layers,
+                    n=self.grid.n, k=k, enforce_radius=radius > 0,
+                )
+            else:
+                res = knn_point(
+                    batch, query_point.x, query_point.y,
+                    jnp.int32(query_point.cell), radius, nb_layers,
+                    n=self.grid.n, k=k, enforce_radius=radius > 0,
+                )
             valid = np.asarray(res.valid)
             oids = [self.interner.lookup(int(o))
                     for o in np.asarray(res.obj_id)[valid]]

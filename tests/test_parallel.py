@@ -488,3 +488,76 @@ class TestGeomStreamDistributedDispatch:
             np.testing.assert_array_equal(
                 np.array([d for _, d in a.records]),
                 np.array([d for _, d in b.records]))
+
+
+class TestTrajectoryDistributedDispatch:
+    """Kernel-backed trajectory ops ride the mesh too (tJoin already goes
+    through the distributed join): tRange containment and tKnn top-k must
+    match single-device bit-for-bit at parallelism 8."""
+
+    def _traj_pts(self, n, seed):
+        from spatialflink_tpu.models import Point
+
+        rng = np.random.default_rng(seed)
+        t0 = 1_700_000_000_000
+        return [
+            Point.create(float(rng.uniform(115.6, 117.5)),
+                         float(rng.uniform(39.7, 41.0)), GRID,
+                         obj_id=f"t{i % 37}", timestamp=t0 + i * 10)
+            for i in range(n)
+        ]
+
+    def _conf(self, devices=None, realtime=False):
+        from spatialflink_tpu.operators import QueryConfiguration, QueryType
+
+        return QueryConfiguration(
+            QueryType.RealTime if realtime else QueryType.WindowBased,
+            window_size_ms=10_000, slide_ms=5_000, devices=devices)
+
+    def test_trange_matches_single_device(self):
+        from spatialflink_tpu.models import Polygon
+        from spatialflink_tpu.operators import PointPolygonTRangeQuery
+
+        pts = self._traj_pts(2000, 61)
+        polys = [Polygon.create(
+            [[(116.2, 40.2), (116.9, 40.2), (116.9, 40.8), (116.2, 40.8)]],
+            GRID)]
+        r1 = list(PointPolygonTRangeQuery(self._conf(), GRID).run(
+            iter(pts), polys))
+        r8 = list(PointPolygonTRangeQuery(self._conf(8), GRID).run(
+            iter(pts), polys))
+        assert any(w.records for w in r1)
+        assert [w.extras.get("matched_ids") for w in r1] == \
+               [w.extras.get("matched_ids") for w in r8]
+
+    def test_trange_realtime_matches_single_device(self):
+        from spatialflink_tpu.models import Polygon
+        from spatialflink_tpu.operators import PointPolygonTRangeQuery
+
+        pts = self._traj_pts(1500, 62)
+        polys = [Polygon.create(
+            [[(116.2, 40.2), (116.9, 40.2), (116.9, 40.8), (116.2, 40.8)]],
+            GRID)]
+        r1 = list(PointPolygonTRangeQuery(self._conf(realtime=True), GRID).run(
+            iter(pts), polys))
+        r8 = list(PointPolygonTRangeQuery(self._conf(8, realtime=True), GRID)
+                  .run(iter(pts), polys))
+        assert any(w.records for w in r1)
+        assert [[(p.obj_id, p.timestamp) for p in w.records] for w in r1] == \
+               [[(p.obj_id, p.timestamp) for p in w.records] for w in r8]
+
+    def test_tknn_matches_single_device(self):
+        from spatialflink_tpu.models import Point
+        from spatialflink_tpu.operators import PointPointTKNNQuery
+
+        pts = self._traj_pts(2000, 63)
+        q = Point.create(QX, QY, GRID)
+        r1 = list(PointPointTKNNQuery(self._conf(), GRID).run(
+            iter(pts), q, 0.5, 8))
+        r8 = list(PointPointTKNNQuery(self._conf(8), GRID).run(
+            iter(pts), q, 0.5, 8))
+        assert any(w.records for w in r1)
+        assert len(r1) == len(r8)
+        for a, b in zip(r1, r8):
+            assert [(o, d) for o, d, _ in a.records] == \
+                   [(o, d) for o, d, _ in b.records]

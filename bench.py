@@ -36,12 +36,14 @@ SLOPE_HI = max(SLOPE_LO + 1,
 TPU_CANDIDATES = ("grouped", "prefilter", "approx_verified")
 
 
-def _probe_default_backend_ok(attempts: int = 3) -> bool:
+def _probe_default_backend_ok(attempts: int = 5) -> bool:
     """The axon TPU tunnel can wedge at backend init; probe it in a
     subprocess so a hang downgrades to CPU instead of stalling the bench.
 
     Probes with bounded retries + backoff (the tunnel sometimes recovers
-    within minutes) instead of a single long attempt.
+    within minutes — round 4 saw multi-hour wedges, so the end-of-round
+    bench spends up to ~12 min trying before surrendering to CPU)
+    instead of a single long attempt.
     """
     timeouts = (60, 90, 120)
     for i in range(attempts):

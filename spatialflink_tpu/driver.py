@@ -403,24 +403,15 @@ def _run_synthetic(params: Params, conf, grid) -> Iterator[WindowResult]:
 # CLI
 
 
-def run_option_bulk(params: Params, input_path: str) -> Optional[Iterator]:
-    """Vectorized replay fast path for windowed Point/Point range & kNN cases
-    over CSV/TSV/GeoJSON point files: native ingest -> bulk window batches ->
-    pipelined kernels, no per-record Python objects. Lateness semantics match
-    the record path exactly: records the watermark would have dropped are
-    filtered vectorized before windowing. Returns None when the case/format
-    cannot ride it (caller falls back to the record path)."""
+def _bulk_parse_stream(cfg: StreamConfig, input_path: str,
+                       allowed_lateness_s: int):
+    """Native-ingest one stream file + vectorized watermark dropping; None
+    when the format cannot ride the bulk path."""
     import dataclasses
 
     from spatialflink_tpu.runtime.watermarks import BoundedOutOfOrderness
     from spatialflink_tpu.streams.bulk import bulk_parse_file
 
-    spec = CASES.get(params.query.option)
-    if (spec is None or spec.family not in ("range", "knn")
-            or (spec.stream, spec.query) != ("Point", "Point")
-            or spec.mode != "window" or spec.latency):
-        return None
-    cfg = params.input1
     fmt = cfg.format.lower()
     if fmt not in ("csv", "tsv", "geojson"):
         return None
@@ -438,13 +429,45 @@ def run_option_bulk(params: Params, input_path: str) -> Optional[Iterator]:
     # reproduce the record path's watermark dropping (same keep/late rule,
     # computed in one vectorized pass over the timestamp array)
     keep = BoundedOutOfOrderness.bulk_keep_mask(
-        parsed.ts, params.query.allowed_lateness_s * 1000)
+        parsed.ts, allowed_lateness_s * 1000)
     if not keep.all():
         parsed = dataclasses.replace(
             parsed, x=parsed.x[keep], y=parsed.y[keep], ts=parsed.ts[keep],
             obj_id=parsed.obj_id[keep])
+    return parsed
+
+
+def run_option_bulk(params: Params, input_path: str,
+                    input_path2: Optional[str] = None) -> Optional[Iterator]:
+    """Vectorized replay fast path for windowed Point/Point range, kNN and
+    join cases over CSV/TSV/GeoJSON point files: native ingest -> bulk window
+    batches -> pipelined kernels, no per-record Python objects. Lateness
+    semantics match the record path exactly. Returns None when the
+    case/format cannot ride it (caller falls back to the record path)."""
+    spec = CASES.get(params.query.option)
+    if (spec is None or spec.family not in ("range", "knn", "join")
+            or (spec.stream, spec.query) != ("Point", "Point")
+            or spec.mode != "window" or spec.latency):
+        return None
+    if spec.family == "join":
+        # cheap format gate on BOTH sides before any ingest work, so an
+        # ineligible side-2 format doesn't waste a full side-1 parse
+        if (input_path2 is None
+                or params.input2.format.lower() not in ("csv", "tsv", "geojson")):
+            return None
+    parsed = _bulk_parse_stream(params.input1, input_path,
+                                params.query.allowed_lateness_s)
+    if parsed is None:
+        return None
     u_grid, _ = params.grids()
     conf = _query_conf(params, spec)
+    if spec.family == "join":
+        parsed2 = _bulk_parse_stream(params.input2, input_path2,
+                                     params.query.allowed_lateness_s)
+        if parsed2 is None:
+            return None
+        return ops.PointPointJoinQuery(conf, u_grid, u_grid).run_bulk(
+            parsed, parsed2, params.query.radius)
     q = _query_object(params, u_grid, "Point")
     if spec.family == "range":
         return ops.PointPointRangeQuery(conf, u_grid).run_bulk(
@@ -478,6 +501,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="override query.option")
     ap.add_argument("--format", default=None,
                     help="override inputStream1.format (GeoJSON/WKT/CSV/TSV)")
+    ap.add_argument("--format2", default=None,
+                    help="override inputStream2.format (two-stream cases)")
     ap.add_argument("--checkpoint", default=None,
                     help="state checkpoint file for stateful realtime queries "
                          "(tStats): saved periodically, restored at startup")
@@ -490,8 +515,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="print a metrics snapshot to stderr at exit")
     ap.add_argument("--bulk", action="store_true",
                     help="vectorized replay fast path (native ingest + bulk "
-                         "windows) for windowed Point/Point range & kNN "
-                         "cases; record-path lateness semantics, but no "
+                         "windows) for windowed Point/Point range, kNN and "
+                         "join cases; record-path lateness semantics, but no "
                          "control-tuple stop hook")
     args = ap.parse_args(argv)
 
@@ -500,12 +525,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         params.query.option = args.option
     if args.devices is not None:
         params.query.parallelism = args.devices
-    if args.format is not None:
+    if args.format is not None or args.format2 is not None:
         import dataclasses
 
-        params = dataclasses.replace(
-            params, input1=dataclasses.replace(params.input1,
-                                               format=args.format))
+        i1 = (dataclasses.replace(params.input1, format=args.format)
+              if args.format is not None else params.input1)
+        i2 = (dataclasses.replace(params.input2, format=args.format2)
+              if args.format2 is not None else params.input2)
+        params = dataclasses.replace(params, input1=i1, input2=i2)
     if args.checkpoint:
         params.checkpoint_path = args.checkpoint
         params.checkpoint_every = args.checkpoint_every
@@ -556,7 +583,7 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     results = None
     if args.bulk:
-        results = run_option_bulk(params, args.input1)
+        results = run_option_bulk(params, args.input1, args.input2)
         if results is None:
             print("--bulk not applicable to this case/format; "
                   "using the record path", file=sys.stderr)

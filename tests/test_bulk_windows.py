@@ -117,23 +117,33 @@ class TestRunBulkEquivalence:
                [(w.window_start, sorted(w.records)) for w in bulk_out]
 
 
+def _write_rows(tmp_path, name="pts.csv", n=300, seed=12):
+    rng = np.random.default_rng(seed)
+    rows = [f"o{i % 30},{T0 + i * 40},{rng.uniform(115.6, 117.5):.6f},"
+            f"{rng.uniform(39.7, 41.0):.6f}" for i in range(n)]
+    f = tmp_path / name
+    f.write_text("\n".join(rows))
+    return f, rows
+
+
+def _driver_params(option, lateness_s=0, radius=0.4):
+    import dataclasses
+    from spatialflink_tpu.config import Params
+
+    p = Params.from_yaml("conf/spatialflink-conf.yml")
+    q = dataclasses.replace(p.query, option=option, radius=radius, k=5,
+                            allowed_lateness_s=lateness_s)
+    i1 = dataclasses.replace(p.input1, format="CSV", date_format=None)
+    i2 = dataclasses.replace(p.input2, format="CSV", date_format=None)
+    return dataclasses.replace(p, query=q, input1=i1, input2=i2)
+
+
 class TestDriverBulk:
     def _write_csv(self, tmp_path, n=300):
-        rng = np.random.default_rng(12)
-        rows = [f"o{i % 30},{T0 + i * 40},{rng.uniform(115.6, 117.5):.6f},"
-                f"{rng.uniform(39.7, 41.0):.6f}" for i in range(n)]
-        f = tmp_path / "pts.csv"
-        f.write_text("\n".join(rows))
-        return f, rows
+        return _write_rows(tmp_path, n=n)
 
     def _params(self, option, lateness_s=0):
-        import dataclasses
-        from spatialflink_tpu.config import Params
-        p = Params.from_yaml("conf/spatialflink-conf.yml")
-        q = dataclasses.replace(p.query, option=option, radius=0.4, k=5,
-                                allowed_lateness_s=lateness_s)
-        i1 = dataclasses.replace(p.input1, format="CSV", date_format=None)
-        return dataclasses.replace(p, query=q, input1=i1)
+        return _driver_params(option, lateness_s)
 
     def test_bulk_matches_record_path_via_driver(self, tmp_path):
         from spatialflink_tpu.driver import run_option, run_option_bulk
@@ -241,3 +251,70 @@ class TestJoinBulk:
         with pytest.raises(ValueError):
             list(PointPointJoinQuery(conf, GRID, GRID).run_bulk(
                 parsed_points(10), parsed_points(10), 0.1))
+
+
+class TestDriverBulkJoin:
+    """run_option_bulk covers the windowed Point/Point join (option 101):
+    both sides native-ingested, pairs match the record path."""
+
+    def _write(self, tmp_path, name, n, seed):
+        return _write_rows(tmp_path, name, n, seed)
+
+    def _params(self):
+        return _driver_params(101, radius=0.2)
+
+    def test_bulk_join_matches_record_path(self, tmp_path):
+        from spatialflink_tpu.driver import run_option, run_option_bulk
+
+        f1, rows1 = self._write(tmp_path, "a.csv", 400, 31)
+        f2, rows2 = self._write(tmp_path, "b.csv", 90, 32)
+        p = self._params()
+        bulk = list(run_option_bulk(p, str(f1), str(f2)))
+        rec = list(run_option(p, iter(rows1), iter(rows2)))
+
+        # resolve bulk (idx_a, idx_b) pairs through the source rows so the
+        # ACTUAL pairs are compared, not just cardinalities
+        def key(row):
+            f = row.split(",")
+            return f[0], int(f[1])
+
+        bulk_pairs = [
+            (w.window_start,
+             sorted((key(rows1[i]), key(rows2[j])) for i, j in w.records))
+            for w in bulk]
+        rec_pairs = [
+            (w.window_start,
+             sorted(((a.obj_id, a.timestamp), (b.obj_id, b.timestamp))
+                    for a, b in w.records))
+            for w in rec]
+        assert bulk_pairs == rec_pairs
+        assert sum(len(p) for _, p in bulk_pairs) > 0
+
+    def test_bulk_join_requires_second_input(self, tmp_path):
+        from spatialflink_tpu.driver import run_option_bulk
+
+        f1, _ = self._write(tmp_path, "a.csv", 50, 33)
+        assert run_option_bulk(self._params(), str(f1)) is None
+
+    def test_bulk_join_declines_ineligible_second_format(self, tmp_path):
+        import dataclasses
+
+        from spatialflink_tpu.driver import run_option_bulk
+
+        f1, _ = self._write(tmp_path, "a.csv", 50, 36)
+        f2, _ = self._write(tmp_path, "b.csv", 20, 37)
+        p = self._params()
+        p = dataclasses.replace(
+            p, input2=dataclasses.replace(p.input2, format="WKT"))
+        assert run_option_bulk(p, str(f1), str(f2)) is None
+
+    def test_driver_cli_bulk_join(self, tmp_path, capsys):
+        from spatialflink_tpu.driver import main
+
+        f1, _ = self._write(tmp_path, "a.csv", 300, 34)
+        f2, _ = self._write(tmp_path, "b.csv", 80, 35)
+        rc = main(["--config", "conf/spatialflink-conf.yml", "--option", "101",
+                   "--format", "CSV", "--format2", "CSV",
+                   "--input1", str(f1), "--input2", str(f2), "--bulk"])
+        assert rc == 0
+        assert capsys.readouterr().out.strip()

@@ -561,3 +561,55 @@ class TestTrajectoryDistributedDispatch:
         for a, b in zip(r1, r8):
             assert [(o, d) for o, d, _ in a.records] == \
                    [(o, d) for o, d, _ in b.records]
+
+
+class TestRealtimeDistributedDispatch:
+    """Realtime (micro-batch) mode through the mesh: identical output to the
+    single-device realtime run for range and kNN."""
+
+    def _pts(self, n, seed):
+        from spatialflink_tpu.models import Point
+
+        rng = np.random.default_rng(seed)
+        t0 = 1_700_000_000_000
+        return [
+            Point.create(float(rng.uniform(115.6, 117.5)),
+                         float(rng.uniform(39.7, 41.0)), GRID,
+                         obj_id=f"o{i % 53}", timestamp=t0 + i * 10)
+            for i in range(n)
+        ]
+
+    def _conf(self, devices=None):
+        from spatialflink_tpu.operators import QueryConfiguration, QueryType
+
+        return QueryConfiguration(QueryType.RealTime, window_size_ms=10_000,
+                                  slide_ms=5_000, realtime_batch_size=256,
+                                  devices=devices)
+
+    def test_realtime_range_matches_single_device(self):
+        from spatialflink_tpu.models import Point
+        from spatialflink_tpu.operators import PointPointRangeQuery
+
+        pts = self._pts(1200, 71)
+        q = Point.create(QX, QY, GRID)
+        r1 = list(PointPointRangeQuery(self._conf(), GRID).run(
+            iter(pts), q, 0.4))
+        r8 = list(PointPointRangeQuery(self._conf(8), GRID).run(
+            iter(pts), q, 0.4))
+        assert any(w.records for w in r1)
+        assert [[(p.obj_id, p.timestamp) for p in w.records] for w in r1] == \
+               [[(p.obj_id, p.timestamp) for p in w.records] for w in r8]
+
+    def test_realtime_knn_matches_single_device(self):
+        from spatialflink_tpu.models import Point
+        from spatialflink_tpu.operators import PointPointKNNQuery
+
+        pts = self._pts(1200, 72)
+        q = Point.create(QX, QY, GRID)
+        r1 = list(PointPointKNNQuery(self._conf(), GRID).run(
+            iter(pts), q, 0.5, 10))
+        r8 = list(PointPointKNNQuery(self._conf(8), GRID).run(
+            iter(pts), q, 0.5, 10))
+        assert len(r1) == len(r8) and any(w.records for w in r1)
+        for a, b in zip(r1, r8):
+            assert a.records == b.records

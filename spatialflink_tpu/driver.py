@@ -27,6 +27,8 @@ import time
 from dataclasses import dataclass
 from typing import Iterable, Iterator, List, Optional
 
+import numpy as np
+
 from spatialflink_tpu import operators as ops
 from spatialflink_tpu.config import Params, StreamConfig
 from spatialflink_tpu.index import UniformGrid
@@ -445,9 +447,14 @@ def run_option_bulk(params: Params, input_path: str,
     semantics match the record path exactly. Returns None when the
     case/format cannot ride it (caller falls back to the record path)."""
     spec = CASES.get(params.query.option)
-    if (spec is None or spec.family not in ("range", "knn", "join")
-            or (spec.stream, spec.query) != ("Point", "Point")
-            or spec.mode != "window" or spec.latency):
+    if spec is None or spec.mode != "window" or spec.latency:
+        return None
+    # geometry STREAMS ride the bulk path for range over WKT files
+    if (spec.family == "range" and spec.stream in ("Polygon", "LineString")
+            and params.input1.format.lower() == "wkt"):
+        return _run_geom_bulk(params, spec, input_path)
+    if (spec.family not in ("range", "knn", "join")
+            or (spec.stream, spec.query) != ("Point", "Point")):
         return None
     if spec.family == "join":
         # cheap format gate on BOTH sides before any ingest work, so an
@@ -474,6 +481,26 @@ def run_option_bulk(params: Params, input_path: str,
             parsed, q, params.query.radius)
     return ops.PointPointKNNQuery(conf, u_grid).run_bulk(
         parsed, q, params.query.radius, params.query.k)
+
+
+def _run_geom_bulk(params: Params, spec: CaseSpec, input_path: str):
+    """Geometry-stream bulk replay: native WKT ingest -> vectorized window
+    assembly -> the mask_stats kernels (optionally mesh-sharded)."""
+    from spatialflink_tpu.runtime.watermarks import BoundedOutOfOrderness
+    from spatialflink_tpu.streams.bulk import bulk_parse_geom_file
+
+    cfg = params.input1
+    parsed = bulk_parse_geom_file(input_path, "WKT", delimiter=cfg.delimiter,
+                                  date_format=cfg.date_format)
+    keep = BoundedOutOfOrderness.bulk_keep_mask(
+        parsed.ts, params.query.allowed_lateness_s * 1000)
+    if not keep.all():
+        parsed = parsed.subset(np.nonzero(keep)[0])
+    u_grid, _ = params.grids()
+    conf = _query_conf(params, spec)
+    cls = getattr(ops, f"{spec.stream}{spec.query}RangeQuery")
+    q = _query_object(params, u_grid, spec.query)
+    return cls(conf, u_grid).run_bulk(parsed, q, params.query.radius)
 
 
 def _emit(result, sink) -> None:

@@ -135,7 +135,7 @@ class EdgeGeomBatch(NamedTuple):
         interner = interner if interner is not None else IdInterner()
         g = len(geoms)
         edge_arrays = [geo.edge_array() for geo in geoms]
-        max_e = max(e.shape[0] for e, _ in edge_arrays)
+        max_e = max((e.shape[0] for e, _ in edge_arrays), default=1)
         E = bucket_size(max_e, 8) if edge_pad is None else edge_pad
         max_c = max((len(geo.cells) for geo in geoms), default=1) or 1
         C = bucket_size(max_c, 8) if cell_pad is None else cell_pad

@@ -152,7 +152,39 @@ class PointGeomRangeQuery(SpatialOperator, GeomQueryMixin):
         return self._drive(stream, eval_batch)
 
 
-class GeomPointRangeQuery(SpatialOperator, GeomQueryMixin):
+class _GeomStreamBulkMixin:
+    """Bulk-replay fast path for geometry STREAMS: native WKT ingest ->
+    vectorized window assembly (``streams.bulk.bulk_geom_window_batches``)
+    -> the operator's own mask_stats kernels; results are original-record
+    index lists, no per-record Python objects."""
+
+    def run_bulk(self, parsed, query, radius: float, *,
+                 pad: Optional[int] = None) -> Iterator[WindowResult]:
+        from spatialflink_tpu.streams.bulk import bulk_geom_window_batches
+
+        mask_stats = self._mask_stats_fn(query, radius)
+        # like base._geom_batch: the geometry dim must divide across the
+        # mesh, so the per-window bucket floor rises to the device count
+        min_bucket = max(8, self.conf.devices) if self.distributed else 8
+
+        def eval_batch(payload, ts_base):
+            idx, batch = payload
+            mask, gn_c, evals = self._filter_stream(batch, mask_stats)
+            return self._defer_with_stats(
+                mask, (gn_c, evals),
+                lambda m: idx[np.asarray(m)[: len(idx)]].tolist())
+
+        batched = (
+            (start, end, (idx, batch))
+            for start, end, idx, batch in bulk_geom_window_batches(
+                parsed, self.conf.window_spec(), self.grid, pad=pad,
+                min_bucket=min_bucket)
+        )
+        return self._drive_batched(batched, eval_batch,
+                                   count=lambda p: len(p[0]))
+
+
+class GeomPointRangeQuery(SpatialOperator, GeomQueryMixin, _GeomStreamBulkMixin):
     """Polygon/linestring stream x point query
     (``range/PolygonPointRangeQuery.java``, ``LineStringPointRangeQuery``).
     GN-subset rule: a geometry passes without distance math only if ALL its
@@ -197,7 +229,7 @@ class GeomPointRangeQuery(SpatialOperator, GeomQueryMixin):
         return self._drive(stream, eval_batch)
 
 
-class GeomGeomRangeQuery(SpatialOperator, GeomQueryMixin):
+class GeomGeomRangeQuery(SpatialOperator, GeomQueryMixin, _GeomStreamBulkMixin):
     """Polygon/linestring stream x polygon/linestring query
     (``range/PolygonPolygonRangeQuery.java`` and the 3 sibling pairs)."""
 

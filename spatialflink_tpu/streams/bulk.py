@@ -296,3 +296,396 @@ def bulk_parse_file(path: str, fmt: str, **kw) -> ParsedPoints:
     if fmt.lower() == "geojson":
         return bulk_parse_geojson(data, **kw)
     raise ValueError(f"bulk ingestion supports csv/tsv/geojson, not {fmt!r}")
+
+
+# --------------------------------------------------------------------------- #
+# Bulk WKT geometry ingestion (polygon / linestring streams)
+
+@dataclass
+class ParsedGeoms:
+    """Structure-of-arrays result of a bulk WKT geometry parse.
+
+    Flattened ragged layout: geometry g owns rings
+    ``ring_off[g] : ring_off[g] + ring_cnt[g]``; ring r owns raw vertices
+    ``ring_voff[r] : ring_voff[r] + ring_size[r]`` in (``vx``, ``vy``).
+    Lines the native parser rejected (MULTI* geometries, date-formatted
+    timestamps, malformed WKT) are re-parsed in Python and flattened into
+    the SAME arrays in original line order, so downstream assembly never
+    sees two representations.
+    """
+
+    ts: np.ndarray        # (N,) i64 epoch millis
+    obj_id: np.ndarray    # (N,) i32 interned
+    is_areal: np.ndarray  # (N,) bool
+    bbox: np.ndarray      # (N, 4) f64
+    ring_off: np.ndarray  # (N,) i64
+    ring_cnt: np.ndarray  # (N,) i32
+    ring_voff: np.ndarray  # (R,) i64
+    ring_size: np.ndarray  # (R,) i32
+    vx: np.ndarray        # (V,) f64
+    vy: np.ndarray        # (V,) f64
+    interner: IdInterner
+
+    def __len__(self) -> int:
+        return self.ts.shape[0]
+
+    def subset(self, idx: np.ndarray) -> "ParsedGeoms":
+        """Geometry subset with re-based ring/vertex offsets (window
+        assembly slices the stream dim; pure numpy)."""
+        idx = np.asarray(idx)
+        rcnt = self.ring_cnt[idx]
+        # ring indices of the selected geometries, in selection order
+        rrep = np.repeat(np.arange(idx.size), rcnt)
+        cum = np.concatenate([[0], np.cumsum(rcnt)])
+        rpos = np.arange(int(cum[-1])) - np.repeat(cum[:-1], rcnt)
+        rings = self.ring_off[idx][rrep] + rpos
+        sizes = self.ring_size[rings].astype(np.int64)
+        # vertex gather per selected ring
+        vrep = np.repeat(np.arange(rings.size), sizes)
+        vcum = np.concatenate([[0], np.cumsum(sizes)])
+        vpos = np.arange(int(vcum[-1])) - np.repeat(vcum[:-1], sizes)
+        verts = self.ring_voff[rings][vrep] + vpos
+        return ParsedGeoms(
+            ts=self.ts[idx], obj_id=self.obj_id[idx],
+            is_areal=self.is_areal[idx], bbox=self.bbox[idx],
+            ring_off=cum[:-1].astype(np.int64),
+            ring_cnt=rcnt,
+            ring_voff=vcum[:-1].astype(np.int64),
+            ring_size=sizes.astype(np.int32),
+            vx=self.vx[verts], vy=self.vy[verts],
+            interner=self.interner,
+        )
+
+
+def _object_rings(obj) -> Tuple[List[np.ndarray], bool]:
+    """A parsed geometry object's rings as coordinate arrays + is_areal —
+    how reject objects flatten into the ParsedGeoms layout. Multi-part
+    geometries flatten to all their parts' rings (the edge/cells semantics
+    EdgeGeomBatch.from_objects derives via obj.edge_array())."""
+    from spatialflink_tpu.models import objects as sobj
+
+    if isinstance(obj, sobj.MultiPolygon):
+        return [np.asarray(r, np.float64) for p in obj.polygons
+                for r in p.rings], True
+    if isinstance(obj, sobj.Polygon):
+        return [np.asarray(r, np.float64) for r in obj.rings], True
+    if isinstance(obj, sobj.MultiLineString):
+        return [np.asarray(l.coords_list, np.float64) for l in obj.lines], False
+    if isinstance(obj, sobj.LineString):
+        return [np.asarray(obj.coords_list, np.float64)], False
+    raise ValueError(
+        f"bulk WKT geometry ingestion got {type(obj).__name__}; use "
+        "streams.formats.parse_spatial for mixed-geometry streams")
+
+
+def bulk_parse_wkt(
+    data: bytes,
+    *,
+    delimiter: str = ",",
+    date_format: Optional[str] = formats.DEFAULT_DATE_FORMAT,
+    interner: Optional[IdInterner] = None,
+) -> ParsedGeoms:
+    """Parse a newline-separated block of WKT polygon/linestring records
+    with optional ``oid<delim>ts<delim>`` prefix fields — the bulk twin of
+    ``parse_spatial(..., "WKT")`` for geometry streams
+    (``Deserialization.java:516-628`` WKT polygon/linestring parsers).
+    """
+    interner = interner if interner is not None else IdInterner()
+    nlib = native.lib()
+    if nlib is None:
+        return _geoms_python_fallback(data, delimiter, date_format, interner)
+    cap = data.count(b"\n") + 1
+    capr = max(1, data.count(b"("))
+    capv = data.count(b",") + capr + 2
+    buf = data if data.endswith(b"\0") else data + b"\0"
+    ts = np.empty(cap, np.int64)
+    oh = np.empty(cap, np.uint64)
+    os_ = np.empty(cap, np.int64)
+    ol = np.empty(cap, np.int32)
+    ispoly = np.empty(cap, np.int8)
+    roff = np.empty(cap, np.int64)
+    rcnt = np.empty(cap, np.int32)
+    bbox = np.empty((cap, 4), np.float64)
+    rvoff = np.empty(capr, np.int64)
+    rsize = np.empty(capr, np.int32)
+    vx = np.empty(capv, np.float64)
+    vy = np.empty(capv, np.float64)
+    rej = np.empty(cap, np.int64)
+    nrej = ctypes.c_long(0)
+    n = nlib.sf_parse_wkt_geoms(
+        buf, len(data), delimiter.encode()[:1] or b",",
+        _ptr(ts, ctypes.c_int64), _ptr(oh, ctypes.c_uint64),
+        _ptr(os_, ctypes.c_int64), _ptr(ol, ctypes.c_int32),
+        _ptr(ispoly, ctypes.c_int8),
+        _ptr(roff, ctypes.c_int64), _ptr(rcnt, ctypes.c_int32),
+        _ptr(bbox, ctypes.c_double),
+        _ptr(rvoff, ctypes.c_int64), _ptr(rsize, ctypes.c_int32),
+        _ptr(vx, ctypes.c_double), _ptr(vy, ctypes.c_double),
+        _ptr(rej, ctypes.c_int64), ctypes.byref(nrej),
+    )
+    oid = _intern_hashes(data, oh[:n], os_[:n], ol[:n], interner, _NORM_CSV)
+    n_rings = int(rcnt[:n].sum())
+    n_verts = int(rsize[:n_rings].sum()) if n_rings else 0
+    accepted = ParsedGeoms(
+        ts=np.ascontiguousarray(ts[:n]), obj_id=oid,
+        is_areal=ispoly[:n].astype(bool),
+        bbox=np.ascontiguousarray(bbox[:n]),
+        ring_off=np.ascontiguousarray(roff[:n]),
+        ring_cnt=np.ascontiguousarray(rcnt[:n]),
+        ring_voff=np.ascontiguousarray(rvoff[:n_rings]),
+        ring_size=np.ascontiguousarray(rsize[:n_rings]),
+        vx=np.ascontiguousarray(vx[:n_verts]),
+        vy=np.ascontiguousarray(vy[:n_verts]),
+        interner=interner,
+    )
+    if not nrej.value:
+        return accepted
+    lines = _nonblank_lines(data)
+    reparsed = []
+    for i in rej[: nrej.value]:
+        ln = lines[int(i)].decode("utf-8", "replace")
+        obj = formats.parse_spatial(ln, "WKT", None, delimiter=delimiter,
+                                    date_format=date_format)
+        reparsed.append((int(i), obj))
+    return _merge_geom_rejects(accepted, reparsed, interner)
+
+
+def _geoms_python_fallback(data: bytes, delimiter, date_format,
+                           interner) -> ParsedGeoms:
+    """No native library: parse every line in Python, same output layout."""
+    reparsed = []
+    i = 0
+    for ln in data.decode("utf-8", "replace").split("\n"):
+        if not ln.strip(" \t\r"):
+            continue
+        reparsed.append((i, formats.parse_spatial(
+            ln, "WKT", None, delimiter=delimiter, date_format=date_format)))
+        i += 1
+    empty = ParsedGeoms(
+        ts=np.empty(0, np.int64), obj_id=np.empty(0, np.int32),
+        is_areal=np.empty(0, bool), bbox=np.empty((0, 4)),
+        ring_off=np.empty(0, np.int64), ring_cnt=np.empty(0, np.int32),
+        ring_voff=np.empty(0, np.int64), ring_size=np.empty(0, np.int32),
+        vx=np.empty(0), vy=np.empty(0), interner=interner,
+    )
+    return _merge_geom_rejects(empty, reparsed, interner)
+
+
+def _merge_geom_rejects(accepted: ParsedGeoms, reparsed, interner
+                        ) -> ParsedGeoms:
+    """Flatten Python-reparsed geometry objects into the SoA layout and
+    stitch them back into original line order with the accepted records.
+
+    Python loops touch only the REJECTED objects (their rings); the accepted
+    block's flattened arrays are appended as-is and the line-order permute
+    rides :meth:`ParsedGeoms.subset` (offset re-basing is exactly the
+    subset gather)."""
+    n_acc = len(accepted)
+    # flatten reject objects -> a small SoA block (O(reject rings) Python)
+    rej_rings: List[np.ndarray] = []
+    rej_cnt = np.empty(len(reparsed), np.int32)
+    rej_ts = np.empty(len(reparsed), np.int64)
+    rej_oid = np.empty(len(reparsed), np.int32)
+    rej_areal = np.empty(len(reparsed), bool)
+    rej_bbox = np.empty((len(reparsed), 4), np.float64)
+    for j, (_line, obj) in enumerate(reparsed):
+        rl, is_areal = _object_rings(obj)
+        rej_rings.extend(rl)
+        rej_cnt[j] = len(rl)
+        rej_ts[j] = obj.timestamp
+        rej_oid[j] = interner.intern(obj.obj_id)
+        rej_areal[j] = is_areal
+        rej_bbox[j] = np.asarray(obj.bbox, np.float64)
+    rej_size = np.array([r.shape[0] for r in rej_rings], np.int32)
+    rej_coords = (np.concatenate(rej_rings, axis=0) if rej_rings
+                  else np.empty((0, 2)))
+    # combined = [accepted block | reject block], offsets shifted
+    n_rings_acc = accepted.ring_size.shape[0]
+    n_verts_acc = accepted.vx.shape[0]
+    combined = ParsedGeoms(
+        ts=np.concatenate([accepted.ts, rej_ts]),
+        obj_id=np.concatenate([accepted.obj_id, rej_oid]),
+        is_areal=np.concatenate([accepted.is_areal, rej_areal]),
+        bbox=np.concatenate([accepted.bbox.reshape(n_acc, 4), rej_bbox]),
+        ring_off=np.concatenate([
+            accepted.ring_off,
+            n_rings_acc + np.concatenate(
+                [[0], np.cumsum(rej_cnt)])[:-1].astype(np.int64)]),
+        ring_cnt=np.concatenate([accepted.ring_cnt, rej_cnt]),
+        ring_voff=np.concatenate([
+            accepted.ring_voff,
+            n_verts_acc + np.concatenate(
+                [[0], np.cumsum(rej_size)])[:-1].astype(np.int64)]),
+        ring_size=np.concatenate([accepted.ring_size, rej_size]),
+        vx=np.concatenate([accepted.vx, rej_coords[:, 0]]),
+        vy=np.concatenate([accepted.vy, rej_coords[:, 1]]),
+        interner=interner,
+    )
+    # permutation back to original line order: accepted rows occupy the
+    # non-rejected line slots in order, rejects their recorded lines
+    total = n_acc + len(reparsed)
+    line_of = np.empty(total, np.int64)
+    reject_lines = np.array([line for line, _ in reparsed], np.int64)
+    is_rej = np.zeros(total, bool)
+    is_rej[reject_lines] = True
+    line_of[:n_acc] = np.nonzero(~is_rej)[0]
+    line_of[n_acc:] = reject_lines
+    perm = np.argsort(line_of, kind="stable")
+    return combined.subset(perm)
+
+
+def geoms_to_edge_batch(parsed: ParsedGeoms, grid=None, *,
+                        ts_base: int = 0, pad: Optional[int] = None,
+                        edge_pad: Optional[int] = None,
+                        cell_pad: Optional[int] = None):
+    """ParsedGeoms -> :class:`EdgeGeomBatch`, fully vectorized.
+
+    Edge construction matches the object path (``Polygon.create`` +
+    ``edge_array``): polygon rings are auto-closed (closure edge appended
+    when the raw first and last vertices differ), linestrings are open
+    chains; cells are the grid cells overlapped by the bbox with the
+    centroid cell as representative (``_EdgeGeom._assign_cells`` rule).
+    """
+    from spatialflink_tpu.models.batches import EdgeGeomBatch
+    from spatialflink_tpu.utils.padding import bucket_size, pad_to
+
+    n = len(parsed)
+    if n == 0:
+        return EdgeGeomBatch.from_objects([], grid, parsed.interner,
+                                          ts_base=ts_base, pad=pad)
+
+    # --- per-ring edge construction --------------------------------------- #
+    sizes = parsed.ring_size.astype(np.int64)
+    voff = parsed.ring_voff
+    R = sizes.shape[0]
+    ring_geom = np.repeat(np.arange(n), parsed.ring_cnt)
+    if R:
+        closure = parsed.is_areal[ring_geom] & (
+            (parsed.vx[voff] != parsed.vx[voff + sizes - 1])
+            | (parsed.vy[voff] != parsed.vy[voff + sizes - 1]))
+        e_r = sizes - 1 + closure
+        eoff = np.concatenate([[0], np.cumsum(e_r)])
+        total_e = int(eoff[-1])
+        base_cnt = sizes - 1
+        brep = np.repeat(np.arange(R), base_cnt)
+        bcum = np.concatenate([[0], np.cumsum(base_cnt)])
+        bpos = np.arange(int(bcum[-1])) - np.repeat(bcum[:-1], base_cnt)
+        src = voff[brep] + bpos
+        e_flat = np.empty((total_e, 4), np.float32)
+        dest = eoff[brep] + bpos
+        e_flat[dest, 0] = parsed.vx[src]
+        e_flat[dest, 1] = parsed.vy[src]
+        e_flat[dest, 2] = parsed.vx[src + 1]
+        e_flat[dest, 3] = parsed.vy[src + 1]
+        cr = np.nonzero(closure)[0]
+        cdest = eoff[cr] + sizes[cr] - 1
+        e_flat[cdest, 0] = parsed.vx[voff[cr] + sizes[cr] - 1]
+        e_flat[cdest, 1] = parsed.vy[voff[cr] + sizes[cr] - 1]
+        e_flat[cdest, 2] = parsed.vx[voff[cr]]
+        e_flat[cdest, 3] = parsed.vy[voff[cr]]
+        ge = np.bincount(ring_geom, weights=e_r, minlength=n).astype(np.int64)
+    else:
+        e_flat = np.empty((0, 4), np.float32)
+        ge = np.zeros(n, np.int64)
+
+    E = (bucket_size(max(int(ge.max()) if n else 1, 1), 8)
+         if edge_pad is None else edge_pad)
+    edges = np.zeros((n, E, 4), np.float32)
+    emask = np.zeros((n, E), bool)
+    if R:
+        goff = np.concatenate([[0], np.cumsum(ge)])
+        edge_geom = np.repeat(np.arange(n), ge)
+        pos_in_geom = np.arange(int(goff[-1])) - np.repeat(goff[:-1], ge)
+        edges[edge_geom, pos_in_geom] = e_flat
+        emask[edge_geom, pos_in_geom] = True
+
+    # --- cells from bbox --------------------------------------------------- #
+    cell_rep = np.full(n, -1, np.int32)
+    if grid is not None:
+        ix1, iy1 = grid.cell_indices(parsed.bbox[:, 0], parsed.bbox[:, 1])
+        ix2, iy2 = grid.cell_indices(parsed.bbox[:, 2], parsed.bbox[:, 3])
+        ix1, iy1 = np.asarray(ix1, np.int64), np.asarray(iy1, np.int64)
+        ix2, iy2 = np.asarray(ix2, np.int64), np.asarray(iy2, np.int64)
+        inside = (ix2 >= 0) & (iy2 >= 0) & (ix1 < grid.n) & (iy1 < grid.n)
+        ix1c = np.clip(ix1, 0, grid.n - 1)
+        iy1c = np.clip(iy1, 0, grid.n - 1)
+        ix2c = np.clip(ix2, 0, grid.n - 1)
+        iy2c = np.clip(iy2, 0, grid.n - 1)
+        nx = np.where(inside, ix2c - ix1c + 1, 0)
+        ny = np.where(inside, iy2c - iy1c + 1, 0)
+        counts = nx * ny
+        C = (bucket_size(max(int(counts.max()), 1), 8)
+             if cell_pad is None else cell_pad)
+        cells = np.full((n, C), -1, np.int32)
+        cmask = np.zeros((n, C), bool)
+        total_c = int(counts.sum())
+        if total_c:
+            grep = np.repeat(np.arange(n), counts)
+            gcum = np.concatenate([[0], np.cumsum(counts)])
+            gpos = np.arange(total_c) - np.repeat(gcum[:-1], counts)
+            ny_r = np.repeat(ny, counts)
+            cxs = np.repeat(ix1c, counts) + gpos // np.maximum(ny_r, 1)
+            cys = np.repeat(iy1c, counts) + gpos % np.maximum(ny_r, 1)
+            cells[grep, gpos] = (cxs * grid.n + cys).astype(np.int32)
+            cmask[grep, gpos] = True
+        # representative: centroid cell when valid (always inside the bbox
+        # range), else the minimum overlapped cell (= (ix1c, iy1c))
+        cx = (parsed.bbox[:, 0] + parsed.bbox[:, 2]) / 2
+        cy = (parsed.bbox[:, 1] + parsed.bbox[:, 3]) / 2
+        c, valid = grid.assign_cell(cx, cy)
+        rep = np.where(np.asarray(valid), np.asarray(c, np.int64),
+                       ix1c * grid.n + iy1c)
+        cell_rep = np.where(counts > 0, rep, -1).astype(np.int32)
+    else:
+        C = cell_pad or 8
+        cells = np.full((n, C), -1, np.int32)
+        cmask = np.zeros((n, C), bool)
+
+    size = bucket_size(n, 8) if pad is None else pad
+    ts32 = (parsed.ts - int(ts_base)).astype(np.int32)
+    return EdgeGeomBatch(
+        edges=pad_to(edges, size),
+        edge_mask=pad_to(emask, size),
+        bbox=pad_to(parsed.bbox.astype(np.float32), size),
+        obj_id=pad_to(parsed.obj_id, size),
+        ts=pad_to(ts32, size),
+        cell=pad_to(cell_rep, size, fill=-1),
+        cells=pad_to(cells, size, fill=-1),
+        cells_mask=pad_to(cmask, size),
+        is_areal=pad_to(parsed.is_areal, size),
+        valid=pad_to(np.ones(n, bool), size),
+    )
+
+
+def bulk_geom_window_batches(parsed: ParsedGeoms, spec, grid=None, *,
+                             pad: Optional[int] = None,
+                             min_bucket: int = 8):
+    """Vectorized window assembly for geometry streams:
+    ParsedGeoms -> per-window (start, end, idx, EdgeGeomBatch) — the
+    geometry twin of :func:`bulk_window_batches`. ``min_bucket`` raises the
+    per-window capacity floor (mesh runs need the geometry dim divisible by
+    the device count)."""
+    from spatialflink_tpu.utils.padding import bucket_size
+
+    if not len(parsed):
+        return
+    win, rec = spec.assign_bulk(parsed.ts)
+    if not len(win):
+        return
+    bounds = np.flatnonzero(np.r_[True, win[1:] != win[:-1], True])
+    for i in range(len(bounds) - 1):
+        lo, hi = int(bounds[i]), int(bounds[i + 1])
+        start = int(win[lo])
+        idx = rec[lo:hi]
+        wpad = pad if pad is not None else bucket_size(idx.size, min_bucket)
+        batch = geoms_to_edge_batch(parsed.subset(idx), grid,
+                                    ts_base=start, pad=wpad)
+        yield start, start + spec.size_ms, idx, batch
+
+
+def bulk_parse_geom_file(path: str, fmt: str = "WKT", **kw) -> ParsedGeoms:
+    """Bulk-parse a whole replay file of WKT polygon/linestring records."""
+    if fmt.lower() != "wkt":
+        raise ValueError(f"bulk geometry ingestion supports WKT, not {fmt!r}")
+    with open(path, "rb") as f:
+        return bulk_parse_wkt(f.read(), **kw)

@@ -1,0 +1,170 @@
+"""Bulk WKT geometry ingestion: native parse, SoA assembly parity with the
+object path, window batching, and the driver fast path."""
+
+import numpy as np
+import pytest
+
+from spatialflink_tpu.index import UniformGrid
+from spatialflink_tpu.models.batches import EdgeGeomBatch
+from spatialflink_tpu.operators import QueryConfiguration
+from spatialflink_tpu.streams.bulk import (
+    ParsedGeoms,
+    bulk_parse_wkt,
+    bulk_geom_window_batches,
+    geoms_to_edge_batch,
+)
+from spatialflink_tpu.streams.formats import parse_spatial
+from spatialflink_tpu.utils import IdInterner
+
+GRID = UniformGrid(0.0, 10.0, 0.0, 10.0, num_grid_partitions=10)
+T0 = 1_700_000_000_000
+
+
+def _lines(n=40, seed=1, t_step=1):
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        cx, cy = rng.uniform(1, 9), rng.uniform(1, 9)
+        w = float(rng.uniform(0.1, 1.5))
+        t = T0 + i * t_step
+        if i % 3 == 0:
+            out.append(f"l{i}, {t}, LINESTRING ({cx} {cy}, {cx+w} {cy+w}, {cx+w} {cy})")
+        elif i % 7 == 0:  # native-rejected: reparsed + flattened in Python
+            out.append(f"m{i}, {t}, MULTIPOLYGON ((({cx} {cy}, {cx+w} {cy}, {cx+w} {cy+w}, {cx} {cy})))")
+        else:
+            out.append(f"p{i}, {t}, POLYGON (({cx} {cy}, {cx+w} {cy}, {cx+w} {cy+w}, {cx} {cy+w}), "
+                       f"({cx+w/4} {cy+w/4}, {cx+w/2} {cy+w/4}, {cx+w/2} {cy+w/2}))")
+    return out
+
+
+class TestParsedGeomsParity:
+    def _check_against_objects(self, lines):
+        parsed = bulk_parse_wkt(("\n".join(lines)).encode())
+        batch = geoms_to_edge_batch(parsed, GRID, ts_base=T0)
+        i2 = IdInterner()
+        objs = [parse_spatial(ln, "WKT", GRID) for ln in lines]
+        want = EdgeGeomBatch.from_objects(objs, GRID, i2, ts_base=T0)
+        n = len(lines)
+        assert (batch.valid == want.valid).all()
+        np.testing.assert_array_equal(batch.ts[:n], want.ts[:n])
+        np.testing.assert_allclose(batch.bbox[:n], want.bbox[:n], atol=1e-6)
+        np.testing.assert_array_equal(batch.is_areal[:n], want.is_areal[:n])
+        np.testing.assert_array_equal(batch.cell[:n], want.cell[:n])
+        for g in range(n):
+            # cells and edge SETS equal (object path sorts polygon rings by
+            # area; the edge set is identical and kernels are edge-order
+            # invariant)
+            assert set(batch.cells[g][batch.cells_mask[g]].tolist()) == \
+                set(want.cells[g][want.cells_mask[g]].tolist()), g
+            a = {tuple(e) for e in batch.edges[g][batch.edge_mask[g]].tolist()}
+            b = {tuple(e) for e in want.edges[g][want.edge_mask[g]].tolist()}
+            assert a == b, g
+            assert parsed.interner.lookup(int(batch.obj_id[g])) == \
+                i2.lookup(int(want.obj_id[g])), g
+
+    def test_native_path_matches_object_path(self):
+        self._check_against_objects(_lines(40))
+
+    def test_python_fallback_matches_object_path(self, monkeypatch):
+        monkeypatch.setenv("SPATIALFLINK_NATIVE", "0")
+        self._check_against_objects(_lines(25, seed=2))
+
+    def test_unclosed_rings_get_closure_edges(self):
+        # raw ring not closed -> closure edge must appear (auto-close parity)
+        parsed = bulk_parse_wkt(b"p, 1, POLYGON ((1 1, 3 1, 3 3, 1 3))")
+        batch = geoms_to_edge_batch(parsed, GRID)
+        edges = batch.edges[0][batch.edge_mask[0]]
+        assert edges.shape[0] == 4  # 3 base + closure
+        assert (edges[-1] == np.float32([1, 3, 1, 1])).all()
+
+    def test_geometrycollection_line_raises(self):
+        with pytest.raises(ValueError):
+            bulk_parse_wkt(b"GEOMETRYCOLLECTION (POINT (1 2))")
+
+    def test_subset_rebases_offsets(self):
+        parsed = bulk_parse_wkt(("\n".join(_lines(30, seed=3))).encode())
+        idx = np.array([4, 7, 20, 21])
+        sub = parsed.subset(idx)
+        full = geoms_to_edge_batch(parsed, GRID, ts_base=T0)
+        part = geoms_to_edge_batch(sub, GRID, ts_base=T0)
+        for k, g in enumerate(idx):
+            a = {tuple(e) for e in part.edges[k][part.edge_mask[k]].tolist()}
+            b = {tuple(e) for e in full.edges[g][full.edge_mask[g]].tolist()}
+            assert a == b
+            assert part.ts[k] == full.ts[g]
+
+
+class TestGeomBulkWindows:
+    def test_run_bulk_matches_record_path(self):
+        from spatialflink_tpu.models import Polygon
+        from spatialflink_tpu.operators import PolygonPolygonRangeQuery
+
+        lines = _lines(60, seed=4, t_step=400)
+        parsed = bulk_parse_wkt(("\n".join(lines)).encode())
+        q = Polygon.create([[(3, 3), (7, 3), (7, 7), (3, 7)]], GRID)
+        conf = QueryConfiguration(window_size_ms=10_000, slide_ms=5_000)
+        objs = [parse_spatial(ln, "WKT", GRID) for ln in lines]
+        rec = list(PolygonPolygonRangeQuery(conf, GRID).run(iter(objs), q, 1.0))
+        bulk = list(PolygonPolygonRangeQuery(conf, GRID).run_bulk(parsed, q, 1.0))
+        assert any(w.records for w in rec)
+        assert [(w.window_start,
+                 sorted(g.obj_id for g in w.records)) for w in rec] == \
+               [(w.window_start,
+                 sorted(parsed.interner.lookup(int(parsed.obj_id[i]))
+                        for i in w.records)) for w in bulk]
+
+    def test_run_bulk_distributed_matches(self):
+        from spatialflink_tpu.models import Point
+        from spatialflink_tpu.operators import PolygonPointRangeQuery
+
+        lines = _lines(60, seed=5, t_step=400)
+        parsed = bulk_parse_wkt(("\n".join(lines)).encode())
+        q = Point.create(5.0, 5.0, GRID)
+        r1 = list(PolygonPointRangeQuery(
+            QueryConfiguration(window_size_ms=10_000, slide_ms=5_000),
+            GRID).run_bulk(parsed, q, 2.0))
+        r8 = list(PolygonPointRangeQuery(
+            QueryConfiguration(window_size_ms=10_000, slide_ms=5_000,
+                               devices=8), GRID).run_bulk(parsed, q, 2.0))
+        assert any(w.records for w in r1)
+        assert [(w.window_start, w.records) for w in r1] == \
+               [(w.window_start, w.records) for w in r8]
+
+    def test_window_assembly_groups_by_ts(self):
+        lines = _lines(30, seed=6, t_step=1000)
+        parsed = bulk_parse_wkt(("\n".join(lines)).encode())
+        from spatialflink_tpu.runtime import WindowSpec
+
+        wins = list(bulk_geom_window_batches(
+            parsed, WindowSpec.sliding(10_000, 5_000), GRID))
+        assert wins
+        for start, end, idx, batch in wins:
+            assert (parsed.ts[idx] >= start - 5_000).all()  # sanity
+            assert int(batch.valid.sum()) == len(idx)
+
+
+class TestDriverGeomBulk:
+    def test_driver_bulk_option21(self, tmp_path, capsys):
+        from spatialflink_tpu.driver import main
+
+        lines = _lines(50, seed=7, t_step=400)
+        f = tmp_path / "polys.wkt"
+        f.write_text("\n".join(lines))
+        import yaml
+
+        with open("conf/spatialflink-conf.yml") as fh:
+            y = yaml.safe_load(fh)
+        y["inputStream1"]["gridBBox"] = [0.0, 0.0, 10.0, 10.0]
+        y["inputStream2"]["gridBBox"] = [0.0, 0.0, 10.0, 10.0]
+        y["query"]["option"] = 21
+        y["query"]["radius"] = 1.0
+        y["query"]["queryPolygons"] = [[[3, 3], [7, 3], [7, 7], [3, 7]]]
+        y["inputStream1"]["format"] = "WKT"
+        y["inputStream1"]["dateFormat"] = None
+        cfgf = tmp_path / "conf.yml"
+        cfgf.write_text(yaml.safe_dump(y))
+        rc = main(["--config", str(cfgf), "--input1", str(f), "--bulk"])
+        assert rc == 0
+        out = capsys.readouterr()
+        assert "not applicable" not in out.err
+        assert out.out.strip()

@@ -449,21 +449,27 @@ def run_option_bulk(params: Params, input_path: str,
     spec = CASES.get(params.query.option)
     if spec is None or spec.mode != "window" or spec.latency:
         return None
-    # geometry STREAMS ride the bulk path for range over WKT files
-    if (spec.family == "range" and spec.stream in ("Polygon", "LineString")
-            and params.input1.format.lower() == "wkt"):
-        return _run_geom_bulk(params, spec, input_path)
-    if (spec.family not in ("range", "knn", "join")
-            or (spec.stream, spec.query) != ("Point", "Point")):
-        return None
-    if spec.family == "join":
-        # cheap format gate on BOTH sides before any ingest work, so an
-        # ineligible side-2 format doesn't waste a full side-1 parse
-        if (input_path2 is None
-                or params.input2.format.lower() not in ("csv", "tsv", "geojson")):
+    geom_stream = spec.stream in ("Polygon", "LineString")
+    if geom_stream:
+        # geometry STREAMS ride the bulk path for range/kNN over WKT files
+        if (spec.family not in ("range", "knn")
+                or params.input1.format.lower() != "wkt"):
             return None
-    parsed = _bulk_parse_stream(params.input1, input_path,
-                                params.query.allowed_lateness_s)
+        parsed = _bulk_parse_geom_stream(params, input_path)
+    else:
+        if (spec.family not in ("range", "knn", "join")
+                or spec.stream != "Point"):
+            return None
+        if spec.family == "join":
+            if spec.query != "Point":
+                return None
+            # cheap format gate on BOTH sides before any ingest work, so an
+            # ineligible side-2 format doesn't waste a full side-1 parse
+            if (input_path2 is None or params.input2.format.lower()
+                    not in ("csv", "tsv", "geojson")):
+                return None
+        parsed = _bulk_parse_stream(params.input1, input_path,
+                                    params.query.allowed_lateness_s)
     if parsed is None:
         return None
     u_grid, _ = params.grids()
@@ -475,17 +481,18 @@ def run_option_bulk(params: Params, input_path: str,
             return None
         return ops.PointPointJoinQuery(conf, u_grid, u_grid).run_bulk(
             parsed, parsed2, params.query.radius)
-    q = _query_object(params, u_grid, "Point")
+    q = _query_object(params, u_grid, spec.query)
+    fam = "Range" if spec.family == "range" else "KNN"
+    cls = getattr(ops, f"{spec.stream}{spec.query}{fam}Query")
     if spec.family == "range":
-        return ops.PointPointRangeQuery(conf, u_grid).run_bulk(
-            parsed, q, params.query.radius)
-    return ops.PointPointKNNQuery(conf, u_grid).run_bulk(
+        return cls(conf, u_grid).run_bulk(parsed, q, params.query.radius)
+    return cls(conf, u_grid).run_bulk(
         parsed, q, params.query.radius, params.query.k)
 
 
-def _run_geom_bulk(params: Params, spec: CaseSpec, input_path: str):
-    """Geometry-stream bulk replay: native WKT ingest -> vectorized window
-    assembly -> the mask_stats kernels (optionally mesh-sharded)."""
+def _bulk_parse_geom_stream(params: Params, input_path: str):
+    """Native WKT geometry ingest + the same vectorized watermark dropping
+    as the point path (ParsedGeoms carries its own subset machinery)."""
     from spatialflink_tpu.runtime.watermarks import BoundedOutOfOrderness
     from spatialflink_tpu.streams.bulk import bulk_parse_geom_file
 
@@ -496,11 +503,7 @@ def _run_geom_bulk(params: Params, spec: CaseSpec, input_path: str):
         parsed.ts, params.query.allowed_lateness_s * 1000)
     if not keep.all():
         parsed = parsed.subset(np.nonzero(keep)[0])
-    u_grid, _ = params.grids()
-    conf = _query_conf(params, spec)
-    cls = getattr(ops, f"{spec.stream}{spec.query}RangeQuery")
-    q = _query_object(params, u_grid, spec.query)
-    return cls(conf, u_grid).run_bulk(parsed, q, params.query.radius)
+    return parsed
 
 
 def _emit(result, sink) -> None:

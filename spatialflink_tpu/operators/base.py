@@ -167,6 +167,21 @@ class SpatialOperator:
         return EdgeGeomBatch.from_objects(records, self.grid, self.interner,
                                           ts_base=ts_base, pad=pad)
 
+    def _bulk_mask_eval(self, mask_stats_fn):
+        """eval_batch for bulk window payloads ((idx, batch)): one shared
+        mask->original-record-index selection for every stream-filter
+        operator's run_bulk (point and geometry alike)."""
+        import numpy as np
+
+        def eval_batch(payload, ts_base):
+            idx, batch = payload
+            mask, gn_c, evals = self._filter_stream(batch, mask_stats_fn)
+            return self._defer_with_stats(
+                mask, (gn_c, evals),
+                lambda m: idx[np.asarray(m)[: len(idx)]].tolist())
+
+        return eval_batch
+
     def _filter_stream(self, batch, mask_stats_fn):
         """(mask, gn_bypassed, dist_evals) for a stream batch: the
         single-device path calls ``mask_stats_fn(batch)`` directly; with

@@ -117,6 +117,23 @@ class _GenericKnn(SpatialOperator, GeomQueryMixin):
     fork semantically.
     """
 
+    def _knn_eval(self, batch, elig_dists, k: int):
+        """(KnnResult, dist_evals) over one batch — THE single kNN
+        evaluation body shared by run() and run_bulk(): distributed runs
+        the same closure per shard, single-device goes through the
+        module-jitted knn_eligible_stats."""
+        if self.distributed:
+            from spatialflink_tpu.parallel.ops import distributed_stream_knn
+
+            return distributed_stream_knn(
+                self._mesh(), self._shard(batch), elig_dists, k=k,
+                strategy=self._knn_strategy())
+        from spatialflink_tpu.ops.knn import knn_eligible_stats
+
+        eligible, dists = elig_dists(batch)
+        return knn_eligible_stats(batch.obj_id, dists, eligible, k=k,
+                                  strategy=self._knn_strategy())
+
     def run(self, stream, query, radius: float, k: Optional[int] = None
             ) -> Iterator[WindowResult]:
         k = k or self.conf.k
@@ -128,25 +145,61 @@ class _GenericKnn(SpatialOperator, GeomQueryMixin):
         def eval_batch(records, ts_base):
             if not records:
                 return []
-            batch = self._batch(records, ts_base)
-            if self.distributed:
-                from spatialflink_tpu.parallel.ops import distributed_stream_knn
-
-                res, dist_evals = distributed_stream_knn(
-                    self._mesh(), self._shard(batch), elig_dists, k=k,
-                    strategy=self._knn_strategy())
-            else:
-                from spatialflink_tpu.ops.knn import knn_eligible_stats
-
-                eligible, dists = elig_dists(batch)
-                res, dist_evals = knn_eligible_stats(
-                    batch.obj_id, dists, eligible, k=k,
-                    strategy=self._knn_strategy())
+            res, dist_evals = self._knn_eval(
+                self._batch(records, ts_base), elig_dists, k)
             return self._defer_knn(res, dist_evals=dist_evals)
 
         for result in self._drive(stream, eval_batch):
             result.extras["k"] = k
             yield result
+
+    def run_bulk(self, parsed, query, radius: float,
+                 k: Optional[int] = None, *, pad: Optional[int] = None
+                 ) -> Iterator[WindowResult]:
+        """Bulk-replay fast path: vectorized window batches (points via
+        ``bulk_window_batches``, geometry streams via
+        ``bulk_geom_window_batches``) through the same eligibility/distance
+        closures; records are (objID, distance) pairs resolved through the
+        parse-time interner."""
+        k = k or self.conf.k
+        setup = self._setup(query, radius)
+
+        def elig_dists(batch):
+            return self._elig_dists(batch, setup)
+
+        def eval_batch(payload, ts_base):
+            _idx, batch = payload
+            res, dist_evals = self._knn_eval(batch, elig_dists, k)
+            return self._defer_knn(res, interner=parsed.interner,
+                                   dist_evals=dist_evals)
+
+        batched = (
+            (start, end, (idx, batch))
+            for start, end, idx, batch in self._bulk_batches(parsed, pad)
+        )
+        for result in self._drive_batched(batched, eval_batch,
+                                          count=lambda p: len(p[0])):
+            result.extras["k"] = k
+            yield result
+
+    def _bulk_batches(self, parsed, pad):
+        raise NotImplementedError
+
+
+class _GeomStreamKnn(_GenericKnn):
+    """Geometry-stream kNN base: EdgeGeomBatch construction + the
+    mesh-divisible bulk window source (shared by GeomPoint and GeomGeom)."""
+
+    def _batch(self, records, ts_base):
+        return self._geom_batch(records, ts_base)
+
+    def _bulk_batches(self, parsed, pad):
+        from spatialflink_tpu.streams.bulk import bulk_geom_window_batches
+
+        min_bucket = max(8, self.conf.devices) if self.distributed else 8
+        return bulk_geom_window_batches(parsed, self.conf.window_spec(),
+                                        self.grid, pad=pad,
+                                        min_bucket=min_bucket)
 
 
 class PointGeomKNNQuery(_GenericKnn):
@@ -159,6 +212,12 @@ class PointGeomKNNQuery(_GenericKnn):
 
     def _batch(self, records, ts_base):
         return self._point_batch(records, ts_base)
+
+    def _bulk_batches(self, parsed, pad):
+        from spatialflink_tpu.streams.bulk import bulk_window_batches
+
+        return bulk_window_batches(parsed, self.conf.window_spec(),
+                                   self.grid, pad=pad)
 
     def _elig_dists(self, batch, setup):
         from spatialflink_tpu.ops.distances import point_bbox_dist
@@ -175,15 +234,12 @@ class PointGeomKNNQuery(_GenericKnn):
         return eligible, dists
 
 
-class GeomPointKNNQuery(_GenericKnn):
+class GeomPointKNNQuery(_GeomStreamKnn):
     """Polygon/linestring stream x point query (``PolygonPointKNNQuery``,
     ``LineStringPointKNNQuery``)."""
 
     def _setup(self, query, radius):
         return dict(nb=self._query_nb(query, radius), query=query)
-
-    def _batch(self, records, ts_base):
-        return self._geom_batch(records, ts_base)
 
     def _elig_dists(self, geoms, setup):
         from spatialflink_tpu.ops.distances import point_bbox_dist
@@ -200,16 +256,13 @@ class GeomPointKNNQuery(_GenericKnn):
         return eligible, dists
 
 
-class GeomGeomKNNQuery(_GenericKnn):
+class GeomGeomKNNQuery(_GeomStreamKnn):
     """Polygon/linestring stream x polygon/linestring query (the remaining
     4 pairs of SURVEY §2.2)."""
 
     def _setup(self, query, radius):
         return dict(nb=self._query_nb(query, radius),
                     edges=self._query_edges(query), bbox=self._query_bbox(query))
-
-    def _batch(self, records, ts_base):
-        return self._geom_batch(records, ts_base)
 
     def _elig_dists(self, geoms, setup):
         from spatialflink_tpu.ops.geom import geoms_bbox_dist

@@ -43,13 +43,10 @@ class PointPointRangeQuery(SpatialOperator):
         mask, stats = self._range_mask(batch, query_point, radius)
         return self._defer_mask_select(mask, records, stats)
 
-    def _range_mask(self, batch, query_point: Point, radius: float):
-        """(mask, (gn_bypassed, dist_evals)) for one window batch — the
-        pruning-counter scalars are psum-merged on the distributed path like
-        every other operator family. With ``conf.devices`` the batch point
-        dim is sharded over the mesh and each device filters its shard via
-        the SAME stats kernel (parallel.ops.distributed_stream_filter) —
-        results are identical to the single-device kernel."""
+    def _mask_stats_fn(self, query_point: Point, radius: float):
+        """Per-batch (mask, gn_bypassed, dist_evals) closure — the same
+        shape every range operator exposes; _filter_stream runs it whole
+        single-device or per shard on the mesh."""
         args = (
             query_point.x, query_point.y, jnp.int32(query_point.cell), radius,
             self.grid.guaranteed_layers(radius),
@@ -62,7 +59,14 @@ class PointPointRangeQuery(SpatialOperator):
             )
             return mask, gn_c, evals
 
-        mask, gn_bypassed, dist_evals = self._filter_stream(batch, mask_stats)
+        return mask_stats
+
+    def _range_mask(self, batch, query_point: Point, radius: float):
+        """(mask, (gn_bypassed, dist_evals)) for one window batch — the
+        pruning-counter scalars are psum-merged on the distributed path like
+        every other operator family."""
+        mask, gn_bypassed, dist_evals = self._filter_stream(
+            batch, self._mask_stats_fn(query_point, radius))
         return mask, (gn_bypassed, dist_evals)
 
     # ---------------------------------------------------------------- #
@@ -75,13 +79,9 @@ class PointPointRangeQuery(SpatialOperator):
 
         Windowed mode only (a bounded replay has no realtime trigger).
         """
-        def eval_batch(payload, ts_base):
-            idx, batch = payload
-            mask, stats = self._range_mask(batch, query_point, radius)
-            return self._defer_with_stats(
-                mask, stats, lambda m: idx[np.asarray(m)[: len(idx)]].tolist())
-
-        return self._drive_bulk(parsed, eval_batch, pad=pad)
+        return self._drive_bulk(
+            parsed, self._bulk_mask_eval(self._mask_stats_fn(query_point, radius)),
+            pad=pad)
 
     def run_incremental(self, stream: Iterable[Point], query_point: Point,
                         radius: float) -> Iterator[WindowResult]:
@@ -151,6 +151,14 @@ class PointGeomRangeQuery(SpatialOperator, GeomQueryMixin):
 
         return self._drive(stream, eval_batch)
 
+    def run_bulk(self, parsed, query_geom, radius: float, *,
+                 pad: Optional[int] = None) -> Iterator[WindowResult]:
+        """Bulk-replay fast path over point-stream windows (native ingest;
+        results are original-record index lists)."""
+        return self._drive_bulk(
+            parsed, self._bulk_mask_eval(self._mask_stats_fn(query_geom, radius)),
+            pad=pad)
+
 
 class _GeomStreamBulkMixin:
     """Bulk-replay fast path for geometry STREAMS: native WKT ingest ->
@@ -162,26 +170,18 @@ class _GeomStreamBulkMixin:
                  pad: Optional[int] = None) -> Iterator[WindowResult]:
         from spatialflink_tpu.streams.bulk import bulk_geom_window_batches
 
-        mask_stats = self._mask_stats_fn(query, radius)
         # like base._geom_batch: the geometry dim must divide across the
         # mesh, so the per-window bucket floor rises to the device count
         min_bucket = max(8, self.conf.devices) if self.distributed else 8
-
-        def eval_batch(payload, ts_base):
-            idx, batch = payload
-            mask, gn_c, evals = self._filter_stream(batch, mask_stats)
-            return self._defer_with_stats(
-                mask, (gn_c, evals),
-                lambda m: idx[np.asarray(m)[: len(idx)]].tolist())
-
         batched = (
             (start, end, (idx, batch))
             for start, end, idx, batch in bulk_geom_window_batches(
                 parsed, self.conf.window_spec(), self.grid, pad=pad,
                 min_bucket=min_bucket)
         )
-        return self._drive_batched(batched, eval_batch,
-                                   count=lambda p: len(p[0]))
+        return self._drive_batched(
+            batched, self._bulk_mask_eval(self._mask_stats_fn(query, radius)),
+            count=lambda p: len(p[0]))
 
 
 class GeomPointRangeQuery(SpatialOperator, GeomQueryMixin, _GeomStreamBulkMixin):

@@ -482,7 +482,11 @@ def parse_spatial(
             if f_.strip()
         ]
         if fields:
-            oid = fields[0].replace('"', "")
+            # strip() aligns WKT-prefix ids with the CSV parser (which
+            # strips the whole line first) and with the native bulk parser's
+            # trimmed field hash — one interned id per logical object no
+            # matter which parse path a line takes
+            oid = fields[0].replace('"', "").strip()
             if len(fields) > 1:
                 ts = parse_timestamp(fields[1], date_format)
         return parse_wkt(line, grid, delimiter=delimiter, date_format=date_format,

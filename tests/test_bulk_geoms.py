@@ -168,3 +168,100 @@ class TestDriverGeomBulk:
         out = capsys.readouterr()
         assert "not applicable" not in out.err
         assert out.out.strip()
+
+
+class TestGeomKnnBulk:
+    def test_geom_knn_run_bulk_matches_record_path(self):
+        from spatialflink_tpu.models import Point
+        from spatialflink_tpu.operators import PolygonPointKNNQuery
+
+        lines = _lines(60, seed=8, t_step=400)
+        parsed = bulk_parse_wkt(("\n".join(lines)).encode())
+        q = Point.create(5.0, 5.0, GRID)
+        conf = QueryConfiguration(window_size_ms=10_000, slide_ms=5_000)
+        objs = [parse_spatial(ln, "WKT", GRID) for ln in lines]
+        rec = list(PolygonPointKNNQuery(conf, GRID).run(iter(objs), q, 0.0, 7))
+        bulk = list(PolygonPointKNNQuery(conf, GRID).run_bulk(parsed, q, 0.0, 7))
+        assert any(w.records for w in rec)
+        # equal-distance ties may order differently (interner id order
+        # differs between parse paths); compare tie-insensitively
+        assert [(w.window_start, sorted(w.records)) for w in rec] == \
+               [(w.window_start, sorted(w.records)) for w in bulk]
+
+    def test_point_geom_knn_run_bulk_matches_record_path(self):
+        from spatialflink_tpu.models import Point, Polygon
+        from spatialflink_tpu.operators import PointPolygonKNNQuery
+        from spatialflink_tpu.streams.bulk import bulk_parse_csv
+
+        rng = np.random.default_rng(9)
+        rows = [f"o{i % 30},{T0 + i * 400},{rng.uniform(0.5, 9.5):.6f},"
+                f"{rng.uniform(0.5, 9.5):.6f}" for i in range(400)]
+        parsed = bulk_parse_csv(("\n".join(rows)).encode(), date_format=None)
+        q = Polygon.create([[(4, 4), (6, 4), (6, 6), (4, 6)]], GRID)
+        conf = QueryConfiguration(window_size_ms=10_000, slide_ms=5_000)
+        pts = [Point.create(float(x), float(y), GRID, o, int(t))
+               for o, t, x, y in (r.split(",") for r in rows)]
+        rec = list(PointPolygonKNNQuery(conf, GRID).run(iter(pts), q, 0.0, 9))
+        bulk = list(PointPolygonKNNQuery(conf, GRID).run_bulk(parsed, q, 0.0, 9))
+        assert any(w.records for w in rec)
+        assert [(w.window_start, sorted(w.records)) for w in rec] == \
+               [(w.window_start, sorted(w.records)) for w in bulk]
+
+    def test_driver_bulk_geom_knn_option(self, tmp_path, capsys):
+        # option 71 = kNN, (Polygon, Point) stream/query pair
+        from spatialflink_tpu.driver import CASES, main
+
+        assert CASES[71].family == "knn" and CASES[71].stream == "Polygon"
+        lines = _lines(50, seed=10, t_step=400)
+        f = tmp_path / "polys.wkt"
+        f.write_text("\n".join(lines))
+        import yaml
+
+        with open("conf/spatialflink-conf.yml") as fh:
+            y = yaml.safe_load(fh)
+        y["inputStream1"]["gridBBox"] = [0.0, 0.0, 10.0, 10.0]
+        y["inputStream2"]["gridBBox"] = [0.0, 0.0, 10.0, 10.0]
+        y["query"]["option"] = 71
+        y["query"]["radius"] = 0.0
+        y["query"]["k"] = 5
+        y["query"]["queryPoints"] = [[5.0, 5.0]]
+        y["inputStream1"]["format"] = "WKT"
+        y["inputStream1"]["dateFormat"] = None
+        cfgf = tmp_path / "conf.yml"
+        cfgf.write_text(yaml.safe_dump(y))
+        rc = main(["--config", str(cfgf), "--input1", str(f), "--bulk"])
+        assert rc == 0
+        out = capsys.readouterr()
+        assert "not applicable" not in out.err
+        assert out.out.strip()
+
+
+class TestPointGeomRangeBulkDriver:
+    def test_driver_bulk_point_polygon_range_option6(self, tmp_path, capsys):
+        from spatialflink_tpu.driver import CASES, main
+
+        assert CASES[6].family == "range" and \
+            (CASES[6].stream, CASES[6].query) == ("Point", "Polygon")
+        rng = np.random.default_rng(11)
+        rows = [f"o{i % 30},{T0 + i * 400},{rng.uniform(0.5, 9.5):.6f},"
+                f"{rng.uniform(0.5, 9.5):.6f}" for i in range(300)]
+        f = tmp_path / "pts.csv"
+        f.write_text("\n".join(rows))
+        import yaml
+
+        with open("conf/spatialflink-conf.yml") as fh:
+            y = yaml.safe_load(fh)
+        y["inputStream1"]["gridBBox"] = [0.0, 0.0, 10.0, 10.0]
+        y["inputStream2"]["gridBBox"] = [0.0, 0.0, 10.0, 10.0]
+        y["query"]["option"] = 6
+        y["query"]["radius"] = 1.0
+        y["query"]["queryPolygons"] = [[[4, 4], [6, 4], [6, 6], [4, 6]]]
+        y["inputStream1"]["format"] = "CSV"
+        y["inputStream1"]["dateFormat"] = None
+        cfgf = tmp_path / "conf.yml"
+        cfgf.write_text(yaml.safe_dump(y))
+        rc = main(["--config", str(cfgf), "--input1", str(f), "--bulk"])
+        assert rc == 0
+        out = capsys.readouterr()
+        assert "not applicable" not in out.err
+        assert out.out.strip()

@@ -24,11 +24,15 @@ K = 50
 RADIUS = 0.5
 # slope window: the high count must put MANY windows of device time between
 # the two timings — over the axon tunnel a single dispatch→readback RTT is
-# tens of ms, which drowned the old 10-window gap and produced the round-3
-# "non-positive slope" fallback
+# tens of ms with multi-ms jitter, which drowned the round-3 10-window gap.
+# The high count now ESCALATES (×5) until the measured gap clears
+# SLOPE_MIN_GAP_S: the approx_verified path runs a 1M window in ~46us, so a
+# fixed 40-window gap (1.8ms) would sit inside the RTT jitter again.
 SLOPE_LO = 2
 SLOPE_HI = max(SLOPE_LO + 1,
                int(os.environ.get("SPATIALFLINK_BENCH_ITERS", "42")))
+SLOPE_MIN_GAP_S = 0.2
+SLOPE_MAX_HI = 40_000
 # candidate strategies the bench times briefly and picks from when no
 # explicit SPATIALFLINK_BENCH_STRATEGY is set: the TPU-optimal choice has
 # never been measured interactively (the tunnel wedges for hours), so the
@@ -115,8 +119,10 @@ def bench_device(grid, batch):
     batch = jax.device_put(batch)
     qc = jnp.int32(q_cell)
 
-    @partial(jax.jit, static_argnames=("iters", "strategy"))
-    def run_n(b, *, iters, strategy):
+    # iters is a DYNAMIC argument (fori_loop lowers to a while loop), so one
+    # compile per strategy covers every loop count the escalation below needs
+    @partial(jax.jit, static_argnames=("strategy",))
+    def run_n(b, iters, *, strategy):
         def body(i, acc):
             r = knn_point(b, qx + i * 1e-7, qy, qc, RADIUS, nb_layers,
                           n=grid.n, k=K, strategy=strategy)
@@ -124,11 +130,12 @@ def bench_device(grid, batch):
         return jax.lax.fori_loop(0, iters, body, jnp.float32(0))
 
     def timed(strategy, iters, reps=3) -> float:
-        jax.block_until_ready(run_n(batch, iters=iters, strategy=strategy))
+        it = jnp.int32(iters)
+        jax.block_until_ready(run_n(batch, it, strategy=strategy))
         best = float("inf")
         for _ in range(reps):
             t0 = time.perf_counter()
-            jax.block_until_ready(run_n(batch, iters=iters, strategy=strategy))
+            jax.block_until_ready(run_n(batch, it, strategy=strategy))
             best = min(best, time.perf_counter() - t0)
         return best
 
@@ -139,32 +146,63 @@ def bench_device(grid, batch):
     elif jax.default_backend() != "tpu":
         strategy = "auto"
     else:
-        quick_iters = 8
+        # probe by slope GAP (not one absolute loop time: the tunnel's fixed
+        # ~60ms dispatch RTT would swamp the difference between a 46us/window
+        # and a 1.2ms/window strategy), ESCALATING the count until the gap
+        # clears a 50ms floor — a fixed 100-window gap is ~4.6ms for the fast
+        # path, inside the RTT jitter, and a jitter-negative gap must rank
+        # the strategy as unmeasured-worst, never as best
+        def probe_per_window(s):
+            p_lo, p_hi = 2, 102
+            t_lo = timed(s, p_lo, reps=2)
+            while True:
+                gap = timed(s, p_hi, reps=2) - t_lo
+                if gap >= 0.05 or p_hi >= 20_000:
+                    break
+                p_hi = min(p_hi * 5, 20_000)
+            return gap / (p_hi - p_lo) if gap > 0 else float("inf")
+
         for s in TPU_CANDIDATES:
             try:
-                pick_info[s] = timed(s, quick_iters, reps=2)
+                pick_info[s] = probe_per_window(s)
             except Exception as e:  # a strategy failing must not kill the run
                 print(f"warning: strategy {s} failed quick probe: {e}",
                       file=sys.stderr)
-        if pick_info:
+        if pick_info and min(pick_info.values()) < float("inf"):
             strategy = min(pick_info, key=pick_info.get)
         else:  # every probe failed; don't let the pick kill the run
             strategy = "grouped"
             print("warning: all strategy probes failed; using 'grouped'",
                   file=sys.stderr)
-        print(f"# strategy pick (best of {quick_iters}-window loop, s): "
-              + ", ".join(f"{s}={t:.3f}" for s, t in pick_info.items())
+        print("# strategy pick (probed s/window): "
+              + ", ".join(f"{s}={t:.6f}" for s, t in pick_info.items())
               + f" -> {strategy}", file=sys.stderr)
 
     lo, hi = SLOPE_LO, SLOPE_HI
-    times = {iters: timed(strategy, iters) for iters in (lo, hi)}
-    per_window = (times[hi] - times[lo]) / (hi - lo)
+    t_lo = timed(strategy, lo)
+    while True:
+        t_hi = timed(strategy, hi)
+        gap = t_hi - t_lo
+        if gap >= SLOPE_MIN_GAP_S or hi >= SLOPE_MAX_HI:
+            break
+        hi = min(hi * 5, SLOPE_MAX_HI)
+    per_window = gap / (hi - lo)
     if per_window <= 0:
-        # timing noise swamped the slope; fall back to the conservative
-        # whole-loop average (includes fixed dispatch overhead) and say so.
+        # timing noise swamped the slope even at SLOPE_MAX_HI; fall back to
+        # the conservative whole-loop average (includes fixed dispatch
+        # overhead) and say so.
         print("warning: non-positive slope; reporting whole-loop average",
               file=sys.stderr)
-        per_window = times[hi] / hi
+        per_window = t_hi / hi
+    elif gap < SLOPE_MIN_GAP_S:
+        # positive but sub-threshold at the cap: still jitter-sized — a
+        # number this produces is NOT a clean measurement, say so loudly
+        print(f"warning: slope gap {gap * 1e3:.1f}ms at the {hi}-window cap "
+              f"is below the {SLOPE_MIN_GAP_S * 1e3:.0f}ms floor; headline "
+              "may be noise-dominated", file=sys.stderr)
+    else:
+        print(f"# slope window: {lo}->{hi}, gap {gap * 1e3:.1f}ms "
+              f"({per_window * 1e6:.1f}us/window)", file=sys.stderr)
 
     # p50 single-window latency: dispatch -> readback wall clock of one
     # window (what a realtime caller sees; the north-star's second metric)

@@ -21,8 +21,6 @@ from __future__ import annotations
 import json
 import os
 import sys
-import time
-from functools import partial
 
 import numpy as np
 
@@ -51,34 +49,23 @@ def main() -> int:
     qc = jnp.int32(grid.assign_cell(qx, qy)[0])
     layers = grid.candidate_layers(radius)
 
-    @partial(jax.jit, static_argnames=("iters",))
-    def run_n(b, *, iters):
+    @jax.jit
+    def run_n(b, iters):
         def body(i, acc):
             r = knn_point(b, qx + i * 1e-7, qy, qc, radius, layers,
                           n=grid.n, k=k, strategy=strategy)
             return acc + r.dist[0]
         return jax.lax.fori_loop(0, iters, body, jnp.float32(0))
 
-    times = {}
-    for iters in (2, 42):
-        jax.block_until_ready(run_n(batch, iters=iters))
-        best = float("inf")
-        for _ in range(3):
-            t0 = time.perf_counter()
-            jax.block_until_ready(run_n(batch, iters=iters))
-            best = min(best, time.perf_counter() - t0)
-        times[iters] = best
-    device_ms = max(times[42] - times[2], 0.0) / 40 * 1e3
+    # the escalating slope helper lives in bench_configs (same directory);
+    # run_n(b, iters) already matches its dynamic-iters contract
+    from bench_configs import _slope_time, _p50_latency_ms
+
+    device_ms = _slope_time(lambda it: run_n(batch, it), lo=2, hi=42) * 1e3
 
     win = jax.jit(lambda b: knn_point(b, qx, qy, qc, radius, layers,
                                       n=grid.n, k=k, strategy=strategy))
-    jax.block_until_ready(win(batch))
-    walls = []
-    for _ in range(11):
-        t0 = time.perf_counter()
-        jax.block_until_ready(win(batch))
-        walls.append((time.perf_counter() - t0) * 1000)
-    wall_ms = float(np.percentile(walls, 50))
+    wall_ms = _p50_latency_ms(lambda: win(batch), n=11)
 
     prof_dir = os.environ.get("SPATIALFLINK_PROFILE_DIR")
     if prof_dir:

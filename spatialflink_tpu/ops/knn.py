@@ -239,7 +239,12 @@ def topk_by_distance(obj_id, dist, eligible, k: int,
             # sort-based path by ~30-50x at 1M points
             strategy = "prefilter"
         else:
-            strategy = "grouped"
+            # measured on TPU v5e (benchmarks/sweep_knn.py, 1M pts, k=50):
+            # approx_min_k lowers to the PartialReduce op and runs the window
+            # at ~46us vs ~1.2ms for grouped/prefilter (top_k and sort both
+            # lower to bitonic networks there) — 21.5G pts/s, exact via the
+            # certificate + full-sort fallback
+            strategy = "approx_verified"
     if strategy == "grouped":
         return _topk_grouped(obj_id, dist, eligible, k, _DEFAULT_GROUPS)
     if strategy == "prefilter":
@@ -249,9 +254,11 @@ def topk_by_distance(obj_id, dist, eligible, k: int,
         return _topk_prefiltered(obj_id, dist, eligible, k, max(8 * k, 256))
     if strategy == "approx_verified":
         # m >> k keeps both the recall misses and the <k-distinct case rare,
-        # so the certificate almost never triggers the full-sort fallback
+        # so the certificate almost never triggers the full-sort fallback;
+        # cost is monotone in m on TPU (sweep: m=16k beats 32k beats 64k),
+        # so use the smallest m with comfortable distinct-object headroom
         return _topk_approx_verified(obj_id, dist, eligible, k,
-                                     max(32 * k, 1024))
+                                     max(16 * k, 512))
     if strategy == "approx":
         return _topk_approx(obj_id, dist, eligible, k, max(32 * k, 1024))
     if strategy != "sort":

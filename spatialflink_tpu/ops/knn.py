@@ -225,7 +225,8 @@ def topk_by_distance(obj_id, dist, eligible, k: int,
                      strategy: str = "auto") -> KnnResult:
     """Dedup by object id (keep min dist) then top-k smallest distances.
 
-    strategy: "auto" (grouped for large windows, full sort for small),
+    strategy: "auto" (full sort for small windows; for large ones the
+    measured per-backend winner — prefilter on CPU, approx_verified on TPU),
     "sort", "grouped", "prefilter", "approx_verified" (all exact), or
     "approx" (recall<1, approximate-mode only).
     """

@@ -504,6 +504,34 @@ class TestTopkStrategies:
         np.testing.assert_array_equal(np.asarray(got.dist),
                                       np.asarray(want.dist))
 
+    def test_auto_dispatches_partialreduce_path_on_tpu(self, monkeypatch):
+        # "auto" on TPU must route large windows to the approx_verified
+        # (PartialReduce) path — the sweep-measured winner (TPU_NOTES.md) —
+        # and the result must stay exact. Backend is monkeypatched; CPU's
+        # approx_min_k fallback keeps the kernel runnable here.
+        calls = []
+        orig = K._topk_approx_verified
+
+        def spy(*a, **kw):
+            calls.append(1)
+            return orig(*a, **kw)
+
+        monkeypatch.setattr(K, "_topk_approx_verified", spy)
+        monkeypatch.setattr(K.jax, "default_backend", lambda: "tpu")
+        n, k = K._GROUPED_MIN_N + 512, 50
+        rng = np.random.default_rng(11)
+        oid = rng.integers(0, n // 4, n).astype(np.int32)
+        d = rng.uniform(0, 1, n).astype(np.float32)
+        got = K.topk_by_distance(jnp.asarray(oid), jnp.asarray(d),
+                                 jnp.ones(n, bool), k, strategy="auto")
+        assert calls, "auto on TPU did not dispatch approx_verified"
+        want = K.topk_by_distance(jnp.asarray(oid), jnp.asarray(d),
+                                  jnp.ones(n, bool), k, strategy="sort")
+        np.testing.assert_array_equal(np.asarray(got.obj_id),
+                                      np.asarray(want.obj_id))
+        np.testing.assert_array_equal(np.asarray(got.dist),
+                                      np.asarray(want.dist))
+
     def test_unknown_strategy_raises(self):
         with pytest.raises(ValueError):
             K.topk_by_distance(jnp.zeros(8, jnp.int32), jnp.zeros(8),

@@ -13,7 +13,6 @@ from __future__ import annotations
 
 import os
 import sys
-import time
 
 import numpy as np
 
@@ -43,17 +42,20 @@ def main() -> int:
     qc = jnp.int32(grid.assign_cell(qx, qy)[0])
     layers = grid.candidate_layers(0.5)
 
-    # the slope gap must dwarf per-dispatch noise: over the axon tunnel a
-    # single dispatch→readback round trip is ~66ms with multi-ms jitter. The
-    # loop count is a DYNAMIC jit arg and the high count escalates ×5 until
-    # the timed gap clears 200ms — a fixed 40-window gap is ~2ms for the
-    # approx_min_k path, inside the jitter (it produced physically
-    # impossible rows on the first round-4 TPU pass). Override the start via
-    # SPATIALFLINK_SWEEP_ITERS=lo,hi.
+    # slope measurement is shared with bench_configs: dynamic loop-count jit
+    # arg + ×5 escalation until the gap clears the RTT-jitter floor (a fixed
+    # 40-window gap is ~2ms for the approx_min_k path — it produced
+    # physically impossible rows on the first round-4 TPU pass). Override
+    # the starting window via SPATIALFLINK_SWEEP_ITERS=lo,hi.
+    from bench_configs import _slope_time_ex
+
     lo, hi0 = (int(v) for v in os.environ.get(
         "SPATIALFLINK_SWEEP_ITERS", "2,42").split(","))
 
-    def slope_ms(select) -> float:
+    def slope_ms(select):
+        """-> (ms/window, ok); ok=False marks a row whose gap never cleared
+        the noise floor even at the cap — the table itself carries the flag
+        so redirected stdout can't record an impossible number unmarked."""
         @jax.jit
         def run_n(b, iters):
             def body(i, acc):
@@ -64,27 +66,8 @@ def main() -> int:
                 return acc + r.dist[0]
             return jax.lax.fori_loop(0, iters, body, jnp.float32(0))
 
-        def timed(iters):
-            it = jnp.int32(iters)
-            jax.block_until_ready(run_n(batch, it))
-            best = float("inf")
-            for _ in range(3):
-                t0 = time.perf_counter()
-                jax.block_until_ready(run_n(batch, it))
-                best = min(best, time.perf_counter() - t0)
-            return best
-
-        hi = hi0
-        t_lo = timed(lo)
-        while True:
-            gap = timed(hi) - t_lo
-            if gap >= 0.2 or hi >= 40_000:
-                break
-            hi = min(hi * 5, 40_000)
-        # ok=False marks a row whose gap never cleared the noise floor even
-        # at the cap — the table itself carries the flag so redirected
-        # stdout can't record an impossible number unmarked
-        return max(gap, 1e-9) / (hi - lo) * 1e3, gap >= 0.2
+        per, ok = _slope_time_ex(lambda it: run_n(batch, it), lo=lo, hi=hi0)
+        return per * 1e3, ok
 
     rows = [("sort", lambda o, d, e: Kn._topk_full_sort(o, d, e, k))]
     for g in (64, 128, 256, 512, 1024):

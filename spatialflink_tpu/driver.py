@@ -131,15 +131,22 @@ def decode_stream(records: Iterable, cfg: StreamConfig, grid: UniformGrid,
     """Raw lines/dicts → spatial objects; already-parsed objects pass through
     (the reference's per-case ``Deserialization.*Stream`` stage). Marks the
     ingest throughput meter and honors the control-tuple stop hook
-    (``HelperClass.checkExitControlTuple``)."""
+    (``HelperClass.checkExitControlTuple``).
+
+    Off-type records — e.g. a stray POINT row in a declared polygon stream,
+    which self-describing WKT/GeoJSON can produce — are DROPPED with a
+    counter (``off-type-dropped``) and a one-time warning rather than
+    crashing the pipeline in the operator's batcher: dead-lettering
+    malformed tuples is the streaming norm, and the typed operator pipelines
+    (like the reference's per-type streams) cannot batch them."""
     from spatialflink_tpu.utils.metrics import REGISTRY, metered
 
     meter = REGISTRY.meter("ingest-throughput")
+    dropped = REGISTRY.counter("off-type-dropped")
+    needs_edges = geometry in ("Polygon", "LineString")
+    warned = False
     for rec in metered(records, meter, control_check=True):
-        if isinstance(rec, SpatialObject):
-            yield rec
-            continue
-        yield parse_spatial(
+        obj = rec if isinstance(rec, SpatialObject) else parse_spatial(
             rec, cfg.format, grid,
             delimiter=cfg.delimiter,
             schema=cfg.csv_tsv_schema,
@@ -150,6 +157,17 @@ def decode_stream(records: Iterable, cfg: StreamConfig, grid: UniformGrid,
             # CSVTSVToSpatialPolygon); GeoJSON/WKT are self-describing
             geometry=geometry,
         )
+        off_type = ((needs_edges and not hasattr(obj, "edge_array"))
+                    or (geometry == "Point" and not hasattr(obj, "x")))
+        if off_type:
+            dropped.inc()
+            if not warned:
+                print(f"warning: dropping off-type {type(obj).__name__} "
+                      f"record(s) from declared {geometry} stream "
+                      "(counter: off-type-dropped)", file=sys.stderr)
+                warned = True
+            continue
+        yield obj
 
 
 def _query_conf(params: Params, spec: CaseSpec) -> QueryConfiguration:
@@ -492,13 +510,22 @@ def run_option_bulk(params: Params, input_path: str,
 
 def _bulk_parse_geom_stream(params: Params, input_path: str):
     """Native WKT geometry ingest + the same vectorized watermark dropping
-    as the point path (ParsedGeoms carries its own subset machinery)."""
+    as the point path (ParsedGeoms carries its own subset machinery).
+    Returns None — honoring run_option_bulk's fall-back-to-record-path
+    contract — when the file holds geometry the bulk path can't ride
+    (e.g. a stray POINT or GEOMETRYCOLLECTION row in a polygon stream)."""
     from spatialflink_tpu.runtime.watermarks import BoundedOutOfOrderness
     from spatialflink_tpu.streams.bulk import bulk_parse_geom_file
 
     cfg = params.input1
-    parsed = bulk_parse_geom_file(input_path, "WKT", delimiter=cfg.delimiter,
-                                  date_format=cfg.date_format)
+    try:
+        parsed = bulk_parse_geom_file(input_path, "WKT",
+                                      delimiter=cfg.delimiter,
+                                      date_format=cfg.date_format)
+    except ValueError as e:
+        print(f"# --bulk: geometry file not bulk-ingestible ({e}); "
+              "using the record path", file=sys.stderr)
+        return None
     keep = BoundedOutOfOrderness.bulk_keep_mask(
         parsed.ts, params.query.allowed_lateness_s * 1000)
     if not keep.all():

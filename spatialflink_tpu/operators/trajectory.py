@@ -456,9 +456,16 @@ class PointTAggregateQuery(SpatialOperator):
             if checkpoint_path and n_batches % max(1, checkpoint_every) == 0:
                 self._save_checkpoint(store, checkpoint_path, consumed)
             heatmap = store.aggregate(agg, self.grid.num_cells)
+            extras = {"heatmap": heatmap, "aggregate": agg}
+            if agg == "ALL":
+                # the realtime heatmap form has no per-(cell, objID) record
+                # shape, so ALL is served as per-cell SUM — flag the
+                # substitution instead of silently relabeling (windowed mode
+                # returns true per-pair records for ALL)
+                extras["heatmap_semantics"] = "SUM"
             yield WindowResult(
                 records[0].timestamp, records[-1].timestamp, [],
-                extras={"heatmap": heatmap},
+                extras=extras,
             )
         if checkpoint_path and n_batches:
             self._save_checkpoint(store, checkpoint_path, consumed)

@@ -31,7 +31,6 @@ functions are elementwise over leading dims and safe under jit/vmap.
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 

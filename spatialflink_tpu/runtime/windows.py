@@ -14,7 +14,7 @@ per-cell window operators (the device half is in spatialflink_tpu.ops).
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Callable, Dict, Iterator, List, Optional, Tuple
+from typing import Dict, Iterator, List, Optional, Tuple
 
 from spatialflink_tpu.runtime.watermarks import BoundedOutOfOrderness
 

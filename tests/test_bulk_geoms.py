@@ -169,6 +169,36 @@ class TestDriverGeomBulk:
         assert "not applicable" not in out.err
         assert out.out.strip()
 
+    def test_driver_bulk_mixed_geometry_falls_back_to_record_path(
+            self, tmp_path, capsys):
+        # a stray POINT row in a polygon WKT stream is not bulk-ingestible;
+        # run_option_bulk's contract is fall-back-to-record-path, not an
+        # uncaught ValueError mid-ingest
+        from spatialflink_tpu.driver import main
+
+        lines = _lines(20, seed=7, t_step=400)
+        lines.insert(3, f"p99, {T0 + 2}, POINT (5 5)")
+        f = tmp_path / "mixed.wkt"
+        f.write_text("\n".join(lines))
+        import yaml
+
+        with open("conf/spatialflink-conf.yml") as fh:
+            y = yaml.safe_load(fh)
+        y["inputStream1"]["gridBBox"] = [0.0, 0.0, 10.0, 10.0]
+        y["inputStream2"]["gridBBox"] = [0.0, 0.0, 10.0, 10.0]
+        y["query"]["option"] = 21
+        y["query"]["radius"] = 1.0
+        y["query"]["queryPolygons"] = [[[3, 3], [7, 3], [7, 7], [3, 7]]]
+        y["inputStream1"]["format"] = "WKT"
+        y["inputStream1"]["dateFormat"] = None
+        cfgf = tmp_path / "conf.yml"
+        cfgf.write_text(yaml.safe_dump(y))
+        rc = main(["--config", str(cfgf), "--input1", str(f), "--bulk"])
+        assert rc == 0
+        out = capsys.readouterr()
+        assert "not bulk-ingestible" in out.err
+        assert out.out.strip()
+
 
 class TestGeomKnnBulk:
     def test_geom_knn_run_bulk_matches_record_path(self):

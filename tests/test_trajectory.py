@@ -201,6 +201,25 @@ class TestTAggregate:
         cell_a, _ = GRID.assign_cell(116.0, 40.0)
         assert hm[int(cell_a)] == 0  # trajectory a evicted after 60s gap
 
+    def test_realtime_all_flags_sum_substitution(self):
+        # the realtime heatmap form can't carry ALL's per-(cell, objID)
+        # records, so ALL is served as SUM — the result must SAY so rather
+        # than silently relabeling (windowed ALL returns true records)
+        pts = [Point.create(116.0, 40.0, GRID, "a", BASE),
+               Point.create(116.0, 40.0, GRID, "a", BASE + 1000)]
+        op = PointTAggregateQuery(realtime_conf(realtime_batch_size=2), GRID)
+        res = list(op.run(iter(pts), "ALL"))[-1]
+        assert res.extras["aggregate"] == "ALL"
+        assert res.extras["heatmap_semantics"] == "SUM"
+        sum_res = list(PointTAggregateQuery(
+            realtime_conf(realtime_batch_size=2), GRID).run(
+                iter([Point.create(116.0, 40.0, GRID, "a", BASE),
+                      Point.create(116.0, 40.0, GRID, "a", BASE + 1000)]),
+                "SUM"))[-1]
+        np.testing.assert_array_equal(res.extras["heatmap"],
+                                      sum_res.extras["heatmap"])
+        assert "heatmap_semantics" not in sum_res.extras
+
 
 class TestTAggregateCheckpointResume:
     """Kill/resume must preserve the realtime heatmap: the (cell, objID)
@@ -571,7 +590,6 @@ class TestTStatsCheckpointResume:
         """End-to-end: driver --checkpoint resume over the SAME input file
         must not re-apply already-checkpointed records — the run equals one
         uninterrupted pass, not pass + replayed prefix."""
-        import json
 
         from spatialflink_tpu.driver import main as cli_main
 

@@ -284,22 +284,30 @@ def main():
     device_tput, p50_ms, strategy, _pick = bench_device(grid, batch)
     cpu_tput = bench_cpu_numpy(grid, xs, ys, oid)
 
-    print(
-        json.dumps(
-            {
-                "metric": "knn_k50_1M_window_points_per_sec_per_chip",
-                "value": round(device_tput),
-                "unit": "points/s",
-                "vs_baseline": round(device_tput / cpu_tput, 2),
-                # The north-star target (BASELINE.md) is a TPU number; a CPU
-                # fallback is reported, but flagged invalid for that target.
-                "backend": backend,
-                "valid_for_target": backend == "tpu",
-                "p50_window_latency_ms": round(p50_ms, 3),
-                "strategy": strategy,
-            }
-        )
-    )
+    row = {
+        "metric": "knn_k50_1M_window_points_per_sec_per_chip",
+        "value": round(device_tput),
+        "unit": "points/s",
+        "vs_baseline": round(device_tput / cpu_tput, 2),
+        # The north-star target (BASELINE.md) is a TPU number; a CPU
+        # fallback is reported, but flagged invalid for that target.
+        "backend": backend,
+        "valid_for_target": backend == "tpu",
+        "p50_window_latency_ms": round(p50_ms, 3),
+        "strategy": strategy,
+    }
+    if backend != "tpu":
+        # the tunnel wedges for hours; if a real-TPU measurement was banked
+        # earlier (committed with full provenance), attach it — clearly
+        # labeled — so a CPU-fallback run doesn't erase the valid number
+        banked = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "benchmarks", "BENCH_tpu_r04_interactive.json")
+        try:
+            with open(banked) as f:
+                row["banked_tpu_run"] = json.load(f)
+        except OSError:
+            pass
+    print(json.dumps(row))
 
 
 if __name__ == "__main__":

@@ -305,8 +305,8 @@ def main():
         try:
             with open(banked) as f:
                 row["banked_tpu_run"] = json.load(f)
-        except OSError:
-            pass
+        except (OSError, ValueError):  # missing or corrupted artifact must
+            pass                       # not cost the one-JSON-line contract
     print(json.dumps(row))
 
 

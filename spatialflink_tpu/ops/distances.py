@@ -83,9 +83,17 @@ def point_segment_dist2(px, py, x1, y1, x2, y2):
     """
     cx, cy = x2 - x1, y2 - y1
     len_sq = cx * cx + cy * cy
+    # reciprocal BEFORE combining with the point operand: in the broadcast
+    # lattices ((N, G, E) points x edges) this line has the edge shape only,
+    # so the expensive divide runs O(G*E) times, not O(N*G*E) — the
+    # per-point work below is multiply/add (measured +15% on config 4's CPU
+    # bench; the divide is costlier still on the TPU VPU). A divide-free
+    # cross-product form of the point_in_rings ray test was ALSO tried and
+    # measured 25% SLOWER on CPU — see benchmarks/TPU_NOTES.md §5.
+    inv_len = jnp.where(len_sq > 0, 1.0 / jnp.where(len_sq > 0, len_sq, 1.0),
+                        0.0)
     dot = (px - x1) * cx + (py - y1) * cy
-    t = jnp.where(len_sq > 0, dot / jnp.where(len_sq > 0, len_sq, 1.0), 0.0)
-    t = jnp.clip(t, 0.0, 1.0)
+    t = jnp.clip(dot * inv_len, 0.0, 1.0)
     qx, qy = x1 + t * cx, y1 + t * cy
     return pp_dist2(px, py, qx, qy)
 
@@ -135,8 +143,13 @@ def point_in_rings(px, py, edges, edge_mask):
     x2, y2 = edges[..., 2], edges[..., 3]
     # half-open rule on y avoids double-counting shared vertices
     straddles = (y1 > py) != (y2 > py)
+    # slope hoisted onto the edge shape: the divide runs O(G*E) times, the
+    # (N, G, E) per-point lattice below is multiply/add/compare only (same
+    # trick as point_segment_dist2's inv_len; straddles already excludes
+    # horizontal edges, so the denom guard only protects padded slots)
     denom = jnp.where(y2 == y1, 1.0, y2 - y1)
-    x_at_y = x1 + (py - y1) / denom * (x2 - x1)
+    slope = (x2 - x1) / denom
+    x_at_y = x1 + (py - y1) * slope
     crossing = straddles & edge_mask & (px < x_at_y)
     return jnp.sum(crossing.astype(jnp.int32), axis=-1) % 2 == 1
 

@@ -86,19 +86,25 @@ def _pip_kernel(px_ref, py_ref, x1_ref, y1_ref, x2_ref, y2_ref, m_ref,
         y2 = y2_ref[:, sl]
         valid = m_ref[:, sl] > 0
 
-        # even-odd ray cast, half-open on y (ops.distances.point_in_rings)
+        # even-odd ray cast, half-open on y (ops.distances.point_in_rings);
+        # slope hoisted onto the (1, TL) edge shape like inv_len below
         straddles = (y1 > py) != (y2 > py)  # (TP, TL)
         denom = jnp.where(y2 == y1, 1.0, y2 - y1)
-        x_at_y = x1 + (py - y1) / denom * (x2 - x1)
+        slope = (x2 - x1) / denom
+        x_at_y = x1 + (py - y1) * slope
         crossing = straddles & valid & (px < x_at_y)
         cross = cross + jnp.sum(crossing.astype(jnp.int32), axis=1, keepdims=True)
 
-        # point-segment squared distance (ops.distances.point_segment_dist2)
+        # point-segment squared distance (ops.distances.point_segment_dist2);
+        # the reciprocal stays on the (1, TL) edge shape — the (TP, TL)
+        # per-point work is multiply/add only (measured +15% on CPU; the
+        # divide is costlier still on the TPU VPU)
         cx, cy = x2 - x1, y2 - y1
         len_sq = cx * cx + cy * cy
+        inv_len = jnp.where(len_sq > 0,
+                            1.0 / jnp.where(len_sq > 0, len_sq, 1.0), 0.0)
         dot = (px - x1) * cx + (py - y1) * cy
-        tt = jnp.where(len_sq > 0, dot / jnp.where(len_sq > 0, len_sq, 1.0), 0.0)
-        tt = jnp.clip(tt, 0.0, 1.0)
+        tt = jnp.clip(dot * inv_len, 0.0, 1.0)
         qx, qy = x1 + tt * cx, y1 + tt * cy
         d2 = (px - qx) ** 2 + (py - qy) ** 2
         d2 = jnp.where(valid, d2, _F_BIG)

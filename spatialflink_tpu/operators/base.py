@@ -16,7 +16,9 @@ Reference parity:
 from __future__ import annotations
 
 import enum
+import sys
 from collections import deque
+import dataclasses
 from dataclasses import dataclass, field
 from typing import Any, Callable, Iterable, Iterator, List, Optional, Tuple
 
@@ -108,7 +110,10 @@ class SpatialOperator:
         if conf.devices and (conf.devices & (conf.devices - 1)):
             raise ValueError(
                 f"conf.devices={conf.devices}: must be a power of two")
-        self.conf = conf
+        # own copy: degraded mode mutates conf.devices, and a caller-shared
+        # config must not silently degrade sibling operators (their cached
+        # meshes would go stale against the mutated width)
+        self.conf = dataclasses.replace(conf)
         self.grid = grid
         self.grid2 = grid2 or grid
         self.interner = IdInterner()
@@ -132,6 +137,49 @@ class SpatialOperator:
         from spatialflink_tpu.parallel.mesh import shard_batch
 
         return shard_batch(batch, self._mesh())
+
+    def _degrade_mesh(self, err: BaseException) -> None:
+        """Elastic degraded mode (SURVEY §7 phase 7): a device failure during
+        a distributed window halves the mesh (keeping the power-of-two
+        invariant — any smaller power of two still divides the bucketed
+        batch capacities) and the window is re-dispatched. Host-side state
+        (window assembler, trajectory maps, checkpoints) is untouched, so
+        degradation is purely a dispatch concern; at devices=1 the operator
+        continues on the single-device path. The reference inherits its
+        equivalent (restart from checkpoint on a task-manager loss) from
+        Flink; here a recompile at the new shard count is the only cost."""
+        from spatialflink_tpu.utils.metrics import REGISTRY
+
+        new = max(1, (self.conf.devices or 1) // 2)
+        print(f"warning: device failure during distributed window "
+              f"({type(err).__name__}: {str(err)[:200]}); degrading mesh "
+              f"{self.conf.devices} -> {new}", file=sys.stderr)
+        REGISTRY.counter("mesh-degradations").inc()
+        self.conf.devices = new
+        self._mesh_obj = None
+
+    def _eval_degradable(self, single_fn, dist_fn):
+        """Run ``dist_fn(mesh)`` with elastic retry, falling back to
+        ``single_fn()`` once the mesh is degraded to one device.
+
+        Catches ``RuntimeError`` (``XlaRuntimeError``'s base — device loss,
+        transfer failures) raised at DISPATCH time. LIMITATION: with async
+        dispatch (``pipeline_depth >= 2``) a failure can instead surface at
+        the deferred readback, after this frame has returned — there it
+        PROPAGATES to the caller (no automatic retry; the window's inputs
+        are gone by then). Recovery for that case is the framework's normal
+        resume story: stateful operators restart from their checkpoint
+        (driver ``--checkpoint``/``--resume``), stateless window pipelines
+        re-run over the replayable source. Non-device exceptions (shape/
+        type bugs) propagate unchanged — and a genuine kernel bug re-raises
+        from the single-device path after the mesh has drained, so
+        degradation cannot mask it."""
+        while self.distributed:
+            try:
+                return dist_fn(self._mesh())
+            except RuntimeError as e:
+                self._degrade_mesh(e)
+        return single_fn()
 
     # ---------------------------------------------------------------- #
 
@@ -190,10 +238,13 @@ class SpatialOperator:
         — the mesh dispatch every reference pipeline gets from
         ``env.setParallelism(30)`` (``StreamingJob.java:221``)."""
         if self.distributed:
+            from spatialflink_tpu.parallel.mesh import shard_batch
             from spatialflink_tpu.parallel.ops import distributed_stream_filter
 
-            return distributed_stream_filter(
-                self._mesh(), self._shard(batch), mask_stats_fn)
+            return self._eval_degradable(
+                lambda: mask_stats_fn(batch),
+                lambda mesh: distributed_stream_filter(
+                    mesh, shard_batch(batch, mesh), mask_stats_fn))
         return mask_stats_fn(batch)
 
     @staticmethod

@@ -255,6 +255,7 @@ class PointPointJoinQuery(SpatialOperator):
         if self.distributed:
             import numpy as np
 
+            from spatialflink_tpu.parallel.mesh import shard_batch
             from spatialflink_tpu.parallel.ops import distributed_join_mask
 
             if nb_layers is None:
@@ -262,13 +263,16 @@ class PointPointJoinQuery(SpatialOperator):
                              else self.grid.candidate_layers(radius))
             cx = self.grid.min_x + self.grid.cell_length * self.grid.n / 2
             cy = self.grid.min_y + self.grid.cell_length * self.grid.n / 2
-            m = distributed_join_mask(
-                self._mesh(), self._shard(batch_a), batch_b, radius,
-                nb_layers, cx, cy, n=self.grid.n)
-            ai, bi = np.nonzero(np.asarray(m))
-            if ai.size:
-                yield ai, bi
-            return
+            m = self._eval_degradable(
+                lambda: None,  # sentinel: single-device path yields below
+                lambda mesh: distributed_join_mask(
+                    mesh, shard_batch(batch_a, mesh), batch_b, radius,
+                    nb_layers, cx, cy, n=self.grid.n))
+            if m is not None:
+                ai, bi = np.nonzero(np.asarray(m))
+                if ai.size:
+                    yield ai, bi
+                return
         yield from join_pairs_host(batch_a, batch_b, radius, self.grid,
                                    nb_layers=nb_layers)
 
@@ -311,13 +315,16 @@ class _GenericStreamJoin(PointPointJoinQuery):
         if self.distributed:
             # broadcast-join layout for the geometry pairs too: a sharded on
             # the mesh, query side replicated, same lattice kernel per shard
+            from spatialflink_tpu.parallel.mesh import shard_batch
             from spatialflink_tpu.parallel.ops import (
                 distributed_stream_join_lattice,
             )
 
-            m_dev = distributed_stream_join_lattice(
-                self._mesh(), self._shard(batch_a), batch_b,
-                lambda a_s, b_r: self._lattice(a_s, b_r, radius))
+            m_dev = self._eval_degradable(
+                lambda: self._lattice(batch_a, batch_b, radius),
+                lambda mesh: distributed_stream_join_lattice(
+                    mesh, shard_batch(batch_a, mesh), batch_b,
+                    lambda a_s, b_r: self._lattice(a_s, b_r, radius)))
         else:
             m_dev = self._lattice(batch_a, batch_b, radius)
 

@@ -58,31 +58,24 @@ class PointPointKNNQuery(SpatialOperator):
         nb_layers = (
             self.grid.n if radius == 0 else self.grid.candidate_layers(radius)
         )
+        def local(b):
+            # ONE closure for both paths: the module-jitted kernel runs on
+            # the whole batch single-device and per shard distributed —
+            # identical fusion, bit-for-bit 8-dev ≡ 1-dev
+            return knn_point_stats(
+                b, query_point.x, query_point.y,
+                jnp.int32(query_point.cell), radius, nb_layers,
+                n=self.grid.n, k=k, strategy=self._knn_strategy())
+
         if self.distributed:
+            from spatialflink_tpu.parallel.mesh import shard_batch
             from spatialflink_tpu.parallel.ops import distributed_stream_knn
 
-            def local(b):
-                # the SAME module-jitted kernel as the single-device branch,
-                # per shard — identical fusion, bit-for-bit 8-dev ≡ 1-dev
-                return knn_point_stats(
-                    b, query_point.x, query_point.y,
-                    jnp.int32(query_point.cell), radius, nb_layers,
-                    n=self.grid.n, k=k, strategy=self._knn_strategy())
-
-            return distributed_stream_knn(
-                self._mesh(), self._shard(batch), k=k,
-                strategy=self._knn_strategy(), local_fn=local)
-        return knn_point_stats(
-            batch,
-            query_point.x,
-            query_point.y,
-            jnp.int32(query_point.cell),
-            radius,
-            nb_layers,
-            n=self.grid.n,
-            k=k,
-            strategy=self._knn_strategy(),
-        )
+            return self._eval_degradable(lambda: local(batch), lambda mesh: (
+                distributed_stream_knn(
+                    mesh, shard_batch(batch, mesh), k=k,
+                    strategy=self._knn_strategy(), local_fn=local)))
+        return local(batch)
 
     def run_bulk(self, parsed, query_point: Point, radius: float,
                  k: Optional[int] = None, *, pad: Optional[int] = None
@@ -122,17 +115,22 @@ class _GenericKnn(SpatialOperator, GeomQueryMixin):
         evaluation body shared by run() and run_bulk(): distributed runs
         the same closure per shard, single-device goes through the
         module-jitted knn_eligible_stats."""
+        def single():
+            from spatialflink_tpu.ops.knn import knn_eligible_stats
+
+            eligible, dists = elig_dists(batch)
+            return knn_eligible_stats(batch.obj_id, dists, eligible, k=k,
+                                      strategy=self._knn_strategy())
+
         if self.distributed:
+            from spatialflink_tpu.parallel.mesh import shard_batch
             from spatialflink_tpu.parallel.ops import distributed_stream_knn
 
-            return distributed_stream_knn(
-                self._mesh(), self._shard(batch), elig_dists, k=k,
-                strategy=self._knn_strategy())
-        from spatialflink_tpu.ops.knn import knn_eligible_stats
-
-        eligible, dists = elig_dists(batch)
-        return knn_eligible_stats(batch.obj_id, dists, eligible, k=k,
-                                  strategy=self._knn_strategy())
+            return self._eval_degradable(single, lambda mesh: (
+                distributed_stream_knn(
+                    mesh, shard_batch(batch, mesh), elig_dists, k=k,
+                    strategy=self._knn_strategy())))
+        return single()
 
     def run(self, stream, query, radius: float, k: Optional[int] = None
             ) -> Iterator[WindowResult]:

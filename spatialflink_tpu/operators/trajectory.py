@@ -769,23 +769,29 @@ class PointPointTKNNQuery(SpatialOperator):
             if not records:
                 return []
             batch = self._point_batch(records, ts_base)
-            if self.distributed:
-                # sharded per-device top-k + gather re-merge, same kernel
-                # per shard (enforce_radius threads through)
-                from spatialflink_tpu.parallel.ops import distributed_knn
 
-                res = distributed_knn(
-                    self._mesh(), self._shard(batch),
-                    query_point.x, query_point.y,
-                    jnp.int32(query_point.cell), radius, nb_layers,
-                    n=self.grid.n, k=k, enforce_radius=radius > 0,
-                )
-            else:
-                res = knn_point(
+            def single():
+                return knn_point(
                     batch, query_point.x, query_point.y,
                     jnp.int32(query_point.cell), radius, nb_layers,
                     n=self.grid.n, k=k, enforce_radius=radius > 0,
                 )
+
+            if self.distributed:
+                # sharded per-device top-k + gather re-merge, same kernel
+                # per shard (enforce_radius threads through)
+                from spatialflink_tpu.parallel.mesh import shard_batch
+                from spatialflink_tpu.parallel.ops import distributed_knn
+
+                res = self._eval_degradable(single, lambda mesh: (
+                    distributed_knn(
+                        mesh, shard_batch(batch, mesh),
+                        query_point.x, query_point.y,
+                        jnp.int32(query_point.cell), radius, nb_layers,
+                        n=self.grid.n, k=k, enforce_radius=radius > 0,
+                    )))
+            else:
+                res = single()
             valid = np.asarray(res.valid)
             oids = [self.interner.lookup(int(o))
                     for o in np.asarray(res.obj_id)[valid]]

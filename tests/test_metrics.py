@@ -116,6 +116,37 @@ class TestOperatorMetrics:
         assert REGISTRY.counter("records-evaluated").count > before
 
 
+class TestOffTypeDropping:
+    """decode_stream dead-letters records whose parsed type can't ride the
+    declared stream's operator pipeline, counting them (off-type-dropped)
+    instead of crashing the batcher."""
+
+    def _decode(self, lines, geometry):
+        from spatialflink_tpu.config import StreamConfig
+        from spatialflink_tpu.driver import decode_stream
+        from spatialflink_tpu.index import UniformGrid
+
+        grid = UniformGrid(0.0, 10.0, 0.0, 10.0, num_grid_partitions=10)
+        cfg = StreamConfig(format="WKT")
+        return list(decode_stream(iter(lines), cfg, grid, geometry))
+
+    def test_point_in_polygon_stream_dropped_and_counted(self):
+        before = REGISTRY.counter("off-type-dropped").count
+        out = self._decode(
+            ["POLYGON ((1 1, 2 1, 2 2, 1 2, 1 1))", "POINT (5 5)"],
+            "Polygon")
+        assert len(out) == 1 and hasattr(out[0], "edge_array")
+        assert REGISTRY.counter("off-type-dropped").count == before + 1
+
+    def test_polygon_in_point_stream_dropped_and_counted(self):
+        before = REGISTRY.counter("off-type-dropped").count
+        out = self._decode(
+            ["POINT (5 5)", "POLYGON ((1 1, 2 1, 2 2, 1 2, 1 1))"],
+            "Point")
+        assert len(out) == 1 and hasattr(out[0], "x")
+        assert REGISTRY.counter("off-type-dropped").count == before + 1
+
+
 class TestPruningCounters:
     """Distance-computation / GN-bypass counters (pruning effectiveness,
     ``spatialObjects/Point.java:220-235``)."""

@@ -613,3 +613,95 @@ class TestRealtimeDistributedDispatch:
         assert len(r1) == len(r8) and any(w.records for w in r1)
         for a, b in zip(r1, r8):
             assert a.records == b.records
+
+
+class TestElasticDegradedMode:
+    """SURVEY §7 phase 7's elastic/degraded-mode story: a device failure
+    during a distributed window halves the mesh and re-dispatches; at one
+    device the single-device path takes over. Output must stay identical to
+    an undisturbed single-device run; host state is untouched."""
+
+    def _points(self, n, seed):
+        from spatialflink_tpu.models import Point
+
+        rng = np.random.default_rng(seed)
+        t0 = 1_700_000_000_000
+        return [
+            Point.create(float(rng.uniform(115.6, 117.5)),
+                         float(rng.uniform(39.7, 41.0)), GRID,
+                         obj_id=f"o{i % 53}", timestamp=t0 + i * 10)
+            for i in range(n)
+        ]
+
+    def _conf(self, devices=None):
+        from spatialflink_tpu.operators import QueryConfiguration, QueryType
+
+        return QueryConfiguration(QueryType.WindowBased, window_size_ms=10_000,
+                                  slide_ms=5_000, devices=devices)
+
+    def test_range_degrades_and_matches(self, monkeypatch):
+        from spatialflink_tpu.models import Point
+        from spatialflink_tpu.operators import PointPointRangeQuery
+        from spatialflink_tpu.parallel import ops as pops
+        from spatialflink_tpu.utils.metrics import REGISTRY
+
+        pts = self._points(2000, 61)
+        q = Point.create(QX, QY, GRID)
+        r1 = list(PointPointRangeQuery(self._conf(), GRID).run(
+            iter(pts), q, 0.4))
+
+        real = pops.distributed_stream_filter
+        failures = {"left": 2}
+
+        def flaky(mesh, batch, fn):
+            if failures["left"] > 0:
+                failures["left"] -= 1
+                raise RuntimeError("injected device loss (test)")
+            return real(mesh, batch, fn)
+
+        monkeypatch.setattr(pops, "distributed_stream_filter", flaky)
+        before = REGISTRY.counter("mesh-degradations").count
+        op = PointPointRangeQuery(self._conf(8), GRID)
+        r8 = list(op.run(iter(pts), q, 0.4))
+        assert REGISTRY.counter("mesh-degradations").count == before + 2
+        assert op.conf.devices == 2  # 8 -> 4 -> 2, success at 2
+        assert [w.window_start for w in r1] == [w.window_start for w in r8]
+        for a, b in zip(r1, r8):
+            assert [(p.obj_id, p.timestamp) for p in a.records] == \
+                   [(p.obj_id, p.timestamp) for p in b.records]
+
+    def test_knn_degrades_to_single_device(self, monkeypatch):
+        from spatialflink_tpu.models import Point
+        from spatialflink_tpu.operators import PointPointKNNQuery
+        from spatialflink_tpu.parallel import ops as pops
+
+        pts = self._points(2000, 62)
+        q = Point.create(QX, QY, GRID)
+        r1 = list(PointPointKNNQuery(self._conf(), GRID).run(
+            iter(pts), q, 0.5, 15))
+
+        def always_fail(*a, **kw):
+            raise RuntimeError("injected device loss (test)")
+
+        monkeypatch.setattr(pops, "distributed_stream_knn", always_fail)
+        op = PointPointKNNQuery(self._conf(8), GRID)
+        r8 = list(op.run(iter(pts), q, 0.5, 15))
+        assert op.conf.devices == 1 and not op.distributed
+        assert len(r1) == len(r8) and any(w.records for w in r1)
+        for a, b in zip(r1, r8):
+            assert a.records == b.records
+
+    def test_non_device_errors_propagate(self, monkeypatch):
+        from spatialflink_tpu.models import Point
+        from spatialflink_tpu.operators import PointPointRangeQuery
+        from spatialflink_tpu.parallel import ops as pops
+
+        def type_bug(*a, **kw):
+            raise TypeError("shape bug (test)")
+
+        monkeypatch.setattr(pops, "distributed_stream_filter", type_bug)
+        pts = self._points(600, 63)
+        q = Point.create(QX, QY, GRID)
+        op = PointPointRangeQuery(self._conf(8), GRID)
+        with pytest.raises(TypeError):
+            list(op.run(iter(pts), q, 0.4))

@@ -88,6 +88,14 @@ class StreamConfig:
     delimiter: str = ","
     charset: str = "UTF-8"
 
+    def geojson_kwargs(self) -> dict:
+        """GeoJSON parser kwargs — the single source shared by the record
+        path (driver.decode_stream) and both bulk ingest paths, so a
+        renamed/added attribute cannot let them diverge."""
+        return {"property_obj_id": self.geojson_obj_id_attr,
+                "property_timestamp": self.geojson_timestamp_attr,
+                "date_format": self.date_format}
+
     @classmethod
     def from_dict(cls, d: Dict[str, Any], where: str) -> "StreamConfig":
         fmt = str(_req(d, "format", where))

@@ -150,12 +150,10 @@ def decode_stream(records: Iterable, cfg: StreamConfig, grid: UniformGrid,
             rec, cfg.format, grid,
             delimiter=cfg.delimiter,
             schema=cfg.csv_tsv_schema,
-            date_format=cfg.date_format,
-            property_obj_id=cfg.geojson_obj_id_attr,
-            property_timestamp=cfg.geojson_timestamp_attr,
             # only CSV/TSV needs the hint (coordinate-string rows,
             # CSVTSVToSpatialPolygon); GeoJSON/WKT are self-describing
             geometry=geometry,
+            **cfg.geojson_kwargs(),
         )
         off_type = ((needs_edges and not hasattr(obj, "edge_array"))
                     or (geometry == "Point" and not hasattr(obj, "x")))
@@ -442,10 +440,7 @@ def _bulk_parse_stream(cfg: StreamConfig, input_path: str,
             input_path, fmt, delimiter=delim, schema=schema[:4],
             date_format=cfg.date_format)
     else:
-        parsed = bulk_parse_file(
-            input_path, fmt, property_obj_id=cfg.geojson_obj_id_attr,
-            property_timestamp=cfg.geojson_timestamp_attr,
-            date_format=cfg.date_format)
+        parsed = bulk_parse_file(input_path, fmt, **cfg.geojson_kwargs())
     # reproduce the record path's watermark dropping (same keep/late rule,
     # computed in one vectorized pass over the timestamp array)
     keep = BoundedOutOfOrderness.bulk_keep_mask(
@@ -469,9 +464,10 @@ def run_option_bulk(params: Params, input_path: str,
         return None
     geom_stream = spec.stream in ("Polygon", "LineString")
     if geom_stream:
-        # geometry STREAMS ride the bulk path for range/kNN over WKT files
+        # geometry STREAMS ride the bulk path for range/kNN over WKT or
+        # GeoJSON files
         if (spec.family not in ("range", "knn")
-                or params.input1.format.lower() != "wkt"):
+                or params.input1.format.lower() not in ("wkt", "geojson")):
             return None
         parsed = _bulk_parse_geom_stream(params, input_path)
     else:
@@ -509,19 +505,22 @@ def run_option_bulk(params: Params, input_path: str,
 
 
 def _bulk_parse_geom_stream(params: Params, input_path: str):
-    """Native WKT geometry ingest + the same vectorized watermark dropping
-    as the point path (ParsedGeoms carries its own subset machinery).
-    Returns None — honoring run_option_bulk's fall-back-to-record-path
-    contract — when the file holds geometry the bulk path can't ride
-    (e.g. a stray POINT or GEOMETRYCOLLECTION row in a polygon stream)."""
+    """Native WKT/GeoJSON geometry ingest + the same vectorized watermark
+    dropping as the point path (ParsedGeoms carries its own subset
+    machinery). Returns None — honoring run_option_bulk's
+    fall-back-to-record-path contract — when the file holds geometry the
+    bulk path can't ride (e.g. a stray POINT or GEOMETRYCOLLECTION row in
+    a polygon stream)."""
     from spatialflink_tpu.runtime.watermarks import BoundedOutOfOrderness
     from spatialflink_tpu.streams.bulk import bulk_parse_geom_file
 
     cfg = params.input1
+    if cfg.format.lower() == "wkt":
+        kw = dict(delimiter=cfg.delimiter, date_format=cfg.date_format)
+    else:
+        kw = cfg.geojson_kwargs()
     try:
-        parsed = bulk_parse_geom_file(input_path, "WKT",
-                                      delimiter=cfg.delimiter,
-                                      date_format=cfg.date_format)
+        parsed = bulk_parse_geom_file(input_path, cfg.format, **kw)
     except ValueError as e:
         print(f"# --bulk: geometry file not bulk-ingestible ({e}); "
               "using the record path", file=sys.stderr)

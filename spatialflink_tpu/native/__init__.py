@@ -80,6 +80,17 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         _c_double_p, _c_double_p,
         _c_int64_p, _c_long_p,
     ]
+    lib.sf_parse_geojson_geoms.restype = ctypes.c_long
+    lib.sf_parse_geojson_geoms.argtypes = [
+        ctypes.c_char_p, ctypes.c_long,
+        ctypes.c_char_p, ctypes.c_char_p,
+        _c_int64_p, _c_uint64_p, _c_int64_p, _c_int32_p,
+        ctypes.POINTER(ctypes.c_int8),
+        _c_int64_p, _c_int32_p, _c_double_p,
+        _c_int64_p, _c_int32_p,
+        _c_double_p, _c_double_p,
+        _c_int64_p, _c_long_p,
+    ]
     return lib
 
 

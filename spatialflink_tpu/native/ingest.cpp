@@ -245,6 +245,119 @@ inline const char* find_key(const char* s, const char* end, const char* key,
     return nullptr;
 }
 
+// Kafka-envelope unwrap ({"...": ..., "value": {...}}) followed by
+// geometry-object narrowing for one GeoJSON record line [ls, le):
+// *rs/*re get the record region (the properties scope), *cs/*ce the
+// coordinates scope ("geometry" object when present, else the record).
+// Returns false -> reject the line to Python. Shared by the point and
+// geometry parsers.
+inline bool narrow_geojson_record(const char* ls, const char* le,
+                                  const char** rs_out, const char** re_out,
+                                  const char** cs_out, const char** ce_out) {
+    const char* rs = ls;
+    const char* re = le;
+    {
+        const char* v = find_key(rs, re, "value", 5);
+        if (v && *v == '{') {
+            const char* ve = match_close(v, re);
+            if (!ve) return false;
+            rs = v;
+            re = ve;
+        }
+    }
+    const char* cs = rs;
+    const char* ce = re;
+    {
+        const char* gkey = find_key(rs, re, "geometry", 8);
+        if (gkey) {
+            if (*gkey != '{') return false;
+            ce = match_close(gkey, re);
+            if (!ce) return false;
+            cs = gkey;
+        }
+    }
+    *rs_out = rs;
+    *re_out = re;
+    *cs_out = cs;
+    *ce_out = ce;
+    return true;
+}
+
+// properties[oid_key] / properties[ts_key] from the record region [rs, re).
+// Mirrors formats.parse_geojson: absent/null properties -> empty id / ts 0;
+// escaped strings, bool ids and non-integer timestamps are not representable
+// here. Returns false -> send the line to Python. Shared by the GeoJSON
+// point and geometry parsers.
+inline bool parse_props_oid_ts(const char* buf, const char* rs, const char* re,
+                               const char* oid_key, long oid_key_len,
+                               const char* ts_key, long ts_key_len,
+                               uint64_t* oh_out, int64_t* os_out,
+                               int32_t* ol_out, int64_t* ts_out) {
+    const char* ps = nullptr;
+    const char* pe = nullptr;
+    {
+        const char* pkey = find_key(rs, re, "properties", 10);
+        if (pkey && *pkey == '{') {
+            pe = match_close(pkey, re);
+            if (!pe) return false;
+            ps = pkey;
+        }
+    }
+    uint64_t oh = fnv1a(nullptr, 0);
+    int64_t os = 0;
+    int32_t ol = 0;
+    if (oid_key_len && ps) {
+        const char* v = find_key(ps, pe, oid_key, oid_key_len);
+        if (v) {
+            const char* vs;
+            const char* ve;
+            if (*v == '"') {
+                vs = v + 1;
+                ve = (const char*)memchr(vs, '"', pe - vs);
+                // escapes need real JSON decoding -> Python
+                if (!ve || memchr(vs, '\\', ve - vs)) return false;
+            } else {  // bare number / literal: up to , } ]
+                vs = v;
+                ve = v;
+                while (ve < pe && *ve != ',' && *ve != '}' && *ve != ']') ve++;
+                ve = rskip_ws(vs, ve);
+                long n_tok = ve - vs;
+                if (n_tok == 4 && memcmp(vs, "null", 4) == 0) {
+                    vs = ve;  // bare JSON null => empty id
+                } else if ((n_tok == 4 && memcmp(vs, "true", 4) == 0) ||
+                           (n_tok == 5 && memcmp(vs, "false", 5) == 0)) {
+                    return false;  // str(True) capitalizes -> Python
+                }
+            }
+            oh = fnv1a(vs, ve - vs);
+            os = vs - buf;
+            ol = (int32_t)(ve - vs);
+        }
+    }
+    int64_t t = 0;
+    if (ts_key_len && ps) {
+        const char* v = find_key(ps, pe, ts_key, ts_key_len);
+        if (v) {
+            const char* vs = v;
+            const char* ve;
+            if (*v == '"') {  // quoted: integer ok, ISO date -> Python
+                vs = v + 1;
+                ve = (const char*)memchr(vs, '"', pe - vs);
+            } else {
+                ve = v;
+                while (ve < pe && *ve != ',' && *ve != '}') ve++;
+                ve = rskip_ws(vs, ve);
+            }
+            if (!ve || !parse_int_field(vs, ve, &t)) return false;
+        }
+    }
+    *oh_out = oh;
+    *os_out = os;
+    *ol_out = ol;
+    *ts_out = t;
+    return true;
+}
+
 }  // namespace
 
 // GeoJSON fast path: extracts Point coordinates plus the oID / timestamp
@@ -280,43 +393,17 @@ long sf_parse_points_geojson(const char* buf, long len,
             }
         }
 
-        // Kafka envelope: parse_geojson unwraps {"...": ..., "value": {...}}
-        // — narrow the scan region to the value object so envelope-level
-        // keys (e.g. the broker "timestamp") are never picked up.
-        const char* rs = ls;
-        const char* re = line_end;
-        {
-            const char* v = find_key(rs, re, "value", 5);
-            if (v && *v == '{') {
-                const char* ve = match_close(v, re);
-                if (!ve) {
-                    rejects[nrej++] = line_idx;
-                    continue;
-                }
-                rs = v;
-                re = ve;
-            }
-        }
-
-        // coordinates live inside the "geometry" object when one exists;
-        // bare-geometry records ({"type": "Point", "coordinates": ...}) are
-        // scanned whole. "geometry": null etc. goes to Python.
-        const char* cs = rs;
-        const char* ce = re;
-        {
-            const char* gkey = find_key(rs, re, "geometry", 8);
-            if (gkey) {
-                if (*gkey != '{') {
-                    rejects[nrej++] = line_idx;
-                    continue;
-                }
-                ce = match_close(gkey, re);
-                if (!ce) {
-                    rejects[nrej++] = line_idx;
-                    continue;
-                }
-                cs = gkey;
-            }
+        // envelope unwrap ("value" object, so envelope-level keys like the
+        // broker "timestamp" are never picked up) + geometry narrowing
+        // (bare-geometry records are scanned whole; "geometry": null etc.
+        // goes to Python) — shared helper with the geometry parser
+        const char* rs;
+        const char* re;
+        const char* cs;
+        const char* ce;
+        if (!narrow_geojson_record(ls, line_end, &rs, &re, &cs, &ce)) {
+            rejects[nrej++] = line_idx;
+            continue;
         }
         const char* c = find_key(cs, ce, "coordinates", 11);
         if (!c || *c != '[') {
@@ -345,84 +432,15 @@ long sf_parse_points_geojson(const char* buf, long len,
             continue;
         }
 
-        // oID / timestamp live in the "properties" object; absent or null
-        // properties mean empty id / 0 (parse_geojson: props = ... or {}).
-        const char* ps = nullptr;
-        const char* pe = nullptr;
-        {
-            const char* pkey = find_key(rs, re, "properties", 10);
-            if (pkey && *pkey == '{') {
-                pe = match_close(pkey, re);
-                if (!pe) {
-                    rejects[nrej++] = line_idx;
-                    continue;
-                }
-                ps = pkey;
-            }
-        }
-
-        uint64_t oh = fnv1a(nullptr, 0);
-        int64_t os = 0;
-        int32_t ol = 0;
-        bool bad = false;
-        if (oid_key_len && ps) {
-            const char* v = find_key(ps, pe, oid_key, oid_key_len);
-            if (v) {
-                const char* vs;
-                const char* ve;
-                if (*v == '"') {
-                    vs = v + 1;
-                    ve = (const char*)memchr(vs, '"', pe - vs);
-                    if (!ve || memchr(vs, '\\', ve - vs)) {
-                        // escapes need real JSON decoding -> Python
-                        rejects[nrej++] = line_idx;
-                        continue;
-                    }
-                } else {  // bare number / literal: up to , } ]
-                    vs = v;
-                    ve = v;
-                    while (ve < pe && *ve != ',' && *ve != '}' && *ve != ']')
-                        ve++;
-                    ve = rskip_ws(vs, ve);
-                    long n_tok = ve - vs;
-                    if (n_tok == 4 && memcmp(vs, "null", 4) == 0) {
-                        // bare JSON null => empty id (parse_geojson: None -> "")
-                        vs = ve;
-                    } else if ((n_tok == 4 && memcmp(vs, "true", 4) == 0) ||
-                               (n_tok == 5 && memcmp(vs, "false", 5) == 0)) {
-                        bad = true;  // str(True) capitalizes -> Python
-                    }
-                }
-                if (!bad) {
-                    oh = fnv1a(vs, ve - vs);
-                    os = vs - buf;
-                    ol = (int32_t)(ve - vs);
-                }
-            }
-        }
-        if (bad) {
+        // oID / timestamp from the "properties" object (shared helper with
+        // the geometry parser below)
+        uint64_t oh;
+        int64_t os, t;
+        int32_t ol;
+        if (!parse_props_oid_ts(buf, rs, re, oid_key, oid_key_len,
+                                ts_key, ts_key_len, &oh, &os, &ol, &t)) {
             rejects[nrej++] = line_idx;
             continue;
-        }
-        int64_t t = 0;
-        if (ts_key_len && ps) {
-            const char* v = find_key(ps, pe, ts_key, ts_key_len);
-            if (v) {
-                const char* vs = v;
-                const char* ve;
-                if (*v == '"') {  // quoted: integer ok, ISO date -> Python
-                    vs = v + 1;
-                    ve = (const char*)memchr(vs, '"', pe - vs);
-                } else {
-                    ve = v;
-                    while (ve < pe && *ve != ',' && *ve != '}') ve++;
-                    ve = rskip_ws(vs, ve);
-                }
-                if (!ve || !parse_int_field(vs, ve, &t)) {
-                    rejects[nrej++] = line_idx;
-                    continue;
-                }
-            }
         }
 
         xs[count] = x;
@@ -688,3 +706,175 @@ long sf_parse_wkt_geoms(const char* buf, long len, char delim,
 }
 
 }  // extern "C" (wkt geometry parser)
+
+// ------------------------------------------------------------------------- //
+// Bulk GeoJSON geometry parsing: Polygon / LineString features -> the same
+// flattened ring/vertex layout as sf_parse_wkt_geoms.
+//
+// TPU-native equivalent of the reference's per-tuple GeoJSON polygon/
+// linestring deserializers (spatialStreams/Deserialization.java:236-334
+// GeoJSONToSpatialPolygon/LineString; properties[oID]/properties[timestamp]
+// extraction as in :167-207). Point / Multi* / GeometryCollection features,
+// escaped strings and date-formatted timestamps reject to the Python parser
+// (full fidelity), exactly like the point parser's reject contract.
+
+extern "C" {
+
+// Output contract identical to sf_parse_wkt_geoms; ring arrays must be
+// sized >= count('['), vertex arrays >= count('[') + 2.
+long sf_parse_geojson_geoms(const char* buf, long len,
+                            const char* oid_key, const char* ts_key,
+                            int64_t* ts, uint64_t* oid_hash,
+                            int64_t* oid_start, int32_t* oid_len,
+                            int8_t* is_poly,
+                            int64_t* ring_off, int32_t* ring_cnt, double* bbox,
+                            int64_t* ring_voff, int32_t* ring_size,
+                            double* vx, double* vy,
+                            int64_t* rejects, long* n_rejects) {
+    long count = 0, nrej = 0, line_idx = -1;
+    long n_rings = 0, n_verts = 0;
+    long oid_key_len = oid_key ? (long)strlen(oid_key) : 0;
+    long ts_key_len = ts_key ? (long)strlen(ts_key) : 0;
+    const char* end = buf + len;
+    const char* p = buf;
+
+    while (p < end) {
+        line_idx++;
+        const char* line_end = (const char*)memchr(p, '\n', end - p);
+        if (!line_end) line_end = end;
+        const char* ls = p;
+        p = line_end + 1;
+        {
+            const char* t0 = skip_ws(ls, line_end);
+            if (t0 == rskip_ws(t0, line_end)) {
+                line_idx--;
+                continue;
+            }
+        }
+
+        // envelope unwrap + geometry-object narrowing (shared helper with
+        // the point parser)
+        const char* rs;
+        const char* re;
+        const char* cs;
+        const char* ce;
+        if (!narrow_geojson_record(ls, line_end, &rs, &re, &cs, &ce)) {
+            rejects[nrej++] = line_idx;
+            continue;
+        }
+        // geometry type must be exactly Polygon or LineString
+        bool poly;
+        {
+            const char* tv = find_key(cs, ce, "type", 4);
+            if (!tv || *tv != '"') { rejects[nrej++] = line_idx; continue; }
+            const char* tvs = tv + 1;
+            const char* tve = (const char*)memchr(tvs, '"', ce - tvs);
+            if (!tve) { rejects[nrej++] = line_idx; continue; }
+            long tn = tve - tvs;
+            if (tn == 7 && memcmp(tvs, "Polygon", 7) == 0)
+                poly = true;
+            else if (tn == 10 && memcmp(tvs, "LineString", 10) == 0)
+                poly = false;
+            else { rejects[nrej++] = line_idx; continue; }
+        }
+        const char* c = find_key(cs, ce, "coordinates", 11);
+        if (!c || *c != '[') { rejects[nrej++] = line_idx; continue; }
+        const char* cend = match_close(c, ce);
+        if (!cend) { rejects[nrej++] = line_idx; continue; }
+
+        uint64_t oh;
+        int64_t os_v, tval;
+        int32_t ol_v;
+        if (!parse_props_oid_ts(buf, rs, re, oid_key, oid_key_len,
+                                ts_key, ts_key_len,
+                                &oh, &os_v, &ol_v, &tval)) {
+            rejects[nrej++] = line_idx;
+            continue;
+        }
+
+        // walk the coordinate nest: Polygon [[[x,y],..],..] (points at
+        // depth 3, each depth-2 '[' opens a ring); LineString [[x,y],..]
+        // (points at depth 2, the depth-1 '[' IS the single ring). A
+        // trailing z in a point array is skipped; deeper nesting is
+        // malformed for these types and rejects.
+        const int pt_depth = poly ? 3 : 2;
+        const int ring_depth = poly ? 2 : 1;
+        long rec_rings = 0;
+        const long saved_rings = n_rings, saved_verts = n_verts;
+        double minx = 1e308, miny = 1e308, maxx = -1e308, maxy = -1e308;
+        bool bad = false;
+        int depth = 0;
+        const char* q = c;
+        while (q < cend) {
+            char ch = *q;
+            if (ch == '[') {
+                depth++;
+                if (depth > pt_depth) { bad = true; break; }
+                if (depth == ring_depth) {
+                    ring_voff[n_rings] = n_verts;
+                    ring_size[n_rings] = 0;
+                    n_rings++;
+                    rec_rings++;
+                }
+                if (depth == pt_depth) {
+                    const char* s2 = skip_ws(q + 1, cend);
+                    char* stop = nullptr;
+                    double x = strtod(s2, &stop);
+                    if (stop == s2) { bad = true; break; }
+                    s2 = skip_ws(stop, cend);
+                    if (s2 >= cend || *s2 != ',') { bad = true; break; }
+                    double y = strtod(s2 + 1, &stop);
+                    if (stop == s2 + 1) { bad = true; break; }
+                    const char* pc =
+                        (const char*)memchr(stop, ']', cend - stop);
+                    if (!pc) { bad = true; break; }
+                    vx[n_verts] = x;
+                    vy[n_verts] = y;
+                    ring_size[n_rings - 1]++;
+                    n_verts++;
+                    if (x < minx) minx = x;
+                    if (x > maxx) maxx = x;
+                    if (y < miny) miny = y;
+                    if (y > maxy) maxy = y;
+                    depth--;
+                    q = pc + 1;
+                    continue;
+                }
+                q++;
+            } else if (ch == ']') {
+                depth--;
+                q++;
+                if (depth == 0) break;
+            } else {
+                q++;
+            }
+        }
+        // empty / degenerate (sub-2-vertex ring) shapes -> Python, which
+        // owns the full error story
+        bool tiny = (rec_rings == 0 || n_verts == saved_verts);
+        for (long r = saved_rings; !tiny && r < n_rings; r++)
+            if (ring_size[r] < 2) tiny = true;
+        if (bad || tiny) {
+            n_rings = saved_rings;
+            n_verts = saved_verts;
+            rejects[nrej++] = line_idx;
+            continue;
+        }
+        ts[count] = tval;
+        oid_hash[count] = oh;
+        oid_start[count] = os_v;
+        oid_len[count] = ol_v;
+        is_poly[count] = poly ? 1 : 0;
+        ring_off[count] = saved_rings;
+        ring_cnt[count] = (int32_t)rec_rings;
+        bbox[count * 4 + 0] = minx;
+        bbox[count * 4 + 1] = miny;
+        bbox[count * 4 + 2] = maxx;
+        bbox[count * 4 + 3] = maxy;
+        count++;
+    }
+    *n_rejects = nrej;
+    return count;
+}
+
+}  // extern "C" (geojson geometry parser)

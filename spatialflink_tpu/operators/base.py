@@ -158,25 +158,35 @@ class SpatialOperator:
         self.conf.devices = new
         self._mesh_obj = None
 
-    def _eval_degradable(self, single_fn, dist_fn):
-        """Run ``dist_fn(mesh)`` with elastic retry, falling back to
+    def _eval_degradable(self, single_fn, dist_fn, batch=None):
+        """Run ``dist_fn(mesh)`` — or ``dist_fn(mesh, sharded_batch)`` when
+        ``batch`` is given — with elastic retry, falling back to
         ``single_fn()`` once the mesh is degraded to one device.
 
         Catches ``RuntimeError`` (``XlaRuntimeError``'s base — device loss,
-        transfer failures) raised at DISPATCH time. LIMITATION: with async
-        dispatch (``pipeline_depth >= 2``) a failure can instead surface at
-        the deferred readback, after this frame has returned — there it
-        PROPAGATES to the caller (no automatic retry; the window's inputs
-        are gone by then). Recovery for that case is the framework's normal
-        resume story: stateful operators restart from their checkpoint
-        (driver ``--checkpoint``/``--resume``), stateless window pipelines
-        re-run over the replayable source. Non-device exceptions (shape/
-        type bugs) propagate unchanged — and a genuine kernel bug re-raises
-        from the single-device path after the mesh has drained, so
-        degradation cannot mask it."""
+        transfer failures) raised at DISPATCH time. Two documented
+        tradeoffs: (1) with async dispatch (``pipeline_depth >= 2``) a
+        failure can instead surface at the deferred readback, after this
+        frame has returned — there it PROPAGATES to the caller (the
+        window's inputs are gone); recovery is the framework's normal
+        resume story (checkpoint ``--resume`` for stateful operators,
+        source replay for stateless windows). (2) availability over bug
+        visibility: a deterministic RuntimeError that lives ONLY in the
+        distributed path (e.g. a collective-merge regression) is absorbed
+        as permanent degradation to a correct-but-single-device run —
+        monitor the ``mesh-degradations`` counter; a degradation count
+        that tracks the window count is a code bug, not hardware. Bugs in
+        the shared per-shard closure still re-raise from the single-device
+        path; non-RuntimeError exceptions (shape/type bugs) propagate
+        unchanged."""
+        from spatialflink_tpu.parallel.mesh import shard_batch
+
         while self.distributed:
             try:
-                return dist_fn(self._mesh())
+                mesh = self._mesh()
+                if batch is not None:
+                    return dist_fn(mesh, shard_batch(batch, mesh))
+                return dist_fn(mesh)
             except RuntimeError as e:
                 self._degrade_mesh(e)
         return single_fn()
@@ -238,13 +248,13 @@ class SpatialOperator:
         — the mesh dispatch every reference pipeline gets from
         ``env.setParallelism(30)`` (``StreamingJob.java:221``)."""
         if self.distributed:
-            from spatialflink_tpu.parallel.mesh import shard_batch
             from spatialflink_tpu.parallel.ops import distributed_stream_filter
 
             return self._eval_degradable(
                 lambda: mask_stats_fn(batch),
-                lambda mesh: distributed_stream_filter(
-                    mesh, shard_batch(batch, mesh), mask_stats_fn))
+                lambda mesh, sb: distributed_stream_filter(
+                    mesh, sb, mask_stats_fn),
+                batch)
         return mask_stats_fn(batch)
 
     @staticmethod

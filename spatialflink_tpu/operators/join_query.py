@@ -255,7 +255,6 @@ class PointPointJoinQuery(SpatialOperator):
         if self.distributed:
             import numpy as np
 
-            from spatialflink_tpu.parallel.mesh import shard_batch
             from spatialflink_tpu.parallel.ops import distributed_join_mask
 
             if nb_layers is None:
@@ -265,9 +264,10 @@ class PointPointJoinQuery(SpatialOperator):
             cy = self.grid.min_y + self.grid.cell_length * self.grid.n / 2
             m = self._eval_degradable(
                 lambda: None,  # sentinel: single-device path yields below
-                lambda mesh: distributed_join_mask(
-                    mesh, shard_batch(batch_a, mesh), batch_b, radius,
-                    nb_layers, cx, cy, n=self.grid.n))
+                lambda mesh, sa: distributed_join_mask(
+                    mesh, sa, batch_b, radius,
+                    nb_layers, cx, cy, n=self.grid.n),
+                batch_a)
             if m is not None:
                 ai, bi = np.nonzero(np.asarray(m))
                 if ai.size:
@@ -315,16 +315,16 @@ class _GenericStreamJoin(PointPointJoinQuery):
         if self.distributed:
             # broadcast-join layout for the geometry pairs too: a sharded on
             # the mesh, query side replicated, same lattice kernel per shard
-            from spatialflink_tpu.parallel.mesh import shard_batch
             from spatialflink_tpu.parallel.ops import (
                 distributed_stream_join_lattice,
             )
 
             m_dev = self._eval_degradable(
                 lambda: self._lattice(batch_a, batch_b, radius),
-                lambda mesh: distributed_stream_join_lattice(
-                    mesh, shard_batch(batch_a, mesh), batch_b,
-                    lambda a_s, b_r: self._lattice(a_s, b_r, radius)))
+                lambda mesh, sa: distributed_stream_join_lattice(
+                    mesh, sa, batch_b,
+                    lambda a_s, b_r: self._lattice(a_s, b_r, radius)),
+                batch_a)
         else:
             m_dev = self._lattice(batch_a, batch_b, radius)
 

@@ -68,13 +68,14 @@ class PointPointKNNQuery(SpatialOperator):
                 n=self.grid.n, k=k, strategy=self._knn_strategy())
 
         if self.distributed:
-            from spatialflink_tpu.parallel.mesh import shard_batch
             from spatialflink_tpu.parallel.ops import distributed_stream_knn
 
-            return self._eval_degradable(lambda: local(batch), lambda mesh: (
-                distributed_stream_knn(
-                    mesh, shard_batch(batch, mesh), k=k,
-                    strategy=self._knn_strategy(), local_fn=local)))
+            return self._eval_degradable(
+                lambda: local(batch),
+                lambda mesh, sb: distributed_stream_knn(
+                    mesh, sb, k=k, strategy=self._knn_strategy(),
+                    local_fn=local),
+                batch)
         return local(batch)
 
     def run_bulk(self, parsed, query_point: Point, radius: float,
@@ -123,13 +124,14 @@ class _GenericKnn(SpatialOperator, GeomQueryMixin):
                                       strategy=self._knn_strategy())
 
         if self.distributed:
-            from spatialflink_tpu.parallel.mesh import shard_batch
             from spatialflink_tpu.parallel.ops import distributed_stream_knn
 
-            return self._eval_degradable(single, lambda mesh: (
-                distributed_stream_knn(
-                    mesh, shard_batch(batch, mesh), elig_dists, k=k,
-                    strategy=self._knn_strategy())))
+            return self._eval_degradable(
+                single,
+                lambda mesh, sb: distributed_stream_knn(
+                    mesh, sb, elig_dists, k=k,
+                    strategy=self._knn_strategy()),
+                batch)
         return single()
 
     def run(self, stream, query, radius: float, k: Optional[int] = None

@@ -780,16 +780,16 @@ class PointPointTKNNQuery(SpatialOperator):
             if self.distributed:
                 # sharded per-device top-k + gather re-merge, same kernel
                 # per shard (enforce_radius threads through)
-                from spatialflink_tpu.parallel.mesh import shard_batch
                 from spatialflink_tpu.parallel.ops import distributed_knn
 
-                res = self._eval_degradable(single, lambda mesh: (
-                    distributed_knn(
-                        mesh, shard_batch(batch, mesh),
-                        query_point.x, query_point.y,
+                res = self._eval_degradable(
+                    single,
+                    lambda mesh, sb: distributed_knn(
+                        mesh, sb, query_point.x, query_point.y,
                         jnp.int32(query_point.cell), radius, nb_layers,
                         n=self.grid.n, k=k, enforce_radius=radius > 0,
-                    )))
+                    ),
+                    batch)
             else:
                 res = single()
             valid = np.asarray(res.valid)

@@ -391,12 +391,71 @@ def bulk_parse_wkt(
     (``Deserialization.java:516-628`` WKT polygon/linestring parsers).
     """
     interner = interner if interner is not None else IdInterner()
+
+    def parse_line(ln):
+        return formats.parse_spatial(ln, "WKT", None, delimiter=delimiter,
+                                     date_format=date_format)
+
     nlib = native.lib()
     if nlib is None:
-        return _geoms_python_fallback(data, delimiter, date_format, interner)
-    cap = data.count(b"\n") + 1
+        return _geoms_python_fallback(data, parse_line, interner)
     capr = max(1, data.count(b"("))
     capv = data.count(b",") + capr + 2
+
+    def invoke(buf, *arrs):
+        return nlib.sf_parse_wkt_geoms(
+            buf, len(data), delimiter.encode()[:1] or b",", *arrs)
+
+    return _native_geoms_parse(data, invoke, parse_line, interner,
+                               _NORM_CSV, capr, capv)
+
+
+def bulk_parse_geojson_geoms(
+    data: bytes,
+    *,
+    property_obj_id: str = "oID",
+    property_timestamp: str = "timestamp",
+    date_format: Optional[str] = None,
+    interner: Optional[IdInterner] = None,
+) -> ParsedGeoms:
+    """Parse a newline-separated block of GeoJSON Polygon/LineString
+    features — the bulk twin of ``parse_spatial(..., "GeoJSON")`` for
+    geometry streams (``Deserialization.java:236-334``
+    GeoJSONToSpatialPolygon/LineString). Point/Multi*/GeometryCollection
+    features, escaped strings and date-formatted timestamps are re-parsed
+    by the Python parser, so this accepts exactly what the record path
+    accepts."""
+    interner = interner if interner is not None else IdInterner()
+    kw = dict(property_obj_id=property_obj_id,
+              property_timestamp=property_timestamp,
+              date_format=date_format)
+
+    def parse_line(ln):
+        return formats.parse_spatial(ln, "GeoJSON", None, **kw)
+
+    nlib = native.lib()
+    if nlib is None:
+        return _geoms_python_fallback(data, parse_line, interner)
+    # every point/ring/coords level opens one '[' -> safe upper bounds
+    capr = max(1, data.count(b"["))
+    capv = capr + 2
+
+    def invoke(buf, *arrs):
+        return nlib.sf_parse_geojson_geoms(
+            buf, len(data), property_obj_id.encode(),
+            property_timestamp.encode(), *arrs)
+
+    return _native_geoms_parse(data, invoke, parse_line, interner,
+                               _NORM_RAW, capr, capv)
+
+
+def _native_geoms_parse(data: bytes, invoke, parse_line, interner, norm,
+                        capr: int, capv: int) -> ParsedGeoms:
+    """Shared buffers + assembly for the native geometry parsers
+    (sf_parse_wkt_geoms / sf_parse_geojson_geoms — identical output
+    contract). ``invoke(buf, *array_ptrs)`` calls the symbol with its
+    format-specific leading arguments; rejects reparse via ``parse_line``."""
+    cap = data.count(b"\n") + 1
     buf = data if data.endswith(b"\0") else data + b"\0"
     ts = np.empty(cap, np.int64)
     oh = np.empty(cap, np.uint64)
@@ -412,8 +471,8 @@ def bulk_parse_wkt(
     vy = np.empty(capv, np.float64)
     rej = np.empty(cap, np.int64)
     nrej = ctypes.c_long(0)
-    n = nlib.sf_parse_wkt_geoms(
-        buf, len(data), delimiter.encode()[:1] or b",",
+    n = invoke(
+        buf,
         _ptr(ts, ctypes.c_int64), _ptr(oh, ctypes.c_uint64),
         _ptr(os_, ctypes.c_int64), _ptr(ol, ctypes.c_int32),
         _ptr(ispoly, ctypes.c_int8),
@@ -423,7 +482,7 @@ def bulk_parse_wkt(
         _ptr(vx, ctypes.c_double), _ptr(vy, ctypes.c_double),
         _ptr(rej, ctypes.c_int64), ctypes.byref(nrej),
     )
-    oid = _intern_hashes(data, oh[:n], os_[:n], ol[:n], interner, _NORM_CSV)
+    oid = _intern_hashes(data, oh[:n], os_[:n], ol[:n], interner, norm)
     n_rings = int(rcnt[:n].sum())
     n_verts = int(rsize[:n_rings].sum()) if n_rings else 0
     accepted = ParsedGeoms(
@@ -444,22 +503,18 @@ def bulk_parse_wkt(
     reparsed = []
     for i in rej[: nrej.value]:
         ln = lines[int(i)].decode("utf-8", "replace")
-        obj = formats.parse_spatial(ln, "WKT", None, delimiter=delimiter,
-                                    date_format=date_format)
-        reparsed.append((int(i), obj))
+        reparsed.append((int(i), parse_line(ln)))
     return _merge_geom_rejects(accepted, reparsed, interner)
 
 
-def _geoms_python_fallback(data: bytes, delimiter, date_format,
-                           interner) -> ParsedGeoms:
+def _geoms_python_fallback(data: bytes, parse_line, interner) -> ParsedGeoms:
     """No native library: parse every line in Python, same output layout."""
     reparsed = []
     i = 0
     for ln in data.decode("utf-8", "replace").split("\n"):
         if not ln.strip(" \t\r"):
             continue
-        reparsed.append((i, formats.parse_spatial(
-            ln, "WKT", None, delimiter=delimiter, date_format=date_format)))
+        reparsed.append((i, parse_line(ln)))
         i += 1
     empty = ParsedGeoms(
         ts=np.empty(0, np.int64), obj_id=np.empty(0, np.int32),
@@ -684,8 +739,15 @@ def bulk_geom_window_batches(parsed: ParsedGeoms, spec, grid=None, *,
 
 
 def bulk_parse_geom_file(path: str, fmt: str = "WKT", **kw) -> ParsedGeoms:
-    """Bulk-parse a whole replay file of WKT polygon/linestring records."""
-    if fmt.lower() != "wkt":
-        raise ValueError(f"bulk geometry ingestion supports WKT, not {fmt!r}")
-    with open(path, "rb") as f:
-        return bulk_parse_wkt(f.read(), **kw)
+    """Bulk-parse a whole replay file of WKT or GeoJSON polygon/linestring
+    records (kwargs are format-specific: delimiter/date_format for WKT,
+    property_obj_id/property_timestamp/date_format for GeoJSON)."""
+    f = fmt.lower()
+    if f not in ("wkt", "geojson"):
+        raise ValueError(
+            f"bulk geometry ingestion supports WKT/GeoJSON, not {fmt!r}")
+    with open(path, "rb") as fh:
+        data = fh.read()
+    if f == "wkt":
+        return bulk_parse_wkt(data, **kw)
+    return bulk_parse_geojson_geoms(data, **kw)

@@ -295,3 +295,129 @@ class TestPointGeomRangeBulkDriver:
         out = capsys.readouterr()
         assert "not applicable" not in out.err
         assert out.out.strip()
+
+
+def _geojson_lines(n=30, seed=1, t_step=1):
+    import json as _json
+
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        cx, cy = rng.uniform(1, 9), rng.uniform(1, 9)
+        w = float(rng.uniform(0.1, 1.5))
+        t = T0 + i * t_step
+        props = {"oID": f"g{i}", "timestamp": t}
+        if i % 3 == 0:
+            geom = {"type": "LineString",
+                    "coordinates": [[cx, cy], [cx + w, cy + w], [cx + w, cy]]}
+        elif i % 7 == 0:  # native-rejected: reparsed + flattened in Python
+            geom = {"type": "MultiPolygon", "coordinates": [
+                [[[cx, cy], [cx + w, cy], [cx + w, cy + w], [cx, cy]]]]}
+        else:  # polygon with a hole
+            geom = {"type": "Polygon", "coordinates": [
+                [[cx, cy], [cx + w, cy], [cx + w, cy + w], [cx, cy + w],
+                 [cx, cy]],
+                [[cx + w / 4, cy + w / 4], [cx + w / 2, cy + w / 4],
+                 [cx + w / 2, cy + w / 2], [cx + w / 4, cy + w / 4]]]}
+        rec = {"type": "Feature", "geometry": geom, "properties": props}
+        if i % 5 == 0:  # Kafka envelope form
+            rec = {"topic": "polys", "timestamp": 0, "value": rec}
+        out.append(_json.dumps(rec))
+    return out
+
+
+class TestGeoJsonGeomsParity:
+    """bulk_parse_geojson_geoms must equal the per-record GeoJSON object
+    path — including native-rejected features (Multi*, envelope oddities)
+    flattened through the Python reparser."""
+
+    def _check_against_objects(self, lines):
+        from spatialflink_tpu.streams.bulk import bulk_parse_geojson_geoms
+
+        parsed = bulk_parse_geojson_geoms(("\n".join(lines)).encode())
+        batch = geoms_to_edge_batch(parsed, GRID, ts_base=T0)
+        i2 = IdInterner()
+        objs = [parse_spatial(ln, "GeoJSON", GRID) for ln in lines]
+        want = EdgeGeomBatch.from_objects(objs, GRID, i2, ts_base=T0)
+        n = len(lines)
+        assert (batch.valid == want.valid).all()
+        np.testing.assert_array_equal(batch.ts[:n], want.ts[:n])
+        np.testing.assert_allclose(batch.bbox[:n], want.bbox[:n], atol=1e-6)
+        np.testing.assert_array_equal(batch.is_areal[:n], want.is_areal[:n])
+        np.testing.assert_array_equal(batch.cell[:n], want.cell[:n])
+        for g in range(n):
+            assert set(batch.cells[g][batch.cells_mask[g]].tolist()) == \
+                set(want.cells[g][want.cells_mask[g]].tolist()), g
+            a = {tuple(e) for e in batch.edges[g][batch.edge_mask[g]].tolist()}
+            b = {tuple(e) for e in want.edges[g][want.edge_mask[g]].tolist()}
+            assert a == b, g
+            assert parsed.interner.lookup(int(batch.obj_id[g])) == \
+                i2.lookup(int(want.obj_id[g])), g
+
+    def test_native_path_matches_object_path(self):
+        self._check_against_objects(_geojson_lines(30))
+
+    def test_python_fallback_matches_object_path(self, monkeypatch):
+        monkeypatch.setenv("SPATIALFLINK_NATIVE", "0")
+        self._check_against_objects(_geojson_lines(20, seed=4))
+
+    def test_point_feature_raises(self):
+        from spatialflink_tpu.streams.bulk import bulk_parse_geojson_geoms
+
+        with pytest.raises(ValueError):
+            bulk_parse_geojson_geoms(
+                b'{"type": "Feature", "geometry": {"type": "Point", '
+                b'"coordinates": [1, 2]}, "properties": {"oID": "p"}}')
+
+
+class TestDriverGeoJsonGeomBulk:
+    def test_driver_bulk_option21_geojson(self, tmp_path, capsys):
+        from spatialflink_tpu.driver import main
+
+        lines = _geojson_lines(40, seed=9, t_step=400)
+        f = tmp_path / "polys.geojson"
+        f.write_text("\n".join(lines))
+        import yaml
+
+        with open("conf/spatialflink-conf.yml") as fh:
+            y = yaml.safe_load(fh)
+        y["inputStream1"]["gridBBox"] = [0.0, 0.0, 10.0, 10.0]
+        y["inputStream2"]["gridBBox"] = [0.0, 0.0, 10.0, 10.0]
+        y["query"]["option"] = 21
+        y["query"]["radius"] = 1.0
+        y["query"]["queryPolygons"] = [[[3, 3], [7, 3], [7, 7], [3, 7]]]
+        y["inputStream1"]["format"] = "GeoJSON"
+        y["inputStream1"]["dateFormat"] = None
+        cfgf = tmp_path / "conf.yml"
+        cfgf.write_text(yaml.safe_dump(y))
+        rc = main(["--config", str(cfgf), "--input1", str(f), "--bulk"])
+        assert rc == 0
+        out = capsys.readouterr()
+        assert "not applicable" not in out.err
+        assert "not bulk-ingestible" not in out.err
+        assert out.out.strip()
+
+    def test_bulk_output_matches_record_path(self, tmp_path, capsys):
+        from spatialflink_tpu.driver import main
+
+        lines = _geojson_lines(40, seed=9, t_step=400)
+        f = tmp_path / "polys.geojson"
+        f.write_text("\n".join(lines))
+        import yaml
+
+        with open("conf/spatialflink-conf.yml") as fh:
+            y = yaml.safe_load(fh)
+        y["inputStream1"]["gridBBox"] = [0.0, 0.0, 10.0, 10.0]
+        y["inputStream2"]["gridBBox"] = [0.0, 0.0, 10.0, 10.0]
+        y["query"]["option"] = 21
+        y["query"]["radius"] = 1.0
+        y["query"]["queryPolygons"] = [[[3, 3], [7, 3], [7, 7], [3, 7]]]
+        y["inputStream1"]["format"] = "GeoJSON"
+        y["inputStream1"]["dateFormat"] = None
+        cfgf = tmp_path / "conf.yml"
+        cfgf.write_text(yaml.safe_dump(y))
+        assert main(["--config", str(cfgf), "--input1", str(f), "--bulk"]) == 0
+        bulk_out = capsys.readouterr().out
+        assert main(["--config", str(cfgf), "--input1", str(f)]) == 0
+        rec_out = capsys.readouterr().out
+        assert bulk_out == rec_out

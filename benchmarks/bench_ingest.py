@@ -1,0 +1,140 @@
+"""Host-side ingest benchmark: native C++ bulk parsers vs the per-record
+Python path.
+
+Ingest runs on the HOST by design (SURVEY §7: streaming scaffolding on CPU,
+geometry math on device), so these are CPU numbers regardless of the
+accelerator. Each row times a cold parse of a generated replay block and
+prints one JSON line: records/s for the native bulk path, the pure-Python
+bulk fallback (SPATIALFLINK_NATIVE=0 semantics), and the per-record
+``parse_spatial`` path the realtime driver uses.
+
+Usage: python benchmarks/bench_ingest.py [n_points] [n_geoms]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _time(fn, *a, **kw):
+    t0 = time.perf_counter()
+    fn(*a, **kw)
+    return time.perf_counter() - t0
+
+
+def gen_point_csv(n: int) -> bytes:
+    rng = np.random.default_rng(0)
+    xs = rng.uniform(115.5, 117.6, n)
+    ys = rng.uniform(39.6, 41.1, n)
+    return "\n".join(
+        f"o{i % 997},{1_700_000_000_000 + i},{xs[i]:.6f},{ys[i]:.6f}"
+        for i in range(n)).encode()
+
+
+def gen_point_geojson(n: int) -> bytes:
+    rng = np.random.default_rng(1)
+    xs = rng.uniform(115.5, 117.6, n)
+    ys = rng.uniform(39.6, 41.1, n)
+    return "\n".join(
+        '{"type": "Feature", "geometry": {"type": "Point", "coordinates": '
+        f'[{xs[i]:.6f}, {ys[i]:.6f}]}}, "properties": {{"oID": "o{i % 997}", '
+        f'"timestamp": {1_700_000_000_000 + i}}}}}'
+        for i in range(n)).encode()
+
+
+def gen_poly_wkt(n: int) -> bytes:
+    rng = np.random.default_rng(2)
+    out = []
+    for i in range(n):
+        cx, cy = rng.uniform(116, 117), rng.uniform(40, 41)
+        w = 0.01 + 0.001 * (i % 7)
+        out.append(
+            f"p{i % 499}, {1_700_000_000_000 + i}, POLYGON (({cx} {cy}, "
+            f"{cx + w} {cy}, {cx + w} {cy + w}, {cx} {cy + w}, {cx} {cy}))")
+    return "\n".join(out).encode()
+
+
+def gen_poly_geojson(n: int) -> bytes:
+    rng = np.random.default_rng(3)
+    out = []
+    for i in range(n):
+        cx, cy = rng.uniform(116, 117), rng.uniform(40, 41)
+        w = 0.01 + 0.001 * (i % 7)
+        ring = (f"[[{cx}, {cy}], [{cx + w}, {cy}], [{cx + w}, {cy + w}], "
+                f"[{cx}, {cy + w}], [{cx}, {cy}]]")
+        out.append(
+            '{"type": "Feature", "geometry": {"type": "Polygon", '
+            f'"coordinates": [{ring}]}}, "properties": '
+            f'{{"oID": "p{i % 499}", "timestamp": {1_700_000_000_000 + i}}}}}')
+    return "\n".join(out).encode()
+
+
+def main() -> int:
+    n_pts = int(sys.argv[1]) if len(sys.argv) > 1 else 500_000
+    n_geo = int(sys.argv[2]) if len(sys.argv) > 2 else 100_000
+    # the pure-Python paths are ~2 orders slower; bench fewer lines there
+    n_pts_py = max(1, n_pts // 20)
+    n_geo_py = max(1, n_geo // 20)
+
+    from spatialflink_tpu import native
+    from spatialflink_tpu.streams import bulk, formats
+
+    if native.lib() is None:
+        print("warning: native library unavailable; native rows will "
+              "actually measure the fallback", file=sys.stderr)
+
+    def per_record(data: bytes, fmt: str, **kw):
+        for ln in data.decode().split("\n"):
+            formats.parse_spatial(ln, fmt, None, **kw)
+
+    rows = []
+    for name, gen, bulk_fn, fmt, kw in (
+        ("csv_points", gen_point_csv, bulk.bulk_parse_csv, "CSV",
+         {"date_format": None}),
+        ("geojson_points", gen_point_geojson, bulk.bulk_parse_geojson,
+         "GeoJSON", {}),
+        ("wkt_polygons", gen_poly_wkt, bulk.bulk_parse_wkt, "WKT",
+         {"date_format": None}),
+        ("geojson_polygons", gen_poly_geojson, bulk.bulk_parse_geojson_geoms,
+         "GeoJSON", {}),
+    ):
+        n = n_geo if "poly" in name else n_pts
+        n_py = n_geo_py if "poly" in name else n_pts_py
+        data = gen(n)
+        native_s = _time(bulk_fn, data, **kw)
+        small = gen(n_py)
+        os.environ["SPATIALFLINK_NATIVE"] = "0"
+        try:
+            fallback_s = _time(bulk_fn, small, **kw)
+        finally:
+            os.environ.pop("SPATIALFLINK_NATIVE", None)
+        record_s = _time(per_record, small, fmt,
+                         **({"date_format": None} if fmt != "GeoJSON" else {}))
+        row = {
+            "stream": name,
+            "records": n,
+            "native_records_per_sec": round(n / native_s),
+            "python_bulk_records_per_sec": round(n_py / fallback_s),
+            "per_record_path_records_per_sec": round(n_py / record_s),
+            "native_speedup_vs_per_record": round(record_s / n_py
+                                                  / (native_s / n), 1),
+        }
+        print(json.dumps(row), flush=True)
+        rows.append(row)
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "RESULTS_ingest.json")
+    with open(out, "w") as f:
+        json.dump(rows, f, indent=1)
+    print(f"# wrote {out}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

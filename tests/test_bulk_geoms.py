@@ -421,3 +421,37 @@ class TestDriverGeoJsonGeomBulk:
         assert main(["--config", str(cfgf), "--input1", str(f)]) == 0
         rec_out = capsys.readouterr().out
         assert bulk_out == rec_out
+
+
+class TestMalformedConsistency:
+    """Bulk ingest accepts exactly what the record path accepts — and FAILS
+    exactly where it fails: a malformed line must raise the same exception
+    type from both, never silently produce a record."""
+
+    CASES = [
+        ("GeoJSON", '{"type": "Feature", "geometry": {"type": "Polygon", '
+                    '"coordinates": [[[1, 2], [3'),
+        ("GeoJSON", '{"type": "Feature", "geometry": {"type": "Polygon"}, '
+                    '"properties": {}}'),
+        ("GeoJSON", "garbage line"),
+        ("GeoJSON", '{"type": "Feature", "geometry": null, '
+                    '"properties": {"oID": "x"}}'),
+        ("WKT", "POLYGON ((1 1, 2 2"),
+        ("WKT", "POLYGONE ((1 1, 2 2, 3 3))"),
+    ]
+
+    @pytest.mark.parametrize("fmt,line", CASES)
+    def test_same_exception_type(self, fmt, line):
+        from spatialflink_tpu.streams.bulk import (
+            bulk_parse_geojson_geoms,
+            bulk_parse_wkt,
+        )
+
+        bulk_fn = (bulk_parse_geojson_geoms if fmt == "GeoJSON"
+                   else bulk_parse_wkt)
+        with pytest.raises(Exception) as bulk_err:
+            bulk_fn(line.encode())
+        with pytest.raises(Exception) as rec_err:
+            parse_spatial(line, fmt, GRID)
+        assert type(bulk_err.value) is type(rec_err.value), \
+            (bulk_err.value, rec_err.value)

@@ -110,13 +110,16 @@ def main() -> int:
         data = gen(n)
         native_s = _time(bulk_fn, data, **kw)
         small = gen(n_py)
+        prior = os.environ.get("SPATIALFLINK_NATIVE")
         os.environ["SPATIALFLINK_NATIVE"] = "0"
         try:
             fallback_s = _time(bulk_fn, small, **kw)
-        finally:
-            os.environ.pop("SPATIALFLINK_NATIVE", None)
-        record_s = _time(per_record, small, fmt,
-                         **({"date_format": None} if fmt != "GeoJSON" else {}))
+        finally:  # restore (not pop): a caller-set value must survive
+            if prior is None:
+                os.environ.pop("SPATIALFLINK_NATIVE", None)
+            else:
+                os.environ["SPATIALFLINK_NATIVE"] = prior
+        record_s = _time(per_record, small, fmt, **kw)
         row = {
             "stream": name,
             "records": n,

@@ -1,0 +1,42 @@
+"""Shared example bootstrap: make the demo run on whatever works.
+
+The default accelerator backend can hang at init (e.g. a wedged remote-TPU
+tunnel — and on this image a sitecustomize force-selects it, overriding the
+``JAX_PLATFORMS`` env var). Probe it in a subprocess with a timeout and fall
+back to CPU so the examples always complete; force a platform explicitly
+with ``SPATIALFLINK_EXAMPLE_PLATFORM=cpu|tpu``.
+
+Call :func:`ensure_backend` BEFORE any jax-touching import.
+"""
+
+import os
+import subprocess
+import sys
+
+
+def ensure_backend(min_devices: int = 1, timeout: int = 45) -> None:
+    plat = os.environ.get("SPATIALFLINK_EXAMPLE_PLATFORM")
+    if not plat:
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                timeout=timeout, capture_output=True)
+            plat = None if r.returncode == 0 else "cpu"
+        except subprocess.TimeoutExpired:
+            plat = "cpu"
+        if plat == "cpu":
+            print("# default backend unreachable; falling back to CPU",
+                  file=sys.stderr)
+    if plat == "cpu" and min_devices > 1:
+        # XLA_FLAGS is read at backend init — set it before first device use
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags +
+                f" --xla_force_host_platform_device_count={min_devices}"
+            ).strip()
+    if plat:
+        os.environ["JAX_PLATFORMS"] = plat
+        import jax  # the env var alone loses to sitecustomize's config set
+
+        jax.config.update("jax_platforms", plat)

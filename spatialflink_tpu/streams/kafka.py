@@ -241,6 +241,11 @@ class IdempotentWindowSink:
             if not _values_equal(self._delivered[key], result):
                 self.duplicates_value_differing += 1
 
+    @property
+    def delivered_count(self) -> int:
+        """Distinct (window, key) results delivered so far."""
+        return len(self._delivered)
+
     def snapshot(self) -> Dict[Tuple, Any]:
         return dict(self._delivered)
 

@@ -69,3 +69,30 @@ def test_option_wires_end_to_end(option):
     assert out, f"option {option} produced no windows"
     if spec.latency:
         assert all("latency_ms" in w.extras for w in out), option
+
+
+_TRAJECTORY_OPTIONS = sorted(
+    o for o, s in CASES.items()
+    if s.family in ("tfilter", "trange", "tstats", "taggregate", "tjoin",
+                    "tknn"))
+
+
+def test_trajectory_matrix_covers_reference_option_space():
+    # 6 families x {realtime, window} + the three naive twins
+    assert len(_TRAJECTORY_OPTIONS) == 6 * 2 + 3
+
+
+@pytest.mark.parametrize("option", _TRAJECTORY_OPTIONS)
+def test_trajectory_option_wires_end_to_end(option):
+    spec = CASES[option]
+    p = _params(option)
+    grid, _ = p.grids()
+    # trajectories: several points per objID so stats/joins have segments
+    s1 = _stream("Point", grid, n=60, seed=option)
+    s2 = (_stream("Point", grid, n=60, seed=option + 1)
+          if spec.family == "tjoin" else None)
+    out = list(run_option(p, s1, s2))
+    assert isinstance(out, list), option  # wiring ran; some families emit
+    #                                       nothing on sparse synthetic data
+    if spec.family in ("tstats", "taggregate", "tfilter"):
+        assert out, f"option {option} produced no results"

@@ -1,0 +1,105 @@
+"""Deterministic mutation fuzz over the native ingest parsers.
+
+The C++ parsers walk raw bytes with hand-managed bounds; a mutated or
+truncated line must either parse, reject to Python (whose error story is
+tested elsewhere), or raise a Python-level exception — NEVER corrupt memory
+or crash the interpreter. The suite mutates valid corpora (truncate, splice,
+duplicate brackets/quotes/delimiters, flip bytes) and simply requires every
+call to return or raise cleanly; an out-of-bounds write would crash the
+test process itself, which is the signal.
+"""
+
+import numpy as np
+import pytest
+
+from spatialflink_tpu import native
+from spatialflink_tpu.streams import bulk
+
+pytestmark = pytest.mark.skipif(native.lib() is None,
+                                reason="native library unavailable")
+
+_WKT = [
+    b"p1, 1700000000000, POLYGON ((1 1, 3 1, 3 3, 1 3, 1 1))",
+    b"l1, 1700000000001, LINESTRING (0 0, 1 1, 2 0)",
+    b"m1, 1700000000002, MULTIPOLYGON (((0 0, 1 0, 1 1, 0 0)))",
+]
+_GJ = [
+    b'{"type": "Feature", "geometry": {"type": "Polygon", "coordinates": '
+    b'[[[1, 1], [3, 1], [3, 3], [1, 1]]]}, "properties": {"oID": "p1", '
+    b'"timestamp": 1700000000000}}',
+    b'{"type": "Feature", "geometry": {"type": "LineString", "coordinates": '
+    b'[[0, 0], [1, 1]]}, "properties": {"oID": "l1"}}',
+    b'{"value": {"type": "Feature", "geometry": {"type": "Point", '
+    b'"coordinates": [1, 2]}, "properties": {"oID": "x"}}}',
+]
+_CSV = [b"o1,1700000000000,116.5,40.5", b"o2,1700000000001,116.6,40.6"]
+
+_NOISE = [b"[", b"]", b"(", b")", b'"', b",", b"\\", b"\n", b"\x00", b"{",
+          b"}", b"POLYGON", b"coordinates", b"-", b"1e308", b" "]
+
+
+def _mutations(corpus, rng, n):
+    lines = list(corpus)
+    for _ in range(n):
+        base = bytearray(lines[rng.integers(len(lines))])
+        op = rng.integers(5)
+        if op == 0 and len(base) > 1:  # truncate
+            base = base[: rng.integers(1, len(base))]
+        elif op == 1:  # splice noise
+            tok = _NOISE[rng.integers(len(_NOISE))]
+            pos = rng.integers(len(base) + 1)
+            base = base[:pos] + tok + base[pos:]
+        elif op == 2 and base:  # flip a byte
+            base[rng.integers(len(base))] = rng.integers(32, 127)
+        elif op == 3:  # duplicate a slice
+            a, b = sorted(rng.integers(0, len(base) + 1, 2))
+            base = base[:a] + base[a:b] * 2 + base[b:]
+        else:  # concatenate two lines on one row
+            base = base + b" " + bytes(lines[rng.integers(len(lines))])
+        yield bytes(base)
+
+
+def _survives(fn, data, **kw):
+    try:
+        fn(data, **kw)
+    except Exception:
+        pass  # clean Python-level failure is fine; a crash is not
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_wkt_geoms_fuzz(seed):
+    rng = np.random.default_rng(seed)
+    for mut in _mutations(_WKT, rng, 400):
+        _survives(bulk.bulk_parse_wkt, mut, date_format=None)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_geojson_geoms_fuzz(seed):
+    rng = np.random.default_rng(seed)
+    for mut in _mutations(_GJ, rng, 400):
+        _survives(bulk.bulk_parse_geojson_geoms, mut)
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_point_parsers_fuzz(seed):
+    rng = np.random.default_rng(seed)
+    for mut in _mutations(_CSV, rng, 300):
+        _survives(bulk.bulk_parse_csv, mut, date_format=None)
+    for mut in _mutations(_GJ, rng, 300):
+        _survives(bulk.bulk_parse_geojson, mut)
+
+
+def test_multi_line_blocks_fuzz():
+    # whole blocks: shuffled valid+mutated lines joined with \n, plus
+    # pathological all-bracket blocks that stress the capacity bounds
+    rng = np.random.default_rng(9)
+    pool = _WKT + [next(_mutations(_WKT, rng, 1)) for _ in range(20)]
+    for _ in range(50):
+        k = rng.integers(1, 8)
+        block = b"\n".join(pool[int(i)] for i in rng.integers(0, len(pool), k))
+        _survives(bulk.bulk_parse_wkt, block, date_format=None)
+    _survives(bulk.bulk_parse_wkt, b"(" * 10_000, date_format=None)
+    _survives(bulk.bulk_parse_geojson_geoms, b"[" * 10_000)
+    _survives(bulk.bulk_parse_geojson_geoms,
+              b'{"type": "Feature", "geometry": {"type": "Polygon", '
+              b'"coordinates": ' + b"[" * 5_000 + b"]" * 5_000 + b"}}")

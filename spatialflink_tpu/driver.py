@@ -567,6 +567,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--devices", type=int, default=None,
                     help="shard window batches across this many devices "
                          "(power of two; overrides query.parallelism)")
+    ap.add_argument("--output", default=None,
+                    help="also write every result RECORD to this file, one "
+                         "per line, serialized in --output-format — the "
+                         "reference's output Kafka topic "
+                         "(StreamingJob.java:512, Serialization.java output "
+                         "schemas), as a file")
+    ap.add_argument("--output-format", default="GeoJSON",
+                    choices=["GeoJSON", "WKT", "CSV", "TSV"],
+                    help="serialization for --output (spatial records; "
+                         "non-spatial result tuples are written as JSON "
+                         "lines)")
     ap.add_argument("--metrics", action="store_true",
                     help="print a metrics snapshot to stderr at exit")
     ap.add_argument("--bulk", action="store_true",
@@ -649,18 +660,43 @@ def main(argv: Optional[List[str]] = None) -> int:
         results = run_option(params, stream1, stream2)
 
     sink = StdoutSink()
+    out_sink = None
+    if args.output:
+        from spatialflink_tpu.streams.sinks import FileSink
+
+        out_sink = FileSink(args.output, args.output_format,
+                            delimiter=params.output.delimiter,
+                            date_format=params.input1.date_format)
     n = 0
     stopped = False
     try:
         for result in results:
             _emit(result, sink)
             n += 1
+            if out_sink is not None:
+                if isinstance(result, WindowResult):
+                    for rec in result.records:
+                        out_sink.emit(rec)
+                elif (isinstance(result, tuple) and len(result) == 2
+                        and isinstance(result[0], SpatialObject)):
+                    # deser-family results are (obj, serialized) pairs —
+                    # the reference produces exactly these to the output
+                    # topic (StreamingJob.java:1289-1545)
+                    out_sink.emit(result[0])
+                else:
+                    out_sink.emit(result)
     except ControlTupleExit:
         # the remote-stop hook (HelperClass.checkExitControlTuple:441-453) is
         # a graceful shutdown, not an error: finish the summary and exit 0
         stopped = True
+    finally:
+        if out_sink is not None:
+            out_sink.close()
     print(f"# emitted {n} results" + (" (control-tuple stop)" if stopped else ""),
           file=sys.stderr)
+    if out_sink is not None:
+        print(f"# wrote {out_sink.records_written} records to {args.output} "
+              f"({args.output_format})", file=sys.stderr)
     if args.metrics:
         from spatialflink_tpu.utils.metrics import REGISTRY
 

@@ -37,14 +37,31 @@ class StdoutSink:
 
 
 class FileSink:
-    def __init__(self, path: str, fmt: Optional[str] = None):
+    """Newline-delimited record file — the reference's output Kafka topic
+    (``Serialization.java`` output schemas) as a file. Spatial records are
+    serialized in ``fmt`` (honoring ``delimiter``/``date_format`` like the
+    Kafka sink); non-spatial records (kNN tuples, stats rows) fall back to
+    JSON lines."""
+
+    def __init__(self, path: str, fmt: Optional[str] = None, *,
+                 delimiter: str = ",", date_format: Optional[str] = None):
         self.fmt = fmt
+        self.delimiter = delimiter
+        self.date_format = date_format
+        self.records_written = 0
         self._f = open(path, "w")
 
     def emit(self, record):
         if self.fmt and hasattr(record, "obj_id"):
-            record = serialize_spatial(record, self.fmt)
+            record = serialize_spatial(record, self.fmt,
+                                       delimiter=self.delimiter,
+                                       date_format=self.date_format)
+        elif self.fmt and not isinstance(record, str):
+            import json
+
+            record = json.dumps(record, default=str)
         self._f.write(str(record) + "\n")
+        self.records_written += 1
 
     def close(self):
         self._f.close()

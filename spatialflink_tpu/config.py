@@ -177,6 +177,10 @@ class QueryConfig:
     # of the reference's task parallelism (``env.setParallelism(30)``,
     # StreamingJob.java:221). 0/1 = single device.
     parallelism: int = 0
+    # outer (DCN) axis width for multi-host runs: hosts > 1 makes the mesh
+    # 2-D (hosts x parallelism/hosts) with two-level ICI->DCN merges; must
+    # divide parallelism. 0/1 = flat 1-D mesh.
+    hosts: int = 0
     radius: float = 0.0
     aggregate_function: str = "SUM"
     k: int = 10
@@ -201,10 +205,18 @@ class QueryConfig:
                 "query.parallelism: must be 0 (off) or a power of two "
                 "(window batch capacities are power-of-two buckets; the "
                 "point dim must divide evenly across the mesh)")
+        hosts = int(_opt(d, "hosts", 0))
+        if hosts < 0 or (hosts & (hosts - 1)):
+            raise ConfigError("query.hosts: must be 0 (off) or a power of two")
+        if hosts > 1 and (parallelism == 0 or parallelism % hosts):
+            raise ConfigError(
+                "query.hosts: must divide query.parallelism (the 2-D mesh is "
+                "hosts x parallelism/hosts)")
         return cls(
             option=int(_req(d, "option", "query")),
             approximate=bool(_opt(d, "approximate", False)),
             parallelism=parallelism,
+            hosts=hosts,
             radius=float(_opt(d, "radius", 0.0)),
             aggregate_function=agg,
             k=int(_opt(d, "k", 10)),

@@ -190,8 +190,10 @@ def _query_conf(params: Params, spec: CaseSpec) -> QueryConfiguration:
         approximate=params.query.approximate,
         k=params.query.k,
         # query.parallelism ≙ env.setParallelism(30) (StreamingJob.java:221):
-        # shard PointPoint window batches across a device mesh
+        # shard window batches across a device mesh; query.hosts > 1 makes
+        # it the 2-D multi-host (DCN x ICI) shape
         devices=params.query.parallelism or None,
+        hosts=params.query.hosts or None,
     )
 
 
@@ -567,6 +569,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--devices", type=int, default=None,
                     help="shard window batches across this many devices "
                          "(power of two; overrides query.parallelism)")
+    ap.add_argument("--hosts", type=int, default=None,
+                    help="outer DCN axis width: > 1 builds the 2-D "
+                         "multi-host mesh (hosts x devices/hosts; overrides "
+                         "query.hosts)")
     ap.add_argument("--output", default=None,
                     help="also write every result RECORD to this file, one "
                          "per line, serialized in --output-format — the "
@@ -592,6 +598,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         params.query.option = args.option
     if args.devices is not None:
         params.query.parallelism = args.devices
+    if args.hosts is not None:
+        params.query.hosts = args.hosts
     if args.format is not None or args.format2 is not None:
         import dataclasses
 

@@ -59,6 +59,11 @@ class QueryConfiguration:
     # minus the reference's parallelism-1 windowAll merge.
     # Must be a power of two (batch capacities are power-of-two buckets).
     devices: Optional[int] = None
+    # outer (DCN) axis width: hosts > 1 builds a 2-D (hosts x devices/hosts)
+    # mesh — kNN merges become two-level ICI->DCN (k * hosts DCN traffic,
+    # window-size independent), filters/joins shard over both axes. Must be
+    # a power of two dividing ``devices``.
+    hosts: Optional[int] = None
 
     def window_spec(self) -> WindowSpec:
         return WindowSpec.sliding(self.window_size_ms, self.slide_ms)
@@ -110,6 +115,14 @@ class SpatialOperator:
         if conf.devices and (conf.devices & (conf.devices - 1)):
             raise ValueError(
                 f"conf.devices={conf.devices}: must be a power of two")
+        if conf.hosts and conf.hosts > 1:
+            if conf.hosts & (conf.hosts - 1):
+                raise ValueError(
+                    f"conf.hosts={conf.hosts}: must be a power of two")
+            if not conf.devices or conf.devices % conf.hosts:
+                raise ValueError(
+                    f"conf.hosts={conf.hosts} must divide "
+                    f"conf.devices={conf.devices}")
         # own copy: degraded mode mutates conf.devices, and a caller-shared
         # config must not silently degrade sibling operators (their cached
         # meshes would go stale against the mutated width)
@@ -124,19 +137,27 @@ class SpatialOperator:
         return bool(self.conf.devices and self.conf.devices > 1)
 
     def _mesh(self):
-        """Lazy 1-D device mesh for ``conf.devices`` (device access is
-        deferred until the first window actually evaluates)."""
+        """Lazy device mesh for ``conf.devices`` (device access is deferred
+        until the first window actually evaluates): 1-D, or 2-D
+        (hosts x devices/hosts) when ``conf.hosts`` > 1 — the multi-host
+        shape whose outer-axis collectives ride DCN."""
         if self._mesh_obj is None:
-            from spatialflink_tpu.parallel.mesh import make_mesh
+            from spatialflink_tpu.parallel.mesh import make_mesh, make_mesh_2d
 
-            self._mesh_obj = make_mesh(self.conf.devices)
+            if self.conf.hosts and self.conf.hosts > 1:
+                self._mesh_obj = make_mesh_2d(
+                    self.conf.hosts, self.conf.devices // self.conf.hosts)
+            else:
+                self._mesh_obj = make_mesh(self.conf.devices)
         return self._mesh_obj
 
     def _shard(self, batch):
-        """Place a window batch with its point dim sharded over the mesh."""
+        """Place a window batch with its point dim sharded over the mesh
+        (over BOTH axes of a 2-D mesh)."""
         from spatialflink_tpu.parallel.mesh import shard_batch
 
-        return shard_batch(batch, self._mesh())
+        mesh = self._mesh()
+        return shard_batch(batch, mesh, axis=tuple(mesh.axis_names))
 
     def _degrade_mesh(self, err: BaseException) -> None:
         """Elastic degraded mode (SURVEY §7 phase 7): a device failure during
@@ -156,6 +177,10 @@ class SpatialOperator:
               f"{self.conf.devices} -> {new}", file=sys.stderr)
         REGISTRY.counter("mesh-degradations").inc()
         self.conf.devices = new
+        # a 2-D mesh drops to flat 1-D: after losing devices the hosts x
+        # chips factorization no longer reflects the hardware, and results
+        # are mesh-layout invariant anyway
+        self.conf.hosts = None
         self._mesh_obj = None
 
     def _eval_degradable(self, single_fn, dist_fn, batch=None):
@@ -179,13 +204,12 @@ class SpatialOperator:
         the shared per-shard closure still re-raise from the single-device
         path; non-RuntimeError exceptions (shape/type bugs) propagate
         unchanged."""
-        from spatialflink_tpu.parallel.mesh import shard_batch
 
         while self.distributed:
             try:
                 mesh = self._mesh()
                 if batch is not None:
-                    return dist_fn(mesh, shard_batch(batch, mesh))
+                    return dist_fn(mesh, self._shard(batch))
                 return dist_fn(mesh)
             except RuntimeError as e:
                 self._degrade_mesh(e)

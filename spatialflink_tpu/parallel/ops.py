@@ -180,6 +180,13 @@ def distributed_join_mask(
                                    center_x, center_y, n=n))
 
 
+def _point_axes(mesh: Mesh):
+    """The point-dim sharding axes of ``mesh``: ``(CELL_AXIS,)`` for the 1-D
+    mesh, ``(DCN_AXIS, CELL_AXIS)`` for the 2-D hybrid — single source for
+    the stream ops' specs/collectives so every op accepts either shape."""
+    return tuple(mesh.axis_names)
+
+
 def distributed_stream_filter(mesh: Mesh, batch, mask_stats_fn):
     """Geometry/point STREAM filter over the mesh (the missing mesh dispatch
     for PointGeom/GeomPoint/GeomGeom range — every reference pipeline runs at
@@ -191,20 +198,20 @@ def distributed_stream_filter(mesh: Mesh, batch, mask_stats_fn):
     replicated query-side arrays), so semantics cannot fork between the two
     paths; the pruning stats are psum-merged. Returns (mask_sharded,
     gn_total, evals_total) — embarrassingly parallel on the mask, one scalar
-    collective for the counters.
+    collective for the counters. Accepts 1-D and 2-D (hosts x chips) meshes.
     """
+    axes = _point_axes(mesh)
 
     def per_shard(b):
         mask, gn, evals = mask_stats_fn(b)
-        return (mask, jax.lax.psum(gn, CELL_AXIS),
-                jax.lax.psum(evals, CELL_AXIS))
+        return (mask, jax.lax.psum(gn, axes), jax.lax.psum(evals, axes))
 
     fn = shard_map(
         per_shard,
         mesh=mesh,
         check_vma=False,
-        in_specs=(P(CELL_AXIS),),
-        out_specs=(P(CELL_AXIS), P(), P()),
+        in_specs=(P(axes),),
+        out_specs=(P(axes), P(), P()),
     )
     return fn(batch)
 
@@ -236,21 +243,36 @@ def distributed_stream_knn(mesh: Mesh, batch, elig_dist_fn=None, *, k: int,
             eligible, dists = elig_dist_fn(b)
             local, n_elig = knn_eligible_stats(b.obj_id, dists, eligible,
                                                k=k, strategy=strategy)
-        all_oid = jax.lax.all_gather(local.obj_id, CELL_AXIS).reshape(-1)
-        all_d = jax.lax.all_gather(local.dist, CELL_AXIS).reshape(-1)
-        all_v = jax.lax.all_gather(local.valid, CELL_AXIS).reshape(-1)
-        merged = topk_by_distance(all_oid, all_d, all_v, k)
-        evals = jax.lax.psum(n_elig, CELL_AXIS)
+        # level 1: merge k-sized partials across the slice (ICI axis)
+        merged = _gather_topk(local, CELL_AXIS, k)
+        if DCN_AXIS in axes:
+            # level 2 (2-D mesh): one k-sized partial per slice across
+            # hosts — DCN traffic is k * n_hosts, window-size independent
+            # (the hierarchical merge of distributed_knn_hierarchical,
+            # available to every stream type through the operator path)
+            merged = _gather_topk(merged, DCN_AXIS, k)
+        evals = jax.lax.psum(n_elig, axes)
         return merged, evals
 
+    axes = _point_axes(mesh)
     fn = shard_map(
         per_shard,
         mesh=mesh,
         check_vma=False,
-        in_specs=(P(CELL_AXIS),),
+        in_specs=(P(axes),),
         out_specs=(KnnResult(P(), P(), P()), P()),
     )
     return fn(batch)
+
+
+def _gather_topk(partial: KnnResult, axis_name: str, k: int) -> KnnResult:
+    """all-gather k-sized per-shard partials over one mesh axis and re-top-k
+    (value-preserving: selection only, distances are exact copies)."""
+    return topk_by_distance(
+        jax.lax.all_gather(partial.obj_id, axis_name).reshape(-1),
+        jax.lax.all_gather(partial.dist, axis_name).reshape(-1),
+        jax.lax.all_gather(partial.valid, axis_name).reshape(-1),
+        k)
 
 
 def distributed_stream_join_lattice(mesh: Mesh, a, b, lattice_fn):
@@ -264,12 +286,13 @@ def distributed_stream_join_lattice(mesh: Mesh, a, b, lattice_fn):
     def per_shard(a_shard, b_rep):
         return lattice_fn(a_shard, b_rep)
 
+    axes = _point_axes(mesh)
     fn = shard_map(
         per_shard,
         mesh=mesh,
         check_vma=False,
-        in_specs=(P(CELL_AXIS), P()),
-        out_specs=P(CELL_AXIS),
+        in_specs=(P(axes), P()),
+        out_specs=P(axes),
     )
     return fn(a, b)
 

@@ -705,3 +705,106 @@ class TestElasticDegradedMode:
         op = PointPointRangeQuery(self._conf(8), GRID)
         with pytest.raises(TypeError):
             list(op.run(iter(pts), q, 0.4))
+
+
+class TestTwoDMeshOperators:
+    """conf.hosts > 1 builds the 2-D (hosts x chips) mesh through the SAME
+    operator paths: output must match single-device bit-for-bit, with kNN
+    merged in two levels (ICI within a slice, then k-sized partials per
+    slice over DCN)."""
+
+    def _points(self, n, seed):
+        from spatialflink_tpu.models import Point
+
+        rng = np.random.default_rng(seed)
+        t0 = 1_700_000_000_000
+        return [
+            Point.create(float(rng.uniform(115.6, 117.5)),
+                         float(rng.uniform(39.7, 41.0)), GRID,
+                         obj_id=f"o{i % 61}", timestamp=t0 + i * 10)
+            for i in range(n)
+        ]
+
+    def _conf(self, devices=None, hosts=None):
+        from spatialflink_tpu.operators import QueryConfiguration, QueryType
+
+        return QueryConfiguration(QueryType.WindowBased, window_size_ms=10_000,
+                                  slide_ms=5_000, devices=devices, hosts=hosts)
+
+    def test_range_2d_matches_single(self):
+        from spatialflink_tpu.models import Point
+        from spatialflink_tpu.operators import PointPointRangeQuery
+
+        pts = self._points(3000, 71)
+        q = Point.create(QX, QY, GRID)
+        r1 = list(PointPointRangeQuery(self._conf(), GRID).run(
+            iter(pts), q, 0.4))
+        r2d = list(PointPointRangeQuery(self._conf(8, hosts=2), GRID).run(
+            iter(pts), q, 0.4))
+        assert [w.window_start for w in r1] == [w.window_start for w in r2d]
+        for a, b in zip(r1, r2d):
+            assert [(p.obj_id, p.timestamp) for p in a.records] == \
+                   [(p.obj_id, p.timestamp) for p in b.records]
+
+    def test_knn_2d_matches_single(self):
+        from spatialflink_tpu.models import Point
+        from spatialflink_tpu.operators import PointPointKNNQuery
+
+        pts = self._points(3000, 72)
+        q = Point.create(QX, QY, GRID)
+        r1 = list(PointPointKNNQuery(self._conf(), GRID).run(
+            iter(pts), q, 0.5, 15))
+        r2d = list(PointPointKNNQuery(self._conf(8, hosts=4), GRID).run(
+            iter(pts), q, 0.5, 15))
+        assert len(r1) == len(r2d) and any(w.records for w in r1)
+        for a, b in zip(r1, r2d):
+            assert a.records == b.records
+
+    def test_join_2d_matches_single(self):
+        from spatialflink_tpu.operators import PointPointJoinQuery
+
+        a = self._points(1500, 73)
+        b = self._points(400, 74)
+        r1 = list(PointPointJoinQuery(self._conf(), GRID, GRID).run(
+            iter(a), iter(b), 0.1))
+        r2d = list(PointPointJoinQuery(self._conf(8, hosts=2), GRID, GRID).run(
+            iter(a), iter(b), 0.1))
+        assert len(r1) == len(r2d) and any(w.records for w in r1)
+        for x, y in zip(r1, r2d):
+            key = lambda prs: sorted((p.obj_id, p.timestamp, q.obj_id,
+                                      q.timestamp) for p, q in prs)
+            assert key(x.records) == key(y.records)
+
+    def test_2d_degrades_to_flat_mesh(self, monkeypatch):
+        from spatialflink_tpu.models import Point
+        from spatialflink_tpu.operators import PointPointRangeQuery
+        from spatialflink_tpu.parallel import ops as pops
+
+        real = pops.distributed_stream_filter
+        failures = {"left": 1}
+
+        def flaky(mesh, batch, fn):
+            if failures["left"] > 0:
+                failures["left"] -= 1
+                raise RuntimeError("injected device loss (test)")
+            return real(mesh, batch, fn)
+
+        monkeypatch.setattr(pops, "distributed_stream_filter", flaky)
+        pts = self._points(1200, 75)
+        q = Point.create(QX, QY, GRID)
+        op = PointPointRangeQuery(self._conf(8, hosts=2), GRID)
+        r = list(op.run(iter(pts), q, 0.4))
+        assert op.conf.devices == 4 and op.conf.hosts is None
+        r1 = list(PointPointRangeQuery(self._conf(), GRID).run(
+            iter(pts), q, 0.4))
+        for a, b in zip(r1, r):
+            assert [(p.obj_id, p.timestamp) for p in a.records] == \
+                   [(p.obj_id, p.timestamp) for p in b.records]
+
+    def test_hosts_must_divide_devices(self):
+        from spatialflink_tpu.operators import PointPointRangeQuery
+
+        with pytest.raises(ValueError):  # power-of-two but > devices
+            PointPointRangeQuery(self._conf(4, hosts=8), GRID)
+        with pytest.raises(ValueError):  # not a power of two
+            PointPointRangeQuery(self._conf(8, hosts=3), GRID)

@@ -45,9 +45,9 @@ def main() -> int:
            for i in range(5000)]
     query = Point.create(116.5, 40.5, grid)
 
-    def run(n_devices):
+    def run(n_devices, hosts=None):
         conf = QueryConfiguration(QueryType.WindowBased, 10_000, 5_000,
-                                  devices=n_devices)
+                                  devices=n_devices, hosts=hosts)
         return list(PointPointKNNQuery(conf, grid).run(
             iter(pts), query, radius=0.5, k=10))
 
@@ -58,6 +58,13 @@ def main() -> int:
         assert a.records == b.records, "mesh result diverged!"
     print(f"{len(single)} windows; {devices}-device mesh output matches "
           "single-device bit-for-bit")
+    if devices >= 4:
+        # the multi-host shape: 2-D (hosts x chips) mesh, two-level merge
+        # (ICI within a slice, k-sized partials per slice over DCN)
+        two_d = run(devices, hosts=2)
+        for a, b in zip(single, two_d):
+            assert a.records == b.records, "2-D mesh result diverged!"
+        print(f"2-D mesh (2 hosts x {devices // 2} chips) matches too")
     for w in single[:3]:
         top = ", ".join(f"{o}@{d:.4f}" for o, d in w.records[:3])
         print(f"  window [{w.window_start}, {w.window_end}) top-3: {top}")

@@ -208,10 +208,9 @@ class QueryConfig:
         hosts = int(_opt(d, "hosts", 0))
         if hosts < 0 or (hosts & (hosts - 1)):
             raise ConfigError("query.hosts: must be 0 (off) or a power of two")
-        if hosts > 1 and (parallelism == 0 or parallelism % hosts):
-            raise ConfigError(
-                "query.hosts: must divide query.parallelism (the 2-D mesh is "
-                "hosts x parallelism/hosts)")
+        # hosts-divides-parallelism is checked AFTER CLI overrides (driver
+        # applies --devices/--hosts on top of the YAML; validate_mesh) and
+        # again in the operator ctor as the backstop
         return cls(
             option=int(_req(d, "option", "query")),
             approximate=bool(_opt(d, "approximate", False)),
@@ -321,6 +320,21 @@ class Params:
     def window_ms(self) -> Tuple[int, int]:
         return (int(self.window.interval_s * 1000),
                 int(self.window.step_s * 1000))
+
+    def validate_mesh(self) -> None:
+        """Cross-field mesh validation — called AFTER CLI overrides land on
+        top of the YAML (--devices/--hosts), so a valid combination split
+        between the two sources isn't rejected at load time and an invalid
+        CLI value fails with a config error, not a deep traceback."""
+        h, p = self.query.hosts, self.query.parallelism
+        if h < 0 or (h & (h - 1)):
+            raise ConfigError("hosts: must be 0 (off) or a power of two")
+        if p < 0 or (p & (p - 1)):
+            raise ConfigError("parallelism: must be 0 (off) or a power of two")
+        if h > 1 and (p == 0 or p % h):
+            raise ConfigError(
+                "hosts must divide parallelism (the 2-D mesh is "
+                f"hosts x parallelism/hosts; got hosts={h}, parallelism={p})")
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)

@@ -600,6 +600,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         params.query.parallelism = args.devices
     if args.hosts is not None:
         params.query.hosts = args.hosts
+    try:
+        params.validate_mesh()
+    except Exception as e:
+        ap.error(str(e))
     if args.format is not None or args.format2 is not None:
         import dataclasses
 

@@ -99,20 +99,10 @@ def distributed_knn_hierarchical(
             pts, qx, qy, q_cell, radius, nb_layers,
             n=n, k=k, enforce_radius=enforce_radius, strategy=strategy,
         )
-        # level 1: merge across the slice (ICI)
-        ici = KnnResult(
-            jax.lax.all_gather(local.obj_id, CELL_AXIS).reshape(-1),
-            jax.lax.all_gather(local.dist, CELL_AXIS).reshape(-1),
-            jax.lax.all_gather(local.valid, CELL_AXIS).reshape(-1),
-        )
-        slice_top = topk_by_distance(ici.obj_id, ici.dist, ici.valid, k)
-        # level 2: merge the per-slice partials across hosts (DCN)
-        dcn = KnnResult(
-            jax.lax.all_gather(slice_top.obj_id, DCN_AXIS).reshape(-1),
-            jax.lax.all_gather(slice_top.dist, DCN_AXIS).reshape(-1),
-            jax.lax.all_gather(slice_top.valid, DCN_AXIS).reshape(-1),
-        )
-        return topk_by_distance(dcn.obj_id, dcn.dist, dcn.valid, k)
+        # level 1 across the slice (ICI), level 2 per-slice partials across
+        # hosts (DCN) — ONE merge implementation (_gather_topk) shared with
+        # distributed_stream_knn's 2-D path
+        return _gather_topk(_gather_topk(local, CELL_AXIS, k), DCN_AXIS, k)
 
     fn = shard_map(
         per_shard,

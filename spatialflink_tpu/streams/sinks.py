@@ -3,6 +3,7 @@ latency sinks in ``utils/HelperClass.java:455-529``)."""
 
 from __future__ import annotations
 
+import json
 import sys
 import time
 from typing import List, Optional
@@ -51,14 +52,20 @@ class FileSink:
         self.records_written = 0
         self._f = open(path, "w")
 
+    def _ser(self, obj):
+        return serialize_spatial(obj, self.fmt, delimiter=self.delimiter,
+                                 date_format=self.date_format)
+
     def emit(self, record):
         if self.fmt and hasattr(record, "obj_id"):
-            record = serialize_spatial(record, self.fmt,
-                                       delimiter=self.delimiter,
-                                       date_format=self.date_format)
+            record = self._ser(record)
+        elif (self.fmt and isinstance(record, (tuple, list)) and record
+                and all(hasattr(r, "obj_id") for r in record)):
+            # join pairs (and any spatial tuple): a JSON array of the
+            # per-element serializations — each element honors the output
+            # format, the array frame keeps the line machine-parseable
+            record = json.dumps([self._ser(r) for r in record])
         elif self.fmt and not isinstance(record, str):
-            import json
-
             record = json.dumps(record, default=str)
         self._f.write(str(record) + "\n")
         self.records_written += 1

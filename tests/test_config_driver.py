@@ -327,3 +327,48 @@ def test_output_file_covers_deser_results(tmp_path):
     assert rc == 0
     recs = out.read_text().strip().splitlines()
     assert len(recs) == 1 and recs[0].startswith("GEOMETRYCOLLECTION (")
+
+
+def test_output_file_join_pairs_are_serialized(tmp_path):
+    # join records are (a, b) pairs: written as a JSON array of the two
+    # per-element serializations (never Python reprs)
+    import json as _json
+    import shutil
+
+    lines, pts, grid = _synth_lines()
+    inp = tmp_path / "pts.geojson"
+    inp.write_text("\n".join(lines))
+    cfg = tmp_path / "conf.yml"
+    shutil.copy(CONF, cfg)
+    out = tmp_path / "pairs.wkt"
+    rc = main(["--config", str(cfg), "--input1", str(inp),
+               "--input2", str(inp), "--option", "101",
+               "--output", str(out), "--output-format", "WKT"])
+    assert rc == 0
+    recs = out.read_text().strip().splitlines()
+    assert recs
+    pair = _json.loads(recs[0])
+    assert len(pair) == 2 and all(s.startswith("POINT") for s in pair)
+
+
+def test_cli_mesh_validation_after_overrides(tmp_path):
+    import shutil
+
+    lines, pts, grid = _synth_lines()
+    inp = tmp_path / "pts.geojson"
+    inp.write_text("\n".join(lines))
+    cfg = tmp_path / "conf.yml"
+    shutil.copy(CONF, cfg)
+    # valid: hosts and devices both from the CLI
+    rc = main(["--config", str(cfg), "--input1", str(inp),
+               "--devices", "8", "--hosts", "2"])
+    assert rc == 0
+    # invalid combinations fail fast with an argparse error, not a traceback
+    import pytest as _pytest
+
+    with _pytest.raises(SystemExit):
+        main(["--config", str(cfg), "--input1", str(inp), "--hosts", "3"])
+    with _pytest.raises(SystemExit):
+        main(["--config", str(cfg), "--input1", str(inp), "--hosts", "-2"])
+    with _pytest.raises(SystemExit):
+        main(["--config", str(cfg), "--input1", str(inp), "--hosts", "2"])

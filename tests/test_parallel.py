@@ -808,3 +808,65 @@ class TestTwoDMeshOperators:
             PointPointRangeQuery(self._conf(4, hosts=8), GRID)
         with pytest.raises(ValueError):  # not a power of two
             PointPointRangeQuery(self._conf(8, hosts=3), GRID)
+
+
+class TestGeomStream2DMesh:
+    """Geometry streams through the 2-D (hosts x chips) mesh: the generic
+    stream funnels (filter / kNN / join lattice) must produce single-device
+    output bit-for-bit on the hosts>1 shape too."""
+
+    def _polys(self, n, seed):
+        from spatialflink_tpu.models import Polygon
+
+        rng = np.random.default_rng(seed)
+        t0 = 1_700_000_000_000
+        out = []
+        for i in range(n):
+            cx = float(rng.uniform(115.7, 117.4))
+            cy = float(rng.uniform(39.8, 40.9))
+            w = float(rng.uniform(0.01, 0.08))
+            out.append(Polygon.create(
+                [[(cx - w, cy - w), (cx + w, cy - w), (cx + w, cy + w),
+                  (cx - w, cy + w)]], GRID, obj_id=f"g{i % 61}",
+                timestamp=t0 + i * 10))
+        return out
+
+    def _conf(self, devices=None, hosts=None):
+        from spatialflink_tpu.operators import QueryConfiguration, QueryType
+
+        return QueryConfiguration(QueryType.WindowBased, window_size_ms=10_000,
+                                  slide_ms=5_000, devices=devices, hosts=hosts)
+
+    def _qpoly(self):
+        from spatialflink_tpu.models import Polygon
+
+        return Polygon.create([[(116.2, 40.2), (116.9, 40.2), (116.9, 40.8),
+                                (116.2, 40.8)]], GRID)
+
+    def test_polygon_range_2d_matches_single(self):
+        from spatialflink_tpu.operators import PolygonPolygonRangeQuery
+
+        polys = self._polys(600, 81)
+        r1 = list(PolygonPolygonRangeQuery(self._conf(), GRID).run(
+            iter(polys), self._qpoly(), 0.3))
+        r2d = list(PolygonPolygonRangeQuery(self._conf(8, hosts=2), GRID).run(
+            iter(polys), self._qpoly(), 0.3))
+        assert any(w.records for w in r1)
+        assert [(w.window_start,
+                 sorted(g.obj_id for g in w.records)) for w in r1] == \
+               [(w.window_start,
+                 sorted(g.obj_id for g in w.records)) for w in r2d]
+
+    def test_polygon_knn_2d_matches_single(self):
+        from spatialflink_tpu.models import Point
+        from spatialflink_tpu.operators import PolygonPointKNNQuery
+
+        polys = self._polys(600, 82)
+        q = Point.create(QX, QY, GRID)
+        r1 = list(PolygonPointKNNQuery(self._conf(), GRID).run(
+            iter(polys), q, 0.5, 9))
+        r2d = list(PolygonPointKNNQuery(self._conf(8, hosts=2), GRID).run(
+            iter(polys), q, 0.5, 9))
+        assert len(r1) == len(r2d) and any(w.records for w in r1)
+        for a, b in zip(r1, r2d):
+            assert a.records == b.records

@@ -105,7 +105,7 @@ def join_counts(
 
 
 # Above this many lattice cells per window, join_pairs_host prefilters the
-# a side with the pallas join_reduce reduction (O(Na) memory) before
+# a side with the tiled join_reduce reduction (O(Na) memory) before
 # materializing any lattice tile — sparse joins then only pay for rows that
 # actually have partners.
 _LATTICE_BUDGET = 1 << 26

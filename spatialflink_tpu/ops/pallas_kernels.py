@@ -1,6 +1,5 @@
-"""Pallas TPU kernels for the hot window-batch ops.
-
-Two fused kernels, each VMEM-resident and tiled for the VPU:
+"""Pallas TPU kernel for the point->query-geometry hot op, plus the tiled
+join reduction.
 
 - :func:`pip_dist` — point -> single-query-geometry distance: even-odd
   ray-cast containment fused with min point-segment boundary distance in one
@@ -8,19 +7,35 @@ Two fused kernels, each VMEM-resident and tiled for the VPU:
   polygon/linestring-query operator (reference:
   ``range/PointPolygonRangeQuery.java:117-``, ``tRange/PointPolygonTRangeQuery
   .java:53-87`` — there a per-tuple JTS call; here one kernel per window).
+  The pallas kernel is LANE-MAJOR: points tiled (128, 128) across the full
+  VPU register file, edges broadcast one at a time from SMEM scalars.
+  Measured on the chip (TPU v5e-1, 1M points x 64-edge polygon, slope
+  method, benchmarks/TPU_NOTES.md §6): 435 us/window vs 773 us for the
+  fused XLA twin (1.8x) and vs 5.25 ms for the round-3 column-major pallas
+  layout (12x) — (TP, 1) column blocks use 1 of 128 vector lanes, which is
+  why the old kernel lost to XLA despite identical arithmetic.
 - :func:`join_reduce` — per-left-point reduction over the whole right batch:
   number of right partners within radius (after Chebyshev cell pruning,
   ``join/JoinQuery.java:148-162`` semantics) plus the nearest partner's
-  distance and index, without materializing the (N, M) pair matrix in HBM.
-  Reachable path: ``ops.join.join_pairs_host`` (every join operator's pair
-  extraction) uses it to prefilter the a side when the window's lattice
-  exceeds the budget, so sparse big-window joins only materialize rows that
-  have partners.
+  distance and index, without materializing the (N, M) pair matrix in HBM
+  (a lax.scan over right-side tiles; peak memory O(N * tile)). Reachable
+  path: ``ops.join.join_pairs_host`` (every join operator's pair extraction)
+  uses it to prefilter the a side when the window's lattice exceeds the
+  budget, so sparse big-window joins only materialize rows that have
+  partners. This one is deliberately NOT pallas: the XLA scan runs the
+  262k x 4k reduction in 3.7 ms (288G pair-tests/s, VPU-saturating) vs
+  51 ms for the round-3 pallas version — the compiler already emits the
+  optimal code for an elementwise broadcast reduction, so the hand kernel
+  was deleted rather than carried as a showpiece (measurements in
+  benchmarks/TPU_NOTES.md §6).
 
-Both have jnp twins (the exact code paths in :mod:`ops.geom` /
-:mod:`ops.join`); dispatch is by backend — pallas on TPU, jnp elsewhere —
-overridable with ``SPATIALFLINK_PALLAS`` = ``off`` | ``interpret`` (CPU
-interpreter, used by the test suite) | ``auto``.
+:func:`pip_dist` dispatch is by backend — pallas on TPU, the jnp twin
+(:func:`ops.geom.points_to_single_edges_raw`) elsewhere — overridable with
+``SPATIALFLINK_PALLAS`` = ``off`` | ``interpret`` (CPU interpreter, used by
+the test suite) | ``auto``. Query geometries beyond ``_MAX_SMEM_EDGES``
+edges also take the jnp twin: the edge array is staged in SMEM, which is a
+few KB of scalar memory, and window-query geometries are small (a query
+polygon with >512 edges is already degenerate for grid pruning).
 """
 
 from __future__ import annotations
@@ -35,12 +50,17 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 _BIG = np.float32(3.4e38)
-_F_BIG = 3.4e38  # plain literals for in-kernel use (pallas
-_I_BIG = 2**31 - 1  # kernels cannot capture traced constants)
+_F_BIG = 3.4e38  # plain literal for in-kernel use (pallas kernels
+#                  cannot capture traced constants)
 
-# point rows per grid step (sublane dim) and edge/right lanes per inner tile
-_TP = 256
-_TL = 128
+# lane-major point tiling: (sublane rows, lanes) = (128, 128) => 16384
+# points per grid step, every op on a full (8, 128) vreg
+_TPS = 128
+_LAN = 128
+# scalar edge loop unroll (measured: 4 is ~35% over 1, 8 is flat)
+_UNROLL = 4
+# edges are staged whole into SMEM; beyond this the jnp twin runs instead
+_MAX_SMEM_EDGES = 512
 
 
 def pallas_mode() -> str:
@@ -67,54 +87,61 @@ def _ceil_to(n: int, m: int) -> int:
 
 
 # --------------------------------------------------------------------------- #
-# Kernel 1: fused point-in-rings + min boundary distance
+# Fused point-in-rings + min boundary distance (lane-major pallas kernel)
 # --------------------------------------------------------------------------- #
 
 
-def _pip_kernel(px_ref, py_ref, x1_ref, y1_ref, x2_ref, y2_ref, m_ref,
-                cross_ref, mind2_ref):
-    px = px_ref[:]  # (TP, 1)
+def _pip_kernel(e_ref, m_ref, px_ref, py_ref, cross_ref, mind2_ref):
+    """One (TPS, LAN) point tile against every edge.
+
+    Edges live in SMEM as (4, E) scalars; each loop step broadcasts one
+    edge's parameters against the whole point tile, so the divide (slope,
+    inv_len) is scalar work done once per edge — the vector units only see
+    multiply/add/compare (the same hoisting as ops.distances, one level
+    stronger: scalar instead of per-edge-lane).
+    """
+    px = px_ref[:]  # (TPS, LAN)
     py = py_ref[:]
-    n_tiles = m_ref.shape[1] // _TL
+    ne = m_ref.shape[1]
 
-    def body(t, carry):
-        cross, mind2 = carry
-        sl = pl.ds(t * _TL, _TL)
-        x1 = x1_ref[:, sl]  # (1, TL)
-        y1 = y1_ref[:, sl]
-        x2 = x2_ref[:, sl]
-        y2 = y2_ref[:, sl]
-        valid = m_ref[:, sl] > 0
+    def one(t, cross, mind2):
+        x1 = e_ref[0, t]
+        y1 = e_ref[1, t]
+        x2 = e_ref[2, t]
+        y2 = e_ref[3, t]
+        valid = m_ref[0, t] > 0
 
-        # even-odd ray cast, half-open on y (ops.distances.point_in_rings);
-        # slope hoisted onto the (1, TL) edge shape like inv_len below
-        straddles = (y1 > py) != (y2 > py)  # (TP, TL)
+        # even-odd ray cast, half-open on y (ops.distances.point_in_rings)
+        straddles = (y1 > py) != (y2 > py)
         denom = jnp.where(y2 == y1, 1.0, y2 - y1)
-        slope = (x2 - x1) / denom
-        x_at_y = x1 + (py - y1) * slope
-        crossing = straddles & valid & (px < x_at_y)
-        cross = cross + jnp.sum(crossing.astype(jnp.int32), axis=1, keepdims=True)
+        x_at_y = x1 + (py - y1) * ((x2 - x1) / denom)
+        crossing = straddles & (px < x_at_y) & valid
+        # f32 accumulator: counts are <= E <= 512, exact in f32, and float
+        # adds keep the whole loop on one vreg bank
+        cross = cross + crossing.astype(jnp.float32)
 
-        # point-segment squared distance (ops.distances.point_segment_dist2);
-        # the reciprocal stays on the (1, TL) edge shape — the (TP, TL)
-        # per-point work is multiply/add only (measured +15% on CPU; the
-        # divide is costlier still on the TPU VPU)
+        # point-segment squared distance (ops.distances.point_segment_dist2)
         cx, cy = x2 - x1, y2 - y1
         len_sq = cx * cx + cy * cy
-        inv_len = jnp.where(len_sq > 0,
-                            1.0 / jnp.where(len_sq > 0, len_sq, 1.0), 0.0)
+        inv_len = jnp.where(len_sq > 0.0,
+                            1.0 / jnp.where(len_sq > 0.0, len_sq, 1.0), 0.0)
         dot = (px - x1) * cx + (py - y1) * cy
         tt = jnp.clip(dot * inv_len, 0.0, 1.0)
         qx, qy = x1 + tt * cx, y1 + tt * cy
         d2 = (px - qx) ** 2 + (py - qy) ** 2
-        d2 = jnp.where(valid, d2, _F_BIG)
-        mind2 = jnp.minimum(mind2, jnp.min(d2, axis=1, keepdims=True))
+        mind2 = jnp.minimum(mind2, jnp.where(valid, d2, _F_BIG))
+        return cross, mind2
+
+    def body(t, carry):
+        cross, mind2 = carry
+        for u in range(_UNROLL):
+            cross, mind2 = one(t * _UNROLL + u, cross, mind2)
         return cross, mind2
 
     cross, mind2 = jax.lax.fori_loop(
-        0, n_tiles, body,
-        (jnp.zeros((_TP, 1), jnp.int32),
-         jnp.full((_TP, 1), _F_BIG, jnp.float32)),
+        0, ne // _UNROLL, body,
+        (jnp.zeros((_TPS, _LAN), jnp.float32),
+         jnp.full((_TPS, _LAN), _F_BIG, jnp.float32)),
     )
     cross_ref[:] = cross
     mind2_ref[:] = mind2
@@ -124,46 +151,50 @@ def _pip_kernel(px_ref, py_ref, x1_ref, y1_ref, x2_ref, y2_ref, m_ref,
 def _pip_pallas(px, py, edges, edge_mask, *, interpret: bool):
     n = px.shape[0]
     e = edges.shape[0]
-    np_pad = _ceil_to(n, _TP)
-    ep_pad = _ceil_to(e, _TL)
+    # bucket the edge count to multiples of 64 so a pipeline's distinct
+    # query geometries share compilations; padded slots are masked out
+    ep = _ceil_to(e, 64)
+    rows = -(-n // _LAN)
+    rpad = _ceil_to(rows, _TPS)
+    npad = rpad * _LAN
 
-    pxp = _pad_to(px.astype(jnp.float32), np_pad, 0.0).reshape(np_pad, 1)
-    pyp = _pad_to(py.astype(jnp.float32), np_pad, 0.0).reshape(np_pad, 1)
-    ed = _pad_to(edges.astype(jnp.float32), ep_pad, 0.0)
-    em = _pad_to(edge_mask.astype(jnp.float32), ep_pad, 0.0).reshape(1, ep_pad)
-    x1, y1 = ed[:, 0].reshape(1, ep_pad), ed[:, 1].reshape(1, ep_pad)
-    x2, y2 = ed[:, 2].reshape(1, ep_pad), ed[:, 3].reshape(1, ep_pad)
+    pxp = _pad_to(px.astype(jnp.float32), npad, 0.0).reshape(rpad, _LAN)
+    pyp = _pad_to(py.astype(jnp.float32), npad, 0.0).reshape(rpad, _LAN)
+    e4 = _pad_to(edges.astype(jnp.float32), ep, 0.0).T  # (4, ep)
+    em = _pad_to(edge_mask.astype(jnp.int32), ep, 0).reshape(1, ep)
 
-    pt_spec = pl.BlockSpec((_TP, 1), lambda i: (i, 0), memory_space=pltpu.VMEM)
-    edge_spec = pl.BlockSpec((1, ep_pad), lambda i: (0, 0), memory_space=pltpu.VMEM)
+    pt_spec = pl.BlockSpec((_TPS, _LAN), lambda i: (i, 0),
+                           memory_space=pltpu.VMEM)
+    out_spec = pl.BlockSpec((_TPS, _LAN), lambda i: (i, 0),
+                            memory_space=pltpu.VMEM)
+    e_spec = pl.BlockSpec((4, ep), lambda i: (0, 0), memory_space=pltpu.SMEM)
+    m_spec = pl.BlockSpec((1, ep), lambda i: (0, 0), memory_space=pltpu.SMEM)
 
     cross, mind2 = pl.pallas_call(
         _pip_kernel,
-        grid=(np_pad // _TP,),
-        in_specs=[pt_spec, pt_spec] + [edge_spec] * 5,
-        out_specs=(
-            pl.BlockSpec((_TP, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((_TP, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
-        ),
+        grid=(rpad // _TPS,),
+        in_specs=[e_spec, m_spec, pt_spec, pt_spec],
+        out_specs=(out_spec, out_spec),
         out_shape=(
-            jax.ShapeDtypeStruct((np_pad, 1), jnp.int32),
-            jax.ShapeDtypeStruct((np_pad, 1), jnp.float32),
+            jax.ShapeDtypeStruct((rpad, _LAN), jnp.float32),
+            jax.ShapeDtypeStruct((rpad, _LAN), jnp.float32),
         ),
         interpret=interpret,
-    )(pxp, pyp, x1, y1, x2, y2, em)
-    inside = (cross[:n, 0] % 2) == 1
-    return inside, mind2[:n, 0]
+    )(e4, em, pxp, pyp)
+    inside = (cross.reshape(-1)[:n].astype(jnp.int32) % 2) == 1
+    return inside, mind2.reshape(-1)[:n]
 
 
 def pip_dist(px, py, edges, edge_mask, is_areal: bool):
     """(N,) JTS-style distance from each point to ONE query geometry.
 
     Drop-in twin of ``ops.geom.points_to_single_geom_dist`` (same semantics:
-    0 inside areal geometries, else min boundary distance); fused pallas on
-    TPU, jnp elsewhere.
+    0 inside areal geometries, else min boundary distance); fused lane-major
+    pallas on TPU, jnp elsewhere (and for >_MAX_SMEM_EDGES-edge geometries,
+    whose edge array would not fit SMEM).
     """
     mode = pallas_mode()
-    if mode == "off":
+    if mode == "off" or edges.shape[0] > _MAX_SMEM_EDGES:
         from spatialflink_tpu.ops.geom import points_to_single_edges_raw
 
         inside, mind2 = points_to_single_edges_raw(px, py, edges, edge_mask)
@@ -174,164 +205,61 @@ def pip_dist(px, py, edges, edge_mask, is_areal: bool):
 
 
 # --------------------------------------------------------------------------- #
-# Kernel 2: per-left-point join reduction (count + nearest partner)
+# Per-left-point join reduction (tiled XLA scan — measured faster than the
+# hand pallas kernel it replaced; see module docstring)
 # --------------------------------------------------------------------------- #
 
 
-# right-side lanes staged into VMEM per (a-tile, b-tile) grid step; the b
-# grid dimension is sequential ("arbitrary") and accumulates into the
-# output block, so VMEM holds only (TP x _NBT) operands however big Nb is
-_NBT = 2048
+@functools.partial(jax.jit, static_argnames=("n",))
+def _join_reduce_impl(a, b, radius, nb_layers, *, n: int):
+    """a/b: PointBatch-like namedtuples with .x/.y/.cell/.valid.
 
-
-def _join_kernel(r2_ref, lay_ref, ax_ref, ay_ref, acx_ref, acy_ref, av_ref,
-                 bx_ref, by_ref, bcx_ref, bcy_ref, bv_ref,
-                 cnt_ref, mind2_ref, arg_ref):
-    j = pl.program_id(1)
-
-    @pl.when(j == 0)
-    def _init():
-        cnt_ref[:] = jnp.zeros((_TP, 1), jnp.int32)
-        mind2_ref[:] = jnp.full((_TP, 1), _F_BIG, jnp.float32)
-        arg_ref[:] = jnp.full((_TP, 1), -1, jnp.int32)
-
-    ax = ax_ref[:]  # (TP, 1)
-    ay = ay_ref[:]
-    acx = acx_ref[:]
-    acy = acy_ref[:]
-    av = av_ref[:] > 0
-    r2 = r2_ref[0, 0]
-    lay = lay_ref[0, 0]
-
-    def body(t, carry):
-        cnt, mind2, amin = carry
-        sl = pl.ds(t * _TL, _TL)
-        bx = bx_ref[:, sl]  # (1, TL)
-        by = by_ref[:, sl]
-        bcx = bcx_ref[:, sl]
-        bcy = bcy_ref[:, sl]
-        bv = bv_ref[:, sl] > 0
-
-        cheb = jnp.maximum(jnp.abs(acx - bcx), jnp.abs(acy - bcy))
-        ok = av & bv & (cheb <= lay)
-        d2 = (ax - bx) ** 2 + (ay - by) ** 2
-        hit = ok & (d2 <= r2)
-        cnt = cnt + jnp.sum(hit.astype(jnp.int32), axis=1, keepdims=True)
-
-        d2m = jnp.where(hit, d2, _F_BIG)
-        tile_min = jnp.min(d2m, axis=1, keepdims=True)  # (TP, 1)
-        idx = (jax.lax.broadcasted_iota(jnp.int32, d2m.shape, 1)
-               + t * _TL + j * _NBT)
-        idx_at_min = jnp.min(
-            jnp.where(hit & (d2m == tile_min), idx, _I_BIG), axis=1, keepdims=True
-        )
-        better = tile_min < mind2
-        mind2 = jnp.where(better, tile_min, mind2)
-        amin = jnp.where(better, idx_at_min, amin)
-        return cnt, mind2, amin
-
-    cnt, mind2, amin = jax.lax.fori_loop(
-        0, _NBT // _TL, body,
-        (cnt_ref[:], mind2_ref[:], arg_ref[:]),
-    )
-    cnt_ref[:] = cnt
-    mind2_ref[:] = mind2
-    arg_ref[:] = amin
-
-
-@functools.partial(jax.jit, static_argnames=("n", "interpret"))
-def _join_reduce_impl(a, b, radius, nb_layers, *, n: int, interpret):
-    """a/b: PointBatch-like namedtuples with .x/.y/.cell/.valid."""
+    A lax.scan over right-side tiles so peak memory is (Na, tile) regardless
+    of Nb (the whole point of this reduction; a single broadcast would
+    materialize the (Na, Nb) lattice in HBM).
+    """
     acx, acy = a.cell // n, a.cell % n
     bcx, bcy = b.cell // n, b.cell % n
-    if interpret is None:
-        # jnp twin — a lax.scan over right-side tiles so peak memory is
-        # (Na, tile) regardless of Nb (the whole point of this reduction;
-        # a single broadcast would materialize the (Na, Nb) lattice on
-        # backends where XLA does not fuse every reduction)
-        nb_ = b.x.shape[0]
-        tile = min(4096, nb_)
-        pad = (-nb_) % tile  # arbitrary capacities pad up, masked via valid
-        n_tiles = (nb_ + pad) // tile
+    nb_ = b.x.shape[0]
+    tile = min(4096, nb_)
+    pad = (-nb_) % tile  # arbitrary capacities pad up, masked via valid
+    n_tiles = (nb_ + pad) // tile
 
-        def resh(v, fill=0):
-            return _pad_to(v, nb_ + pad, fill).reshape(
-                n_tiles, tile, *v.shape[1:])
+    def resh(v, fill=0):
+        return _pad_to(v, nb_ + pad, fill).reshape(n_tiles, tile, *v.shape[1:])
 
-        bx_t, by_t = resh(b.x), resh(b.y)
-        bcx_t, bcy_t = resh(bcx), resh(bcy)
-        bv_t = resh(b.valid, False)
-        offsets = jnp.arange(n_tiles, dtype=jnp.int32) * tile
+    bx_t, by_t = resh(b.x), resh(b.y)
+    bcx_t, bcy_t = resh(bcx), resh(bcy)
+    bv_t = resh(b.valid, False)
+    offsets = jnp.arange(n_tiles, dtype=jnp.int32) * tile
 
-        def step(carry, xs):
-            cnt, mind2, amin = carry
-            bx, by, bcx_, bcy_, bv, off = xs
-            cheb = jnp.maximum(jnp.abs(acx[:, None] - bcx_[None, :]),
-                               jnp.abs(acy[:, None] - bcy_[None, :]))
-            d2 = ((a.x[:, None] - bx[None, :]) ** 2
-                  + (a.y[:, None] - by[None, :]) ** 2)
-            hit = (a.valid[:, None] & bv[None, :]
-                   & (cheb <= nb_layers) & (d2 <= radius * radius))
-            cnt = cnt + jnp.sum(hit, axis=1, dtype=jnp.int32)
-            d2m = jnp.where(hit, d2, _BIG)
-            tmin = jnp.min(d2m, axis=1)
-            targ = jnp.where(jnp.any(hit, axis=1),
-                             jnp.argmin(d2m, axis=1).astype(jnp.int32) + off,
-                             jnp.int32(-1))
-            # strict < keeps the earliest tile's index on ties, matching the
-            # one-pass argmin (and the pallas kernel's tie rule)
-            better = tmin < mind2
-            return (cnt, jnp.where(better, tmin, mind2),
-                    jnp.where(better, targ, amin)), None
+    def step(carry, xs):
+        cnt, mind2, amin = carry
+        bx, by, bcx_, bcy_, bv, off = xs
+        cheb = jnp.maximum(jnp.abs(acx[:, None] - bcx_[None, :]),
+                           jnp.abs(acy[:, None] - bcy_[None, :]))
+        d2 = ((a.x[:, None] - bx[None, :]) ** 2
+              + (a.y[:, None] - by[None, :]) ** 2)
+        hit = (a.valid[:, None] & bv[None, :]
+               & (cheb <= nb_layers) & (d2 <= radius * radius))
+        cnt = cnt + jnp.sum(hit, axis=1, dtype=jnp.int32)
+        d2m = jnp.where(hit, d2, _BIG)
+        tmin = jnp.min(d2m, axis=1)
+        targ = jnp.where(jnp.any(hit, axis=1),
+                         jnp.argmin(d2m, axis=1).astype(jnp.int32) + off,
+                         jnp.int32(-1))
+        # strict < keeps the earliest tile's index on ties, matching a
+        # one-pass argmin over the full lattice
+        better = tmin < mind2
+        return (cnt, jnp.where(better, tmin, mind2),
+                jnp.where(better, targ, amin)), None
 
-        na_ = a.x.shape[0]
-        init = (jnp.zeros(na_, jnp.int32), jnp.full(na_, _BIG, jnp.float32),
-                jnp.full(na_, -1, jnp.int32))
-        (cnt, mind2, amin), _ = jax.lax.scan(
-            step, init, (bx_t, by_t, bcx_t, bcy_t, bv_t, offsets))
-        return cnt, mind2, amin
-
-    na, nb_ = a.x.shape[0], b.x.shape[0]
-    np_pad, mb_pad = _ceil_to(na, _TP), _ceil_to(nb_, _NBT)
-
-    def col(v, fill, dt):
-        return _pad_to(v.astype(dt), np_pad, fill).reshape(np_pad, 1)
-
-    def row(v, fill, dt):
-        return _pad_to(v.astype(dt), mb_pad, fill).reshape(1, mb_pad)
-
-    args = (
-        jnp.asarray([[radius * radius]], jnp.float32),
-        jnp.asarray([[nb_layers]], jnp.int32),
-        col(a.x, 0.0, jnp.float32), col(a.y, 0.0, jnp.float32),
-        col(acx, 0, jnp.int32), col(acy, 0, jnp.int32),
-        col(a.valid, 0.0, jnp.float32),
-        row(b.x, 0.0, jnp.float32), row(b.y, 0.0, jnp.float32),
-        row(bcx, 0, jnp.int32), row(bcy, 0, jnp.int32),
-        row(b.valid, 0.0, jnp.float32),
-    )
-    s_spec = pl.BlockSpec((1, 1), lambda i, j: (0, 0), memory_space=pltpu.SMEM)
-    a_spec = pl.BlockSpec((_TP, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM)
-    b_spec = pl.BlockSpec((1, _NBT), lambda i, j: (0, j), memory_space=pltpu.VMEM)
-    o_spec = pl.BlockSpec((_TP, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM)
-
-    cnt, mind2, amin = pl.pallas_call(
-        _join_kernel,
-        grid=(np_pad // _TP, mb_pad // _NBT),
-        in_specs=[s_spec, s_spec] + [a_spec] * 5 + [b_spec] * 5,
-        out_specs=(o_spec, o_spec, o_spec),
-        out_shape=(
-            jax.ShapeDtypeStruct((np_pad, 1), jnp.int32),
-            jax.ShapeDtypeStruct((np_pad, 1), jnp.float32),
-            jax.ShapeDtypeStruct((np_pad, 1), jnp.int32),
-        ),
-        # the b grid dim accumulates into the (i-indexed) output blocks, so
-        # it must iterate sequentially; the a dim is embarrassingly parallel
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary")),
-        interpret=interpret,
-    )(*args)
-    return cnt[:na, 0], mind2[:na, 0], amin[:na, 0]
+    na_ = a.x.shape[0]
+    init = (jnp.zeros(na_, jnp.int32), jnp.full(na_, _BIG, jnp.float32),
+            jnp.full(na_, -1, jnp.int32))
+    (cnt, mind2, amin), _ = jax.lax.scan(
+        step, init, (bx_t, by_t, bcx_t, bcy_t, bv_t, offsets))
+    return cnt, mind2, amin
 
 
 def join_reduce(a, b, radius, nb_layers, *, n: int):
@@ -343,6 +271,4 @@ def join_reduce(a, b, radius, nb_layers, *, n: int):
     squared distance to the nearest such partner (+inf if none) and its index
     in the right batch (-1 if none).
     """
-    mode = pallas_mode()
-    interpret = None if mode == "off" else (mode == "interpret")
-    return _join_reduce_impl(a, b, radius, nb_layers, n=n, interpret=interpret)
+    return _join_reduce_impl(a, b, radius, nb_layers, n=n)

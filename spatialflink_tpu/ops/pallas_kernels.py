@@ -150,18 +150,18 @@ def _pip_kernel(e_ref, m_ref, px_ref, py_ref, cross_ref, mind2_ref):
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def _pip_pallas(px, py, edges, edge_mask, *, interpret: bool):
     n = px.shape[0]
-    e = edges.shape[0]
-    # bucket the edge count to multiples of 64 so a pipeline's distinct
-    # query geometries share compilations; padded slots are masked out
-    ep = _ceil_to(e, 64)
+    # edges arrive pre-bucketed to a multiple of 64 (pip_dist pads OUTSIDE
+    # this jit boundary, so distinct small query geometries land on the same
+    # (ep, 4) aval and share this compilation)
+    ep = edges.shape[0]
     rows = -(-n // _LAN)
     rpad = _ceil_to(rows, _TPS)
     npad = rpad * _LAN
 
     pxp = _pad_to(px.astype(jnp.float32), npad, 0.0).reshape(rpad, _LAN)
     pyp = _pad_to(py.astype(jnp.float32), npad, 0.0).reshape(rpad, _LAN)
-    e4 = _pad_to(edges.astype(jnp.float32), ep, 0.0).T  # (4, ep)
-    em = _pad_to(edge_mask.astype(jnp.int32), ep, 0).reshape(1, ep)
+    e4 = edges.astype(jnp.float32).T  # (4, ep)
+    em = edge_mask.astype(jnp.int32).reshape(1, ep)
 
     pt_spec = pl.BlockSpec((_TPS, _LAN), lambda i: (i, 0),
                            memory_space=pltpu.VMEM)
@@ -199,8 +199,13 @@ def pip_dist(px, py, edges, edge_mask, is_areal: bool):
 
         inside, mind2 = points_to_single_edges_raw(px, py, edges, edge_mask)
     else:
-        inside, mind2 = _pip_pallas(px, py, edges, edge_mask,
-                                    interpret=(mode == "interpret"))
+        # bucket the edge count to multiples of 64 BEFORE the jit boundary so
+        # a pipeline's distinct query geometries share one compilation;
+        # padded slots are masked out in-kernel
+        ep = _ceil_to(edges.shape[0], 64)
+        inside, mind2 = _pip_pallas(
+            px, py, _pad_to(edges, ep, 0.0), _pad_to(edge_mask, ep, False),
+            interpret=(mode == "interpret"))
     return jnp.where(inside & is_areal, 0.0, jnp.sqrt(mind2))
 
 
@@ -210,8 +215,8 @@ def pip_dist(px, py, edges, edge_mask, is_areal: bool):
 # --------------------------------------------------------------------------- #
 
 
-@functools.partial(jax.jit, static_argnames=("n",))
-def _join_reduce_impl(a, b, radius, nb_layers, *, n: int):
+@functools.partial(jax.jit, static_argnames=("n", "tile"))
+def _join_reduce_impl(a, b, radius, nb_layers, *, n: int, tile: int):
     """a/b: PointBatch-like namedtuples with .x/.y/.cell/.valid.
 
     A lax.scan over right-side tiles so peak memory is (Na, tile) regardless
@@ -221,7 +226,7 @@ def _join_reduce_impl(a, b, radius, nb_layers, *, n: int):
     acx, acy = a.cell // n, a.cell % n
     bcx, bcy = b.cell // n, b.cell % n
     nb_ = b.x.shape[0]
-    tile = min(4096, nb_)
+    tile = min(tile, nb_)
     pad = (-nb_) % tile  # arbitrary capacities pad up, masked via valid
     n_tiles = (nb_ + pad) // tile
 
@@ -262,13 +267,14 @@ def _join_reduce_impl(a, b, radius, nb_layers, *, n: int):
     return cnt, mind2, amin
 
 
-def join_reduce(a, b, radius, nb_layers, *, n: int):
+def join_reduce(a, b, radius, nb_layers, *, n: int, tile: int = 4096):
     """Per-left-point join reduction against the whole right batch.
 
     Returns ``(count, min_dist2, argmin)`` each (N,): how many valid right
     points lie within ``radius`` after Chebyshev cell pruning (the
     replicate-to-neighboring-cells rule, ``join/JoinQuery.java:72-90``), the
     squared distance to the nearest such partner (+inf if none) and its index
-    in the right batch (-1 if none).
+    in the right batch (-1 if none). ``tile`` bounds the per-scan-step
+    lattice width (peak memory Na * tile).
     """
-    return _join_reduce_impl(a, b, radius, nb_layers, n=n)
+    return _join_reduce_impl(a, b, radius, nb_layers, n=n, tile=tile)

@@ -119,6 +119,26 @@ class TestJoinReduce:
         np.testing.assert_array_equal(np.asarray(amin)[has], oamin[has])
         assert (np.asarray(amin)[~has] == -1).all()
 
+    def test_multi_tile_scan(self, grid):
+        """tile=64 on a 300-point (512-capacity) b side forces 8 scan steps
+        incl. padded tail tiles — covering the cross-tile accumulation
+        (offsets, strict-< merge, argmin + off) that a single-tile run
+        never executes."""
+        ax, ay, _ = _random_batch(grid, 257, 9)
+        bx, by, _ = _random_batch(grid, 300, 10)
+        a = PointBatch.from_arrays(ax, ay, grid=grid)
+        b = PointBatch.from_arrays(bx, by, grid=grid)
+        r, lay = 1.5, grid.candidate_layers(1.5)
+        tiled = PK.join_reduce(a, b, r, lay, n=grid.n, tile=64)
+        whole = PK.join_reduce(a, b, r, lay, n=grid.n)
+        ocnt, omind2, oamin = self._oracle(a, b, r, lay, grid.n)
+        for got in (tiled, whole):
+            np.testing.assert_array_equal(np.asarray(got[0]), ocnt)
+            has = ocnt > 0
+            np.testing.assert_allclose(np.asarray(got[1])[has], omind2[has],
+                                       rtol=1e-5)
+            np.testing.assert_array_equal(np.asarray(got[2])[has], oamin[has])
+
     def test_small_uneven_tiles(self, grid):
         ax, ay, _ = _random_batch(grid, 64, 7)
         bx, by, _ = _random_batch(grid, 96, 8)

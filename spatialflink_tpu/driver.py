@@ -377,7 +377,8 @@ def _run_synthetic(params: Params, conf, grid) -> Iterator[WindowResult]:
     Results are tagged with the family via ``extras['family']`` so a smoke
     run can assert each family actually fired."""
     from spatialflink_tpu.models import Polygon
-    from spatialflink_tpu.streams.sources import SyntheticPointSource
+    from spatialflink_tpu.streams.sources import (SyntheticPointSource,
+                                                  generate_query_polygons)
 
     def src():
         return SyntheticPointSource(grid, num_trajectories=16, steps=8, seed=7)
@@ -391,8 +392,10 @@ def _run_synthetic(params: Params, conf, grid) -> Iterator[WindowResult]:
     first = list(src())
     traj_ids = {p.obj_id for p in first[:4]}
     qp = first[0]
-    # a query polygon covering the middle of the grid (the reference built
-    # synthetic query geometry with HelperClass.generateQueryPolygons)
+    # a query polygon covering the middle of the grid (guarantees matches)
+    # plus cell-sized tiles from the HelperClass.generateQueryPolygons
+    # rebuild (streams.sources.generate_query_polygons) — the polygon-SET
+    # shape the reference harness fed tRange
     cx = (grid.min_x + grid.max_x) / 2
     cy = (grid.min_y + grid.max_y) / 2
     dx = (grid.max_x - grid.min_x) / 4
@@ -400,11 +403,12 @@ def _run_synthetic(params: Params, conf, grid) -> Iterator[WindowResult]:
     qpoly = Polygon.create(
         [[(cx - dx, cy - dy), (cx + dx, cy - dy), (cx + dx, cy + dy),
           (cx - dx, cy + dy)]], grid)
+    qpolys = [qpoly] + generate_query_polygons(8, grid)
 
     yield from tagged("tfilter",
                       ops.PointTFilterQuery(conf, grid).run(src(), traj_ids))
     yield from tagged("trange",
-                      ops.PointPolygonTRangeQuery(conf, grid).run(src(), [qpoly]))
+                      ops.PointPolygonTRangeQuery(conf, grid).run(src(), qpolys))
     yield from tagged("tstats", ops.PointTStatsQuery(conf, grid).run(src()))
     yield from tagged("taggregate", ops.PointTAggregateQuery(conf, grid).run(
         src(), params.query.aggregate_function))

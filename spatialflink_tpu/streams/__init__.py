@@ -11,6 +11,7 @@ from spatialflink_tpu.streams.sources import (
     FileReplaySource,
     ListSource,
     SyntheticPointSource,
+    generate_query_polygons,
     kafka_source,
 )
 from spatialflink_tpu.streams.sinks import CollectSink, FileSink, LatencySink, StdoutSink
@@ -34,6 +35,7 @@ __all__ = [
     "FileReplaySource",
     "ListSource",
     "SyntheticPointSource",
+    "generate_query_polygons",
     "kafka_source",
     "CollectSink",
     "FileSink",

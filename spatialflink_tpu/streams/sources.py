@@ -138,3 +138,38 @@ def kafka_source(topic: str, bootstrap_servers: str = "", *, broker=None,
     consumer = KafkaConsumer(topic, bootstrap_servers=bootstrap_servers, **consumer_kwargs)
     for msg in consumer:
         yield msg.value.decode() if isinstance(msg.value, bytes) else msg.value
+
+
+def generate_query_polygons(num: int, grid: UniformGrid):
+    """Deterministic cell-sized square query polygons tiling the grid bbox —
+    the synthetic query-geometry generator for polygon-set queries (tRange
+    and friends), rebuilding ``HelperClass.generateQueryPolygons``
+    (``utils/HelperClass.java:387-439``).
+
+    Deviations from the reference, all deliberate: the side length is THIS
+    grid's ``cell_length`` (the reference re-derives it from a hardcoded
+    Beijing bbox and gridSize=100 regardless of the uGrid passed in — and
+    grid cells are ``cell_length`` squares on both axes, so min(dx, dy)/n
+    tiles would misalign with cells on non-square bboxes), and the count cap
+    never overshoots (the reference checks only per x-column; it also
+    returns a HashSet, so its order is unspecified — ours is column-major
+    and reproducible). Like the reference, a bbox holding fewer than ``num``
+    tiles yields them all: the result has ``min(num, tiles_in_bbox)``
+    polygons.
+    """
+    from spatialflink_tpu.models import Polygon
+
+    side = grid.cell_length
+    if side <= 0:  # degenerate bbox — no cells, no tiles
+        return []
+    out: List = []
+    x = grid.min_x
+    while x < grid.max_x and len(out) < num:
+        y = grid.min_y
+        while y < grid.max_y and len(out) < num:
+            out.append(Polygon.create(
+                [[(x, y), (x + side, y), (x + side, y + side),
+                  (x, y + side), (x, y)]], grid))
+            y += side
+        x += side
+    return out

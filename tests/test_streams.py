@@ -250,6 +250,35 @@ class TestSources:
         with pytest.raises(RuntimeError, match="kafka"):
             next(iter(kafka_source("topic", "localhost:9092")))
 
+    def test_generate_query_polygons(self):
+        """HelperClass.generateQueryPolygons rebuild: num cell-sized squares
+        tiling from the bbox corner, deterministic, grid-assigned."""
+        from spatialflink_tpu.streams.sources import generate_query_polygons
+
+        polys = generate_query_polygons(7, GRID)
+        assert len(polys) == 7
+        for p in polys:
+            xs = [c[0] for c in p.rings[0]]
+            ys = [c[1] for c in p.rings[0]]
+            # tiles are GRID-cell-sized squares (cells bucket both axes by
+            # cell_length), so each covers exactly one cell
+            assert max(xs) - min(xs) == pytest.approx(GRID.cell_length)
+            assert max(ys) - min(ys) == pytest.approx(GRID.cell_length)
+            assert p.cells  # assigned against the passed grid
+        # column-major from the bbox corner, reproducible
+        again = generate_query_polygons(7, GRID)
+        assert [p.rings[0][0] for p in polys] == [p.rings[0][0] for p in again]
+        assert polys[0].rings[0][0] == (GRID.min_x, GRID.min_y)
+
+    def test_generate_query_polygons_capped_by_bbox(self):
+        from spatialflink_tpu.index import UniformGrid
+        from spatialflink_tpu.streams.sources import generate_query_polygons
+
+        small = UniformGrid(0, 10, 0, 10, num_grid_partitions=2)
+        assert len(generate_query_polygons(8, small)) == 4  # only 4 tiles fit
+        flat = UniformGrid(0, 0, 0, 0, num_grid_partitions=2)
+        assert generate_query_polygons(4, flat) == []  # degenerate, no hang
+
 
 class TestWatermarks:
     def test_monotonic_and_lateness(self):

@@ -22,6 +22,7 @@ Option numbering parity (``StreamingJob.java:470-1704``):
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 import time
 from dataclasses import dataclass
@@ -549,6 +550,35 @@ def _emit(result, sink) -> None:
         sink.emit(result)
 
 
+def _enable_compilation_cache() -> None:
+    """Persist XLA compilations across CLI invocations.
+
+    A pipeline's kernels are identical run to run, but every fresh process
+    pays the compiles again — ~0.4 s on CPU and tens of seconds on TPU
+    (where the first jit is 20-40 s). Defaults to a user cache dir; an
+    explicit ``JAX_COMPILATION_CACHE_DIR`` (or pre-set jax config) wins.
+    Failure is non-fatal: the cache is an optimization, not a dependency.
+    """
+    import jax
+
+    try:
+        if os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+            cache = os.environ["JAX_COMPILATION_CACHE_DIR"]
+        elif jax.config.jax_compilation_cache_dir:
+            return  # user already configured it in-process
+        else:
+            cache = os.path.join(
+                os.environ.get("XDG_CACHE_HOME",
+                               os.path.expanduser("~/.cache")),
+                "spatialflink_tpu", "jax_cache")
+        os.makedirs(cache, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache)
+        if "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS" not in os.environ:
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    except Exception as e:  # pragma: no cover - depends on fs/env
+        print(f"note: compilation cache disabled ({e})", file=sys.stderr)
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="spatialflink-tpu",
@@ -597,6 +627,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "control-tuple stop hook")
     args = ap.parse_args(argv)
 
+    _enable_compilation_cache()
     params = Params.from_yaml(args.config)
     if args.option is not None:
         params.query.option = args.option

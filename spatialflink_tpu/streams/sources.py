@@ -163,13 +163,17 @@ def generate_query_polygons(num: int, grid: UniformGrid):
     if side <= 0:  # degenerate bbox — no cells, no tiles
         return []
     out: List = []
-    x = grid.min_x
-    while x < grid.max_x and len(out) < num:
-        y = grid.min_y
-        while y < grid.max_y and len(out) < num:
+    # integer-driven loops: exactly n x n tiles (float `x += side`
+    # accumulation can land an extra out-of-bbox column/row)
+    for ix in range(grid.n):
+        if len(out) >= num:
+            break
+        x = grid.min_x + ix * side
+        for iy in range(grid.n):
+            if len(out) >= num:
+                break
+            y = grid.min_y + iy * side
             out.append(Polygon.create(
                 [[(x, y), (x + side, y), (x + side, y + side),
                   (x, y + side), (x, y)]], grid))
-            y += side
-        x += side
     return out

@@ -1,0 +1,181 @@
+"""End-to-end streaming pipeline benchmark: sustained records/s through the
+WHOLE pipeline — host ingest -> watermarks -> window assembly -> device
+kernel -> results — not just the device hot loop.
+
+The kernel benches (bench.py, bench_configs.py) isolate per-window device
+time; bench_ingest.py isolates the parsers. This harness measures what the
+reference's Kafka->Flink jobs were actually measured by (throughput meters
+wrapping the live pipeline, ``spatialObjects/Point.java:237-253``): wall
+clock from the first raw record entering deserialization to the last window
+sealed, for the same driver paths a user runs:
+
+- ``record``: per-record parse -> ``driver.run_option`` (the
+  reference-shaped path; one Python object per tuple)
+- ``bulk``:   native C++ ingest -> ``driver.run_option_bulk`` (columnar
+  windowing; the ``--bulk`` CLI flag)
+
+Usage: python benchmarks/bench_e2e.py [--n N] [--options 1,51,101]
+       [--out PATH]
+
+Emits one JSON line per (option, path) and writes the table to
+``benchmarks/RESULTS_e2e_<backend>.json``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BEIJING = (115.50, 117.60, 39.60, 41.10)
+WINDOW_S, SLIDE_S = 10, 5
+SPAN_S = 100  # event time spanned by the stream -> ~20 sliding windows
+
+
+def _write_stream(path: str, n: int, seed: int = 0) -> None:
+    """CSV point rows ``oid,ts_ms,x,y`` spanning SPAN_S of event time,
+    timestamps nondecreasing (in-order stream; lateness is the lateness
+    tests' concern, throughput is this bench's)."""
+    rng = np.random.default_rng(seed)
+    xs = rng.uniform(BEIJING[0], BEIJING[1], n)
+    ys = rng.uniform(BEIJING[2], BEIJING[3], n)
+    oid = rng.integers(0, max(n // 4, 1), n)
+    t0 = 1_700_000_000_000
+    ts = t0 + (np.arange(n) * (SPAN_S * 1000) // max(n, 1))
+    with open(path, "w") as f:
+        for i in range(n):
+            f.write(f"v{oid[i]},{ts[i]},{xs[i]:.6f},{ys[i]:.6f}\n")
+
+
+def _params(option: int):
+    from spatialflink_tpu.config import Params
+
+    conf = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "conf", "spatialflink-conf.yml")
+    p = Params.from_yaml(conf)
+    p.query.option = option
+    p.query.radius = 0.5
+    p.query.k = 50
+    p.input1.format = "CSV"
+    p.input1.date_format = None  # epoch-millisecond timestamps
+    p.input2.format = "CSV"
+    p.input2.date_format = None
+    p.window.interval_s = WINDOW_S
+    p.window.step_s = SLIDE_S
+    return p
+
+
+def _drain(it) -> int:
+    windows = 0
+    for _ in it:
+        windows += 1
+    return windows
+
+
+def bench_option(option: int, path: str, path2, n: int) -> list:
+    from spatialflink_tpu import driver
+
+    rows = []
+    needs2 = driver.CASES[option].family == "join"
+
+    # bulk first: it warms the jit cache the record path reuses, so the
+    # record row measures steady-state host cost, not compiles
+    p = _params(option)
+    t0 = time.perf_counter()
+    it = driver.run_option_bulk(p, path, path2 if needs2 else None)
+    windows = _drain(it) if it is not None else None
+    dt = time.perf_counter() - t0
+    if windows is not None:
+        rows.append(dict(option=option, path="bulk", records=n,
+                         windows=windows, wall_s=round(dt, 3),
+                         records_per_sec=round(n / dt)))
+    else:
+        # visible, not silent: without the bulk pass the record row below
+        # also pays jit compiles instead of measuring steady-state host cost
+        print(f"warning: option {option}: bulk path declined "
+              "(run_option_bulk returned None); bulk row omitted and the "
+              "record row includes jit-compile time", file=sys.stderr)
+
+    p = _params(option)
+    with open(path) as f1:
+        streams = [f1]
+        if needs2:
+            streams.append(open(path2))
+        try:
+            t0 = time.perf_counter()
+            windows = _drain(driver.run_option(p, *streams))
+            dt = time.perf_counter() - t0
+        finally:
+            for s in streams[1:]:
+                s.close()
+    rows.append(dict(option=option, path="record", records=n,
+                     windows=windows, wall_s=round(dt, 3),
+                     records_per_sec=round(n / dt)))
+    return rows
+
+
+def _settle_backend() -> None:
+    """The axon sitecustomize force-sets jax_platforms='axon,cpu' in every
+    interpreter, so the JAX_PLATFORMS env var alone cannot keep a process
+    off a wedged accelerator tunnel — honor it at the config level, and
+    when no platform was requested, probe the default backend the way
+    bench.py does so a wedged tunnel downgrades to CPU instead of hanging
+    the harness."""
+    req = os.environ.get("JAX_PLATFORMS", "")
+    from bench import _force_cpu, _probe_default_backend_ok
+
+    if req and "axon" not in req:
+        import jax
+
+        jax.config.update("jax_platforms", req)
+    elif not _probe_default_backend_ok(attempts=2):
+        print("warning: backend probe failed; falling back to CPU",
+              file=sys.stderr)
+        _force_cpu()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=None,
+                    help="records per stream (default 1M, 100k on CPU)")
+    ap.add_argument("--options", default="1,51,101",
+                    help="comma-separated driver queryOptions")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    _settle_backend()
+    import jax
+
+    backend = jax.default_backend()
+    n = args.n or (1_000_000 if backend == "tpu" else 100_000)
+
+    rows = []
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "stream1.csv")
+        path2 = os.path.join(td, "stream2.csv")
+        _write_stream(path, n, seed=0)
+        _write_stream(path2, max(n // 64, 1), seed=1)  # small query stream
+        for opt in (int(x) for x in args.options.split(",")):
+            for row in bench_option(opt, path, path2, n):
+                row["backend"] = backend
+                print(json.dumps(row), flush=True)
+                rows.append(row)
+
+    out = args.out or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        f"RESULTS_e2e_{backend}.json")
+    with open(out, "w") as f:
+        json.dump({"backend": backend, "n": n, "rows": rows}, f, indent=1)
+    print(f"# wrote {out}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,38 @@
+"""The benchmark harnesses are part of the deliverable (they produce the
+BASELINE.md ledger) — smoke-run the end-to-end one as a real subprocess at
+tiny scale so it can't rot, and pin the JSON-row contract the ledger and
+driver rely on."""
+
+import json
+import os
+import subprocess
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_e2e_smoke(tmp_path):
+    out_path = tmp_path / "e2e.json"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "benchmarks", "bench_e2e.py"),
+         "--n", "2000", "--options", "1,101", "--out", str(out_path)],
+        capture_output=True, text=True, timeout=420, env=env, cwd=_ROOT)
+    assert r.returncode == 0, r.stderr[-2000:]
+    rows = [json.loads(ln) for ln in r.stdout.splitlines()
+            if ln.startswith("{")]
+    # both paths per option: the bulk fast path must stay reachable for
+    # range AND join (a silent fallback to record-only would hide a
+    # regression in run_option_bulk's eligibility gates)
+    assert [(x["option"], x["path"]) for x in rows] == [
+        (1, "bulk"), (1, "record"), (101, "bulk"), (101, "record")]
+    for row in rows:
+        assert row["records"] == 2000
+        assert row["records_per_sec"] > 0
+        assert row["windows"] > 0
+    # bulk and record paths agree on how many windows the stream seals
+    assert rows[0]["windows"] == rows[1]["windows"]
+    assert rows[2]["windows"] == rows[3]["windows"]
+    table = json.loads(out_path.read_text())
+    assert table["rows"] and table["backend"] == "cpu"

@@ -1,0 +1,28 @@
+"""Shared benchmark-harness plumbing."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def settle_backend() -> None:
+    """The axon sitecustomize force-sets jax_platforms='axon,cpu' in every
+    interpreter, so the JAX_PLATFORMS env var alone cannot keep a process
+    off a wedged accelerator tunnel — honor it at the config level, and
+    when no platform was requested, probe the default backend the way
+    bench.py does so a wedged tunnel downgrades to CPU instead of hanging
+    the harness."""
+    req = os.environ.get("JAX_PLATFORMS", "")
+    from bench import _force_cpu, _probe_default_backend_ok
+
+    if req and "axon" not in req:
+        import jax
+
+        jax.config.update("jax_platforms", req)
+    elif not _probe_default_backend_ok(attempts=2):
+        print("warning: backend probe failed; falling back to CPU",
+              file=sys.stderr)
+        _force_cpu()

@@ -121,26 +121,6 @@ def bench_option(option: int, path: str, path2, n: int) -> list:
     return rows
 
 
-def _settle_backend() -> None:
-    """The axon sitecustomize force-sets jax_platforms='axon,cpu' in every
-    interpreter, so the JAX_PLATFORMS env var alone cannot keep a process
-    off a wedged accelerator tunnel — honor it at the config level, and
-    when no platform was requested, probe the default backend the way
-    bench.py does so a wedged tunnel downgrades to CPU instead of hanging
-    the harness."""
-    req = os.environ.get("JAX_PLATFORMS", "")
-    from bench import _force_cpu, _probe_default_backend_ok
-
-    if req and "axon" not in req:
-        import jax
-
-        jax.config.update("jax_platforms", req)
-    elif not _probe_default_backend_ok(attempts=2):
-        print("warning: backend probe failed; falling back to CPU",
-              file=sys.stderr)
-        _force_cpu()
-
-
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=None,
@@ -150,7 +130,9 @@ def main() -> int:
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
 
-    _settle_backend()
+    from benchmarks._common import settle_backend
+
+    settle_backend()
     import jax
 
     backend = jax.default_backend()

@@ -331,6 +331,19 @@ class SpatialOperator:
         stats = None if dist_evals is None else (0, dist_evals)
         return self._defer_with_stats(res, stats, rows)
 
+    def _multi_results(self, stream: Iterable, eval_batch
+                       ) -> Iterator["WindowResult"]:
+        """_drive for multi-query evaluators, whose per-window result is a
+        list of Q per-query lists — always truthy, so _drive_batched's
+        realtime no-empty-emission gate cannot see an all-empty micro-batch;
+        re-apply it on the per-query contents (the reference's
+        fire-per-element trigger never emits empties)."""
+        realtime = self.conf.query_type is QueryType.RealTime
+        for result in self._drive(stream, eval_batch):
+            if realtime and not any(result.records):
+                continue
+            yield result
+
     def _knn_strategy(self) -> str:
         """Top-k selection strategy: approximate mode rides the TPU
         partial-reduce fast path (``lax.approx_min_k``), exact mode

@@ -47,6 +47,13 @@ class PointPointKNNQuery(SpatialOperator):
         res, dist_evals = self._knn_result(batch, query_point, radius, k)
         return self._defer_knn(res, dist_evals=dist_evals)
 
+    def _nb_layers(self, radius: float) -> int:
+        """Candidate-cell layer count; radius 0 disables pruning (all cells
+        neighbor, ``UniformGrid.java:264-266``) — ONE rule for run() and
+        run_multi()."""
+        return (self.grid.n if radius == 0
+                else self.grid.candidate_layers(radius))
+
     def _knn_result(self, batch, query_point: Point, radius: float, k: int):
         """(KnnResult, dist_evals) over one window batch — the count rides
         the same dispatch (ops.knn.knn_point_stats single-device; a psum on
@@ -55,9 +62,7 @@ class PointPointKNNQuery(SpatialOperator):
         all-gathered and re-merged (parallel.ops.distributed_stream_knn) —
         the two-stage merge of SURVEY §2.5 without the reference's
         parallelism-1 windowAll stage."""
-        nb_layers = (
-            self.grid.n if radius == 0 else self.grid.candidate_layers(radius)
-        )
+        nb_layers = self._nb_layers(radius)
         def local(b):
             # ONE closure for both paths: the module-jitted kernel runs on
             # the whole batch single-device and per shard distributed —
@@ -94,6 +99,71 @@ class PointPointKNNQuery(SpatialOperator):
         for result in self._drive_bulk(parsed, eval_batch, pad=pad):
             result.extras["k"] = k
             yield result
+
+    def run_multi(self, stream: Iterable[Point],
+                  query_points: "List[Point]", radius: float,
+                  k: Optional[int] = None) -> Iterator[WindowResult]:
+        """Q continuous kNN queries over ONE stream in ONE dispatch per
+        window — a TPU-native extension with no reference analogue (GeoFlink
+        wires exactly one query object per job, ``StreamingJob.java:470``,
+        so Q queries cost Q jobs re-reading the stream). The vmapped kernel
+        (``ops.knn.knn_point_multi``) answers all Q queries over the
+        window's single device residency.
+
+        Each WindowResult's ``records`` is a list of Q per-query result
+        lists (``records[q]`` = the (objID, distance) pairs for
+        ``query_points[q]``), with ``extras["queries"] = Q``. All queries
+        share ``radius`` (one candidate-cell layer count). Single-device:
+        combine with ``conf.devices`` by sharding the *query* batch across
+        operators if needed."""
+        if self.distributed:
+            raise NotImplementedError(
+                "run_multi is single-device; shard the query batch across "
+                "operators to combine with conf.devices")
+        k = k or self.conf.k
+        import numpy as np
+
+        from spatialflink_tpu.ops.knn import knn_point_multi_stats
+
+        qx = np.asarray([q.x for q in query_points], np.float32)
+        qy = np.asarray([q.y for q in query_points], np.float32)
+        qc = np.asarray([q.cell for q in query_points], np.int32)
+        nb_layers = self._nb_layers(radius)
+
+        def eval_batch(records, ts_base):
+            if not records:
+                return [[] for _ in query_points]
+            batch = self._point_batch(records, ts_base)
+            res, evals = knn_point_multi_stats(
+                batch, qx, qy, qc, radius, nb_layers, n=self.grid.n, k=k,
+                strategy=self._knn_strategy())
+            return self._defer_knn_multi(res, jnp.sum(evals))
+
+        for result in self._multi_results(stream, eval_batch):
+            result.extras["k"] = k
+            result.extras["queries"] = len(query_points)
+            yield result
+
+    def _defer_knn_multi(self, res, dist_evals):
+        """Deferred per-query (objID, distance) lists from a (Q, k)
+        KnnResult; ``dist_evals`` (device scalar, summed over the Q
+        queries) feeds the distance-computation counter like every other
+        kNN path."""
+        import numpy as np
+
+        interner = self.interner
+
+        def rows(r):
+            valid = np.asarray(r.valid)
+            oids = np.asarray(r.obj_id)
+            dists = np.asarray(r.dist)
+            return [
+                [(interner.lookup(int(o)), float(d))
+                 for o, d in zip(oids[q][valid[q]], dists[q][valid[q]])]
+                for q in range(valid.shape[0])
+            ]
+
+        return self._defer_with_stats(res, (0, dist_evals), rows)
 
 
 

@@ -83,6 +83,51 @@ class PointPointRangeQuery(SpatialOperator):
             parsed, self._bulk_mask_eval(self._mask_stats_fn(query_point, radius)),
             pad=pad)
 
+    def run_multi(self, stream: Iterable[Point],
+                  query_points: List[Point], radius: float
+                  ) -> Iterator[WindowResult]:
+        """Q continuous range queries over ONE stream in ONE dispatch per
+        window (TPU-native extension; the reference runs one query per job,
+        ``StreamingJob.java:470``). ``records[q]`` holds the records within
+        ``radius`` of ``query_points[q]`` under the usual GN-bypass/CN
+        semantics; ``extras["queries"] = Q``. Pruning counters aggregate
+        across the Q queries of each dispatch. Single-device, like
+        ``PointPointKNNQuery.run_multi``."""
+        if self.distributed:
+            raise NotImplementedError(
+                "run_multi is single-device; shard the query batch across "
+                "operators to combine with conf.devices")
+        from spatialflink_tpu.ops.range import range_filter_point_multi_masks
+
+        qx = np.asarray([q.x for q in query_points], np.float32)
+        qy = np.asarray([q.y for q in query_points], np.float32)
+        qc = np.asarray([q.cell for q in query_points], np.int32)
+        args = (radius, self.grid.guaranteed_layers(radius),
+                self.grid.candidate_layers(radius))
+
+        def eval_batch(records, ts_base):
+            if not records:
+                return [[] for _ in query_points]
+            batch = self._point_batch(records, ts_base)
+            masks, gn_c, evals = range_filter_point_multi_masks(
+                batch, qx, qy, qc, *args, n=self.grid.n,
+                approximate=self.conf.approximate)
+
+            def rows(m):
+                m = np.asarray(m)  # ONE (Q, N) device->host transfer
+                out = []
+                for q in range(len(query_points)):
+                    idx = np.nonzero(m[q])[0]
+                    out.append([records[i] for i in idx if i < len(records)])
+                return out
+
+            return self._defer_with_stats(
+                masks, (jnp.sum(gn_c), jnp.sum(evals)), rows)
+
+        for result in self._multi_results(stream, eval_batch):
+            result.extras["queries"] = len(query_points)
+            yield result
+
     def run_incremental(self, stream: Iterable[Point], query_point: Point,
                         radius: float) -> Iterator[WindowResult]:
         """Incremental sliding windows: carry the previous window's survivors

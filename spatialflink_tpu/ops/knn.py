@@ -121,16 +121,17 @@ def _topk_grouped(obj_id, dist, eligible, k: int, groups: int) -> KnnResult:
     return _topk_full_sort(cand_oid, cand_d, cand_d < _BIG, k)
 
 
-def _topk_prefiltered(obj_id, dist, eligible, k: int, m: int) -> KnnResult:
-    """Exact top-k via a global m-candidate prefilter with verified fallback.
+def _prefilter_fast(obj_id, dist, eligible, k: int, m: int):
+    """Prefilter fast path WITHOUT the rescue branch: -> (fast, exact).
 
     ``lax.top_k(m)`` selects the m smallest distances (duplicates included),
-    then a tiny dedup+top-k runs over those m. If the m candidates contain at
-    least k distinct objects — or all eligible points — the result is provably
-    exact (any excluded object's min distance exceeds every candidate's, hence
-    exceeds k distinct objects' minima). Otherwise a ``lax.cond`` falls back
-    to the full-sort path; with m >> k that branch needs one object to own
-    m-k+1 of the m nearest points, which real streams do not do.
+    then a tiny dedup+top-k runs over those m. ``exact`` certifies the fast
+    result: at least k distinct objects among the m candidates — or all
+    eligible points captured — proves no excluded object can enter the top-k
+    (any excluded object's min distance exceeds every candidate's, hence
+    exceeds k distinct objects' minima). Split out cond-free so the
+    multi-query path can vmap it and rescue with ONE scalar cond (a vmapped
+    ``lax.cond`` lowers to ``select`` and would pay the fallback always).
     """
     n = obj_id.shape[0]
     m = min(m, n)
@@ -143,6 +144,15 @@ def _topk_prefiltered(obj_id, dist, eligible, k: int, m: int) -> KnnResult:
     distinct = jnp.sum(fast.valid)
     n_eligible = jnp.sum(eligible)
     exact = (distinct >= jnp.minimum(k, n_eligible)) | (n_eligible <= m)
+    return fast, exact
+
+
+def _topk_prefiltered(obj_id, dist, eligible, k: int, m: int) -> KnnResult:
+    """Exact top-k via the global m-candidate prefilter with verified
+    fallback: when the certificate fails, a ``lax.cond`` falls back to the
+    full-sort path; with m >> k that branch needs one object to own m-k+1 of
+    the m nearest points, which real streams do not do."""
+    fast, exact = _prefilter_fast(obj_id, dist, eligible, k, m)
     return jax.lax.cond(
         exact,
         lambda: fast,
@@ -174,6 +184,17 @@ def _topk_approx_verified(obj_id, dist, eligible, k: int, m: int) -> KnnResult:
     fallback fires only on adversarial distributions; recall misses cost a
     recompute, never a wrong answer.
     """
+    fast, exact = _approx_verified_fast(obj_id, dist, eligible, k, m)
+    return jax.lax.cond(
+        exact,
+        lambda: fast,
+        lambda: _topk_full_sort(obj_id, dist, eligible, k),
+    )
+
+
+def _approx_verified_fast(obj_id, dist, eligible, k: int, m: int):
+    """approx_verified fast path WITHOUT the rescue branch: -> (fast, exact).
+    Cond-free for the same multi-query reason as :func:`_prefilter_fast`."""
     d_all, d_m, oid_m = _approx_candidates(obj_id, dist, eligible, m)
     fast = _topk_full_sort(oid_m, d_m, d_m < _BIG, k)
     distinct = jnp.sum(fast.valid)
@@ -183,11 +204,7 @@ def _topk_approx_verified(obj_id, dist, eligible, k: int, m: int) -> KnnResult:
     n_elig = jnp.sum(eligible)
     cand_elig = jnp.sum(d_m < _BIG)
     exact = ((distinct >= k) & (cnt_all == cnt_cand)) | (cand_elig == n_elig)
-    return jax.lax.cond(
-        exact,
-        lambda: fast,
-        lambda: _topk_full_sort(obj_id, dist, eligible, k),
-    )
+    return fast, exact
 
 
 def _approx_candidates(obj_id, dist, eligible, m: int):
@@ -221,6 +238,24 @@ _GROUPED_MIN_N = 1 << 15
 _DEFAULT_GROUPS = 256
 
 
+def _resolve_auto(n: int) -> str:
+    """Measured per-backend "auto" choice, shared by the single- and
+    multi-query entries so they cannot drift."""
+    if n < _GROUPED_MIN_N:
+        return "sort"
+    if jax.default_backend() == "cpu":
+        # measured (benchmarks/sweep_knn.py): CPU top_k is a linear-time
+        # partial selection, so the m-candidate prefilter beats every
+        # sort-based path by ~30-50x at 1M points
+        return "prefilter"
+    # measured on TPU v5e (benchmarks/sweep_knn.py, 1M pts, k=50):
+    # approx_min_k lowers to the PartialReduce op and runs the window
+    # at ~46us vs ~1.2ms for grouped/prefilter (top_k and sort both
+    # lower to bitonic networks there) — 21.5G pts/s, exact via the
+    # certificate + full-sort fallback
+    return "approx_verified"
+
+
 def topk_by_distance(obj_id, dist, eligible, k: int,
                      strategy: str = "auto") -> KnnResult:
     """Dedup by object id (keep min dist) then top-k smallest distances.
@@ -232,20 +267,7 @@ def topk_by_distance(obj_id, dist, eligible, k: int,
     """
     n = obj_id.shape[0]
     if strategy == "auto":
-        if n < _GROUPED_MIN_N:
-            strategy = "sort"
-        elif jax.default_backend() == "cpu":
-            # measured (benchmarks/sweep_knn.py): CPU top_k is a linear-time
-            # partial selection, so the m-candidate prefilter beats every
-            # sort-based path by ~30-50x at 1M points
-            strategy = "prefilter"
-        else:
-            # measured on TPU v5e (benchmarks/sweep_knn.py, 1M pts, k=50):
-            # approx_min_k lowers to the PartialReduce op and runs the window
-            # at ~46us vs ~1.2ms for grouped/prefilter (top_k and sort both
-            # lower to bitonic networks there) — 21.5G pts/s, exact via the
-            # certificate + full-sort fallback
-            strategy = "approx_verified"
+        strategy = _resolve_auto(n)
     if strategy == "grouped":
         return _topk_grouped(obj_id, dist, eligible, k, _DEFAULT_GROUPS)
     if strategy == "prefilter":
@@ -266,6 +288,57 @@ def topk_by_distance(obj_id, dist, eligible, k: int,
         raise ValueError(f"unknown kNN strategy {strategy!r}; expected "
                          "auto|sort|grouped|prefilter|approx_verified|approx")
     return _topk_full_sort(obj_id, dist, eligible, k)
+
+
+def topk_by_distance_multi(obj_id, dist, eligible, k: int,
+                           strategy: str = "auto") -> KnnResult:
+    """Batched dedup+top-k: ``dist``/``eligible`` are (Q, N) over a SHARED
+    (N,) ``obj_id`` window; returns a KnnResult with (Q, k) fields — Q
+    continuous queries answered in one dispatch.
+
+    No reference analogue: GeoFlink runs one continuous query per job
+    (``StreamingJob.java:470`` wires exactly one query object per pipeline),
+    so Q queries cost Q Flink jobs re-reading the same stream. Here they are
+    one extra array axis over the same resident window.
+
+    Exactness under vmap: the verified strategies' rescue is hoisted OUT of
+    the vmap — the cond-free fast paths run batched, and one SCALAR
+    ``lax.cond`` over "every query certified exact" re-runs the full sort
+    (batched) only when some query's certificate failed, merging per-query
+    with ``jnp.where``. A vmapped per-query cond would lower to ``select``
+    and execute the O(N log^2 N) fallback unconditionally.
+    """
+    n = obj_id.shape[-1]
+    if strategy == "auto":
+        strategy = _resolve_auto(n)
+    if strategy in ("sort", "grouped", "approx"):
+        fns = {
+            "sort": lambda d, e: _topk_full_sort(obj_id, d, e, k),
+            "grouped": lambda d, e: _topk_grouped(obj_id, d, e, k,
+                                                  _DEFAULT_GROUPS),
+            "approx": lambda d, e: _topk_approx(obj_id, d, e, k,
+                                                max(32 * k, 1024)),
+        }
+        return jax.vmap(fns[strategy])(dist, eligible)
+    if strategy == "prefilter":
+        fast_fn = partial(_prefilter_fast, m=max(8 * k, 256))
+    elif strategy == "approx_verified":
+        fast_fn = partial(_approx_verified_fast, m=max(16 * k, 512))
+    else:
+        raise ValueError(f"unknown kNN strategy {strategy!r}; expected "
+                         "auto|sort|grouped|prefilter|approx_verified|approx")
+    fast, exact = jax.vmap(
+        lambda d, e: fast_fn(obj_id, d, e, k))(dist, eligible)
+
+    def rescue():
+        full = jax.vmap(lambda d, e: _topk_full_sort(obj_id, d, e, k))(
+            dist, eligible)
+        pick = lambda a, b: jnp.where(exact[:, None], a, b)  # noqa: E731
+        return KnnResult(obj_id=pick(fast.obj_id, full.obj_id),
+                         dist=pick(fast.dist, full.dist),
+                         valid=pick(fast.valid, full.valid))
+
+    return jax.lax.cond(jnp.all(exact), lambda: fast, rescue)
 
 
 def _knn_point_parts(points, qx, qy, q_cell, radius, nb_layers, n,
@@ -333,6 +406,64 @@ def knn_point_stats(
         points, qx, qy, q_cell, radius, nb_layers, n, enforce_radius)
     res = topk_by_distance(points.obj_id, d, eligible, k, strategy)
     return res, jnp.sum(cell_eligible, dtype=jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("n", "k", "enforce_radius", "strategy"))
+def knn_point_multi(
+    points: PointBatch,
+    qx,
+    qy,
+    q_cell,
+    radius,
+    nb_layers,
+    *,
+    n: int,
+    k: int,
+    enforce_radius: bool = False,
+    strategy: str = "auto",
+) -> KnnResult:
+    """kNN of a (Q,)-batch of query points over ONE window batch in ONE
+    dispatch; returns a KnnResult with (Q, k) fields, row q answering query
+    q with :func:`knn_point` semantics (same cell pruning, same no-radius
+    windowed rule). TPU-native extension with no reference analogue — see
+    :func:`topk_by_distance_multi`; the distance/eligibility stage is a
+    vmapped :func:`_knn_point_parts`, so XLA fuses all Q queries' masks and
+    distances over a single pass of the resident window."""
+    def parts(qx_, qy_, qc_):
+        d, eligible, _ = _knn_point_parts(points, qx_, qy_, qc_, radius,
+                                          nb_layers, n, enforce_radius)
+        return d, eligible
+
+    d, eligible = jax.vmap(parts)(qx, qy, q_cell)
+    return topk_by_distance_multi(points.obj_id, d, eligible, k, strategy)
+
+
+@partial(jax.jit, static_argnames=("n", "k", "enforce_radius", "strategy"))
+def knn_point_multi_stats(
+    points: PointBatch,
+    qx,
+    qy,
+    q_cell,
+    radius,
+    nb_layers,
+    *,
+    n: int,
+    k: int,
+    enforce_radius: bool = False,
+    strategy: str = "auto",
+):
+    """:func:`knn_point_multi` + per-query candidate counts (Q,) in the SAME
+    dispatch — the multi-query analogue of :func:`knn_point_stats`, feeding
+    the distance-computation counter (kNN evaluates a distance for every
+    cell-eligible slot, per query)."""
+    def parts(qx_, qy_, qc_):
+        d, eligible, cell_eligible = _knn_point_parts(
+            points, qx_, qy_, qc_, radius, nb_layers, n, enforce_radius)
+        return d, eligible, jnp.sum(cell_eligible, dtype=jnp.int32)
+
+    d, eligible, evals = jax.vmap(parts)(qx, qy, q_cell)
+    res = topk_by_distance_multi(points.obj_id, d, eligible, k, strategy)
+    return res, evals
 
 
 @partial(jax.jit, static_argnames=("k", "enforce_radius", "strategy"))

@@ -95,6 +95,69 @@ def range_filter_point_stats(
     return mask, dists, gn_bypassed, dist_evals
 
 
+@partial(jax.jit, static_argnames=("n", "approximate"))
+def range_filter_point_multi(
+    points: PointBatch,
+    qx,
+    qy,
+    q_cell,
+    radius,
+    gn_layers,
+    cn_layers,
+    *,
+    n: int,
+    approximate: bool = False,
+):
+    """Batched :func:`range_filter_point_stats`: ``qx``/``qy``/``q_cell`` are
+    (Q,) query-point arrays answered in ONE dispatch over one window batch.
+    Returns (mask, dists, gn_bypassed, dist_evals) with a leading Q axis on
+    every output — per-query selection masks and per-query pruning counters.
+
+    TPU-native extension with no reference analogue (one continuous query
+    per GeoFlink job, ``StreamingJob.java:470``): the Q queries share the
+    window's single residency, so XLA evaluates all Q Chebyshev masks and
+    distance checks in one fused pass instead of Q stream re-reads.
+    ``radius`` (hence the layer counts) is shared across the batch — queries
+    with different radii belong in separate batches (they would recompile
+    per radius anyway only if the layer counts were made static, which they
+    are not; the share here is a semantic choice matching one query set).
+
+    The body is a vmap of :func:`range_filter_point_stats` — one source of
+    truth for the mask and pruning-counter semantics."""
+    return jax.vmap(
+        lambda qx_, qy_, qc_: range_filter_point_stats(
+            points, qx_, qy_, qc_, radius, gn_layers, cn_layers, n=n,
+            approximate=approximate)
+    )(qx, qy, q_cell)
+
+
+@partial(jax.jit, static_argnames=("n", "approximate"))
+def range_filter_point_multi_masks(
+    points: PointBatch,
+    qx,
+    qy,
+    q_cell,
+    radius,
+    gn_layers,
+    cn_layers,
+    *,
+    n: int,
+    approximate: bool = False,
+):
+    """:func:`range_filter_point_multi` minus the (Q, N) distance array —
+    (mask, gn_bypassed, dist_evals) only. The operator path uses this: a
+    jit output cannot be dead-code-eliminated by the caller, and the full
+    variant's per-query distances are Q x N x 4 bytes of HBM writes per
+    window that the selection path never reads."""
+    def one(qx_, qy_, qc_):
+        mask, _dists, gn_c, evals = range_filter_point_stats(
+            points, qx_, qy_, qc_, radius, gn_layers, cn_layers, n=n,
+            approximate=approximate)
+        return mask, gn_c, evals
+
+    return jax.vmap(one)(qx, qy, q_cell)
+
+
 def _range_masks_parts(points, gn_mask, cn_mask, dists, radius, approximate):
     cell = jnp.maximum(points.cell, 0)  # guard the -1 pad; gated by cell_ok
     cell_ok = points.cell >= 0

@@ -1,0 +1,232 @@
+"""Multi-query batching (TPU-native extension, no reference analogue):
+Q queries answered in one dispatch must agree bit-for-bit with Q
+single-query dispatches for every selection strategy, including when a
+query's exactness certificate fails and the scalar-cond rescue re-runs the
+full sort."""
+
+import numpy as np
+import pytest
+
+from spatialflink_tpu.index import UniformGrid
+from spatialflink_tpu.models import Point, PointBatch
+from spatialflink_tpu.operators import (
+    PointPointKNNQuery,
+    PointPointRangeQuery,
+    QueryConfiguration,
+    QueryType,
+)
+from spatialflink_tpu.ops.knn import knn_point, knn_point_multi
+from spatialflink_tpu.ops.range import (
+    range_filter_point_multi,
+    range_filter_point_stats,
+)
+
+GRID = UniformGrid(115.50, 117.60, 39.60, 41.10, num_grid_partitions=100)
+RADIUS = 0.5
+K = 5
+
+
+def _batch(n=4096, seed=0, oid_mod=None):
+    rng = np.random.default_rng(seed)
+    xs = rng.uniform(GRID.min_x, GRID.max_x, n)
+    ys = rng.uniform(GRID.min_y, GRID.max_y, n)
+    oid = rng.integers(0, oid_mod or n // 4, n).astype(np.int32)
+    return PointBatch.from_arrays(xs, ys, grid=GRID, obj_id=oid)
+
+
+def _queries(q=7, seed=1):
+    rng = np.random.default_rng(seed)
+    qx = rng.uniform(116.0, 117.0, q).astype(np.float32)
+    qy = rng.uniform(40.0, 41.0, q).astype(np.float32)
+    qc = np.asarray([GRID.assign_cell(float(x), float(y))[0]
+                     for x, y in zip(qx, qy)], np.int32)
+    return qx, qy, qc
+
+
+STRATEGIES = ("sort", "grouped", "prefilter", "approx_verified")
+
+
+class TestKnnMulti:
+    @pytest.mark.parametrize("strategy", STRATEGIES)
+    def test_matches_single_query_loop(self, strategy):
+        b = _batch()
+        qx, qy, qc = _queries()
+        nb = GRID.candidate_layers(RADIUS)
+        multi = knn_point_multi(b, qx, qy, qc, RADIUS, nb, n=GRID.n, k=K,
+                                strategy=strategy)
+        for q in range(len(qx)):
+            single = knn_point(b, float(qx[q]), float(qy[q]), int(qc[q]),
+                               RADIUS, nb, n=GRID.n, k=K, strategy=strategy)
+            np.testing.assert_array_equal(np.asarray(multi.obj_id[q]),
+                                          np.asarray(single.obj_id))
+            np.testing.assert_allclose(np.asarray(multi.dist[q]),
+                                       np.asarray(single.dist))
+
+    @pytest.mark.parametrize("strategy,fast_fn,m", [
+        ("prefilter", "_prefilter_fast", 256),
+        ("approx_verified", "_approx_verified_fast", 512),
+    ])
+    def test_certificate_failure_rescue(self, strategy, fast_fn, m):
+        """A mono-object cloud around query 0 starves its candidate set
+        below k distinct objects — query 0's certificate fails while the
+        other queries' pass, so the scalar-cond rescue must re-run the full
+        sort and the per-query ``jnp.where`` merge must keep the passing
+        queries' fast results AND replace the failing one. Asserts the
+        mixed pass/fail precondition white-box so data drift can't silently
+        turn this into an all-pass (merge-untested) run."""
+        import jax
+
+        from spatialflink_tpu.ops import knn as KN
+
+        n = 2048
+        rng = np.random.default_rng(3)
+        qx = np.asarray([116.5, 117.3, 116.8], np.float32)
+        qy = np.asarray([40.5, 41.0, 40.8], np.float32)
+        qc = np.asarray([GRID.assign_cell(float(x), float(y))[0]
+                         for x, y in zip(qx, qy)], np.int32)
+        xs = rng.uniform(GRID.min_x, GRID.max_x, n)
+        ys = rng.uniform(GRID.min_y, GRID.max_y, n)
+        oid = rng.integers(0, n // 4, n).astype(np.int32)
+        cloud = slice(0, 1024)  # mono-object ONLY near query 0
+        xs[cloud] = float(qx[0]) + rng.normal(0, 1e-4, 1024)
+        ys[cloud] = float(qy[0]) + rng.normal(0, 1e-4, 1024)
+        oid[cloud] = 7
+        b = PointBatch.from_arrays(xs, ys, grid=GRID, obj_id=oid)
+        nb = GRID.n  # radius-0 semantics: no cell pruning
+
+        def parts(qx_, qy_, qc_):
+            d, e, _ = KN._knn_point_parts(b, qx_, qy_, qc_, 0.0, nb,
+                                          GRID.n, False)
+            return d, e
+
+        d, e = jax.vmap(parts)(qx, qy, qc)
+        fn = getattr(KN, fast_fn)
+        _, exact = jax.vmap(lambda d_, e_: fn(b.obj_id, d_, e_, K, m))(d, e)
+        exact = np.asarray(exact)
+        assert not exact[0] and exact[1:].all(), exact
+
+        multi = knn_point_multi(b, qx, qy, qc, 0.0, nb, n=GRID.n, k=K,
+                                strategy=strategy)
+        oracle = knn_point_multi(b, qx, qy, qc, 0.0, nb, n=GRID.n, k=K,
+                                 strategy="sort")
+        np.testing.assert_array_equal(np.asarray(multi.obj_id),
+                                      np.asarray(oracle.obj_id))
+        np.testing.assert_allclose(np.asarray(multi.dist),
+                                   np.asarray(oracle.dist))
+
+    def test_q1_matches_single(self):
+        """A 1-query batch is the single kernel with an extra axis."""
+        b = _batch(seed=5)
+        qx, qy, qc = _queries(q=1, seed=6)
+        nb = GRID.candidate_layers(RADIUS)
+        multi = knn_point_multi(b, qx, qy, qc, RADIUS, nb, n=GRID.n, k=K)
+        single = knn_point(b, float(qx[0]), float(qy[0]), int(qc[0]),
+                           RADIUS, nb, n=GRID.n, k=K)
+        np.testing.assert_array_equal(np.asarray(multi.obj_id[0]),
+                                      np.asarray(single.obj_id))
+
+
+class TestRangeMulti:
+    @pytest.mark.parametrize("approximate", (False, True))
+    def test_matches_single_query_loop(self, approximate):
+        b = _batch(seed=7)
+        qx, qy, qc = _queries(q=5, seed=8)
+        gn = GRID.guaranteed_layers(RADIUS)
+        cn = GRID.candidate_layers(RADIUS)
+        masks, dists, gn_c, evals = range_filter_point_multi(
+            b, qx, qy, qc, RADIUS, gn, cn, n=GRID.n, approximate=approximate)
+        for q in range(len(qx)):
+            m1, d1, g1, e1 = range_filter_point_stats(
+                b, float(qx[q]), float(qy[q]), int(qc[q]), RADIUS, gn, cn,
+                n=GRID.n, approximate=approximate)
+            np.testing.assert_array_equal(np.asarray(masks[q]),
+                                          np.asarray(m1))
+            np.testing.assert_allclose(np.asarray(dists[q]), np.asarray(d1))
+            assert int(gn_c[q]) == int(g1) and int(evals[q]) == int(e1)
+
+
+def _stream(n=600, seed=11):
+    rng = np.random.default_rng(seed)
+    t0 = 1_700_000_000_000
+    return [Point.create(float(rng.uniform(116.0, 117.0)),
+                         float(rng.uniform(40.0, 41.0)), GRID,
+                         obj_id=f"v{i % 37}", timestamp=t0 + i * 40)
+            for i in range(n)]
+
+
+class TestOperatorMulti:
+    def _conf(self):
+        return QueryConfiguration(QueryType.WindowBased, 10_000, 5_000)
+
+    def _qpoints(self, q=4):
+        rng = np.random.default_rng(12)
+        return [Point.create(float(rng.uniform(116.2, 116.8)),
+                             float(rng.uniform(40.2, 40.8)), GRID)
+                for _ in range(q)]
+
+    def test_knn_run_multi_matches_run_loop(self):
+        qs = self._qpoints()
+        multi = list(PointPointKNNQuery(self._conf(), GRID).run_multi(
+            _stream(), qs, RADIUS, K))
+        singles = [list(PointPointKNNQuery(self._conf(), GRID).run(
+            _stream(), q, RADIUS, K)) for q in qs]
+        assert multi and multi[0].extras["queries"] == len(qs)
+        for w, res in enumerate(multi):
+            assert len(res.records) == len(qs)
+            for qi in range(len(qs)):
+                ref = singles[qi][w]
+                assert res.window_start == ref.window_start
+                assert res.records[qi] == ref.records
+
+    def test_range_run_multi_matches_run_loop(self):
+        qs = self._qpoints()
+        multi = list(PointPointRangeQuery(self._conf(), GRID).run_multi(
+            _stream(), qs, RADIUS))
+        singles = [list(PointPointRangeQuery(self._conf(), GRID).run(
+            _stream(), q, RADIUS)) for q in qs]
+        for w, res in enumerate(multi):
+            for qi in range(len(qs)):
+                ref = singles[qi][w]
+                assert res.window_start == ref.window_start
+                assert ([r.obj_id for r in res.records[qi]]
+                        == [r.obj_id for r in ref.records])
+
+    def test_realtime_suppresses_all_empty_micro_batches(self):
+        """The reference's fire-per-element trigger never emits empties;
+        the multi path's list-of-Q-lists result is always truthy, so the
+        suppression must look inside (operators/base.py _multi_results)."""
+        conf = QueryConfiguration(QueryType.RealTime, 10_000, 5_000,
+                                  realtime_batch_size=64)
+        far = [Point.create(115.55, 39.65, GRID)]  # nothing within radius
+        out = list(PointPointRangeQuery(conf, GRID).run_multi(
+            _stream(), far, 0.01))
+        assert out == []
+        # a query batch where SOME query matches still emits (with empty
+        # rows for the non-matching queries)
+        mixed = far + [Point.create(116.5, 40.5, GRID)]
+        out = list(PointPointRangeQuery(conf, GRID).run_multi(
+            _stream(), mixed, 0.5))
+        assert out and all(len(r.records) == 2 for r in out)
+        assert any(r.records[1] for r in out)
+        conf2 = QueryConfiguration(QueryType.RealTime, 10_000, 5_000,
+                                   realtime_batch_size=64)
+        assert list(PointPointKNNQuery(conf2, GRID).run_multi(
+            _stream(), far, 0.0, K))  # kNN has no radius filter -> emits
+
+    def test_knn_run_multi_feeds_distance_counter(self):
+        from spatialflink_tpu.utils.metrics import REGISTRY
+
+        before = REGISTRY.counter("distance-computations").count
+        list(PointPointKNNQuery(self._conf(), GRID).run_multi(
+            _stream(), self._qpoints(3), RADIUS, K))
+        assert REGISTRY.counter("distance-computations").count > before
+
+    def test_run_multi_distributed_raises(self):
+        conf = QueryConfiguration(QueryType.WindowBased, 10_000, 5_000,
+                                  devices=8)
+        with pytest.raises(NotImplementedError):
+            next(PointPointKNNQuery(conf, GRID).run_multi(
+                _stream(60), self._qpoints(2), RADIUS, K))
+        with pytest.raises(NotImplementedError):
+            next(PointPointRangeQuery(conf, GRID).run_multi(
+                _stream(60), self._qpoints(2), RADIUS))

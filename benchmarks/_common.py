@@ -1,11 +1,13 @@
-"""Shared benchmark-harness plumbing."""
+"""Shared benchmark-harness plumbing.
+
+Importers reach this as ``benchmarks._common``, which already requires the
+repo root on sys.path (each harness script inserts it before importing); the
+``from bench import ...`` below resolves through that same root entry."""
 
 from __future__ import annotations
 
 import os
 import sys
-
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def settle_backend() -> None:

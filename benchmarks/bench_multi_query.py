@@ -63,28 +63,27 @@ def main() -> int:
                          for x, y in zip(qx_all, qy_all)], np.int32)
 
     rows = []
-    per_query_single = None
 
-    # baseline: the q=1 kernel looped over queries inside one fori_loop
-    # (same dispatch conditions as the multi rows — isolates the vmap win
-    # from dispatch-overhead effects)
+    # baseline: one iteration = one single-query kernel, under EXACTLY the
+    # multi rows' dispatch conditions — the query is a hoisted constant with
+    # the same i*1e-7 anti-hoist perturbation, no per-iteration gather (a
+    # dynamic qx[i % Q] indexing made the round-1 version of this baseline
+    # ~1.9x slower than the q=1 multi row, i.e. the "speedup" measured the
+    # harness, not the batching)
     def run_single_loop(iters):
-        qx_d = jnp.asarray(qx_all)
-        qy_d = jnp.asarray(qy_all)
-        qc_d = jnp.asarray(qc_all)
+        qx0, qy0 = float(qx_all[0]), float(qy_all[0])
+        qc0 = jnp.int32(qc_all[0])
 
         def body(i, acc):
-            r = knn_point(batch, qx_d[i % q_max], qy_d[i % q_max],
-                          qc_d[i % q_max], RADIUS, nb, n=grid.n, k=K,
-                          strategy=args.strategy)
+            r = knn_point(batch, qx0 + i * 1e-7, qy0, qc0, RADIUS, nb,
+                          n=grid.n, k=K, strategy=args.strategy)
             return acc + r.dist[0]
         return jax.lax.fori_loop(0, iters, body, jnp.float32(0))
 
-    per = _slope_time(run_single_loop, lo=2, hi=10)
-    per_query_single = per
+    per_query_single = _slope_time(run_single_loop, lo=2, hi=10)
     row = dict(mode="single_loop", queries=1,
-               per_query_us=round(per * 1e6, 2),
-               points_x_queries_per_sec=round(n / per),
+               per_query_us=round(per_query_single * 1e6, 2),
+               points_x_queries_per_sec=round(n / per_query_single),
                backend=backend, n=n, strategy=args.strategy)
     print(json.dumps(row), flush=True)
     rows.append(row)

@@ -331,6 +331,40 @@ class SpatialOperator:
         stats = None if dist_evals is None else (0, dist_evals)
         return self._defer_with_stats(res, stats, rows)
 
+    def _require_single_device(self) -> None:
+        """Shared guard for the run_multi family."""
+        if self.distributed:
+            raise NotImplementedError(
+                "run_multi is single-device; shard the query batch across "
+                "operators to combine with conf.devices")
+
+    @staticmethod
+    def _query_point_arrays(query_points):
+        """(qx, qy, qc) device-ready arrays from a query-point batch."""
+        qx = np.asarray([q.x for q in query_points], np.float32)
+        qy = np.asarray([q.y for q in query_points], np.float32)
+        qc = np.asarray([q.cell for q in query_points], np.int32)
+        return qx, qy, qc
+
+    def _defer_knn_multi(self, res, dist_evals) -> Deferred:
+        """Deferred per-query (objID, distance) lists from a (Q, k)
+        KnnResult; ``dist_evals`` (device scalar, summed over the Q
+        queries) feeds the distance-computation counter like every other
+        kNN path."""
+        interner = self.interner
+
+        def rows(r):
+            valid = np.asarray(r.valid)
+            oids = np.asarray(r.obj_id)
+            dists = np.asarray(r.dist)
+            return [
+                [(interner.lookup(int(o)), float(d))
+                 for o, d in zip(oids[q][valid[q]], dists[q][valid[q]])]
+                for q in range(valid.shape[0])
+            ]
+
+        return self._defer_with_stats(res, (0, dist_evals), rows)
+
     def _multi_results(self, stream: Iterable, eval_batch
                        ) -> Iterator["WindowResult"]:
         """_drive for multi-query evaluators, whose per-window result is a

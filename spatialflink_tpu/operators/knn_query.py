@@ -116,18 +116,11 @@ class PointPointKNNQuery(SpatialOperator):
         share ``radius`` (one candidate-cell layer count). Single-device:
         combine with ``conf.devices`` by sharding the *query* batch across
         operators if needed."""
-        if self.distributed:
-            raise NotImplementedError(
-                "run_multi is single-device; shard the query batch across "
-                "operators to combine with conf.devices")
+        self._require_single_device()
         k = k or self.conf.k
-        import numpy as np
-
         from spatialflink_tpu.ops.knn import knn_point_multi_stats
 
-        qx = np.asarray([q.x for q in query_points], np.float32)
-        qy = np.asarray([q.y for q in query_points], np.float32)
-        qc = np.asarray([q.cell for q in query_points], np.int32)
+        qx, qy, qc = self._query_point_arrays(query_points)
         nb_layers = self._nb_layers(radius)
 
         def eval_batch(records, ts_base):
@@ -143,27 +136,6 @@ class PointPointKNNQuery(SpatialOperator):
             result.extras["k"] = k
             result.extras["queries"] = len(query_points)
             yield result
-
-    def _defer_knn_multi(self, res, dist_evals):
-        """Deferred per-query (objID, distance) lists from a (Q, k)
-        KnnResult; ``dist_evals`` (device scalar, summed over the Q
-        queries) feeds the distance-computation counter like every other
-        kNN path."""
-        import numpy as np
-
-        interner = self.interner
-
-        def rows(r):
-            valid = np.asarray(r.valid)
-            oids = np.asarray(r.obj_id)
-            dists = np.asarray(r.dist)
-            return [
-                [(interner.lookup(int(o)), float(d))
-                 for o, d in zip(oids[q][valid[q]], dists[q][valid[q]])]
-                for q in range(valid.shape[0])
-            ]
-
-        return self._defer_with_stats(res, (0, dist_evals), rows)
 
 
 
@@ -275,6 +247,44 @@ class _GeomStreamKnn(_GenericKnn):
 class PointGeomKNNQuery(_GenericKnn):
     """Point stream x polygon/linestring query (``PointPolygonKNNQuery``,
     ``PointLineStringKNNQuery``)."""
+
+    def run_multi(self, stream, query_geoms, radius: float,
+                  k: Optional[int] = None) -> Iterator[WindowResult]:
+        """Q polygon/linestring QUERIES over one point stream in ONE
+        dispatch per window (``ops.geom.knn_points_to_geom_queries`` — the
+        Q query geometries ride one padded edge batch and the existing
+        (N, G) lattice; selection is the batched dedup+top-k with the
+        exactness rescue). Same result contract as
+        ``PointPointKNNQuery.run_multi``: ``records[q]`` answers
+        ``query_geoms[q]``; approximate mode substitutes bbox distances.
+        Single-device, shared radius — see the PointPoint docstring."""
+        self._require_single_device()
+        k = k or self.conf.k
+        import numpy as np
+
+        from spatialflink_tpu.models.batches import EdgeGeomBatch
+        from spatialflink_tpu.ops.geom import knn_points_to_geom_queries
+
+        # exact capacity (no bucket padding): the query batch is built once
+        # per run_multi and its G axis must match the (Q,) nb_masks
+        gb = EdgeGeomBatch.from_objects(query_geoms, self.grid,
+                                        pad=len(query_geoms))
+        nb_masks = jnp.asarray(np.stack(
+            [np.asarray(self._query_nb(q, radius)) for q in query_geoms]))
+
+        def eval_batch(records, ts_base):
+            if not records:
+                return [[] for _ in query_geoms]
+            batch = self._point_batch(records, ts_base)
+            res, evals = knn_points_to_geom_queries(
+                batch, gb, nb_masks, k=k, strategy=self._knn_strategy(),
+                approximate=self.conf.approximate)
+            return self._defer_knn_multi(res, jnp.sum(evals))
+
+        for result in self._multi_results(stream, eval_batch):
+            result.extras["k"] = k
+            result.extras["queries"] = len(query_geoms)
+            yield result
 
     def _setup(self, query, radius):
         return dict(nb=self._query_nb(query, radius),

@@ -93,15 +93,10 @@ class PointPointRangeQuery(SpatialOperator):
         semantics; ``extras["queries"] = Q``. Pruning counters aggregate
         across the Q queries of each dispatch. Single-device, like
         ``PointPointKNNQuery.run_multi``."""
-        if self.distributed:
-            raise NotImplementedError(
-                "run_multi is single-device; shard the query batch across "
-                "operators to combine with conf.devices")
+        self._require_single_device()
         from spatialflink_tpu.ops.range import range_filter_point_multi_masks
 
-        qx = np.asarray([q.x for q in query_points], np.float32)
-        qy = np.asarray([q.y for q in query_points], np.float32)
-        qc = np.asarray([q.cell for q in query_points], np.int32)
+        qx, qy, qc = self._query_point_arrays(query_points)
         args = (radius, self.grid.guaranteed_layers(radius),
                 self.grid.candidate_layers(radius))
 

@@ -17,6 +17,8 @@ reduction operands that XLA fuses; nothing of that size is materialized.
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -55,6 +57,39 @@ def points_to_geoms_dist(points: PointBatch, geoms: EdgeGeomBatch):
     bdist = points_to_edges_dist(points.x, points.y, geoms.edges, geoms.edge_mask)
     inside = points_in_geoms(points.x, points.y, geoms.edges, geoms.edge_mask)
     return jnp.where(inside & geoms.is_areal[None, :], 0.0, bdist)
+
+
+@partial(jax.jit, static_argnames=("k", "strategy", "approximate"))
+def knn_points_to_geom_queries(points: PointBatch, geoms: EdgeGeomBatch,
+                               nb_masks, *, k: int, strategy: str = "auto",
+                               approximate: bool = False):
+    """kNN of each of Q geometry QUERIES over one point-window batch in ONE
+    dispatch: -> (KnnResult with (Q, k) fields, dist_evals (Q,)).
+
+    Multi-query companion of the ``PointPolygonKNNQuery`` path (reference
+    runs one query polygon per job, ``StreamingJob.java:470``): ``geoms``
+    holds the Q query polygons/linestrings as one padded edge batch,
+    ``nb_masks`` is the (Q, n*n) dense neighboring-cells mask per query
+    (``GeomQueryMixin._query_nb`` per geometry). Exact mode reuses the
+    (N, G) point->geometry lattice; approximate mode substitutes bbox
+    distances per the reference's approximate flag. Selection is the
+    batched dedup+top-k (``ops.knn.topk_by_distance_multi`` — exactness
+    rescue included).
+    """
+    from spatialflink_tpu.ops.knn import topk_by_distance_multi
+
+    if approximate:
+        b = geoms.bbox  # (Q, 4)
+        d = D.point_bbox_dist(
+            points.x[None, :], points.y[None, :],
+            b[:, 0, None], b[:, 1, None], b[:, 2, None], b[:, 3, None])
+    else:
+        d = points_to_geoms_dist(points, geoms).T  # (Q, N)
+    cell = jnp.maximum(points.cell, 0)
+    in_grid = points.valid & (points.cell >= 0)
+    elig = in_grid[None, :] & nb_masks[:, cell]
+    res = topk_by_distance_multi(points.obj_id, d, elig, k, strategy)
+    return res, jnp.sum(elig, axis=1, dtype=jnp.int32)
 
 
 def points_to_single_geom_dist(points: PointBatch, edges, edge_mask, is_areal: bool):

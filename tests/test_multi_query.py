@@ -221,6 +221,42 @@ class TestOperatorMulti:
             _stream(), self._qpoints(3), RADIUS, K))
         assert REGISTRY.counter("distance-computations").count > before
 
+    def _qpolys(self, q=3):
+        from spatialflink_tpu.models import Polygon
+
+        rng = np.random.default_rng(21)
+        out = []
+        for _ in range(q):
+            cx = float(rng.uniform(116.2, 116.8))
+            cy = float(rng.uniform(40.2, 40.8))
+            w = float(rng.uniform(0.05, 0.2))
+            out.append(Polygon.create(
+                [[(cx - w, cy - w), (cx + w, cy - w), (cx + w, cy + w),
+                  (cx - w, cy + w), (cx - w, cy - w)]], GRID))
+        return out
+
+    @pytest.mark.parametrize("approximate", (False, True))
+    def test_geom_query_run_multi_matches_run_loop(self, approximate):
+        from spatialflink_tpu.operators import (
+            PointPolygonKNNQuery as PointGeomKNNQuery,
+        )
+
+        def conf():
+            return QueryConfiguration(QueryType.WindowBased, 10_000, 5_000,
+                                      approximate=approximate)
+
+        polys = self._qpolys()
+        multi = list(PointGeomKNNQuery(conf(), GRID).run_multi(
+            _stream(), polys, RADIUS, K))
+        singles = [list(PointGeomKNNQuery(conf(), GRID).run(
+            _stream(), p, RADIUS, K)) for p in polys]
+        assert multi and multi[0].extras["queries"] == len(polys)
+        for w, res in enumerate(multi):
+            for qi in range(len(polys)):
+                ref = singles[qi][w]
+                assert res.window_start == ref.window_start
+                assert res.records[qi] == ref.records
+
     def test_run_multi_distributed_raises(self):
         conf = QueryConfiguration(QueryType.WindowBased, 10_000, 5_000,
                                   devices=8)

@@ -173,6 +173,11 @@ class QueryConfig:
 
     option: int = 1
     approximate: bool = False
+    # answer ALL configured query points/geometries in one dispatch per
+    # window (run_multi — TPU-native extension; the reference uses only the
+    # FIRST query object, one query per job). Opt-in to preserve that
+    # reference parity by default.
+    multi_query: bool = False
     # device-mesh width for distributed window evaluation — the TPU analogue
     # of the reference's task parallelism (``env.setParallelism(30)``,
     # StreamingJob.java:221). 0/1 = single device.
@@ -214,6 +219,7 @@ class QueryConfig:
         return cls(
             option=int(_req(d, "option", "query")),
             approximate=bool(_opt(d, "approximate", False)),
+            multi_query=bool(_opt(d, "multiQuery", False)),
             parallelism=parallelism,
             hosts=hosts,
             radius=float(_opt(d, "radius", 0.0)),

@@ -215,6 +215,43 @@ def _query_object(params: Params, grid: UniformGrid, kind: str):
     return lss[0]
 
 
+def _run_multi_case(params: Params, spec: CaseSpec, op, s1,
+                    u_grid: UniformGrid, radius: float) -> Iterator:
+    """``query.multiQuery`` dispatch: answer ALL configured query objects in
+    one dispatch per window via run_multi (TPU-native extension; without the
+    flag the driver keeps reference parity and uses only the first query
+    object). Supported: PointPoint range/kNN and Point x Polygon/LineString
+    kNN — the run_multi surface; other cases error rather than silently
+    falling back to first-query semantics."""
+    if spec.latency:
+        raise ValueError(
+            "multiQuery does not combine with the latency variants "
+            "(per-record latency assumes single-query record lists)")
+    def _non_empty(qs, name):
+        if not qs:
+            raise ValueError(f"query.{name} is empty")
+        return qs
+
+    pair = (spec.stream, spec.query)
+    if spec.family == "range" and pair == ("Point", "Point"):
+        return op.run_multi(
+            s1, _non_empty(params.query_point_objects(u_grid), "queryPoints"),
+            radius)
+    if spec.family == "knn" and spec.stream == "Point":
+        getter, name = {
+            "Point": (params.query_point_objects, "queryPoints"),
+            "Polygon": (params.query_polygon_objects, "queryPolygons"),
+            "LineString": (params.query_linestring_objects,
+                           "queryLineStrings"),
+        }[spec.query]
+        return op.run_multi(s1, _non_empty(getter(u_grid), name), radius,
+                            params.query.k)
+    raise ValueError(
+        f"multiQuery is not supported for queryOption {params.query.option} "
+        f"({spec.family} {spec.stream}-{spec.query}); supported: PointPoint "
+        "range/kNN and Point-Polygon/LineString kNN")
+
+
 def _with_latency(results: Iterator[WindowResult]) -> Iterator[WindowResult]:
     """Annotate each result with per-record latency millis (reference:
     ``now - ingestionTime`` shipped to a Kafka topic,
@@ -254,6 +291,11 @@ def run_option(params: Params, stream1: Iterable, stream2: Optional[Iterable]
                            f"{ {'range': 'Range', 'knn': 'KNN', 'join': 'Join'}[spec.family] }Query")
         s1 = decode_stream(stream1, params.input1, u_grid, spec.stream)
         if spec.family == "join":
+            if params.query.multi_query:
+                raise ValueError(
+                    f"multiQuery is not supported for queryOption {opt} "
+                    "(join); supported: PointPoint range/kNN and "
+                    "Point-Polygon/LineString kNN")
             op = cls(conf, u_grid, q_grid)
             if stream2 is None:
                 raise ValueError(f"queryOption {opt} (join) needs stream2")
@@ -261,11 +303,14 @@ def run_option(params: Params, stream1: Iterable, stream2: Optional[Iterable]
             out = op.run(s1, s2, radius)
         else:
             op = cls(conf, u_grid)
-            q = _query_object(params, u_grid, spec.query)
-            if spec.family == "knn":
-                out = op.run(s1, q, radius, params.query.k)
+            if params.query.multi_query:
+                out = _run_multi_case(params, spec, op, s1, u_grid, radius)
             else:
-                out = op.run(s1, q, radius)
+                q = _query_object(params, u_grid, spec.query)
+                if spec.family == "knn":
+                    out = op.run(s1, q, radius, params.query.k)
+                else:
+                    out = op.run(s1, q, radius)
         return _with_latency(out) if spec.latency else out
 
     if spec.family in ("tfilter", "trange", "tstats", "taggregate", "tjoin",
@@ -469,6 +514,11 @@ def run_option_bulk(params: Params, input_path: str,
     spec = CASES.get(params.query.option)
     if spec is None or spec.mode != "window" or spec.latency:
         return None
+    if params.query.multi_query:
+        # the bulk evaluators are single-query; silently answering only the
+        # first configured query under --multi-query would be worse than
+        # the slower record path
+        return None
     geom_stream = spec.stream in ("Polygon", "LineString")
     if geom_stream:
         # geometry STREAMS ride the bulk path for range/kNN over WKT or
@@ -541,9 +591,15 @@ def _bulk_parse_geom_stream(params: Params, input_path: str):
 
 def _emit(result, sink) -> None:
     if isinstance(result, WindowResult):
+        if "queries" in result.extras:
+            # multi-query windows: records is a list of Q per-query lists
+            counts = {"count": sum(len(r) for r in result.records),
+                      "per_query_counts": [len(r) for r in result.records]}
+        else:
+            counts = {"count": len(result.records)}
         sink.emit({
             "window": [result.window_start, result.window_end],
-            "count": len(result.records),
+            **counts,
             **{k: v for k, v in result.extras.items() if k != "latency_ms"},
         })
     else:
@@ -625,12 +681,19 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "windows) for windowed Point/Point range, kNN and "
                          "join cases; record-path lateness semantics, but no "
                          "control-tuple stop hook")
+    ap.add_argument("--multi-query", action="store_true",
+                    help="answer ALL configured query points/geometries in "
+                         "one dispatch per window (run_multi; default keeps "
+                         "reference parity: first query object only). "
+                         "PointPoint range/kNN and Point-geometry kNN cases")
     args = ap.parse_args(argv)
 
     _enable_compilation_cache()
     params = Params.from_yaml(args.config)
     if args.option is not None:
         params.query.option = args.option
+    if args.multi_query:
+        params.query.multi_query = True
     if args.devices is not None:
         params.query.parallelism = args.devices
     if args.hosts is not None:
@@ -722,7 +785,13 @@ def main(argv: Optional[List[str]] = None) -> int:
             n += 1
             if out_sink is not None:
                 if isinstance(result, WindowResult):
-                    for rec in result.records:
+                    recs = result.records
+                    if "queries" in result.extras:
+                        # multi-query windows: records is one list per
+                        # query; flatten so the file keeps its one-record-
+                        # per-line contract across queries
+                        recs = [r for per_query in recs for r in per_query]
+                    for rec in recs:
                         out_sink.emit(rec)
                 elif (isinstance(result, tuple) and len(result) == 2
                         and isinstance(result[0], SpatialObject)):

@@ -220,9 +220,10 @@ def _run_multi_case(params: Params, spec: CaseSpec, op, s1,
     """``query.multiQuery`` dispatch: answer ALL configured query objects in
     one dispatch per window via run_multi (TPU-native extension; without the
     flag the driver keeps reference parity and uses only the first query
-    object). Supported: PointPoint range/kNN and Point x Polygon/LineString
-    kNN — the run_multi surface; other cases error rather than silently
-    falling back to first-query semantics."""
+    object). Supported: all nine kNN pairs and PointPoint range — the
+    run_multi surface; other cases error rather than silently falling back
+    to first-query semantics (run_option rejects non-range/kNN families
+    before dispatch reaches here)."""
     if spec.latency:
         raise ValueError(
             "multiQuery does not combine with the latency variants "
@@ -237,7 +238,7 @@ def _run_multi_case(params: Params, spec: CaseSpec, op, s1,
         return op.run_multi(
             s1, _non_empty(params.query_point_objects(u_grid), "queryPoints"),
             radius)
-    if spec.family == "knn" and spec.stream == "Point":
+    if spec.family == "knn":
         getter, name = {
             "Point": (params.query_point_objects, "queryPoints"),
             "Polygon": (params.query_polygon_objects, "queryPolygons"),
@@ -248,8 +249,8 @@ def _run_multi_case(params: Params, spec: CaseSpec, op, s1,
                             params.query.k)
     raise ValueError(
         f"multiQuery is not supported for queryOption {params.query.option} "
-        f"({spec.family} {spec.stream}-{spec.query}); supported: PointPoint "
-        "range/kNN and Point-Polygon/LineString kNN")
+        f"({spec.family} {spec.stream}-{spec.query}); supported: all nine "
+        "kNN pairs and PointPoint range")
 
 
 def _with_latency(results: Iterator[WindowResult]) -> Iterator[WindowResult]:
@@ -282,6 +283,13 @@ def run_option(params: Params, stream1: Iterable, stream2: Optional[Iterable]
     if opt not in CASES:
         raise ValueError(f"unknown queryOption {opt}")
     spec = CASES[opt]
+    if params.query.multi_query and spec.family not in ("range", "knn"):
+        # every ineligible family errors — silently answering only the
+        # first query under the flag would be worse than failing
+        raise ValueError(
+            f"multiQuery is not supported for queryOption {opt} "
+            f"({spec.family}); supported: all nine kNN pairs and "
+            "PointPoint range")
     u_grid, q_grid = params.grids()
     conf = _query_conf(params, spec)
     radius = params.query.radius
@@ -291,11 +299,6 @@ def run_option(params: Params, stream1: Iterable, stream2: Optional[Iterable]
                            f"{ {'range': 'Range', 'knn': 'KNN', 'join': 'Join'}[spec.family] }Query")
         s1 = decode_stream(stream1, params.input1, u_grid, spec.stream)
         if spec.family == "join":
-            if params.query.multi_query:
-                raise ValueError(
-                    f"multiQuery is not supported for queryOption {opt} "
-                    "(join); supported: PointPoint range/kNN and "
-                    "Point-Polygon/LineString kNN")
             op = cls(conf, u_grid, q_grid)
             if stream2 is None:
                 raise ValueError(f"queryOption {opt} (join) needs stream2")
@@ -685,7 +688,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="answer ALL configured query points/geometries in "
                          "one dispatch per window (run_multi; default keeps "
                          "reference parity: first query object only). "
-                         "PointPoint range/kNN and Point-geometry kNN cases")
+                         "All nine kNN pairs and PointPoint range")
     args = ap.parse_args(argv)
 
     _enable_compilation_cache()

@@ -492,6 +492,14 @@ class GeomQueryMixin:
             self.grid.neighboring_cells_mask(radius, self._query_cells(query))
         )
 
+    def _stack_query_nb(self, queries, radius: float):
+        """(Q, n*n) dense neighboring-cells masks, one per query object —
+        the multi-query form of :meth:`_query_nb`."""
+        import jax.numpy as jnp
+
+        return jnp.asarray(np.stack(
+            [np.asarray(self._query_nb(q, radius)) for q in queries]))
+
     def _query_edges(self, query):
         from spatialflink_tpu.models.batches import single_query_edges
         import jax.numpy as jnp

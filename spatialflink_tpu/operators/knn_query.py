@@ -235,6 +235,21 @@ class _GeomStreamKnn(_GenericKnn):
     def _batch(self, records, ts_base):
         return self._geom_batch(records, ts_base)
 
+    def _drive_multi(self, stream, n_queries: int, eval_geoms, k: int
+                     ) -> Iterator[WindowResult]:
+        """Shared run_multi loop over geometry-stream window batches:
+        ``eval_geoms(geoms)`` -> (KnnResult (Q, k), dist_evals (Q,))."""
+        def eval_batch(records, ts_base):
+            if not records:
+                return [[] for _ in range(n_queries)]
+            res, evals = eval_geoms(self._geom_batch(records, ts_base))
+            return self._defer_knn_multi(res, jnp.sum(evals))
+
+        for result in self._multi_results(stream, eval_batch):
+            result.extras["k"] = k
+            result.extras["queries"] = n_queries
+            yield result
+
     def _bulk_batches(self, parsed, pad):
         from spatialflink_tpu.streams.bulk import bulk_geom_window_batches
 
@@ -260,8 +275,6 @@ class PointGeomKNNQuery(_GenericKnn):
         Single-device, shared radius — see the PointPoint docstring."""
         self._require_single_device()
         k = k or self.conf.k
-        import numpy as np
-
         from spatialflink_tpu.models.batches import EdgeGeomBatch
         from spatialflink_tpu.ops.geom import knn_points_to_geom_queries
 
@@ -269,8 +282,7 @@ class PointGeomKNNQuery(_GenericKnn):
         # per run_multi and its G axis must match the (Q,) nb_masks
         gb = EdgeGeomBatch.from_objects(query_geoms, self.grid,
                                         pad=len(query_geoms))
-        nb_masks = jnp.asarray(np.stack(
-            [np.asarray(self._query_nb(q, radius)) for q in query_geoms]))
+        nb_masks = self._stack_query_nb(query_geoms, radius)
 
         def eval_batch(records, ts_base):
             if not records:
@@ -318,6 +330,24 @@ class GeomPointKNNQuery(_GeomStreamKnn):
     """Polygon/linestring stream x point query (``PolygonPointKNNQuery``,
     ``LineStringPointKNNQuery``)."""
 
+    def run_multi(self, stream, query_points, radius: float,
+                  k: Optional[int] = None) -> Iterator[WindowResult]:
+        """Q query POINTS over one polygon/linestring stream in ONE dispatch
+        per window (``ops.geom.knn_geoms_to_point_queries``); same contract
+        as ``PointPointKNNQuery.run_multi``."""
+        self._require_single_device()
+        k = k or self.conf.k
+        from spatialflink_tpu.ops.geom import knn_geoms_to_point_queries
+
+        qx, qy, _qc = self._query_point_arrays(query_points)
+        nb_masks = self._stack_query_nb(query_points, radius)
+        return self._drive_multi(
+            stream, len(query_points),
+            lambda geoms: knn_geoms_to_point_queries(
+                geoms, qx, qy, nb_masks, k=k, strategy=self._knn_strategy(),
+                approximate=self.conf.approximate),
+            k)
+
     def _setup(self, query, radius):
         return dict(nb=self._query_nb(query, radius), query=query)
 
@@ -339,6 +369,27 @@ class GeomPointKNNQuery(_GeomStreamKnn):
 class GeomGeomKNNQuery(_GeomStreamKnn):
     """Polygon/linestring stream x polygon/linestring query (the remaining
     4 pairs of SURVEY §2.2)."""
+
+    def run_multi(self, stream, query_geoms, radius: float,
+                  k: Optional[int] = None) -> Iterator[WindowResult]:
+        """Q query GEOMETRIES over one polygon/linestring stream in ONE
+        dispatch per window (``ops.geom.knn_geoms_to_geom_queries``); the Q
+        queries ride one exact-capacity padded edge batch. Same contract as
+        the other run_multi surfaces."""
+        self._require_single_device()
+        k = k or self.conf.k
+        from spatialflink_tpu.models.batches import EdgeGeomBatch
+        from spatialflink_tpu.ops.geom import knn_geoms_to_geom_queries
+
+        qgb = EdgeGeomBatch.from_objects(query_geoms, self.grid,
+                                         pad=len(query_geoms))
+        nb_masks = self._stack_query_nb(query_geoms, radius)
+        return self._drive_multi(
+            stream, len(query_geoms),
+            lambda geoms: knn_geoms_to_geom_queries(
+                geoms, qgb, nb_masks, k=k, strategy=self._knn_strategy(),
+                approximate=self.conf.approximate),
+            k)
 
     def _setup(self, query, radius):
         return dict(nb=self._query_nb(query, radius),

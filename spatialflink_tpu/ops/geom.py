@@ -175,6 +175,62 @@ def point_to_geoms_dist(px, py, geoms: EdgeGeomBatch):
     return jnp.where(inside & geoms.is_areal, 0.0, bdist)
 
 
+def _geom_elig_multi(geoms: EdgeGeomBatch, nb_masks):
+    """(Q, G) eligibility of each batch geometry for each query: valid and
+    ANY overlapped cell inside that query's dense neighboring-cells mask
+    (the multi-query form of :func:`geom_cells_any_within`)."""
+    hit = nb_masks[:, jnp.maximum(geoms.cells, 0)]  # (Q, G, C)
+    any_in = jnp.any(hit & geoms.cells_mask[None], axis=-1)
+    return geoms.valid[None, :] & any_in
+
+
+@partial(jax.jit, static_argnames=("k", "strategy", "approximate"))
+def knn_geoms_to_point_queries(geoms: EdgeGeomBatch, qx, qy, nb_masks, *,
+                               k: int, strategy: str = "auto",
+                               approximate: bool = False):
+    """kNN of Q query POINTS over one polygon/linestring window batch in ONE
+    dispatch (multi-query ``PolygonPointKNNQuery``/``LineStringPoint...``):
+    -> (KnnResult with (Q, k) fields, dist_evals (Q,)). Approximate mode
+    substitutes point->bbox distances like the single-query path."""
+    from spatialflink_tpu.ops.knn import topk_by_distance_multi
+
+    if approximate:
+        b = geoms.bbox
+        # vmap of the single-query expression (not a 2-D broadcast): the
+        # per-row computation graph then matches GeomPointKNNQuery._elig_dists
+        # bit-for-bit, so run() and run_multi() results are identical
+        d = jax.vmap(lambda x, y: D.point_bbox_dist(
+            x, y, b[:, 0], b[:, 1], b[:, 2], b[:, 3]))(qx, qy)
+    else:
+        d = jax.vmap(lambda x, y: point_to_geoms_dist(x, y, geoms))(qx, qy)
+    elig = _geom_elig_multi(geoms, nb_masks)
+    res = topk_by_distance_multi(geoms.obj_id, d, elig, k, strategy)
+    return res, jnp.sum(elig, axis=1, dtype=jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("k", "strategy", "approximate"))
+def knn_geoms_to_geom_queries(geoms: EdgeGeomBatch, queries: EdgeGeomBatch,
+                              nb_masks, *, k: int, strategy: str = "auto",
+                              approximate: bool = False):
+    """kNN of Q query GEOMETRIES over one polygon/linestring window batch in
+    ONE dispatch (multi-query ``PolygonPolygonKNNQuery`` and the other
+    geometry-geometry pairs): ``queries`` is the Q query geometries as one
+    exact-capacity padded edge batch; distances are the vmapped
+    geometry->geometry kernel (:func:`geoms_to_single_geom_dist`), bbox-bbox
+    in approximate mode."""
+    from spatialflink_tpu.ops.knn import topk_by_distance_multi
+
+    if approximate:
+        d = jax.vmap(lambda b: geoms_bbox_dist(geoms, b))(queries.bbox)
+    else:
+        d = jax.vmap(
+            lambda e, m, a: geoms_to_single_geom_dist(geoms, e, m, a)
+        )(queries.edges, queries.edge_mask, queries.is_areal)
+    elig = _geom_elig_multi(geoms, nb_masks)
+    res = topk_by_distance_multi(geoms.obj_id, d, elig, k, strategy)
+    return res, jnp.sum(elig, axis=1, dtype=jnp.int32)
+
+
 def geom_cells_all_within(cells, cells_mask, target_mask):
     """(G,) True iff ALL of a geometry's grid cells fall inside
     ``target_mask`` — the PolygonPointRangeQuery GN-subset rule: a polygon is

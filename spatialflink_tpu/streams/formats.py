@@ -277,7 +277,31 @@ def _strip_parens(s: str) -> str:
     return s[1:-1].strip() if s.startswith("(") and s.endswith(")") else s
 
 
-def serialize_wkt(obj: SpatialObject) -> str:
+def serialize_wkt(obj: SpatialObject, *, delimiter: str = ",",
+                  date_format: Optional[str] = None,
+                  include_fields: bool = False) -> str:
+    """WKT text for ``obj``; with ``include_fields`` the objID and timestamp
+    ride as delimiter-separated PREFIX fields (``"oid, ts, WKT"``).
+
+    The reference's WKT output schemas carry both fields too
+    (``Serialization.java:53-96`` — objID prefix, date-formatted timestamp
+    SUFFIX, quoted); we normalize to the prefix position because that is the
+    field order our WKT *parser* (and the reference's CSV convention)
+    accepts, making serialize->parse a lossless round trip — a documented
+    deviation from the reference's asymmetric output-only suffix form. Both
+    fields are emitted whenever either is set (an empty oid keeps the ts
+    from being mis-read as the oid)."""
+    body = _serialize_wkt_body(obj)
+    if include_fields and (obj.obj_id or obj.timestamp):
+        ts = format_timestamp(obj.timestamp, date_format)
+        # an empty oid must still occupy its field — quoted, so the parser's
+        # blank-field filter keeps it and the ts is not mis-read as the oid
+        oid = str(obj.obj_id) if obj.obj_id else '""'
+        return f"{oid}{delimiter} {ts}{delimiter} {body}"
+    return body
+
+
+def _serialize_wkt_body(obj: SpatialObject) -> str:
     if isinstance(obj, Point):
         return f"POINT ({obj.x} {obj.y})"
     if isinstance(obj, LineString):
@@ -302,7 +326,7 @@ def serialize_wkt(obj: SpatialObject) -> str:
     if isinstance(obj, GeometryCollection):
         # ``Serialization.java:682-774`` (GeometryCollectionToWKTOutputSchema)
         return "GEOMETRYCOLLECTION (" + ", ".join(
-            serialize_wkt(g) for g in obj.geometries
+            _serialize_wkt_body(g) for g in obj.geometries
         ) + ")"
     raise ValueError(f"cannot WKT-serialize {type(obj).__name__}")
 
@@ -504,7 +528,10 @@ def serialize_spatial(obj: SpatialObject, fmt: str, *, delimiter: str = ",",
     if f == "geojson":
         return serialize_geojson(obj, date_format=date_format)
     if f == "wkt":
-        return serialize_wkt(obj)
+        # carry objID/timestamp like the reference's WKT output schemas
+        # (prefix-normalized; see serialize_wkt)
+        return serialize_wkt(obj, delimiter=delimiter,
+                             date_format=date_format, include_fields=True)
     if f in ("csv", "tsv"):
         return serialize_csv(obj, delimiter="\t" if f == "tsv" else delimiter,
                              date_format=date_format)

@@ -257,6 +257,101 @@ class TestOperatorMulti:
                 assert res.window_start == ref.window_start
                 assert res.records[qi] == ref.records
 
+    def _geom_stream(self, n=200, seed=31):
+        from spatialflink_tpu.models import LineString, Polygon
+
+        rng = np.random.default_rng(seed)
+        t0 = 1_700_000_000_000
+        out = []
+        for i in range(n):
+            cx = float(rng.uniform(116.0, 117.0))
+            cy = float(rng.uniform(40.0, 41.0))
+            w = float(rng.uniform(0.01, 0.05))
+            if i % 3:
+                out.append(Polygon.create(
+                    [[(cx - w, cy - w), (cx + w, cy - w), (cx + w, cy + w),
+                      (cx - w, cy + w), (cx - w, cy - w)]], GRID,
+                    obj_id=f"g{i % 41}", timestamp=t0 + i * 60))
+            else:
+                out.append(LineString.create(
+                    [(cx - w, cy), (cx, cy + w), (cx + w, cy)], GRID,
+                    obj_id=f"g{i % 41}", timestamp=t0 + i * 60))
+        return out
+
+    @staticmethod
+    def _assert_query_parity(multi_recs, single_recs, approximate):
+        """Exact mode is bit-for-bit (both paths run the same jitted
+        kernels); approximate mode allows 1-ulp distance drift — the
+        single-query operator computes its bbox distances eagerly while the
+        multi kernel fuses them inside one jit, and XLA fusion may round
+        differently. Membership and order must still agree."""
+        if not approximate:
+            assert multi_recs == single_recs
+            return
+        assert [oid for oid, _ in multi_recs] == [o for o, _ in single_recs]
+        np.testing.assert_allclose([d for _, d in multi_recs],
+                                   [d for _, d in single_recs], rtol=1e-6)
+
+    @pytest.mark.parametrize("approximate", (False, True))
+    def test_geom_stream_point_query_run_multi(self, approximate):
+        from spatialflink_tpu.operators import PolygonPointKNNQuery
+
+        def conf():
+            return QueryConfiguration(QueryType.WindowBased, 10_000, 5_000,
+                                      approximate=approximate)
+
+        qs = self._qpoints(3)
+        multi = list(PolygonPointKNNQuery(conf(), GRID).run_multi(
+            self._geom_stream(), qs, RADIUS, K))
+        singles = [list(PolygonPointKNNQuery(conf(), GRID).run(
+            self._geom_stream(), q, RADIUS, K)) for q in qs]
+        assert multi
+        for w, res in enumerate(multi):
+            for qi in range(len(qs)):
+                self._assert_query_parity(res.records[qi],
+                                          singles[qi][w].records, approximate)
+
+    @pytest.mark.parametrize("approximate", (False, True))
+    def test_geom_stream_geom_query_run_multi(self, approximate):
+        from spatialflink_tpu.operators import PolygonPolygonKNNQuery
+
+        def conf():
+            return QueryConfiguration(QueryType.WindowBased, 10_000, 5_000,
+                                      approximate=approximate)
+
+        qs = self._qpolys(3)
+        multi = list(PolygonPolygonKNNQuery(conf(), GRID).run_multi(
+            self._geom_stream(), qs, RADIUS, K))
+        singles = [list(PolygonPolygonKNNQuery(conf(), GRID).run(
+            self._geom_stream(), q, RADIUS, K)) for q in qs]
+        assert multi
+        for w, res in enumerate(multi):
+            for qi in range(len(qs)):
+                self._assert_query_parity(res.records[qi],
+                                          singles[qi][w].records, approximate)
+
+    def test_driver_multi_query_geom_stream_option(self):
+        """queryOption 66 (Polygon-Point kNN) routes through run_multi under
+        multiQuery."""
+        from spatialflink_tpu.config import Params
+        from spatialflink_tpu.driver import run_option
+        from spatialflink_tpu.streams.formats import serialize_spatial
+
+        lines = [serialize_spatial(g, "WKT")
+                 for g in self._geom_stream(120)]
+        p = Params.from_yaml("conf/spatialflink-conf.yml")
+        p.query.option = 66
+        p.query.radius = RADIUS
+        p.query.k = K
+        p.query.multi_query = True
+        p.query.query_points = [(116.3, 40.3), (116.7, 40.7)]
+        import dataclasses
+        p = dataclasses.replace(
+            p, input1=dataclasses.replace(p.input1, format="WKT"))
+        wins = list(run_option(p, lines))
+        assert wins and wins[0].extras["queries"] == 2
+        assert all(len(w.records) == 2 for w in wins)
+
     def test_driver_multi_query_dispatch(self):
         """query.multiQuery answers ALL configured queryPoints through
         run_option; without it the driver keeps reference parity (first
@@ -278,13 +373,23 @@ class TestOperatorMulti:
         first_only = list(run_option(p, lines))
         assert [w.records[0] for w in multi] == [w.records for w in first_only]
 
-    def test_driver_multi_query_unsupported_case_errors(self):
+    @pytest.mark.parametrize("option", (101,   # join
+                                        208,   # trajectory (taggregate)
+                                        504,   # deser
+                                        2))    # realtime range is fine; 2 IS
+    def test_driver_multi_query_ineligible_family_errors(self, option):
+        """Every ineligible family errors under multiQuery — a silent
+        first-query fallback would misreport coverage. (Option 2, realtime
+        PP range, IS eligible and must not raise.)"""
         from spatialflink_tpu.config import Params
         from spatialflink_tpu.driver import run_option
 
         p = Params.from_yaml("conf/spatialflink-conf.yml")
-        p.query.option = 101  # join
+        p.query.option = option
         p.query.multi_query = True
+        if option == 2:
+            assert list(run_option(p, [])) == []
+            return
         with pytest.raises(ValueError, match="multiQuery is not supported"):
             next(iter(run_option(p, [], [])))
 

@@ -123,6 +123,26 @@ class TestWKT:
         assert len(back.geometries) == 3
         assert back.geometries[0].x == pytest.approx(116.5)
 
+    def test_wkt_serialization_preserves_fields(self):
+        """serialize->parse keeps objID and timestamp: the reference's WKT
+        output schemas carry both (``Serialization.java:53-96``, objID
+        prefix + date suffix); we prefix-normalize so our own parser reads
+        them back losslessly. Bare geometries (no fields set) stay bare."""
+        poly = Polygon.create([[(1, 1), (2, 1), (2, 2), (1, 1)]], GRID,
+                              obj_id="g7", timestamp=1700000000123)
+        s = serialize_spatial(poly, "WKT", date_format=None)
+        assert s.startswith("g7, 1700000000123, POLYGON")
+        back = parse_spatial(s, "WKT", GRID, date_format=None)
+        assert back.obj_id == "g7" and back.timestamp == 1700000000123
+        bare = serialize_spatial(Point.create(1.0, 2.0, GRID), "WKT")
+        assert bare == "POINT (1.0 2.0)"
+        # empty oid + set timestamp: the oid field is emitted quoted-empty
+        # so the parser cannot mis-read the timestamp as the object id
+        ts_only = Point.create(1.0, 2.0, GRID, obj_id="", timestamp=12345)
+        s = serialize_spatial(ts_only, "WKT", date_format=None)
+        back = parse_spatial(s, "WKT", GRID, date_format=None)
+        assert back.obj_id == "" and back.timestamp == 12345
+
     def test_geometrycollection_trajectory_fields(self):
         # trajectory variant (Deserialization.java:854): oID/time prefix fields
         gc = parse_spatial(

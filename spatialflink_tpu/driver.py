@@ -518,10 +518,30 @@ def run_option_bulk(params: Params, input_path: str,
     if spec is None or spec.mode != "window" or spec.latency:
         return None
     if params.query.multi_query:
-        # the bulk evaluators are single-query; silently answering only the
-        # first configured query under --multi-query would be worse than
-        # the slower record path
-        return None
+        # PointPoint range/kNN have bulk multi-query evaluators; every
+        # other case falls back to the record path (run_option), which
+        # dispatches or errors per the multiQuery eligibility rules —
+        # silently answering only the first configured query would be
+        # worse than the slower path
+        if (spec.family not in ("range", "knn")
+                or (spec.stream, spec.query) != ("Point", "Point")):
+            return None
+        u_grid, _ = params.grids()
+        qs = params.query_point_objects(u_grid)
+        if not qs:
+            # validate BEFORE the full-file native ingest, like the record
+            # path's _non_empty guard
+            raise ValueError("query.queryPoints is empty")
+        parsed = _bulk_parse_stream(params.input1, input_path,
+                                    params.query.allowed_lateness_s)
+        if parsed is None:
+            return None
+        conf = _query_conf(params, spec)
+        if spec.family == "range":
+            return ops.PointPointRangeQuery(conf, u_grid).run_multi_bulk(
+                parsed, qs, params.query.radius)
+        return ops.PointPointKNNQuery(conf, u_grid).run_multi_bulk(
+            parsed, qs, params.query.radius, params.query.k)
     geom_stream = spec.stream in ("Polygon", "LineString")
     if geom_stream:
         # geometry STREAMS ride the bulk path for range/kNN over WKT or

@@ -346,12 +346,12 @@ class SpatialOperator:
         qc = np.asarray([q.cell for q in query_points], np.int32)
         return qx, qy, qc
 
-    def _defer_knn_multi(self, res, dist_evals) -> Deferred:
+    def _defer_knn_multi(self, res, dist_evals, interner=None) -> Deferred:
         """Deferred per-query (objID, distance) lists from a (Q, k)
         KnnResult; ``dist_evals`` (device scalar, summed over the Q
         queries) feeds the distance-computation counter like every other
-        kNN path."""
-        interner = self.interner
+        kNN path. Bulk paths pass the parse-time ``interner``."""
+        interner = interner if interner is not None else self.interner
 
         def rows(r):
             valid = np.asarray(r.valid)

@@ -137,6 +137,33 @@ class PointPointKNNQuery(SpatialOperator):
             result.extras["queries"] = len(query_points)
             yield result
 
+    def run_multi_bulk(self, parsed, query_points, radius: float,
+                       k: Optional[int] = None, *, pad: Optional[int] = None
+                       ) -> Iterator[WindowResult]:
+        """Bulk-replay multi-query: vectorized window batches through the
+        same multi kernel; per-query (objID, distance) records resolve
+        through the parse-time interner (the ``--bulk --multi-query`` CLI
+        path)."""
+        self._require_single_device()
+        k = k or self.conf.k
+        from spatialflink_tpu.ops.knn import knn_point_multi_stats
+
+        qx, qy, qc = self._query_point_arrays(query_points)
+        nb_layers = self._nb_layers(radius)
+
+        def eval_batch(payload, ts_base):
+            _idx, batch = payload
+            res, evals = knn_point_multi_stats(
+                batch, qx, qy, qc, radius, nb_layers, n=self.grid.n, k=k,
+                strategy=self._knn_strategy())
+            return self._defer_knn_multi(res, jnp.sum(evals),
+                                         interner=parsed.interner)
+
+        for result in self._drive_bulk(parsed, eval_batch, pad=pad):
+            result.extras["k"] = k
+            result.extras["queries"] = len(query_points)
+            yield result
+
 
 
 class _GenericKnn(SpatialOperator, GeomQueryMixin):

@@ -123,6 +123,37 @@ class PointPointRangeQuery(SpatialOperator):
             result.extras["queries"] = len(query_points)
             yield result
 
+    def run_multi_bulk(self, parsed, query_points: List[Point],
+                       radius: float, *, pad: Optional[int] = None
+                       ) -> Iterator[WindowResult]:
+        """Bulk-replay multi-query range: per-query original-record index
+        lists from one (Q, N) mask dispatch per window (the
+        ``--bulk --multi-query`` CLI path)."""
+        self._require_single_device()
+        from spatialflink_tpu.ops.range import range_filter_point_multi_masks
+
+        qx, qy, qc = self._query_point_arrays(query_points)
+        args = (radius, self.grid.guaranteed_layers(radius),
+                self.grid.candidate_layers(radius))
+
+        def eval_batch(payload, ts_base):
+            idx, batch = payload
+            masks, gn_c, evals = range_filter_point_multi_masks(
+                batch, qx, qy, qc, *args, n=self.grid.n,
+                approximate=self.conf.approximate)
+
+            def rows(m):
+                m = np.asarray(m)  # ONE (Q, N) device->host transfer
+                return [idx[m[q][: len(idx)]].tolist()
+                        for q in range(len(query_points))]
+
+            return self._defer_with_stats(
+                masks, (jnp.sum(gn_c), jnp.sum(evals)), rows)
+
+        for result in self._drive_bulk(parsed, eval_batch, pad=pad):
+            result.extras["queries"] = len(query_points)
+            yield result
+
     def run_incremental(self, stream: Iterable[Point], query_point: Point,
                         radius: float) -> Iterator[WindowResult]:
         """Incremental sliding windows: carry the previous window's survivors

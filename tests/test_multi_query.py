@@ -400,8 +400,8 @@ class TestOperatorMulti:
         # YAML opt-in parses
         p = Params.from_yaml("conf/spatialflink-conf.yml")
         assert p.query.multi_query is False
-        # --bulk declines multi-query (single-query evaluators) instead of
-        # silently answering only the first query
+        # PointPoint cases ride the bulk multi evaluators; a non-PointPoint
+        # case declines to the record path (which dispatches or errors)
         p.query.multi_query = True
         p.query.option = 1
         src = tmp_path / "pts.csv"
@@ -410,6 +410,9 @@ class TestOperatorMulti:
         p = dataclasses.replace(
             p, input1=dataclasses.replace(p.input1, format="CSV"))
         p.input1.date_format = None
+        res = list(drv.run_option_bulk(p, str(src)))
+        assert res and res[0].extras["queries"] >= 1
+        p.query.option = 56  # Point-Polygon kNN: no bulk multi evaluator
         assert drv.run_option_bulk(p, str(src)) is None
 
     def test_driver_multi_query_empty_list_errors(self):
@@ -443,6 +446,47 @@ class TestOperatorMulti:
         # every line parses back as a single spatial record
         for ln in lines[:5]:
             assert parse_spatial(ln, "WKT").obj_id is not None
+
+    def test_bulk_multi_query_matches_record_path(self, tmp_path):
+        """--bulk --multi-query: the vectorized replay answers the same
+        queries as the record path (kNN records identical; range counts
+        identical — bulk range emits original-record indices)."""
+        from spatialflink_tpu.config import Params
+        from spatialflink_tpu.driver import run_option, run_option_bulk
+
+        rng = np.random.default_rng(17)
+        t0 = 1_700_000_000_000
+        src = tmp_path / "pts.csv"
+        src.write_text("\n".join(
+            f"v{i % 37},{t0 + i * 40},{rng.uniform(116, 117):.6f},"
+            f"{rng.uniform(40, 41):.6f}" for i in range(800)) + "\n")
+
+        def params(option):
+            p = Params.from_yaml("conf/spatialflink-conf.yml")
+            p.query.option = option
+            p.query.radius = RADIUS
+            p.query.k = K
+            p.query.multi_query = True
+            p.query.query_points = [(116.3, 40.3), (116.7, 40.7)]
+            import dataclasses
+            p = dataclasses.replace(
+                p, input1=dataclasses.replace(p.input1, format="CSV"))
+            p.input1.date_format = None
+            return p
+
+        for option in (1, 51):
+            bulk = list(run_option_bulk(params(option), str(src)))
+            with open(src) as f:
+                rec = list(run_option(params(option), f))
+            assert bulk and len(bulk) == len(rec), option
+            for b, r in zip(bulk, rec):
+                assert b.window_start == r.window_start
+                assert b.extras["queries"] == 2
+                if option == 51:
+                    assert b.records == r.records
+                else:
+                    assert [len(x) for x in b.records] == \
+                        [len(x) for x in r.records]
 
     def test_cli_multi_query_flag(self, tmp_path, capsys):
         """--multi-query end-to-end through driver.main: the window summary

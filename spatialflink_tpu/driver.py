@@ -220,37 +220,25 @@ def _run_multi_case(params: Params, spec: CaseSpec, op, s1,
     """``query.multiQuery`` dispatch: answer ALL configured query objects in
     one dispatch per window via run_multi (TPU-native extension; without the
     flag the driver keeps reference parity and uses only the first query
-    object). Supported: all nine kNN pairs and PointPoint range — the
-    run_multi surface; other cases error rather than silently falling back
-    to first-query semantics (run_option rejects non-range/kNN families
-    before dispatch reaches here)."""
+    object). Supported: ALL NINE range and kNN pairs — the run_multi
+    surface; other families error rather than silently falling back to
+    first-query semantics (run_option rejects them before dispatch reaches
+    here)."""
     if spec.latency:
         raise ValueError(
             "multiQuery does not combine with the latency variants "
             "(per-record latency assumes single-query record lists)")
-    def _non_empty(qs, name):
-        if not qs:
-            raise ValueError(f"query.{name} is empty")
-        return qs
-
-    pair = (spec.stream, spec.query)
-    if spec.family == "range" and pair == ("Point", "Point"):
-        return op.run_multi(
-            s1, _non_empty(params.query_point_objects(u_grid), "queryPoints"),
-            radius)
-    if spec.family == "knn":
-        getter, name = {
-            "Point": (params.query_point_objects, "queryPoints"),
-            "Polygon": (params.query_polygon_objects, "queryPolygons"),
-            "LineString": (params.query_linestring_objects,
-                           "queryLineStrings"),
-        }[spec.query]
-        return op.run_multi(s1, _non_empty(getter(u_grid), name), radius,
-                            params.query.k)
-    raise ValueError(
-        f"multiQuery is not supported for queryOption {params.query.option} "
-        f"({spec.family} {spec.stream}-{spec.query}); supported: all nine "
-        "kNN pairs and PointPoint range")
+    getter, name = {
+        "Point": (params.query_point_objects, "queryPoints"),
+        "Polygon": (params.query_polygon_objects, "queryPolygons"),
+        "LineString": (params.query_linestring_objects, "queryLineStrings"),
+    }[spec.query]
+    qs = getter(u_grid)
+    if not qs:
+        raise ValueError(f"query.{name} is empty")
+    if spec.family == "range":
+        return op.run_multi(s1, qs, radius)
+    return op.run_multi(s1, qs, radius, params.query.k)
 
 
 def _with_latency(results: Iterator[WindowResult]) -> Iterator[WindowResult]:
@@ -288,8 +276,8 @@ def run_option(params: Params, stream1: Iterable, stream2: Optional[Iterable]
         # first query under the flag would be worse than failing
         raise ValueError(
             f"multiQuery is not supported for queryOption {opt} "
-            f"({spec.family}); supported: all nine kNN pairs and "
-            "PointPoint range")
+            f"({spec.family}); supported: all nine range and kNN "
+            "pairs")
     u_grid, q_grid = params.grids()
     conf = _query_conf(params, spec)
     radius = params.query.radius
@@ -708,7 +696,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="answer ALL configured query points/geometries in "
                          "one dispatch per window (run_multi; default keeps "
                          "reference parity: first query object only). "
-                         "All nine kNN pairs and PointPoint range")
+                         "All nine range and kNN pairs")
     args = ap.parse_args(argv)
 
     _enable_compilation_cache()

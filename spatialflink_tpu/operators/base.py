@@ -365,6 +365,36 @@ class SpatialOperator:
 
         return self._defer_with_stats(res, (0, dist_evals), rows)
 
+    def _run_multi_filter(self, stream: Iterable, n_queries: int,
+                          multi_mask_stats, batch_builder
+                          ) -> Iterator["WindowResult"]:
+        """Shared run_multi driver for FILTER-shaped operators (range):
+        ``multi_mask_stats(batch) -> (masks (Q, N), gn_c (Q,), evals (Q,))``;
+        records become Q per-query record lists, pruning counters aggregate
+        across the query batch."""
+        import jax.numpy as jnp
+
+        def eval_batch(records, ts_base):
+            if not records:
+                return [[] for _ in range(n_queries)]
+            batch = batch_builder(records, ts_base)
+            masks, gn_c, evals = multi_mask_stats(batch)
+
+            def rows(m):
+                m = np.asarray(m)  # ONE (Q, N) device->host transfer
+                return [
+                    [records[i] for i in np.nonzero(m[q])[0]
+                     if i < len(records)]
+                    for q in range(n_queries)
+                ]
+
+            return self._defer_with_stats(
+                masks, (jnp.sum(gn_c), jnp.sum(evals)), rows)
+
+        for result in self._multi_results(stream, eval_batch):
+            result.extras["queries"] = n_queries
+            yield result
+
     def _multi_results(self, stream: Iterable, eval_batch
                        ) -> Iterator["WindowResult"]:
         """_drive for multi-query evaluators, whose per-window result is a
@@ -495,10 +525,40 @@ class GeomQueryMixin:
     def _stack_query_nb(self, queries, radius: float):
         """(Q, n*n) dense neighboring-cells masks, one per query object —
         the multi-query form of :meth:`_query_nb`."""
+        return self._stack_query_masks(queries, radius, which=("nb",))[0]
+
+    def _stack_query_masks(self, queries, radius: float,
+                           which=("gn", "cn", "nb")):
+        """Selected dense-mask stacks, each (Q, n*n), in ``which`` order —
+        the multi-query form of :meth:`_query_masks`. Builds straight from
+        the grid's host-side masks (no per-query device round-trip) and
+        only the masks the caller asked for (cn derives from gn, so
+        requesting cn computes gn internally without stacking it)."""
         import jax.numpy as jnp
 
-        return jnp.asarray(np.stack(
-            [np.asarray(self._query_nb(q, radius)) for q in queries]))
+        rows = {k: [] for k in which}
+        for q in queries:
+            cells = self._query_cells(q)
+            gn = (self.grid.guaranteed_cells_mask(radius, cells)
+                  if ("gn" in which or "cn" in which) else None)
+            if "gn" in which:
+                rows["gn"].append(np.asarray(gn))
+            if "cn" in which:
+                rows["cn"].append(np.asarray(
+                    self.grid.candidate_cells_mask(radius, cells, gn)))
+            if "nb" in which:
+                rows["nb"].append(np.asarray(
+                    self.grid.neighboring_cells_mask(radius, cells)))
+        return tuple(jnp.asarray(np.stack(rows[k])) for k in which)
+
+    def _query_geom_batch(self, queries):
+        """The Q query geometries as ONE exact-capacity padded edge batch
+        (no bucket padding: built once per run_multi, and the G axis must
+        match the (Q,) per-query mask stacks)."""
+        from spatialflink_tpu.models.batches import EdgeGeomBatch
+
+        return EdgeGeomBatch.from_objects(queries, self.grid,
+                                          pad=len(queries))
 
     def _query_edges(self, query):
         from spatialflink_tpu.models.batches import single_query_edges

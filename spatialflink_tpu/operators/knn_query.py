@@ -302,13 +302,9 @@ class PointGeomKNNQuery(_GenericKnn):
         Single-device, shared radius — see the PointPoint docstring."""
         self._require_single_device()
         k = k or self.conf.k
-        from spatialflink_tpu.models.batches import EdgeGeomBatch
         from spatialflink_tpu.ops.geom import knn_points_to_geom_queries
 
-        # exact capacity (no bucket padding): the query batch is built once
-        # per run_multi and its G axis must match the (Q,) nb_masks
-        gb = EdgeGeomBatch.from_objects(query_geoms, self.grid,
-                                        pad=len(query_geoms))
+        gb = self._query_geom_batch(query_geoms)
         nb_masks = self._stack_query_nb(query_geoms, radius)
 
         def eval_batch(records, ts_base):
@@ -405,11 +401,9 @@ class GeomGeomKNNQuery(_GeomStreamKnn):
         the other run_multi surfaces."""
         self._require_single_device()
         k = k or self.conf.k
-        from spatialflink_tpu.models.batches import EdgeGeomBatch
         from spatialflink_tpu.ops.geom import knn_geoms_to_geom_queries
 
-        qgb = EdgeGeomBatch.from_objects(query_geoms, self.grid,
-                                         pad=len(query_geoms))
+        qgb = self._query_geom_batch(query_geoms)
         nb_masks = self._stack_query_nb(query_geoms, radius)
         return self._drive_multi(
             stream, len(query_geoms),

@@ -99,29 +99,12 @@ class PointPointRangeQuery(SpatialOperator):
         qx, qy, qc = self._query_point_arrays(query_points)
         args = (radius, self.grid.guaranteed_layers(radius),
                 self.grid.candidate_layers(radius))
-
-        def eval_batch(records, ts_base):
-            if not records:
-                return [[] for _ in query_points]
-            batch = self._point_batch(records, ts_base)
-            masks, gn_c, evals = range_filter_point_multi_masks(
+        return self._run_multi_filter(
+            stream, len(query_points),
+            lambda batch: range_filter_point_multi_masks(
                 batch, qx, qy, qc, *args, n=self.grid.n,
-                approximate=self.conf.approximate)
-
-            def rows(m):
-                m = np.asarray(m)  # ONE (Q, N) device->host transfer
-                out = []
-                for q in range(len(query_points)):
-                    idx = np.nonzero(m[q])[0]
-                    out.append([records[i] for i in idx if i < len(records)])
-                return out
-
-            return self._defer_with_stats(
-                masks, (jnp.sum(gn_c), jnp.sum(evals)), rows)
-
-        for result in self._multi_results(stream, eval_batch):
-            result.extras["queries"] = len(query_points)
-            yield result
+                approximate=self.conf.approximate),
+            self._point_batch)
 
     def run_multi_bulk(self, parsed, query_points: List[Point],
                        radius: float, *, pad: Optional[int] = None
@@ -230,6 +213,24 @@ class PointGeomRangeQuery(SpatialOperator, GeomQueryMixin):
             parsed, self._bulk_mask_eval(self._mask_stats_fn(query_geom, radius)),
             pad=pad)
 
+    def run_multi(self, stream: Iterable[Point], query_geoms,
+                  radius: float) -> Iterator[WindowResult]:
+        """Q polygon/linestring QUERIES over one point stream in ONE
+        dispatch per window (``ops.geom.range_points_to_geom_queries``);
+        same contract as ``PointPointRangeQuery.run_multi``."""
+        self._require_single_device()
+        from spatialflink_tpu.ops.geom import range_points_to_geom_queries
+
+        qgb = self._query_geom_batch(query_geoms)
+        gn, cn = self._stack_query_masks(query_geoms, radius,
+                                         which=("gn", "cn"))
+        return self._run_multi_filter(
+            stream, len(query_geoms),
+            lambda batch: range_points_to_geom_queries(
+                batch, qgb, gn, cn, radius,
+                approximate=self.conf.approximate),
+            self._point_batch)
+
 
 class _GeomStreamBulkMixin:
     """Bulk-replay fast path for geometry STREAMS: native WKT ingest ->
@@ -299,6 +300,24 @@ class GeomPointRangeQuery(SpatialOperator, GeomQueryMixin, _GeomStreamBulkMixin)
 
         return self._drive(stream, eval_batch)
 
+    def run_multi(self, stream: Iterable, query_points,
+                  radius: float) -> Iterator[WindowResult]:
+        """Q query POINTS over one polygon/linestring stream in ONE dispatch
+        per window (``ops.geom.range_geoms_to_point_queries`` — GN-subset
+        rule applied per query)."""
+        self._require_single_device()
+        from spatialflink_tpu.ops.geom import range_geoms_to_point_queries
+
+        qx, qy, _qc = self._query_point_arrays(query_points)
+        gn, nb = self._stack_query_masks(query_points, radius,
+                                         which=("gn", "nb"))
+        return self._run_multi_filter(
+            stream, len(query_points),
+            lambda geoms: range_geoms_to_point_queries(
+                geoms, qx, qy, gn, nb, radius,
+                approximate=self.conf.approximate),
+            self._geom_batch)
+
 
 class GeomGeomRangeQuery(SpatialOperator, GeomQueryMixin, _GeomStreamBulkMixin):
     """Polygon/linestring stream x polygon/linestring query
@@ -341,6 +360,24 @@ class GeomGeomRangeQuery(SpatialOperator, GeomQueryMixin, _GeomStreamBulkMixin):
             return self._defer_mask_select(mask, records, (gn_c, evals))
 
         return self._drive(stream, eval_batch)
+
+    def run_multi(self, stream: Iterable, query_geoms,
+                  radius: float) -> Iterator[WindowResult]:
+        """Q query GEOMETRIES over one polygon/linestring stream in ONE
+        dispatch per window (``ops.geom.range_geoms_to_geom_queries`` — the
+        Q queries ride one exact-capacity padded edge batch)."""
+        self._require_single_device()
+        from spatialflink_tpu.ops.geom import range_geoms_to_geom_queries
+
+        qgb = self._query_geom_batch(query_geoms)
+        gn, nb = self._stack_query_masks(query_geoms, radius,
+                                         which=("gn", "nb"))
+        return self._run_multi_filter(
+            stream, len(query_geoms),
+            lambda geoms: range_geoms_to_geom_queries(
+                geoms, qgb, gn, nb, radius,
+                approximate=self.conf.approximate),
+            self._geom_batch)
 
 
 # Reference-named aliases (stream type x query type), SURVEY §2.2

@@ -231,6 +231,83 @@ def knn_geoms_to_geom_queries(geoms: EdgeGeomBatch, queries: EdgeGeomBatch,
     return res, jnp.sum(elig, axis=1, dtype=jnp.int32)
 
 
+@partial(jax.jit, static_argnames=("approximate",))
+def range_points_to_geom_queries(points: PointBatch, queries: EdgeGeomBatch,
+                                 gn_masks, cn_masks, radius, *,
+                                 approximate: bool = False):
+    """Range filter of Q geometry QUERIES over one point window batch in ONE
+    dispatch (multi-query ``PointPolygonRangeQuery``/``PointLineString...``):
+    -> (masks (Q, N), gn_bypassed (Q,), dist_evals (Q,)). Per query, a vmap
+    of the single-query expressions — dense GN/CN masks + exact geometry
+    distance (bbox distance in approximate mode, which still passes through
+    the radius check like the single path)."""
+    from spatialflink_tpu.ops.range import range_filter_masks_stats
+
+    if approximate:
+        def one(bb, gn, cn):
+            d = D.point_bbox_dist(points.x, points.y, bb[0], bb[1], bb[2],
+                                  bb[3])
+            return range_filter_masks_stats(points, gn, cn, d, radius)
+
+        return jax.vmap(one)(queries.bbox, gn_masks, cn_masks)
+    # exact mode rides the (N, G) lattice like the kNN multi path (the
+    # single-geom kernel's pallas dispatch needs a STATIC is_areal, which a
+    # vmapped per-query flag cannot provide)
+    d_all = points_to_geoms_dist(points, queries).T  # (Q, N)
+    return jax.vmap(
+        lambda d, gn, cn: range_filter_masks_stats(points, gn, cn, d, radius)
+    )(d_all, gn_masks, cn_masks)
+
+
+@partial(jax.jit, static_argnames=("approximate",))
+def range_geoms_to_point_queries(geoms: EdgeGeomBatch, qx, qy, gn_masks,
+                                 nb_masks, radius, *,
+                                 approximate: bool = False):
+    """Range filter of Q query POINTS over one polygon/linestring window
+    batch in ONE dispatch (multi-query ``PolygonPointRangeQuery``/
+    ``LineStringPoint...``): -> (masks (Q, G), gn_bypassed (Q,),
+    dist_evals (Q,)). Applies the GN-subset rule per query (ALL of a
+    geometry's cells guaranteed -> no distance math,
+    ``range/PolygonPointRangeQuery.java:54-87``)."""
+    from spatialflink_tpu.ops.range import range_filter_geom_stream_stats
+
+    def one(x, y, gn, nbm):
+        all_gn = geom_cells_all_within(geoms.cells, geoms.cells_mask, gn)
+        any_nb = geom_cells_any_within(geoms.cells, geoms.cells_mask, nbm)
+        if approximate:
+            b = geoms.bbox
+            d = D.point_bbox_dist(x, y, b[:, 0], b[:, 1], b[:, 2], b[:, 3])
+        else:
+            d = point_to_geoms_dist(x, y, geoms)
+        return range_filter_geom_stream_stats(all_gn, any_nb, d, radius,
+                                              geoms.valid)
+
+    return jax.vmap(one)(qx, qy, gn_masks, nb_masks)
+
+
+@partial(jax.jit, static_argnames=("approximate",))
+def range_geoms_to_geom_queries(geoms: EdgeGeomBatch, queries: EdgeGeomBatch,
+                                gn_masks, nb_masks, radius, *,
+                                approximate: bool = False):
+    """Range filter of Q query GEOMETRIES over one polygon/linestring window
+    batch in ONE dispatch (multi-query ``PolygonPolygonRangeQuery`` and
+    siblings): -> (masks (Q, G), gn_bypassed (Q,), dist_evals (Q,))."""
+    from spatialflink_tpu.ops.range import range_filter_geom_stream_stats
+
+    def one(e, m, a, bb, gn, nbm):
+        all_gn = geom_cells_all_within(geoms.cells, geoms.cells_mask, gn)
+        any_nb = geom_cells_any_within(geoms.cells, geoms.cells_mask, nbm)
+        if approximate:
+            d = geoms_bbox_dist(geoms, bb)
+        else:
+            d = geoms_to_single_geom_dist(geoms, e, m, a)
+        return range_filter_geom_stream_stats(all_gn, any_nb, d, radius,
+                                              geoms.valid)
+
+    return jax.vmap(one)(queries.edges, queries.edge_mask, queries.is_areal,
+                         queries.bbox, gn_masks, nb_masks)
+
+
 def geom_cells_all_within(cells, cells_mask, target_mask):
     """(G,) True iff ALL of a geometry's grid cells fall inside
     ``target_mask`` — the PolygonPointRangeQuery GN-subset rule: a polygon is

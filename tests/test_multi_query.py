@@ -330,6 +330,134 @@ class TestOperatorMulti:
                 self._assert_query_parity(res.records[qi],
                                           singles[qi][w].records, approximate)
 
+    @pytest.mark.parametrize("approximate", (False, True))
+    def test_range_geom_query_run_multi(self, approximate):
+        """Point stream x Q polygon queries (range)."""
+        from spatialflink_tpu.operators import PointPolygonRangeQuery
+
+        def conf():
+            return QueryConfiguration(QueryType.WindowBased, 10_000, 5_000,
+                                      approximate=approximate)
+
+        qs = self._qpolys(3)
+        multi = list(PointPolygonRangeQuery(conf(), GRID).run_multi(
+            _stream(), qs, RADIUS))
+        singles = [list(PointPolygonRangeQuery(conf(), GRID).run(
+            _stream(), q, RADIUS)) for q in qs]
+        assert multi and multi[0].extras["queries"] == 3
+        for w, res in enumerate(multi):
+            for qi in range(len(qs)):
+                assert ([r.obj_id for r in res.records[qi]]
+                        == [r.obj_id for r in singles[qi][w].records])
+
+    @pytest.mark.parametrize("approximate", (False, True))
+    def test_range_geom_stream_point_query_run_multi(self, approximate):
+        """Polygon/linestring stream x Q point queries (range, GN-subset
+        rule per query)."""
+        from spatialflink_tpu.operators import PolygonPointRangeQuery
+
+        def conf():
+            return QueryConfiguration(QueryType.WindowBased, 10_000, 5_000,
+                                      approximate=approximate)
+
+        qs = self._qpoints(3)
+        multi = list(PolygonPointRangeQuery(conf(), GRID).run_multi(
+            self._geom_stream(), qs, RADIUS))
+        singles = [list(PolygonPointRangeQuery(conf(), GRID).run(
+            self._geom_stream(), q, RADIUS)) for q in qs]
+        assert multi
+        for w, res in enumerate(multi):
+            for qi in range(len(qs)):
+                assert ([r.obj_id for r in res.records[qi]]
+                        == [r.obj_id for r in singles[qi][w].records])
+
+    @pytest.mark.parametrize("approximate", (False, True))
+    def test_range_geom_stream_geom_query_run_multi(self, approximate):
+        """Polygon/linestring stream x Q polygon queries (range)."""
+        from spatialflink_tpu.operators import PolygonPolygonRangeQuery
+
+        def conf():
+            return QueryConfiguration(QueryType.WindowBased, 10_000, 5_000,
+                                      approximate=approximate)
+
+        qs = self._qpolys(3)
+        multi = list(PolygonPolygonRangeQuery(conf(), GRID).run_multi(
+            self._geom_stream(), qs, RADIUS))
+        singles = [list(PolygonPolygonRangeQuery(conf(), GRID).run(
+            self._geom_stream(), q, RADIUS)) for q in qs]
+        assert multi
+        for w, res in enumerate(multi):
+            for qi in range(len(qs)):
+                assert ([r.obj_id for r in res.records[qi]]
+                        == [r.obj_id for r in singles[qi][w].records])
+
+    def _mixed_queries(self):
+        """One polygon + one linestring query — exercises the TRACED
+        per-query is_areal flag in the multi kernels (the single-query
+        kernels take it statically)."""
+        from spatialflink_tpu.models import LineString
+
+        polys = self._qpolys(1)
+        ls = LineString.create([(116.55, 40.35), (116.7, 40.5),
+                                (116.85, 40.65)], GRID)
+        return polys + [ls]
+
+    def test_mixed_areal_query_batch_knn(self):
+        from spatialflink_tpu.operators import PointPolygonKNNQuery
+
+        def conf():
+            return QueryConfiguration(QueryType.WindowBased, 10_000, 5_000)
+
+        qs = self._mixed_queries()
+        multi = list(PointPolygonKNNQuery(conf(), GRID).run_multi(
+            _stream(), qs, RADIUS, K))
+        singles = [list(PointPolygonKNNQuery(conf(), GRID).run(
+            _stream(), q, RADIUS, K)) for q in qs]
+        assert multi
+        for w, res in enumerate(multi):
+            for qi in range(len(qs)):
+                assert res.records[qi] == singles[qi][w].records, (w, qi)
+
+    def test_mixed_areal_query_batch_range(self):
+        from spatialflink_tpu.operators import PolygonPolygonRangeQuery
+
+        def conf():
+            return QueryConfiguration(QueryType.WindowBased, 10_000, 5_000)
+
+        qs = self._mixed_queries()
+        multi = list(PolygonPolygonRangeQuery(conf(), GRID).run_multi(
+            self._geom_stream(), qs, RADIUS))
+        singles = [list(PolygonPolygonRangeQuery(conf(), GRID).run(
+            self._geom_stream(), q, RADIUS)) for q in qs]
+        assert multi
+        for w, res in enumerate(multi):
+            for qi in range(len(qs)):
+                assert ([r.obj_id for r in res.records[qi]]
+                        == [r.obj_id for r in singles[qi][w].records]), (w, qi)
+
+    def test_driver_multi_query_range_geom_option(self):
+        """queryOption 21 (Polygon-Polygon range) routes through run_multi
+        under multiQuery."""
+        from spatialflink_tpu.config import Params
+        from spatialflink_tpu.driver import run_option
+        from spatialflink_tpu.streams.formats import serialize_spatial
+
+        lines = [serialize_spatial(g, "WKT") for g in self._geom_stream(120)]
+        p = Params.from_yaml("conf/spatialflink-conf.yml")
+        p.query.option = 21
+        p.query.radius = RADIUS
+        p.query.multi_query = True
+        p.query.query_polygons = [
+            [(116.2, 40.2), (116.5, 40.2), (116.5, 40.5), (116.2, 40.2)],
+            [(116.6, 40.6), (116.9, 40.6), (116.9, 40.9), (116.6, 40.6)],
+        ]
+        import dataclasses
+        p = dataclasses.replace(
+            p, input1=dataclasses.replace(p.input1, format="WKT"))
+        wins = list(run_option(p, lines))
+        assert wins and wins[0].extras["queries"] == 2
+        assert all(len(w.records) == 2 for w in wins)
+
     def test_driver_multi_query_geom_stream_option(self):
         """queryOption 66 (Polygon-Point kNN) routes through run_multi under
         multiQuery."""

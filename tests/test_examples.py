@@ -29,6 +29,7 @@ def _run(script: str) -> str:
     ("streaming_range_query.py", "delivered windows:"),
     ("distributed_knn.py", "matches single-device bit-for-bit"),
     ("checkpoint_resume.py", "matches uninterrupted run"),
+    ("multi_query_hotspots.py", "standing queries x"),
 ])
 def test_example_runs(script, expect):
     out = _run(script)

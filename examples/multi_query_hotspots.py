@@ -36,10 +36,12 @@ from spatialflink_tpu.operators import (
 
 def main() -> int:
     grid = UniformGrid(115.50, 117.60, 39.60, 41.10, num_grid_partitions=100)
-    rng = np.random.default_rng(11)
     t0 = 1_700_000_000_000
 
     def stream():
+        # fresh generator per call: both operator passes replay the SAME
+        # vehicle stream, as the docstring promises
+        rng = np.random.default_rng(11)
         for i in range(6000):
             yield Point.create(float(rng.uniform(116, 117)),
                                float(rng.uniform(40, 41)), grid,

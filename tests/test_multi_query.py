@@ -145,6 +145,66 @@ class TestRangeMulti:
             assert int(gn_c[q]) == int(g1) and int(evals[q]) == int(e1)
 
 
+class TestMultiEdgeCases:
+    """Padding/degenerate boundaries: multi must agree with a single-query
+    loop when the window is smaller than k, nothing is eligible, or sizes
+    land on odd bucket boundaries."""
+
+    @pytest.mark.parametrize("n,k,strategy", [
+        (3, 5, "sort"),            # window smaller than k
+        (7, 5, "prefilter"),       # m > n clamps
+        (16, 5, "approx_verified"),
+        (130, 7, "grouped"),       # non-power-of-two across groups
+    ])
+    def test_tiny_and_odd_sizes(self, n, k, strategy):
+        b = _batch(n=n, seed=n, oid_mod=max(2, n // 2))
+        qx, qy, qc = _queries(q=3, seed=n + 1)
+        nb = GRID.n
+        multi = knn_point_multi(b, qx, qy, qc, 0.0, nb, n=GRID.n, k=k,
+                                strategy=strategy)
+        for q in range(3):
+            single = knn_point(b, float(qx[q]), float(qy[q]), int(qc[q]),
+                               0.0, nb, n=GRID.n, k=k, strategy=strategy)
+            np.testing.assert_array_equal(np.asarray(multi.obj_id[q]),
+                                          np.asarray(single.obj_id))
+
+    def test_nothing_eligible(self):
+        """Radius pruning that excludes every point for every query: all
+        rows come back invalid, no NaNs/garbage ids."""
+        b = _batch(n=64, seed=2)
+        # queries far outside every point's candidate layers
+        qx = np.asarray([115.51, 115.52], np.float32)
+        qy = np.asarray([39.61, 39.62], np.float32)
+        qc = np.asarray([GRID.assign_cell(float(x), float(y))[0]
+                         for x, y in zip(qx, qy)], np.int32)
+        res = knn_point_multi(b, qx, qy, qc, 0.01, 0, n=GRID.n, k=K)
+        assert not np.asarray(res.valid).any()
+
+    def test_random_parity_sweep(self):
+        """Randomized multi-vs-single parity across sizes/Q/strategies —
+        padding boundaries are where vmapped reshapes break first."""
+        rng = np.random.default_rng(99)
+        for trial in range(6):
+            n = int(rng.integers(8, 3000))
+            q = int(rng.integers(1, 9))
+            k = int(rng.integers(1, 12))
+            strategy = ("sort", "grouped", "prefilter",
+                        "approx_verified")[trial % 4]
+            b = _batch(n=n, seed=1000 + trial, oid_mod=max(2, n // 3))
+            qx, qy, qc = _queries(q=q, seed=2000 + trial)
+            multi = knn_point_multi(b, qx, qy, qc, RADIUS,
+                                    GRID.candidate_layers(RADIUS),
+                                    n=GRID.n, k=k, strategy=strategy)
+            for qi in range(q):
+                single = knn_point(b, float(qx[qi]), float(qy[qi]),
+                                   int(qc[qi]), RADIUS,
+                                   GRID.candidate_layers(RADIUS),
+                                   n=GRID.n, k=k, strategy=strategy)
+                np.testing.assert_array_equal(
+                    np.asarray(multi.obj_id[qi]), np.asarray(single.obj_id),
+                    err_msg=f"trial={trial} n={n} q={q} k={k} {strategy}")
+
+
 def _stream(n=600, seed=11):
     rng = np.random.default_rng(seed)
     t0 = 1_700_000_000_000

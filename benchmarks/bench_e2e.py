@@ -121,12 +121,71 @@ def bench_option(option: int, path: str, path2, n: int) -> list:
     return rows
 
 
+class _BulkDeclined(Exception):
+    pass
+
+
+def bench_multi_vs_jobs(option: int, path: str, n: int, q: int) -> list:
+    """ONE multiQuery pipeline vs Q sequential single-query pipelines over
+    the same replay — the end-to-end form of the 'Q standing queries cost Q
+    reference jobs re-reading the stream' claim. Bulk path for both sides
+    (the throughput configuration)."""
+    from spatialflink_tpu import driver
+
+    hotspots = [(116.0 + 0.9 * i / max(q - 1, 1),
+                 40.0 + 0.9 * i / max(q - 1, 1)) for i in range(q)]
+
+    def _drain_bulk(p):
+        it = driver.run_option_bulk(p, path)
+        if it is None:  # eligibility gate declined — degrade visibly,
+            print(f"warning: option {option}: bulk path declined for the "
+                  "multi-vs-jobs rows; rows omitted", file=sys.stderr)
+            raise _BulkDeclined
+        return _drain(it)
+
+    def run_multi():
+        p = _params(option)
+        p.query.multi_query = True
+        p.query.query_points = hotspots
+        return _drain_bulk(p)
+
+    def run_jobs():
+        for hx, hy in hotspots:
+            p = _params(option)
+            p.query.query_points = [(hx, hy)]
+            _drain_bulk(p)
+
+    # warm both sides (jit compiles; the sequential side would otherwise
+    # free-ride on kernels the single-query rows above already compiled
+    # while the (Q,)-shaped multi kernels compile inside the timed region)
+    run_multi()
+    run_jobs()
+    t0 = time.perf_counter()
+    windows = run_multi()
+    dt_multi = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    run_jobs()
+    dt_jobs = time.perf_counter() - t0
+
+    return [dict(option=option, path="multi_query", queries=q, records=n,
+                 windows=windows, wall_s=round(dt_multi, 3),
+                 record_x_queries_per_sec=round(n * q / dt_multi),
+                 speedup_vs_sequential_jobs=round(dt_jobs / dt_multi, 2)),
+            dict(option=option, path="sequential_jobs", queries=q, records=n,
+                 wall_s=round(dt_jobs, 3),
+                 record_x_queries_per_sec=round(n * q / dt_jobs))]
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=None,
                     help="records per stream (default 1M, 100k on CPU)")
     ap.add_argument("--options", default="1,51,101",
                     help="comma-separated driver queryOptions")
+    ap.add_argument("--multi", type=int, default=8,
+                    help="query count for the multi-query-vs-sequential-"
+                         "jobs rows (values < 2 disable them — a 1-query "
+                         "'batch' measures nothing the single rows don't)")
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
 
@@ -149,6 +208,18 @@ def main() -> int:
                 row["backend"] = backend
                 print(json.dumps(row), flush=True)
                 rows.append(row)
+        if args.multi > 1:
+            for opt in (1, 51):
+                if opt not in [int(x) for x in args.options.split(",")]:
+                    continue
+                try:
+                    multi_rows = bench_multi_vs_jobs(opt, path, n, args.multi)
+                except _BulkDeclined:
+                    continue
+                for row in multi_rows:
+                    row["backend"] = backend
+                    print(json.dumps(row), flush=True)
+                    rows.append(row)
 
     out = args.out or os.path.join(
         os.path.dirname(os.path.abspath(__file__)),

@@ -17,22 +17,27 @@ def test_bench_e2e_smoke(tmp_path):
     env["JAX_PLATFORMS"] = "cpu"
     r = subprocess.run(
         [sys.executable, os.path.join(_ROOT, "benchmarks", "bench_e2e.py"),
-         "--n", "2000", "--options", "1,101", "--out", str(out_path)],
+         "--n", "2000", "--options", "1,101", "--multi", "2",
+         "--out", str(out_path)],
         capture_output=True, text=True, timeout=420, env=env, cwd=_ROOT)
     assert r.returncode == 0, r.stderr[-2000:]
     rows = [json.loads(ln) for ln in r.stdout.splitlines()
             if ln.startswith("{")]
     # both paths per option: the bulk fast path must stay reachable for
     # range AND join (a silent fallback to record-only would hide a
-    # regression in run_option_bulk's eligibility gates)
+    # regression in run_option_bulk's eligibility gates); the multi rows
+    # cover the --multi-query --bulk composition end-to-end
     assert [(x["option"], x["path"]) for x in rows] == [
-        (1, "bulk"), (1, "record"), (101, "bulk"), (101, "record")]
-    for row in rows:
+        (1, "bulk"), (1, "record"), (101, "bulk"), (101, "record"),
+        (1, "multi_query"), (1, "sequential_jobs")]
+    for row in rows[:4]:
         assert row["records"] == 2000
         assert row["records_per_sec"] > 0
         assert row["windows"] > 0
     # bulk and record paths agree on how many windows the stream seals
     assert rows[0]["windows"] == rows[1]["windows"]
     assert rows[2]["windows"] == rows[3]["windows"]
+    assert rows[4]["queries"] == 2
+    assert rows[4]["speedup_vs_sequential_jobs"] > 0
     table = json.loads(out_path.read_text())
     assert table["rows"] and table["backend"] == "cpu"

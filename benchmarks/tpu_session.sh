@@ -1,0 +1,45 @@
+#!/bin/bash
+# One-shot TPU measurement session: run when the axon tunnel answers.
+# Captures every pending TPU row in priority order, banking each result as
+# it lands so a mid-session wedge keeps whatever completed.
+#
+#   bash benchmarks/tpu_session.sh [outdir]
+#
+# Probe first (cheap):  timeout 50 python -c "import jax; jax.devices()"
+# Priority order: headline bench (BENCH contract) -> canonical configs
+# ledger -> multi-query scaling -> e2e pipeline. Each step has its own
+# timeout; a hang moves on rather than killing the session.
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-benchmarks}"
+mkdir -p "$OUT"
+STAMP=$(date -u +%Y%m%dT%H%MZ)
+echo "# TPU session $STAMP — each step banks to $OUT" >&2
+
+run() {  # run <name> <timeout_s> <cmd...>
+  local name=$1 t=$2; shift 2
+  echo "== $name (timeout ${t}s)" >&2
+  timeout "$t" "$@" 2> >(tail -5 >&2)
+  local rc=$?
+  [ $rc -ne 0 ] && echo "!! $name rc=$rc (continuing)" >&2
+  return 0
+}
+
+# 1. headline (writes one JSON line; keep a copy for banking)
+run headline 900 python bench.py | tee "$OUT/BENCH_tpu_${STAMP}.json"
+
+# 2. canonical configs 1/3/4/5
+run configs 1200 python benchmarks/bench_configs.py --scale full \
+    --out "$OUT/RESULTS_tpu.json"
+
+# 3. multi-query scaling
+run multiquery 900 python benchmarks/bench_multi_query.py \
+    --out "$OUT/RESULTS_multiquery_tpu.json"
+
+# 4. e2e pipeline (+ multi-vs-jobs)
+run e2e 1200 python benchmarks/bench_e2e.py \
+    --out "$OUT/RESULTS_e2e_tpu.json"
+
+echo "# session done; update BASELINE.md from the fresh RESULTS_*.json," >&2
+echo "# refresh benchmarks/BENCH_tpu_r04_interactive.json from the" >&2
+echo "# headline line if it improved, and commit." >&2

@@ -220,10 +220,12 @@ def _run_multi_case(params: Params, spec: CaseSpec, op, s1,
     """``query.multiQuery`` dispatch: answer ALL configured query objects in
     one dispatch per window via run_multi (TPU-native extension; without the
     flag the driver keeps reference parity and uses only the first query
-    object). Supported: ALL NINE range and kNN pairs — the run_multi
-    surface; other families error rather than silently falling back to
-    first-query semantics (run_option rejects them before dispatch reaches
-    here)."""
+    object). Supported: ALL NINE range and kNN pairs here, plus trajectory
+    kNN (211/212) routed through its own branch in ``_run_trajectory`` —
+    keep the three in sync: this dispatch, the tknn branch, and
+    run_option's family gate. Other families error rather than silently
+    falling back to first-query semantics (run_option rejects them before
+    dispatch reaches here)."""
     if spec.latency:
         raise ValueError(
             "multiQuery does not combine with the latency variants "
@@ -271,13 +273,14 @@ def run_option(params: Params, stream1: Iterable, stream2: Optional[Iterable]
     if opt not in CASES:
         raise ValueError(f"unknown queryOption {opt}")
     spec = CASES[opt]
-    if params.query.multi_query and spec.family not in ("range", "knn"):
+    if params.query.multi_query and spec.family not in ("range", "knn",
+                                                        "tknn"):
         # every ineligible family errors — silently answering only the
         # first query under the flag would be worse than failing
         raise ValueError(
             f"multiQuery is not supported for queryOption {opt} "
             f"({spec.family}); supported: all nine range and kNN "
-            "pairs")
+            "pairs, plus trajectory kNN (211/212)")
     u_grid, q_grid = params.grids()
     conf = _query_conf(params, spec)
     radius = params.query.radius
@@ -382,8 +385,17 @@ def _run_trajectory(params, spec, conf, u_grid, q_grid, stream1, stream2):
         run = op.run_naive if spec.naive else op.run
         return run(s1, s2, params.query.radius)
     if spec.family == "tknn":
-        qp = _query_object(params, u_grid, "Point")
         op = ops.PointPointTKNNQuery(conf, u_grid)
+        if params.query.multi_query:
+            if spec.naive:
+                raise ValueError(
+                    "multiQuery does not combine with the naive-twin tKnn "
+                    "(the oracle exists to check the pruned single path)")
+            qps = params.query_point_objects(u_grid)
+            if not qps:
+                raise ValueError("query.queryPoints is empty")
+            return op.run_multi(s1, qps, params.query.radius, q.k)
+        qp = _query_object(params, u_grid, "Point")
         run = op.run_naive if spec.naive else op.run
         return run(s1, qp, params.query.radius, q.k)
     raise AssertionError(spec.family)
@@ -696,7 +708,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="answer ALL configured query points/geometries in "
                          "one dispatch per window (run_multi; default keeps "
                          "reference parity: first query object only). "
-                         "All nine range and kNN pairs")
+                         "All nine range and kNN pairs, plus trajectory kNN")
     args = ap.parse_args(argv)
 
     _enable_compilation_cache()

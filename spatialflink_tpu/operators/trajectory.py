@@ -806,6 +806,53 @@ class PointPointTKNNQuery(SpatialOperator):
             result.extras["k"] = k
             yield result
 
+    def run_multi(self, stream: Iterable[Point], query_points, radius: float,
+                  k: Optional[int] = None) -> Iterator[WindowResult]:
+        """Q query points, each answered with its k nearest TRAJECTORIES, in
+        ONE dispatch per window (the trajectory layer's multi-query
+        extension — ``ops.knn.knn_point_multi`` with the tKnn exact-radius
+        rule threaded through). ``records[q]`` holds
+        (objID, min_distance, sub_trajectory) triples for
+        ``query_points[q]``; sub-trajectories are assembled once for the
+        union of all queries' selected trajectories."""
+        from spatialflink_tpu.ops.knn import knn_point_multi
+
+        self._require_single_device()
+        k = k or self.conf.k
+        qx, qy, qc = self._query_point_arrays(query_points)
+        nb_layers = (
+            self.grid.candidate_layers(radius) if radius > 0 else self.grid.n
+        )
+
+        def eval_batch(records, ts_base):
+            if not records:
+                return [[] for _ in query_points]
+            batch = self._point_batch(records, ts_base)
+            res = knn_point_multi(
+                batch, qx, qy, qc, radius, nb_layers, n=self.grid.n, k=k,
+                enforce_radius=radius > 0)
+            valid = np.asarray(res.valid)
+            oid_rows = np.asarray(res.obj_id)
+            dist_rows = np.asarray(res.dist)
+            per_q = []
+            union = set()
+            for q in range(len(query_points)):
+                oids = [self.interner.lookup(int(o))
+                        for o in oid_rows[q][valid[q]]]
+                per_q.append((oids, dist_rows[q][valid[q]]))
+                union.update(oids)
+            subs = assemble_subtrajectories(
+                [p for p in records if p.obj_id in union])
+            return [
+                [(oid, float(d), subs.get(oid)) for oid, d in zip(oids, ds)]
+                for oids, ds in per_q
+            ]
+
+        for result in self._multi_results(stream, eval_batch):
+            result.extras["k"] = k
+            result.extras["queries"] = len(query_points)
+            yield result
+
 
 # Reference base-class names
 TFilterQuery = PointTFilterQuery

@@ -40,6 +40,11 @@ run multiquery 900 python benchmarks/bench_multi_query.py \
 run e2e 1200 python benchmarks/bench_e2e.py \
     --out "$OUT/RESULTS_e2e_tpu.json"
 
+# 5. bf16-vs-f32 join lattice A/B (TPU_NOTES §7 experiment; if bf16 wins,
+#    flip the SPATIALFLINK_JOIN_LATTICE default and record the rows)
+run bf16join 600 python benchmarks/exp_bf16_join.py \
+    | tee "$OUT/RESULTS_bf16join_${STAMP}.json"
+
 echo "# session done; update BASELINE.md from the fresh RESULTS_*.json," >&2
 echo "# refresh benchmarks/BENCH_tpu_r04_interactive.json from the" >&2
 echo "# headline line if it improved, and commit." >&2

@@ -52,6 +52,80 @@ def pairwise_dist2(ax, ay, bx, by, center_x=0.0, center_y=0.0):
     return jnp.maximum(a2 + b2 - 2.0 * cross, 0.0)
 
 
+def bf16_distance_margin(ax, ay, bx, by, valid_a, valid_b,
+                         center_x, center_y):
+    """-> (margin, slack_sq): rigorous error bounds for the bf16 lattice.
+
+    With centered coordinates bounded by X = max |coord| over valid slots:
+
+    - ``margin`` (DISTANCE space) bounds the coordinate-rounding term: bf16
+      rounding error per coordinate is <= X * 2^-8 (8 significand bits), so
+      the bf16 pair offset differs from the true offset by at most
+      sqrt(2) * 2 * X * 2^-8 in Euclidean norm.
+    - ``slack_sq`` (SQUARED space) bounds the f32 accumulation of the
+      a2 + b2 - 2ab expansion itself, whose rounding is ABSOLUTE at the
+      operand magnitude (~X^2 * 2^-23 per op) and therefore must scale
+      with X^2 — a fixed distance-space slack would be swamped for
+      wide-extent grids (and gives only ~2*r*slack of squared-space
+      headroom, vanishing at small radii). X^2 * 2^-16 over-covers the
+      handful of f32 roundings by ~2 orders of magnitude while inflating
+      the superset imperceptibly.
+
+    Superset guarantee: any true pair (d <= r) satisfies
+    ``d2_bf16 <= (r + margin)^2 + slack_sq``."""
+    xa = jnp.max(jnp.where(valid_a, jnp.abs(ax - center_x), 0.0))
+    ya = jnp.max(jnp.where(valid_a, jnp.abs(ay - center_y), 0.0))
+    xb = jnp.max(jnp.where(valid_b, jnp.abs(bx - center_x), 0.0))
+    yb = jnp.max(jnp.where(valid_b, jnp.abs(by - center_y), 0.0))
+    x = jnp.maximum(jnp.maximum(xa, ya), jnp.maximum(xb, yb))
+    margin = jnp.sqrt(2.0) * 2.0 * x * (2.0 ** -8)
+    slack_sq = x * x * (2.0 ** -16) + 1e-12
+    return margin, slack_sq
+
+
+def pairwise_dist2_bf16(ax, ay, bx, by, center_x=0.0, center_y=0.0):
+    """(Na, Nb) squared distances from a SINGLE-PASS bf16 MXU matmul.
+
+    The f32 path (:func:`pairwise_dist2`) pins ``Precision.HIGHEST`` — three
+    bf16 passes per matmul on TPU. Rounding the centered operands to bf16
+    explicitly and accumulating in f32 runs one pass (~3x the MXU rate) at
+    a bounded absolute distance error (:func:`bf16_distance_margin`);
+    consumers use it as a conservative prefilter, never as the decision."""
+    a = jnp.stack([ax - center_x, ay - center_y], axis=1).astype(jnp.bfloat16)
+    b = jnp.stack([bx - center_x, by - center_y], axis=1).astype(jnp.bfloat16)
+    af = a.astype(jnp.float32)
+    bf = b.astype(jnp.float32)
+    a2 = jnp.sum(af * af, axis=1, keepdims=True)
+    b2 = jnp.sum(bf * bf, axis=1, keepdims=True).T
+    cross = jnp.dot(a, b.T, preferred_element_type=jnp.float32)
+    return jnp.maximum(a2 + b2 - 2.0 * cross, 0.0)
+
+
+@partial(jax.jit, static_argnames=("n",))
+def join_mask_bf16_superset(
+    a: PointBatch,
+    b: PointBatch,
+    radius,
+    nb_layers,
+    center_x,
+    center_y,
+    *,
+    n: int,
+):
+    """Conservative SUPERSET of :func:`join_mask` from the single-pass bf16
+    lattice: every pair the f32 lattice keeps is kept (margin-inflated
+    radius); extra near-boundary pairs are removed by the caller's exact
+    f32 re-check on the (sparse) survivors. Cell pruning and validity are
+    exact either way."""
+    m, slack_sq = bf16_distance_margin(a.x, a.y, b.x, b.y, a.valid, b.valid,
+                                       center_x, center_y)
+    d2 = pairwise_dist2_bf16(a.x, a.y, b.x, b.y, center_x, center_y)
+    r_sup = radius + m
+    ok = _pair_cell_ok(a.cell, b.cell, nb_layers, n)
+    return (ok & (d2 <= r_sup * r_sup + slack_sq)
+            & a.valid[:, None] & b.valid[None, :])
+
+
 def _pair_cell_ok(cell_a, cell_b, nb_layers, n):
     """(Na, Nb) cell-join predicate: a's cell within the neighboring layers
     of b's cell. ``nb_layers >= n`` disables pruning (radius-0 semantics)."""
@@ -111,6 +185,26 @@ def join_counts(
 _LATTICE_BUDGET = 1 << 26
 
 
+def _lattice_strategy() -> str:
+    """'f32' (default) or 'bf16': which lattice _tiled_pairs runs. bf16 is
+    the single-pass MXU superset + exact f32 re-check on survivors — the
+    same pair sets up to f32 ties EXACTLY on the radius boundary (the
+    re-check computes dx^2+dy^2 directly, which is slightly MORE accurate
+    than the f32 lattice's a2+b2-2ab expansion; a pair whose true distance
+    equals r to the last ulp can differ between strategies, measure-zero on
+    real streams) at ~3x the lattice rate on TPU (to be measured; see
+    benchmarks/TPU_NOTES.md §7). Env-switched so the bench can A/B it
+    without threading a parameter through every join operator."""
+    import os
+
+    v = os.environ.get("SPATIALFLINK_JOIN_LATTICE", "f32").strip().lower()
+    if v not in ("f32", "bf16"):
+        raise ValueError(
+            f"SPATIALFLINK_JOIN_LATTICE={v!r}: expected 'f32' or 'bf16' "
+            "(a typo here would silently measure f32 twice)")
+    return v
+
+
 def join_pairs_host(a: PointBatch, b: PointBatch, radius, grid, tile: int = 4096,
                     nb_layers=None, lattice_budget=None):
     """Host-side sparse pair extraction (the actual joined output stream).
@@ -125,6 +219,10 @@ def join_pairs_host(a: PointBatch, b: PointBatch, radius, grid, tile: int = 4096
     the rows with partners, and only the compacted lattice is extracted —
     for sparse joins this shrinks the materialized lattice by the selectivity
     factor.
+
+    ``SPATIALFLINK_JOIN_LATTICE=bf16`` swaps the per-tile lattice for the
+    single-pass bf16 superset + exact f32 re-check of the survivors (same
+    pairs, less MXU time on TPU).
     """
     import numpy as np
 
@@ -176,10 +274,33 @@ def _tiled_pairs(a: PointBatch, b: PointBatch, radius, nb_layers, cx, cy,
                  n: int, tile: int):
     import numpy as np
 
+    bf16 = _lattice_strategy() == "bf16"
+    if bf16:
+        # host copies once for the sparse re-check (centered f32, the same
+        # arithmetic as the f32 lattice's expansion)
+        axh, ayh = np.asarray(a.x) - cx, np.asarray(a.y) - cy
+        bxh, byh = np.asarray(b.x) - cx, np.asarray(b.y) - cy
+        r2 = np.float32(radius) * np.float32(radius)
     nb = b.x.shape[0]
     tile = min(tile, nb)
     for start in range(0, nb, tile):
         b_tile = jax.tree.map(lambda v: v[start : start + tile], b)
+        if bf16:
+            m = np.asarray(join_mask_bf16_superset(
+                a, b_tile, radius, nb_layers, cx, cy, n=n))
+            ai, bi = np.nonzero(m)
+            if not ai.size:
+                continue
+            bj = bi + start
+            # exact f32 re-check on the survivors only (sparse): the
+            # superset margin admits near-boundary extras, nothing else
+            dx = axh[ai] - bxh[bj]
+            dy = ayh[ai] - byh[bj]
+            keep = (dx * dx + dy * dy).astype(np.float32) <= r2
+            ai, bj = ai[keep], bj[keep]
+            if ai.size:
+                yield ai, bj
+            continue
         m = np.asarray(
             join_mask(a, b_tile, radius, nb_layers, cx, cy, n=n)
         )

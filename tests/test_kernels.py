@@ -242,6 +242,84 @@ class TestJoin:
         for i, j in list(pairs)[:200]:
             assert O.pp_dist(ax[i], ay[i], bx[j], by[j]) <= r + 1e-3
 
+    def test_bf16_superset_contains_f32_mask(self):
+        """Every pair the f32 lattice keeps survives the bf16 superset (the
+        margin guarantee), across radii incl. small ones."""
+        for r, seeds in ((0.1, (3, 4)), (0.02, (5, 6)), (0.5, (7, 8))):
+            a, *_ = random_batch(300, seed=seeds[0])
+            b, *_ = random_batch(200, seed=seeds[1])
+            L = GRID.candidate_layers(r)
+            cx = (GRID.min_x + GRID.max_x) / 2
+            cy = (GRID.min_y + GRID.max_y) / 2
+            exact = np.asarray(J.join_mask(a, b, r, L, cx, cy, n=GRID.n))
+            sup = np.asarray(J.join_mask_bf16_superset(
+                a, b, r, L, cx, cy, n=GRID.n))
+            assert (sup | ~exact).all(), f"superset violated at r={r}"
+
+    @pytest.mark.parametrize("extent", (1.0, 60.0))
+    def test_bf16_margin_bounds_error(self, extent):
+        """The published (margin, slack_sq) pair really covers the bf16
+        lattice error — squared-space guarantee d2_bf16 <= (d+m)^2 + s2
+        against an f64 oracle, at Beijing extent AND a wide-extent grid
+        (where the f32 accumulation term scales with X^2 and a fixed
+        distance-space slack would fail)."""
+        from spatialflink_tpu.models import PointBatch
+
+        rng = np.random.default_rng(11)
+        g = UniformGrid(0.0, 2 * extent, 0.0, 2 * extent,
+                        num_grid_partitions=50)
+        ax = rng.uniform(0, 2 * extent, 256)
+        ay = rng.uniform(0, 2 * extent, 256)
+        bx = rng.uniform(0, 2 * extent, 256)
+        by = rng.uniform(0, 2 * extent, 256)
+        a = PointBatch.from_arrays(ax, ay, grid=g)
+        b = PointBatch.from_arrays(bx, by, grid=g)
+        cx = cy = extent
+        d2_b = np.asarray(
+            J.pairwise_dist2_bf16(a.x, a.y, b.x, b.y, cx, cy))
+        m, s2 = J.bf16_distance_margin(a.x, a.y, b.x, b.y, a.valid,
+                                       b.valid, cx, cy)
+        m, s2 = float(m), float(s2)
+        # f64 oracle distances over the stored (f32) batch coordinates
+        axd = np.asarray(a.x, np.float64) - cx
+        ayd = np.asarray(a.y, np.float64) - cy
+        bxd = np.asarray(b.x, np.float64) - cx
+        byd = np.asarray(b.y, np.float64) - cy
+        d_true = np.sqrt((axd[:, None] - bxd[None, :]) ** 2
+                         + (ayd[:, None] - byd[None, :]) ** 2)
+        valid = np.asarray(a.valid)[:, None] & np.asarray(b.valid)[None, :]
+        bound = (d_true + m) ** 2 + s2
+        assert (d2_b[valid] <= bound[valid]).all(), extent
+
+    def test_lattice_strategy_env_validation(self, monkeypatch):
+        monkeypatch.setenv("SPATIALFLINK_JOIN_LATTICE", "bfloat16")
+        with pytest.raises(ValueError, match="SPATIALFLINK_JOIN_LATTICE"):
+            J._lattice_strategy()
+        monkeypatch.setenv("SPATIALFLINK_JOIN_LATTICE", " BF16 ")
+        assert J._lattice_strategy() == "bf16"
+
+    def test_bf16_pairs_match_f32_pairs(self, monkeypatch):
+        """SPATIALFLINK_JOIN_LATTICE=bf16 yields the same pair sets as the
+        f32 lattice (superset + exact re-check), incl. through the
+        over-budget prefilter path."""
+        r = 0.1
+        a, *_ = random_batch(300, seed=8)
+        b, *_ = random_batch(300, seed=9)
+
+        def pairs(budget=None):
+            out = set()
+            kw = {} if budget is None else {"lattice_budget": budget}
+            for ai, bi in J.join_pairs_host(a, b, r, GRID, tile=128, **kw):
+                out.update(zip(ai.tolist(), bi.tolist()))
+            return out
+
+        monkeypatch.delenv("SPATIALFLINK_JOIN_LATTICE", raising=False)
+        want = pairs()
+        want_budget = pairs(budget=1)
+        monkeypatch.setenv("SPATIALFLINK_JOIN_LATTICE", "bf16")
+        assert pairs() == want
+        assert pairs(budget=1) == want_budget == want
+
     def test_pairwise_dist2_precision_with_centering(self):
         # Close points at degree magnitude. The error floor is the f32
         # *storage* quantization of the inputs (~7.6e-6 deg at |x|~116, i.e.

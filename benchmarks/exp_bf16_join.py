@@ -48,6 +48,7 @@ def main() -> int:
 
     for name, fn in (("f32", J.join_mask),
                      ("bf16_superset", J.join_mask_bf16_superset)):
+        @jax.jit  # one compile covers every count (_slope_time's contract)
         def run_n(iters, fn=fn):
             def body(i, acc):
                 m = fn(a._replace(x=a.x + i * 1e-9), b, RADIUS, L, cx, cy,

@@ -271,15 +271,12 @@ class SpatialOperator:
         shard with psum-merged stats (parallel.ops.distributed_stream_filter)
         — the mesh dispatch every reference pipeline gets from
         ``env.setParallelism(30)`` (``StreamingJob.java:221``)."""
-        if self.distributed:
-            from spatialflink_tpu.parallel.ops import distributed_stream_filter
+        from spatialflink_tpu.parallel.ops import distributed_stream_filter
 
-            return self._eval_degradable(
-                lambda: mask_stats_fn(batch),
-                lambda mesh, sb: distributed_stream_filter(
-                    mesh, sb, mask_stats_fn),
-                batch)
-        return mask_stats_fn(batch)
+        return self._stream_dispatch(
+            batch, mask_stats_fn,
+            lambda mesh, sb: distributed_stream_filter(
+                mesh, sb, mask_stats_fn))
 
     @staticmethod
     def _record_pruning_stats(gn_bypassed, dist_evals) -> None:
@@ -331,13 +328,6 @@ class SpatialOperator:
         stats = None if dist_evals is None else (0, dist_evals)
         return self._defer_with_stats(res, stats, rows)
 
-    def _require_single_device(self) -> None:
-        """Shared guard for the run_multi family."""
-        if self.distributed:
-            raise NotImplementedError(
-                "run_multi is single-device; shard the query batch across "
-                "operators to combine with conf.devices")
-
     @staticmethod
     def _query_point_arrays(query_points):
         """(qx, qy, qc) device-ready arrays from a query-point batch."""
@@ -365,20 +355,57 @@ class SpatialOperator:
 
         return self._defer_with_stats(res, (0, dist_evals), rows)
 
+    def _stream_dispatch(self, batch, local_fn, dist_entry):
+        """SINGLE owner of the whole-batch-vs-mesh dispatch shape shared by
+        every stream evaluation (filter/kNN, single- and multi-query):
+        ``local_fn(batch)`` runs the single-device kernels; on a mesh,
+        ``dist_entry(mesh, sharded_batch)`` runs the distributed twin with
+        elastic degraded retry. One place to change the contract."""
+        if self.distributed:
+            return self._eval_degradable(
+                lambda: local_fn(batch), dist_entry, batch)
+        return local_fn(batch)
+
+    def _multi_filter_stream(self, batch, multi_mask_stats):
+        """(masks (Q, N), gn (Q,), evals (Q,)) for one batch — the same
+        closure whole-batch or per shard with psum-merged per-query counters
+        (parallel.ops.distributed_stream_filter_multi)."""
+        from spatialflink_tpu.parallel.ops import (
+            distributed_stream_filter_multi,
+        )
+
+        return self._stream_dispatch(
+            batch, multi_mask_stats,
+            lambda mesh, sb: distributed_stream_filter_multi(
+                mesh, sb, multi_mask_stats))
+
+    def _knn_multi_result(self, batch, local_fn, k: int):
+        """(KnnResult (Q, k), evals (Q,)) for one batch — whole-batch, or
+        per-shard partials merged per query
+        (parallel.ops.distributed_stream_knn_multi)."""
+        from spatialflink_tpu.parallel.ops import distributed_stream_knn_multi
+
+        return self._stream_dispatch(
+            batch, local_fn,
+            lambda mesh, sb: distributed_stream_knn_multi(
+                mesh, sb, local_fn, k=k))
+
     def _run_multi_filter(self, stream: Iterable, n_queries: int,
                           multi_mask_stats, batch_builder
                           ) -> Iterator["WindowResult"]:
         """Shared run_multi driver for FILTER-shaped operators (range):
         ``multi_mask_stats(batch) -> (masks (Q, N), gn_c (Q,), evals (Q,))``;
         records become Q per-query record lists, pruning counters aggregate
-        across the query batch."""
+        across the query batch. With ``conf.devices`` the batch is sharded
+        and the same closure runs per shard."""
         import jax.numpy as jnp
 
         def eval_batch(records, ts_base):
             if not records:
                 return [[] for _ in range(n_queries)]
             batch = batch_builder(records, ts_base)
-            masks, gn_c, evals = multi_mask_stats(batch)
+            masks, gn_c, evals = self._multi_filter_stream(
+                batch, multi_mask_stats)
 
             def rows(m):
                 m = np.asarray(m)  # ONE (Q, N) device->host transfer

@@ -72,16 +72,13 @@ class PointPointKNNQuery(SpatialOperator):
                 jnp.int32(query_point.cell), radius, nb_layers,
                 n=self.grid.n, k=k, strategy=self._knn_strategy())
 
-        if self.distributed:
-            from spatialflink_tpu.parallel.ops import distributed_stream_knn
+        from spatialflink_tpu.parallel.ops import distributed_stream_knn
 
-            return self._eval_degradable(
-                lambda: local(batch),
-                lambda mesh, sb: distributed_stream_knn(
-                    mesh, sb, k=k, strategy=self._knn_strategy(),
-                    local_fn=local),
-                batch)
-        return local(batch)
+        return self._stream_dispatch(
+            batch, local,
+            lambda mesh, sb: distributed_stream_knn(
+                mesh, sb, k=k, strategy=self._knn_strategy(),
+                local_fn=local))
 
     def run_bulk(self, parsed, query_point: Point, radius: float,
                  k: Optional[int] = None, *, pad: Optional[int] = None
@@ -113,23 +110,26 @@ class PointPointKNNQuery(SpatialOperator):
         Each WindowResult's ``records`` is a list of Q per-query result
         lists (``records[q]`` = the (objID, distance) pairs for
         ``query_points[q]``), with ``extras["queries"] = Q``. All queries
-        share ``radius`` (one candidate-cell layer count). Single-device:
-        combine with ``conf.devices`` by sharding the *query* batch across
-        operators if needed."""
-        self._require_single_device()
+        share ``radius`` (one candidate-cell layer count). With
+        ``conf.devices`` the STREAM batch shards over the mesh and per-shard
+        (Q, k) partials merge per query
+        (parallel.ops.distributed_stream_knn_multi) — 8-dev ≡ 1-dev."""
         k = k or self.conf.k
         from spatialflink_tpu.ops.knn import knn_point_multi_stats
 
         qx, qy, qc = self._query_point_arrays(query_points)
         nb_layers = self._nb_layers(radius)
 
+        def local(b):
+            return knn_point_multi_stats(
+                b, qx, qy, qc, radius, nb_layers, n=self.grid.n, k=k,
+                strategy=self._knn_strategy())
+
         def eval_batch(records, ts_base):
             if not records:
                 return [[] for _ in query_points]
             batch = self._point_batch(records, ts_base)
-            res, evals = knn_point_multi_stats(
-                batch, qx, qy, qc, radius, nb_layers, n=self.grid.n, k=k,
-                strategy=self._knn_strategy())
+            res, evals = self._knn_multi_result(batch, local, k)
             return self._defer_knn_multi(res, jnp.sum(evals))
 
         for result in self._multi_results(stream, eval_batch):
@@ -144,18 +144,20 @@ class PointPointKNNQuery(SpatialOperator):
         same multi kernel; per-query (objID, distance) records resolve
         through the parse-time interner (the ``--bulk --multi-query`` CLI
         path)."""
-        self._require_single_device()
         k = k or self.conf.k
         from spatialflink_tpu.ops.knn import knn_point_multi_stats
 
         qx, qy, qc = self._query_point_arrays(query_points)
         nb_layers = self._nb_layers(radius)
 
+        def local(b):
+            return knn_point_multi_stats(
+                b, qx, qy, qc, radius, nb_layers, n=self.grid.n, k=k,
+                strategy=self._knn_strategy())
+
         def eval_batch(payload, ts_base):
             _idx, batch = payload
-            res, evals = knn_point_multi_stats(
-                batch, qx, qy, qc, radius, nb_layers, n=self.grid.n, k=k,
-                strategy=self._knn_strategy())
+            res, evals = self._knn_multi_result(batch, local, k)
             return self._defer_knn_multi(res, jnp.sum(evals),
                                          interner=parsed.interner)
 
@@ -185,23 +187,19 @@ class _GenericKnn(SpatialOperator, GeomQueryMixin):
         evaluation body shared by run() and run_bulk(): distributed runs
         the same closure per shard, single-device goes through the
         module-jitted knn_eligible_stats."""
-        def single():
+        def single(b):
             from spatialflink_tpu.ops.knn import knn_eligible_stats
 
-            eligible, dists = elig_dists(batch)
-            return knn_eligible_stats(batch.obj_id, dists, eligible, k=k,
+            eligible, dists = elig_dists(b)
+            return knn_eligible_stats(b.obj_id, dists, eligible, k=k,
                                       strategy=self._knn_strategy())
 
-        if self.distributed:
-            from spatialflink_tpu.parallel.ops import distributed_stream_knn
+        from spatialflink_tpu.parallel.ops import distributed_stream_knn
 
-            return self._eval_degradable(
-                single,
-                lambda mesh, sb: distributed_stream_knn(
-                    mesh, sb, elig_dists, k=k,
-                    strategy=self._knn_strategy()),
-                batch)
-        return single()
+        return self._stream_dispatch(
+            batch, single,
+            lambda mesh, sb: distributed_stream_knn(
+                mesh, sb, elig_dists, k=k, strategy=self._knn_strategy()))
 
     def run(self, stream, query, radius: float, k: Optional[int] = None
             ) -> Iterator[WindowResult]:
@@ -269,7 +267,8 @@ class _GeomStreamKnn(_GenericKnn):
         def eval_batch(records, ts_base):
             if not records:
                 return [[] for _ in range(n_queries)]
-            res, evals = eval_geoms(self._geom_batch(records, ts_base))
+            batch = self._geom_batch(records, ts_base)
+            res, evals = self._knn_multi_result(batch, eval_geoms, k)
             return self._defer_knn_multi(res, jnp.sum(evals))
 
         for result in self._multi_results(stream, eval_batch):
@@ -298,22 +297,24 @@ class PointGeomKNNQuery(_GenericKnn):
         (N, G) lattice; selection is the batched dedup+top-k with the
         exactness rescue). Same result contract as
         ``PointPointKNNQuery.run_multi``: ``records[q]`` answers
-        ``query_geoms[q]``; approximate mode substitutes bbox distances.
-        Single-device, shared radius — see the PointPoint docstring."""
-        self._require_single_device()
+        ``query_geoms[q]``; approximate mode substitutes bbox distances;
+        shared radius; meshes like the PointPoint variant."""
         k = k or self.conf.k
         from spatialflink_tpu.ops.geom import knn_points_to_geom_queries
 
         gb = self._query_geom_batch(query_geoms)
         nb_masks = self._stack_query_nb(query_geoms, radius)
 
+        def local(b):
+            return knn_points_to_geom_queries(
+                b, gb, nb_masks, k=k, strategy=self._knn_strategy(),
+                approximate=self.conf.approximate)
+
         def eval_batch(records, ts_base):
             if not records:
                 return [[] for _ in query_geoms]
             batch = self._point_batch(records, ts_base)
-            res, evals = knn_points_to_geom_queries(
-                batch, gb, nb_masks, k=k, strategy=self._knn_strategy(),
-                approximate=self.conf.approximate)
+            res, evals = self._knn_multi_result(batch, local, k)
             return self._defer_knn_multi(res, jnp.sum(evals))
 
         for result in self._multi_results(stream, eval_batch):
@@ -358,7 +359,6 @@ class GeomPointKNNQuery(_GeomStreamKnn):
         """Q query POINTS over one polygon/linestring stream in ONE dispatch
         per window (``ops.geom.knn_geoms_to_point_queries``); same contract
         as ``PointPointKNNQuery.run_multi``."""
-        self._require_single_device()
         k = k or self.conf.k
         from spatialflink_tpu.ops.geom import knn_geoms_to_point_queries
 
@@ -399,7 +399,6 @@ class GeomGeomKNNQuery(_GeomStreamKnn):
         dispatch per window (``ops.geom.knn_geoms_to_geom_queries``); the Q
         queries ride one exact-capacity padded edge batch. Same contract as
         the other run_multi surfaces."""
-        self._require_single_device()
         k = k or self.conf.k
         from spatialflink_tpu.ops.geom import knn_geoms_to_geom_queries
 

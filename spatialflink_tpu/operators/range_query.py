@@ -91,9 +91,8 @@ class PointPointRangeQuery(SpatialOperator):
         ``StreamingJob.java:470``). ``records[q]`` holds the records within
         ``radius`` of ``query_points[q]`` under the usual GN-bypass/CN
         semantics; ``extras["queries"] = Q``. Pruning counters aggregate
-        across the Q queries of each dispatch. Single-device, like
-        ``PointPointKNNQuery.run_multi``."""
-        self._require_single_device()
+        across the Q queries of each dispatch; with ``conf.devices`` the
+        stream batch shards over the mesh like every other operator."""
         from spatialflink_tpu.ops.range import range_filter_point_multi_masks
 
         qx, qy, qc = self._query_point_arrays(query_points)
@@ -112,18 +111,21 @@ class PointPointRangeQuery(SpatialOperator):
         """Bulk-replay multi-query range: per-query original-record index
         lists from one (Q, N) mask dispatch per window (the
         ``--bulk --multi-query`` CLI path)."""
-        self._require_single_device()
         from spatialflink_tpu.ops.range import range_filter_point_multi_masks
 
         qx, qy, qc = self._query_point_arrays(query_points)
         args = (radius, self.grid.guaranteed_layers(radius),
                 self.grid.candidate_layers(radius))
 
+        def multi_mask_stats(b):
+            return range_filter_point_multi_masks(
+                b, qx, qy, qc, *args, n=self.grid.n,
+                approximate=self.conf.approximate)
+
         def eval_batch(payload, ts_base):
             idx, batch = payload
-            masks, gn_c, evals = range_filter_point_multi_masks(
-                batch, qx, qy, qc, *args, n=self.grid.n,
-                approximate=self.conf.approximate)
+            masks, gn_c, evals = self._multi_filter_stream(
+                batch, multi_mask_stats)
 
             def rows(m):
                 m = np.asarray(m)  # ONE (Q, N) device->host transfer
@@ -218,7 +220,6 @@ class PointGeomRangeQuery(SpatialOperator, GeomQueryMixin):
         """Q polygon/linestring QUERIES over one point stream in ONE
         dispatch per window (``ops.geom.range_points_to_geom_queries``);
         same contract as ``PointPointRangeQuery.run_multi``."""
-        self._require_single_device()
         from spatialflink_tpu.ops.geom import range_points_to_geom_queries
 
         qgb = self._query_geom_batch(query_geoms)
@@ -305,7 +306,6 @@ class GeomPointRangeQuery(SpatialOperator, GeomQueryMixin, _GeomStreamBulkMixin)
         """Q query POINTS over one polygon/linestring stream in ONE dispatch
         per window (``ops.geom.range_geoms_to_point_queries`` — GN-subset
         rule applied per query)."""
-        self._require_single_device()
         from spatialflink_tpu.ops.geom import range_geoms_to_point_queries
 
         qx, qy, _qc = self._query_point_arrays(query_points)
@@ -366,7 +366,6 @@ class GeomGeomRangeQuery(SpatialOperator, GeomQueryMixin, _GeomStreamBulkMixin):
         """Q query GEOMETRIES over one polygon/linestring stream in ONE
         dispatch per window (``ops.geom.range_geoms_to_geom_queries`` — the
         Q queries ride one exact-capacity padded edge batch)."""
-        self._require_single_device()
         from spatialflink_tpu.ops.geom import range_geoms_to_geom_queries
 
         qgb = self._query_geom_batch(query_geoms)

@@ -815,22 +815,24 @@ class PointPointTKNNQuery(SpatialOperator):
         (objID, min_distance, sub_trajectory) triples for
         ``query_points[q]``; sub-trajectories are assembled once for the
         union of all queries' selected trajectories."""
-        from spatialflink_tpu.ops.knn import knn_point_multi
+        from spatialflink_tpu.ops.knn import knn_point_multi_stats
 
-        self._require_single_device()
         k = k or self.conf.k
         qx, qy, qc = self._query_point_arrays(query_points)
         nb_layers = (
             self.grid.candidate_layers(radius) if radius > 0 else self.grid.n
         )
 
+        def local(b):
+            return knn_point_multi_stats(
+                b, qx, qy, qc, radius, nb_layers, n=self.grid.n, k=k,
+                enforce_radius=radius > 0)
+
         def eval_batch(records, ts_base):
             if not records:
                 return [[] for _ in query_points]
             batch = self._point_batch(records, ts_base)
-            res = knn_point_multi(
-                batch, qx, qy, qc, radius, nb_layers, n=self.grid.n, k=k,
-                enforce_radius=radius > 0)
+            res, _evals = self._knn_multi_result(batch, local, k)
             valid = np.asarray(res.valid)
             oid_rows = np.asarray(res.obj_id)
             dist_rows = np.asarray(res.dist)

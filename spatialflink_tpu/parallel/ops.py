@@ -190,10 +190,29 @@ def distributed_stream_filter(mesh: Mesh, batch, mask_stats_fn):
     gn_total, evals_total) — embarrassingly parallel on the mask, one scalar
     collective for the counters. Accepts 1-D and 2-D (hosts x chips) meshes.
     """
+    return _stream_filter_impl(mesh, batch, mask_stats_fn,
+                               lambda axes: P(axes))
+
+
+def distributed_stream_filter_multi(mesh: Mesh, batch, multi_mask_stats):
+    """Multi-query stream filter over the mesh: ``multi_mask_stats(shard) ->
+    (masks (Q, n_shard), gn (Q,), evals (Q,))`` runs the SAME vmapped
+    single-device kernels per shard (closures over replicated query-side
+    stacks); per-query pruning counters psum-merge. Returns
+    (masks (Q, N) sharded on the point dim, gn totals (Q,), evals totals
+    (Q,)). Accepts 1-D and 2-D meshes."""
+    return _stream_filter_impl(mesh, batch, multi_mask_stats,
+                               lambda axes: P(None, axes))
+
+
+def _stream_filter_impl(mesh: Mesh, batch, stats_fn, mask_spec):
+    """Shared shard_map wiring for the single- and multi-query stream
+    filters — they differ only in where the sharded point dim sits in the
+    mask output (leading vs after the query axis)."""
     axes = _point_axes(mesh)
 
     def per_shard(b):
-        mask, gn, evals = mask_stats_fn(b)
+        mask, gn, evals = stats_fn(b)
         return (mask, jax.lax.psum(gn, axes), jax.lax.psum(evals, axes))
 
     fn = shard_map(
@@ -201,7 +220,7 @@ def distributed_stream_filter(mesh: Mesh, batch, mask_stats_fn):
         mesh=mesh,
         check_vma=False,
         in_specs=(P(axes),),
-        out_specs=(P(axes), P(), P()),
+        out_specs=(mask_spec(axes), P(), P()),
     )
     return fn(batch)
 
@@ -226,25 +245,44 @@ def distributed_stream_knn(mesh: Mesh, batch, elig_dist_fn=None, *, k: int,
     """
     from spatialflink_tpu.ops.knn import knn_eligible_stats
 
-    def per_shard(b):
+    def local(b):
         if local_fn is not None:
-            local, n_elig = local_fn(b)
-        else:
-            eligible, dists = elig_dist_fn(b)
-            local, n_elig = knn_eligible_stats(b.obj_id, dists, eligible,
-                                               k=k, strategy=strategy)
+            return local_fn(b)
+        eligible, dists = elig_dist_fn(b)
+        return knn_eligible_stats(b.obj_id, dists, eligible,
+                                  k=k, strategy=strategy)
+
+    return _stream_knn_impl(mesh, batch, local, k, _gather_topk)
+
+
+def distributed_stream_knn_multi(mesh: Mesh, batch, local_fn, *, k: int):
+    """Multi-query stream kNN over the mesh: ``local_fn(shard) ->
+    (KnnResult (Q, k), evals (Q,))`` is the vmapped single-device kernel
+    closure; per-shard (Q, k) partials all-gather and re-top-k per query
+    (two-level on a 2-D mesh — DCN traffic is Q * k * hosts, window-size
+    independent). Returns (KnnResult (Q, k) replicated, evals totals (Q,))."""
+    return _stream_knn_impl(mesh, batch, local_fn, k, _gather_topk_multi)
+
+
+def _stream_knn_impl(mesh: Mesh, batch, local_fn, k: int, gather):
+    """Shared shard_map wiring for the single- and multi-query stream kNN —
+    they differ only in the partial shape ((k,) vs (Q, k)) and hence the
+    gather-merge helper."""
+    axes = _point_axes(mesh)
+
+    def per_shard(b):
+        local, n_elig = local_fn(b)
         # level 1: merge k-sized partials across the slice (ICI axis)
-        merged = _gather_topk(local, CELL_AXIS, k)
+        merged = gather(local, CELL_AXIS, k)
         if DCN_AXIS in axes:
             # level 2 (2-D mesh): one k-sized partial per slice across
-            # hosts — DCN traffic is k * n_hosts, window-size independent
-            # (the hierarchical merge of distributed_knn_hierarchical,
-            # available to every stream type through the operator path)
-            merged = _gather_topk(merged, DCN_AXIS, k)
-        evals = jax.lax.psum(n_elig, axes)
-        return merged, evals
+            # hosts — DCN traffic is k * n_hosts (* Q for multi),
+            # window-size independent (the hierarchical merge of
+            # distributed_knn_hierarchical, available to every stream type
+            # through the operator path)
+            merged = gather(merged, DCN_AXIS, k)
+        return merged, jax.lax.psum(n_elig, axes)
 
-    axes = _point_axes(mesh)
     fn = shard_map(
         per_shard,
         mesh=mesh,
@@ -263,6 +301,23 @@ def _gather_topk(partial: KnnResult, axis_name: str, k: int) -> KnnResult:
         jax.lax.all_gather(partial.dist, axis_name).reshape(-1),
         jax.lax.all_gather(partial.valid, axis_name).reshape(-1),
         k)
+
+
+def _gather_topk_multi(partial: KnnResult, axis_name: str, k: int
+                       ) -> KnnResult:
+    """:func:`_gather_topk` for (Q, k) partials: all-gather over the mesh
+    axis gives (D, Q, k); re-top-k per query over the D*k merged candidates.
+    The merge operands are tiny (devices * k), so a vmapped full sort is the
+    right selection — no cond, value-preserving."""
+    def gather(x):
+        g = jax.lax.all_gather(x, axis_name)         # (D, Q, k)
+        return jnp.moveaxis(g, 0, 1).reshape(g.shape[1], -1)  # (Q, D*k)
+
+    oid, dist, valid = (gather(partial.obj_id), gather(partial.dist),
+                        gather(partial.valid))
+    return jax.vmap(
+        lambda o, d, v: topk_by_distance(o, d, v, k, strategy="sort")
+    )(oid, dist, valid)
 
 
 def distributed_stream_join_lattice(mesh: Mesh, a, b, lattice_fn):

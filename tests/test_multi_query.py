@@ -751,12 +751,45 @@ class TestOperatorMulti:
         assert all("per_query_counts" in s and s["queries"] >= 1
                    for s in summaries)
 
-    def test_run_multi_distributed_raises(self):
-        conf = QueryConfiguration(QueryType.WindowBased, 10_000, 5_000,
-                                  devices=8)
-        with pytest.raises(NotImplementedError):
-            next(PointPointKNNQuery(conf, GRID).run_multi(
-                _stream(60), self._qpoints(2), RADIUS, K))
-        with pytest.raises(NotImplementedError):
-            next(PointPointRangeQuery(conf, GRID).run_multi(
-                _stream(60), self._qpoints(2), RADIUS))
+    @pytest.mark.parametrize("op_kind", ("range", "knn", "geom_knn",
+                                         "geom_range", "tknn"))
+    def test_run_multi_8dev_matches_1dev(self, op_kind):
+        """Multi-query composes with the mesh: 8-device runs match
+        single-device bit-for-bit across operator families (the same
+        vmapped kernels run per shard; per-query partials merge with
+        collectives)."""
+        from spatialflink_tpu.operators import (
+            PointPointTKNNQuery,
+            PolygonPolygonRangeQuery,
+            PointPolygonKNNQuery,
+        )
+
+        def conf(devices=None):
+            return QueryConfiguration(QueryType.WindowBased, 10_000, 5_000,
+                                      devices=devices)
+
+        def run(devices):
+            if op_kind == "range":
+                return [
+                    [[r.obj_id for r in q] for q in w.records]
+                    for w in PointPointRangeQuery(conf(devices), GRID)
+                    .run_multi(_stream(), self._qpoints(3), RADIUS)]
+            if op_kind == "knn":
+                return [w.records for w in
+                        PointPointKNNQuery(conf(devices), GRID).run_multi(
+                            _stream(), self._qpoints(3), RADIUS, K)]
+            if op_kind == "geom_knn":
+                return [w.records for w in
+                        PointPolygonKNNQuery(conf(devices), GRID).run_multi(
+                            _stream(), self._qpolys(2), RADIUS, K)]
+            if op_kind == "geom_range":
+                return [
+                    [[r.obj_id for r in q] for q in w.records]
+                    for w in PolygonPolygonRangeQuery(conf(devices), GRID)
+                    .run_multi(self._geom_stream(), self._qpolys(2), RADIUS)]
+            return [
+                [[(o, d) for o, d, _s in q] for q in w.records]
+                for w in PointPointTKNNQuery(conf(devices), GRID).run_multi(
+                    _stream(), self._qpoints(2), RADIUS, K)]
+
+        assert run(None) == run(8), op_kind

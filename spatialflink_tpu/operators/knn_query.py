@@ -97,6 +97,22 @@ class PointPointKNNQuery(SpatialOperator):
             result.extras["k"] = k
             yield result
 
+    def _multi_local(self, query_points, radius: float, k: int):
+        """The per-batch multi-kernel closure shared by run_multi and
+        run_multi_bulk — one definition so the stream and bulk paths cannot
+        fork."""
+        from spatialflink_tpu.ops.knn import knn_point_multi_stats
+
+        qx, qy, qc = self._query_point_arrays(query_points)
+        nb_layers = self._nb_layers(radius)
+
+        def local(b):
+            return knn_point_multi_stats(
+                b, qx, qy, qc, radius, nb_layers, n=self.grid.n, k=k,
+                strategy=self._knn_strategy())
+
+        return local
+
     def run_multi(self, stream: Iterable[Point],
                   query_points: "List[Point]", radius: float,
                   k: Optional[int] = None) -> Iterator[WindowResult]:
@@ -115,15 +131,7 @@ class PointPointKNNQuery(SpatialOperator):
         (Q, k) partials merge per query
         (parallel.ops.distributed_stream_knn_multi) — 8-dev ≡ 1-dev."""
         k = k or self.conf.k
-        from spatialflink_tpu.ops.knn import knn_point_multi_stats
-
-        qx, qy, qc = self._query_point_arrays(query_points)
-        nb_layers = self._nb_layers(radius)
-
-        def local(b):
-            return knn_point_multi_stats(
-                b, qx, qy, qc, radius, nb_layers, n=self.grid.n, k=k,
-                strategy=self._knn_strategy())
+        local = self._multi_local(query_points, radius, k)
 
         def eval_batch(records, ts_base):
             if not records:
@@ -145,15 +153,7 @@ class PointPointKNNQuery(SpatialOperator):
         through the parse-time interner (the ``--bulk --multi-query`` CLI
         path)."""
         k = k or self.conf.k
-        from spatialflink_tpu.ops.knn import knn_point_multi_stats
-
-        qx, qy, qc = self._query_point_arrays(query_points)
-        nb_layers = self._nb_layers(radius)
-
-        def local(b):
-            return knn_point_multi_stats(
-                b, qx, qy, qc, radius, nb_layers, n=self.grid.n, k=k,
-                strategy=self._knn_strategy())
+        local = self._multi_local(query_points, radius, k)
 
         def eval_batch(payload, ts_base):
             _idx, batch = payload

@@ -83,34 +83,9 @@ class PointPointRangeQuery(SpatialOperator):
             parsed, self._bulk_mask_eval(self._mask_stats_fn(query_point, radius)),
             pad=pad)
 
-    def run_multi(self, stream: Iterable[Point],
-                  query_points: List[Point], radius: float
-                  ) -> Iterator[WindowResult]:
-        """Q continuous range queries over ONE stream in ONE dispatch per
-        window (TPU-native extension; the reference runs one query per job,
-        ``StreamingJob.java:470``). ``records[q]`` holds the records within
-        ``radius`` of ``query_points[q]`` under the usual GN-bypass/CN
-        semantics; ``extras["queries"] = Q``. Pruning counters aggregate
-        across the Q queries of each dispatch; with ``conf.devices`` the
-        stream batch shards over the mesh like every other operator."""
-        from spatialflink_tpu.ops.range import range_filter_point_multi_masks
-
-        qx, qy, qc = self._query_point_arrays(query_points)
-        args = (radius, self.grid.guaranteed_layers(radius),
-                self.grid.candidate_layers(radius))
-        return self._run_multi_filter(
-            stream, len(query_points),
-            lambda batch: range_filter_point_multi_masks(
-                batch, qx, qy, qc, *args, n=self.grid.n,
-                approximate=self.conf.approximate),
-            self._point_batch)
-
-    def run_multi_bulk(self, parsed, query_points: List[Point],
-                       radius: float, *, pad: Optional[int] = None
-                       ) -> Iterator[WindowResult]:
-        """Bulk-replay multi-query range: per-query original-record index
-        lists from one (Q, N) mask dispatch per window (the
-        ``--bulk --multi-query`` CLI path)."""
+    def _multi_mask_stats(self, query_points, radius: float):
+        """The per-batch multi-mask closure shared by run_multi and
+        run_multi_bulk."""
         from spatialflink_tpu.ops.range import range_filter_point_multi_masks
 
         qx, qy, qc = self._query_point_arrays(query_points)
@@ -121,6 +96,31 @@ class PointPointRangeQuery(SpatialOperator):
             return range_filter_point_multi_masks(
                 b, qx, qy, qc, *args, n=self.grid.n,
                 approximate=self.conf.approximate)
+
+        return multi_mask_stats
+
+    def run_multi(self, stream: Iterable[Point],
+                  query_points: List[Point], radius: float
+                  ) -> Iterator[WindowResult]:
+        """Q continuous range queries over ONE stream in ONE dispatch per
+        window (TPU-native extension; the reference runs one query per job,
+        ``StreamingJob.java:470``). ``records[q]`` holds the records within
+        ``radius`` of ``query_points[q]`` under the usual GN-bypass/CN
+        semantics; ``extras["queries"] = Q``. Pruning counters aggregate
+        across the Q queries of each dispatch; with ``conf.devices`` the
+        stream batch shards over the mesh like every other operator."""
+        return self._run_multi_filter(
+            stream, len(query_points),
+            self._multi_mask_stats(query_points, radius),
+            self._point_batch)
+
+    def run_multi_bulk(self, parsed, query_points: List[Point],
+                       radius: float, *, pad: Optional[int] = None
+                       ) -> Iterator[WindowResult]:
+        """Bulk-replay multi-query range: per-query original-record index
+        lists from one (Q, N) mask dispatch per window (the
+        ``--bulk --multi-query`` CLI path)."""
+        multi_mask_stats = self._multi_mask_stats(query_points, radius)
 
         def eval_batch(payload, ts_base):
             idx, batch = payload

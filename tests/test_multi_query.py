@@ -792,4 +792,14 @@ class TestOperatorMulti:
                 for w in PointPointTKNNQuery(conf(devices), GRID).run_multi(
                     _stream(), self._qpoints(2), RADIUS, K)]
 
-        assert run(None) == run(8), op_kind
+        from spatialflink_tpu.utils.metrics import REGISTRY
+
+        single = run(None)
+        degradations = REGISTRY.counter("mesh-degradations").count
+        mesh = run(8)
+        # a RuntimeError in the distributed path would silently degrade the
+        # mesh to the single-device code and pass vacuously — assert the
+        # mesh path actually ran
+        assert REGISTRY.counter("mesh-degradations").count == degradations, \
+            f"{op_kind}: mesh degraded — distributed multi path broken"
+        assert single == mesh, op_kind

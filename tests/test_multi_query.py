@@ -751,13 +751,17 @@ class TestOperatorMulti:
         assert all("per_query_counts" in s and s["queries"] >= 1
                    for s in summaries)
 
-    @pytest.mark.parametrize("op_kind", ("range", "knn", "geom_knn",
-                                         "geom_range", "tknn"))
-    def test_run_multi_8dev_matches_1dev(self, op_kind):
-        """Multi-query composes with the mesh: 8-device runs match
-        single-device bit-for-bit across operator families (the same
-        vmapped kernels run per shard; per-query partials merge with
-        collectives)."""
+    @pytest.mark.parametrize("op_kind,hosts", [
+        ("range", None), ("knn", None), ("geom_knn", None),
+        ("geom_range", None), ("tknn", None),
+        # 2-D (hosts x chips) mesh drives the per-query merge's DCN level
+        ("range", 2), ("knn", 2),
+    ])
+    def test_run_multi_mesh_matches_1dev(self, op_kind, hosts):
+        """Multi-query composes with the mesh: 8-device (and 2-D
+        hosts x chips) runs match single-device bit-for-bit across operator
+        families (the same vmapped kernels run per shard; per-query
+        partials merge with collectives)."""
         from spatialflink_tpu.operators import (
             PointPointTKNNQuery,
             PolygonPolygonRangeQuery,
@@ -766,7 +770,8 @@ class TestOperatorMulti:
 
         def conf(devices=None):
             return QueryConfiguration(QueryType.WindowBased, 10_000, 5_000,
-                                      devices=devices)
+                                      devices=devices,
+                                      hosts=hosts if devices else None)
 
         def run(devices):
             if op_kind == "range":
@@ -803,3 +808,4 @@ class TestOperatorMulti:
         assert REGISTRY.counter("mesh-degradations").count == degradations, \
             f"{op_kind}: mesh degraded — distributed multi path broken"
         assert single == mesh, op_kind
+

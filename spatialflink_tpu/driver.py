@@ -198,6 +198,12 @@ def _query_conf(params: Params, spec: CaseSpec) -> QueryConfiguration:
     )
 
 
+def _operator_class(spec: CaseSpec):
+    """The stream x query operator class for a range/kNN/join CaseSpec."""
+    fam = {"range": "Range", "knn": "KNN", "join": "Join"}[spec.family]
+    return getattr(ops, f"{spec.stream}{spec.query}{fam}Query")
+
+
 def _query_object(params: Params, grid: UniformGrid, kind: str):
     if kind == "Point":
         pts = params.query_point_objects(grid)
@@ -286,8 +292,7 @@ def run_option(params: Params, stream1: Iterable, stream2: Optional[Iterable]
     radius = params.query.radius
 
     if spec.family in ("range", "knn", "join"):
-        cls = getattr(ops, f"{spec.stream}{spec.query}"
-                           f"{ {'range': 'Range', 'knn': 'KNN', 'join': 'Join'}[spec.family] }Query")
+        cls = _operator_class(spec)
         s1 = decode_stream(stream1, params.input1, u_grid, spec.stream)
         if spec.family == "join":
             op = cls(conf, u_grid, q_grid)
@@ -518,29 +523,41 @@ def run_option_bulk(params: Params, input_path: str,
     if spec is None or spec.mode != "window" or spec.latency:
         return None
     if params.query.multi_query:
-        # PointPoint range/kNN have bulk multi-query evaluators; every
-        # other case falls back to the record path (run_option), which
+        # every range/kNN pair has a bulk multi-query evaluator (point
+        # streams over CSV/TSV/GeoJSON, geometry streams over WKT/GeoJSON);
+        # anything else falls back to the record path (run_option), which
         # dispatches or errors per the multiQuery eligibility rules —
         # silently answering only the first configured query would be
         # worse than the slower path
-        if (spec.family not in ("range", "knn")
-                or (spec.stream, spec.query) != ("Point", "Point")):
+        if spec.family not in ("range", "knn"):
             return None
         u_grid, _ = params.grids()
-        qs = params.query_point_objects(u_grid)
+        getter, qname = {
+            "Point": (params.query_point_objects, "queryPoints"),
+            "Polygon": (params.query_polygon_objects, "queryPolygons"),
+            "LineString": (params.query_linestring_objects,
+                           "queryLineStrings"),
+        }[spec.query]
+        qs = getter(u_grid)
         if not qs:
             # validate BEFORE the full-file native ingest, like the record
             # path's _non_empty guard
-            raise ValueError("query.queryPoints is empty")
-        parsed = _bulk_parse_stream(params.input1, input_path,
-                                    params.query.allowed_lateness_s)
+            raise ValueError(f"query.{qname} is empty")
+        if spec.stream in ("Polygon", "LineString"):
+            if params.input1.format.lower() not in ("wkt", "geojson"):
+                return None
+            parsed = _bulk_parse_geom_stream(params, input_path)
+        else:
+            parsed = _bulk_parse_stream(params.input1, input_path,
+                                        params.query.allowed_lateness_s)
         if parsed is None:
             return None
         conf = _query_conf(params, spec)
+        cls = _operator_class(spec)
         if spec.family == "range":
-            return ops.PointPointRangeQuery(conf, u_grid).run_multi_bulk(
+            return cls(conf, u_grid).run_multi_bulk(
                 parsed, qs, params.query.radius)
-        return ops.PointPointKNNQuery(conf, u_grid).run_multi_bulk(
+        return cls(conf, u_grid).run_multi_bulk(
             parsed, qs, params.query.radius, params.query.k)
     geom_stream = spec.stream in ("Polygon", "LineString")
     if geom_stream:
@@ -576,8 +593,7 @@ def run_option_bulk(params: Params, input_path: str,
         return ops.PointPointJoinQuery(conf, u_grid, u_grid).run_bulk(
             parsed, parsed2, params.query.radius)
     q = _query_object(params, u_grid, spec.query)
-    fam = "Range" if spec.family == "range" else "KNN"
-    cls = getattr(ops, f"{spec.stream}{spec.query}{fam}Query")
+    cls = _operator_class(spec)
     if spec.family == "range":
         return cls(conf, u_grid).run_bulk(parsed, q, params.query.radius)
     return cls(conf, u_grid).run_bulk(

@@ -422,6 +422,51 @@ class SpatialOperator:
             result.extras["queries"] = n_queries
             yield result
 
+    def _run_multi_filter_bulk(self, batched, n_queries: int,
+                               multi_mask_stats
+                               ) -> Iterator["WindowResult"]:
+        """Bulk twin of :meth:`_run_multi_filter`: ``batched`` yields
+        (start, end, (idx, batch)) window payloads; records become Q
+        per-query ORIGINAL-RECORD-INDEX lists from one (Q, N) mask dispatch
+        per window."""
+        import jax.numpy as jnp
+
+        def eval_batch(payload, ts_base):
+            idx, batch = payload
+            masks, gn_c, evals = self._multi_filter_stream(
+                batch, multi_mask_stats)
+
+            def rows(m):
+                m = np.asarray(m)  # ONE (Q, N) device->host transfer
+                return [idx[m[q][: len(idx)]].tolist()
+                        for q in range(n_queries)]
+
+            return self._defer_with_stats(
+                masks, (jnp.sum(gn_c), jnp.sum(evals)), rows)
+
+        for result in self._drive_batched(batched, eval_batch,
+                                          count=lambda p: len(p[0])):
+            result.extras["queries"] = n_queries
+            yield result
+
+    def _run_multi_knn_bulk(self, batched, n_queries: int, local, k: int,
+                            interner) -> Iterator["WindowResult"]:
+        """Bulk twin of the kNN multi loops: per-window (Q, k) results with
+        ids resolved through the parse-time ``interner``."""
+        import jax.numpy as jnp
+
+        def eval_batch(payload, ts_base):
+            _idx, batch = payload
+            res, evals = self._knn_multi_result(batch, local, k)
+            return self._defer_knn_multi(res, jnp.sum(evals),
+                                         interner=interner)
+
+        for result in self._drive_batched(batched, eval_batch,
+                                          count=lambda p: len(p[0])):
+            result.extras["k"] = k
+            result.extras["queries"] = n_queries
+            yield result
+
     def _multi_results(self, stream: Iterable, eval_batch
                        ) -> Iterator["WindowResult"]:
         """_drive for multi-query evaluators, whose per-window result is a

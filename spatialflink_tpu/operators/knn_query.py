@@ -145,26 +145,25 @@ class PointPointKNNQuery(SpatialOperator):
             result.extras["queries"] = len(query_points)
             yield result
 
+    def _bulk_batches(self, parsed, pad):
+        from spatialflink_tpu.streams.bulk import bulk_window_batches
+
+        return bulk_window_batches(parsed, self.conf.window_spec(),
+                                   self.grid, pad=pad)
+
     def run_multi_bulk(self, parsed, query_points, radius: float,
                        k: Optional[int] = None, *, pad: Optional[int] = None
                        ) -> Iterator[WindowResult]:
-        """Bulk-replay multi-query: vectorized window batches through the
-        same multi kernel; per-query (objID, distance) records resolve
-        through the parse-time interner (the ``--bulk --multi-query`` CLI
-        path)."""
+        """Bulk-replay multi-query (the ``--bulk --multi-query`` path) —
+        the shared base driver over point-stream windows."""
         k = k or self.conf.k
-        local = self._multi_local(query_points, radius, k)
-
-        def eval_batch(payload, ts_base):
-            _idx, batch = payload
-            res, evals = self._knn_multi_result(batch, local, k)
-            return self._defer_knn_multi(res, jnp.sum(evals),
-                                         interner=parsed.interner)
-
-        for result in self._drive_bulk(parsed, eval_batch, pad=pad):
-            result.extras["k"] = k
-            result.extras["queries"] = len(query_points)
-            yield result
+        batched = (
+            (start, end, (idx, batch))
+            for start, end, idx, batch in self._bulk_batches(parsed, pad)
+        )
+        return self._run_multi_knn_bulk(
+            batched, len(query_points),
+            self._multi_local(query_points, radius, k), k, parsed.interner)
 
 
 
@@ -252,6 +251,46 @@ class _GenericKnn(SpatialOperator, GeomQueryMixin):
     def _bulk_batches(self, parsed, pad):
         raise NotImplementedError
 
+    def _drive_multi(self, stream, n_queries: int, local, k: int
+                     ) -> Iterator[WindowResult]:
+        """Shared run_multi loop: ``local(batch)`` is the class's
+        multi-kernel closure (:meth:`_multi_local`) over the class's stream
+        batch form (:meth:`_batch`)."""
+        def eval_batch(records, ts_base):
+            if not records:
+                return [[] for _ in range(n_queries)]
+            batch = self._batch(records, ts_base)
+            res, evals = self._knn_multi_result(batch, local, k)
+            return self._defer_knn_multi(res, jnp.sum(evals))
+
+        for result in self._multi_results(stream, eval_batch):
+            result.extras["k"] = k
+            result.extras["queries"] = n_queries
+            yield result
+
+    def run_multi(self, stream, queries, radius: float,
+                  k: Optional[int] = None) -> Iterator[WindowResult]:
+        """Q queries in ONE dispatch per window — contract as
+        ``PointPointKNNQuery.run_multi`` (the class docstrings name the
+        kernel each pair rides)."""
+        k = k or self.conf.k
+        return self._drive_multi(stream, len(queries),
+                                 self._multi_local(queries, radius, k), k)
+
+    def run_multi_bulk(self, parsed, queries, radius: float,
+                       k: Optional[int] = None, *, pad: Optional[int] = None
+                       ) -> Iterator[WindowResult]:
+        """Bulk-replay multi-query over this class's vectorized window
+        source (the ``--bulk --multi-query`` path for the geometry pairs)."""
+        k = k or self.conf.k
+        batched = (
+            (start, end, (idx, batch))
+            for start, end, idx, batch in self._bulk_batches(parsed, pad)
+        )
+        return self._run_multi_knn_bulk(
+            batched, len(queries), self._multi_local(queries, radius, k), k,
+            parsed.interner)
+
 
 class _GeomStreamKnn(_GenericKnn):
     """Geometry-stream kNN base: EdgeGeomBatch construction + the
@@ -259,22 +298,6 @@ class _GeomStreamKnn(_GenericKnn):
 
     def _batch(self, records, ts_base):
         return self._geom_batch(records, ts_base)
-
-    def _drive_multi(self, stream, n_queries: int, eval_geoms, k: int
-                     ) -> Iterator[WindowResult]:
-        """Shared run_multi loop over geometry-stream window batches:
-        ``eval_geoms(geoms)`` -> (KnnResult (Q, k), dist_evals (Q,))."""
-        def eval_batch(records, ts_base):
-            if not records:
-                return [[] for _ in range(n_queries)]
-            batch = self._geom_batch(records, ts_base)
-            res, evals = self._knn_multi_result(batch, eval_geoms, k)
-            return self._defer_knn_multi(res, jnp.sum(evals))
-
-        for result in self._multi_results(stream, eval_batch):
-            result.extras["k"] = k
-            result.extras["queries"] = n_queries
-            yield result
 
     def _bulk_batches(self, parsed, pad):
         from spatialflink_tpu.streams.bulk import bulk_geom_window_batches
@@ -289,17 +312,11 @@ class PointGeomKNNQuery(_GenericKnn):
     """Point stream x polygon/linestring query (``PointPolygonKNNQuery``,
     ``PointLineStringKNNQuery``)."""
 
-    def run_multi(self, stream, query_geoms, radius: float,
-                  k: Optional[int] = None) -> Iterator[WindowResult]:
-        """Q polygon/linestring QUERIES over one point stream in ONE
-        dispatch per window (``ops.geom.knn_points_to_geom_queries`` — the
-        Q query geometries ride one padded edge batch and the existing
-        (N, G) lattice; selection is the batched dedup+top-k with the
-        exactness rescue). Same result contract as
-        ``PointPointKNNQuery.run_multi``: ``records[q]`` answers
-        ``query_geoms[q]``; approximate mode substitutes bbox distances;
-        shared radius; meshes like the PointPoint variant."""
-        k = k or self.conf.k
+    def _multi_local(self, query_geoms, radius: float, k: int):
+        """Q polygon/linestring QUERIES over a point stream: the Q query
+        geometries ride one padded edge batch and the existing (N, G)
+        lattice (``ops.geom.knn_points_to_geom_queries``); approximate mode
+        substitutes bbox distances."""
         from spatialflink_tpu.ops.geom import knn_points_to_geom_queries
 
         gb = self._query_geom_batch(query_geoms)
@@ -310,17 +327,7 @@ class PointGeomKNNQuery(_GenericKnn):
                 b, gb, nb_masks, k=k, strategy=self._knn_strategy(),
                 approximate=self.conf.approximate)
 
-        def eval_batch(records, ts_base):
-            if not records:
-                return [[] for _ in query_geoms]
-            batch = self._point_batch(records, ts_base)
-            res, evals = self._knn_multi_result(batch, local, k)
-            return self._defer_knn_multi(res, jnp.sum(evals))
-
-        for result in self._multi_results(stream, eval_batch):
-            result.extras["k"] = k
-            result.extras["queries"] = len(query_geoms)
-            yield result
+        return local
 
     def _setup(self, query, radius):
         return dict(nb=self._query_nb(query, radius),
@@ -354,22 +361,16 @@ class GeomPointKNNQuery(_GeomStreamKnn):
     """Polygon/linestring stream x point query (``PolygonPointKNNQuery``,
     ``LineStringPointKNNQuery``)."""
 
-    def run_multi(self, stream, query_points, radius: float,
-                  k: Optional[int] = None) -> Iterator[WindowResult]:
-        """Q query POINTS over one polygon/linestring stream in ONE dispatch
-        per window (``ops.geom.knn_geoms_to_point_queries``); same contract
-        as ``PointPointKNNQuery.run_multi``."""
-        k = k or self.conf.k
+    def _multi_local(self, query_points, radius: float, k: int):
+        """Q query POINTS over a polygon/linestring stream
+        (``ops.geom.knn_geoms_to_point_queries``)."""
         from spatialflink_tpu.ops.geom import knn_geoms_to_point_queries
 
         qx, qy, _qc = self._query_point_arrays(query_points)
         nb_masks = self._stack_query_nb(query_points, radius)
-        return self._drive_multi(
-            stream, len(query_points),
-            lambda geoms: knn_geoms_to_point_queries(
-                geoms, qx, qy, nb_masks, k=k, strategy=self._knn_strategy(),
-                approximate=self.conf.approximate),
-            k)
+        return lambda geoms: knn_geoms_to_point_queries(
+            geoms, qx, qy, nb_masks, k=k, strategy=self._knn_strategy(),
+            approximate=self.conf.approximate)
 
     def _setup(self, query, radius):
         return dict(nb=self._query_nb(query, radius), query=query)
@@ -393,23 +394,17 @@ class GeomGeomKNNQuery(_GeomStreamKnn):
     """Polygon/linestring stream x polygon/linestring query (the remaining
     4 pairs of SURVEY §2.2)."""
 
-    def run_multi(self, stream, query_geoms, radius: float,
-                  k: Optional[int] = None) -> Iterator[WindowResult]:
-        """Q query GEOMETRIES over one polygon/linestring stream in ONE
-        dispatch per window (``ops.geom.knn_geoms_to_geom_queries``); the Q
-        queries ride one exact-capacity padded edge batch. Same contract as
-        the other run_multi surfaces."""
-        k = k or self.conf.k
+    def _multi_local(self, query_geoms, radius: float, k: int):
+        """Q query GEOMETRIES over a polygon/linestring stream — one
+        exact-capacity padded query edge batch
+        (``ops.geom.knn_geoms_to_geom_queries``)."""
         from spatialflink_tpu.ops.geom import knn_geoms_to_geom_queries
 
         qgb = self._query_geom_batch(query_geoms)
         nb_masks = self._stack_query_nb(query_geoms, radius)
-        return self._drive_multi(
-            stream, len(query_geoms),
-            lambda geoms: knn_geoms_to_geom_queries(
-                geoms, qgb, nb_masks, k=k, strategy=self._knn_strategy(),
-                approximate=self.conf.approximate),
-            k)
+        return lambda geoms: knn_geoms_to_geom_queries(
+            geoms, qgb, nb_masks, k=k, strategy=self._knn_strategy(),
+            approximate=self.conf.approximate)
 
     def _setup(self, query, radius):
         return dict(nb=self._query_nb(query, radius),

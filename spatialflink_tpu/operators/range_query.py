@@ -25,7 +25,40 @@ from spatialflink_tpu.operators.base import (
 from spatialflink_tpu.ops.range import range_filter_point_stats
 
 
-class PointPointRangeQuery(SpatialOperator):
+class _RangeMultiBulkMixin:
+    """One run_multi_bulk body for every range pair: subclasses provide
+    the window source (:meth:`_bulk_batches`) and the per-class multi-mask
+    closure (:meth:`_multi_mask_stats`)."""
+
+    def _bulk_batches(self, parsed, pad):
+        raise NotImplementedError
+
+    def run_multi_bulk(self, parsed, queries, radius: float, *,
+                       pad: Optional[int] = None) -> Iterator[WindowResult]:
+        """Bulk-replay multi-query (the ``--bulk --multi-query`` path):
+        per-query original-record index lists from one (Q, N) mask dispatch
+        per window."""
+        batched = (
+            (start, end, (idx, batch))
+            for start, end, idx, batch in self._bulk_batches(parsed, pad)
+        )
+        return self._run_multi_filter_bulk(
+            batched, len(queries), self._multi_mask_stats(queries, radius))
+
+
+class _PointStreamBulkSource:
+    """Point-stream bulk window source shared by the point-stream range
+    classes' multi-bulk paths."""
+
+    def _bulk_batches(self, parsed, pad):
+        from spatialflink_tpu.streams.bulk import bulk_window_batches
+
+        return bulk_window_batches(parsed, self.conf.window_spec(),
+                                   self.grid, pad=pad)
+
+
+class PointPointRangeQuery(_PointStreamBulkSource, _RangeMultiBulkMixin,
+                           SpatialOperator):
     def run(self, stream: Iterable[Point], query_point: Point, radius: float
             ) -> Iterator[WindowResult]:
         return self._drive(
@@ -114,31 +147,6 @@ class PointPointRangeQuery(SpatialOperator):
             self._multi_mask_stats(query_points, radius),
             self._point_batch)
 
-    def run_multi_bulk(self, parsed, query_points: List[Point],
-                       radius: float, *, pad: Optional[int] = None
-                       ) -> Iterator[WindowResult]:
-        """Bulk-replay multi-query range: per-query original-record index
-        lists from one (Q, N) mask dispatch per window (the
-        ``--bulk --multi-query`` CLI path)."""
-        multi_mask_stats = self._multi_mask_stats(query_points, radius)
-
-        def eval_batch(payload, ts_base):
-            idx, batch = payload
-            masks, gn_c, evals = self._multi_filter_stream(
-                batch, multi_mask_stats)
-
-            def rows(m):
-                m = np.asarray(m)  # ONE (Q, N) device->host transfer
-                return [idx[m[q][: len(idx)]].tolist()
-                        for q in range(len(query_points))]
-
-            return self._defer_with_stats(
-                masks, (jnp.sum(gn_c), jnp.sum(evals)), rows)
-
-        for result in self._drive_bulk(parsed, eval_batch, pad=pad):
-            result.extras["queries"] = len(query_points)
-            yield result
-
     def run_incremental(self, stream: Iterable[Point], query_point: Point,
                         radius: float) -> Iterator[WindowResult]:
         """Incremental sliding windows: carry the previous window's survivors
@@ -165,7 +173,8 @@ class PointPointRangeQuery(SpatialOperator):
             yield WindowResult(start, end, list(out.values()))
 
 
-class PointGeomRangeQuery(SpatialOperator, GeomQueryMixin):
+class PointGeomRangeQuery(_PointStreamBulkSource, _RangeMultiBulkMixin,
+                          SpatialOperator, GeomQueryMixin):
     """Point stream x polygon/linestring query
     (``range/PointPolygonRangeQuery.java``, ``PointLineStringRangeQuery``).
 
@@ -215,21 +224,23 @@ class PointGeomRangeQuery(SpatialOperator, GeomQueryMixin):
             parsed, self._bulk_mask_eval(self._mask_stats_fn(query_geom, radius)),
             pad=pad)
 
-    def run_multi(self, stream: Iterable[Point], query_geoms,
-                  radius: float) -> Iterator[WindowResult]:
-        """Q polygon/linestring QUERIES over one point stream in ONE
-        dispatch per window (``ops.geom.range_points_to_geom_queries``);
-        same contract as ``PointPointRangeQuery.run_multi``."""
+    def _multi_mask_stats(self, query_geoms, radius: float):
         from spatialflink_tpu.ops.geom import range_points_to_geom_queries
 
         qgb = self._query_geom_batch(query_geoms)
         gn, cn = self._stack_query_masks(query_geoms, radius,
                                          which=("gn", "cn"))
+        return lambda batch: range_points_to_geom_queries(
+            batch, qgb, gn, cn, radius, approximate=self.conf.approximate)
+
+    def run_multi(self, stream: Iterable[Point], query_geoms,
+                  radius: float) -> Iterator[WindowResult]:
+        """Q polygon/linestring QUERIES over one point stream in ONE
+        dispatch per window (``ops.geom.range_points_to_geom_queries``);
+        same contract as ``PointPointRangeQuery.run_multi``."""
         return self._run_multi_filter(
             stream, len(query_geoms),
-            lambda batch: range_points_to_geom_queries(
-                batch, qgb, gn, cn, radius,
-                approximate=self.conf.approximate),
+            self._multi_mask_stats(query_geoms, radius),
             self._point_batch)
 
 
@@ -239,25 +250,29 @@ class _GeomStreamBulkMixin:
     -> the operator's own mask_stats kernels; results are original-record
     index lists, no per-record Python objects."""
 
-    def run_bulk(self, parsed, query, radius: float, *,
-                 pad: Optional[int] = None) -> Iterator[WindowResult]:
+    def _bulk_batches(self, parsed, pad):
         from spatialflink_tpu.streams.bulk import bulk_geom_window_batches
 
         # like base._geom_batch: the geometry dim must divide across the
         # mesh, so the per-window bucket floor rises to the device count
         min_bucket = max(8, self.conf.devices) if self.distributed else 8
+        return bulk_geom_window_batches(parsed, self.conf.window_spec(),
+                                        self.grid, pad=pad,
+                                        min_bucket=min_bucket)
+
+    def run_bulk(self, parsed, query, radius: float, *,
+                 pad: Optional[int] = None) -> Iterator[WindowResult]:
         batched = (
             (start, end, (idx, batch))
-            for start, end, idx, batch in bulk_geom_window_batches(
-                parsed, self.conf.window_spec(), self.grid, pad=pad,
-                min_bucket=min_bucket)
+            for start, end, idx, batch in self._bulk_batches(parsed, pad)
         )
         return self._drive_batched(
             batched, self._bulk_mask_eval(self._mask_stats_fn(query, radius)),
             count=lambda p: len(p[0]))
 
 
-class GeomPointRangeQuery(SpatialOperator, GeomQueryMixin, _GeomStreamBulkMixin):
+class GeomPointRangeQuery(SpatialOperator, GeomQueryMixin,
+                          _GeomStreamBulkMixin, _RangeMultiBulkMixin):
     """Polygon/linestring stream x point query
     (``range/PolygonPointRangeQuery.java``, ``LineStringPointRangeQuery``).
     GN-subset rule: a geometry passes without distance math only if ALL its
@@ -301,25 +316,29 @@ class GeomPointRangeQuery(SpatialOperator, GeomQueryMixin, _GeomStreamBulkMixin)
 
         return self._drive(stream, eval_batch)
 
-    def run_multi(self, stream: Iterable, query_points,
-                  radius: float) -> Iterator[WindowResult]:
-        """Q query POINTS over one polygon/linestring stream in ONE dispatch
-        per window (``ops.geom.range_geoms_to_point_queries`` — GN-subset
-        rule applied per query)."""
+    def _multi_mask_stats(self, query_points, radius: float):
         from spatialflink_tpu.ops.geom import range_geoms_to_point_queries
 
         qx, qy, _qc = self._query_point_arrays(query_points)
         gn, nb = self._stack_query_masks(query_points, radius,
                                          which=("gn", "nb"))
+        return lambda geoms: range_geoms_to_point_queries(
+            geoms, qx, qy, gn, nb, radius,
+            approximate=self.conf.approximate)
+
+    def run_multi(self, stream: Iterable, query_points,
+                  radius: float) -> Iterator[WindowResult]:
+        """Q query POINTS over one polygon/linestring stream in ONE dispatch
+        per window (``ops.geom.range_geoms_to_point_queries`` — GN-subset
+        rule applied per query)."""
         return self._run_multi_filter(
             stream, len(query_points),
-            lambda geoms: range_geoms_to_point_queries(
-                geoms, qx, qy, gn, nb, radius,
-                approximate=self.conf.approximate),
+            self._multi_mask_stats(query_points, radius),
             self._geom_batch)
 
 
-class GeomGeomRangeQuery(SpatialOperator, GeomQueryMixin, _GeomStreamBulkMixin):
+class GeomGeomRangeQuery(SpatialOperator, GeomQueryMixin,
+                         _GeomStreamBulkMixin, _RangeMultiBulkMixin):
     """Polygon/linestring stream x polygon/linestring query
     (``range/PolygonPolygonRangeQuery.java`` and the 3 sibling pairs)."""
 
@@ -361,21 +380,23 @@ class GeomGeomRangeQuery(SpatialOperator, GeomQueryMixin, _GeomStreamBulkMixin):
 
         return self._drive(stream, eval_batch)
 
-    def run_multi(self, stream: Iterable, query_geoms,
-                  radius: float) -> Iterator[WindowResult]:
-        """Q query GEOMETRIES over one polygon/linestring stream in ONE
-        dispatch per window (``ops.geom.range_geoms_to_geom_queries`` — the
-        Q queries ride one exact-capacity padded edge batch)."""
+    def _multi_mask_stats(self, query_geoms, radius: float):
         from spatialflink_tpu.ops.geom import range_geoms_to_geom_queries
 
         qgb = self._query_geom_batch(query_geoms)
         gn, nb = self._stack_query_masks(query_geoms, radius,
                                          which=("gn", "nb"))
+        return lambda geoms: range_geoms_to_geom_queries(
+            geoms, qgb, gn, nb, radius, approximate=self.conf.approximate)
+
+    def run_multi(self, stream: Iterable, query_geoms,
+                  radius: float) -> Iterator[WindowResult]:
+        """Q query GEOMETRIES over one polygon/linestring stream in ONE
+        dispatch per window (``ops.geom.range_geoms_to_geom_queries`` — the
+        Q queries ride one exact-capacity padded edge batch)."""
         return self._run_multi_filter(
             stream, len(query_geoms),
-            lambda geoms: range_geoms_to_geom_queries(
-                geoms, qgb, gn, nb, radius,
-                approximate=self.conf.approximate),
+            self._multi_mask_stats(query_geoms, radius),
             self._geom_batch)
 
 

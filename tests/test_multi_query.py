@@ -600,7 +600,7 @@ class TestOperatorMulti:
         p.input1.date_format = None
         res = list(drv.run_option_bulk(p, str(src)))
         assert res and res[0].extras["queries"] >= 1
-        p.query.option = 56  # Point-Polygon kNN: no bulk multi evaluator
+        p.query.option = 212  # trajectory kNN: record-path-only multi
         assert drv.run_option_bulk(p, str(src)) is None
 
     def test_driver_multi_query_empty_list_errors(self):
@@ -727,6 +727,80 @@ class TestOperatorMulti:
         p.query.option = 2011
         with pytest.raises(ValueError, match="naive-twin"):
             next(iter(run_option(p, lines)))
+
+    @pytest.mark.parametrize("option", (6,    # Point-Polygon range
+                                        56,   # Point-Polygon kNN
+                                        16,   # Polygon-Point range
+                                        71,   # Polygon-Polygon kNN
+                                        ))
+    def test_bulk_multi_geometry_cases_match_record_path(self, option,
+                                                         tmp_path):
+        """The widened --bulk --multi-query matrix: geometry queries over
+        point streams and geometry streams ride the bulk evaluators and
+        agree with the record path (kNN records identical; range per-query
+        counts identical — bulk range emits original-record indices)."""
+        import dataclasses
+
+        from spatialflink_tpu.config import Params
+        from spatialflink_tpu.driver import CASES, run_option, run_option_bulk
+        from spatialflink_tpu.streams.formats import serialize_spatial
+
+        spec = CASES[option]
+        src = tmp_path / "stream.txt"
+        if spec.stream == "Point":
+            rng = np.random.default_rng(41)
+            t0 = 1_700_000_000_000
+            line_ids = [f"v{i % 37}" for i in range(600)]
+            src.write_text("\n".join(
+                f"{line_ids[i]},{t0 + i * 40},{rng.uniform(116, 117):.6f},"
+                f"{rng.uniform(40, 41):.6f}" for i in range(600)) + "\n")
+            fmt = "CSV"
+        else:
+            geoms = self._geom_stream(200)
+            line_ids = [g.obj_id for g in geoms]
+            src.write_text("\n".join(
+                serialize_spatial(g, "WKT") for g in geoms) + "\n")
+            fmt = "WKT"
+
+        def params():
+            p = Params.from_yaml("conf/spatialflink-conf.yml")
+            p.query.option = option
+            p.query.radius = RADIUS
+            p.query.k = K
+            p.query.multi_query = True
+            p.query.query_points = [(116.3, 40.3), (116.7, 40.7)]
+            p.query.query_polygons = [
+                [(116.2, 40.2), (116.6, 40.2), (116.6, 40.6), (116.2, 40.2)],
+                [(116.5, 40.5), (116.9, 40.5), (116.9, 40.9), (116.5, 40.5)],
+            ]
+            p = dataclasses.replace(
+                p, input1=dataclasses.replace(p.input1, format=fmt))
+            p.input1.date_format = None
+            return p
+
+        bulk = list(run_option_bulk(params(), str(src)))
+        with open(src) as f:
+            rec = list(run_option(params(), f))
+        assert bulk and len(bulk) == len(rec), option
+        for b, r in zip(bulk, rec):
+            assert b.window_start == r.window_start
+            assert b.extras["queries"] == 2
+            if spec.family == "knn":
+                # geometry queries produce mass ties at distance 0 (points
+                # INSIDE the polygon); top-k of ties has no canonical
+                # member set, and the bulk/record batch layouts break ties
+                # differently — distances must agree exactly, members only
+                # where untied
+                for bq, rq in zip(b.records, r.records):
+                    assert [d for _, d in bq] == [d for _, d in rq], option
+            else:
+                # bulk range emits original-record indices; map them back
+                # through the source lines and require per-query obj_id
+                # MULTISETS to match the record path (counts alone would
+                # pass a transposed mask)
+                for bq, rq in zip(b.records, r.records):
+                    assert sorted(line_ids[i] for i in bq) == \
+                        sorted(p.obj_id for p in rq), option
 
     def test_cli_multi_query_flag(self, tmp_path, capsys):
         """--multi-query end-to-end through driver.main: the window summary

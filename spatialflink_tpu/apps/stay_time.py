@@ -96,6 +96,11 @@ def _segments_intersect_rects(x0, y0, x1, y1, rx0, ry0, rx1, ry1) -> np.ndarray:
 class StayTime(SpatialOperator):
     """Windowed stay-time pipeline over a :class:`UniformGrid`."""
 
+    # the normalized join pairs the point and sensor streams BY WINDOW
+    # START; count windows' starts are data timestamps that would never
+    # align across streams, so the app keeps time windows only
+    supports_count_windows = False
+
     # ------------------------------------------------------------------ #
     # stage 1: per-(objID, pair) stay-time shares
 

@@ -174,9 +174,11 @@ def _query_conf(params: Params, spec: CaseSpec) -> QueryConfiguration:
     if spec.mode == "realtime":
         qt = QueryType.RealTime
     elif params.window.type == "COUNT":
-        # supported for tAggregate only, like the reference
-        # (``TAggregateQuery.java:381-494``); every other operator raises
-        # "Not yet support" at construction (QueryType.java:6)
+        # sliding count windows for every single-stream windowed operator
+        # (the reference declares CountBased and throws "Not yet support"
+        # everywhere except tAggregate's per-cell variant, QueryType.java:6;
+        # here the mode is implemented — see operators/base.py
+        # _count_windows); joins/apps with bespoke window logic still raise
         qt = QueryType.CountBased
         # count windows interpret interval/step as raw element COUNTS — the
         # reference hands the same config values to countWindow un-scaled
@@ -521,6 +523,10 @@ def run_option_bulk(params: Params, input_path: str,
     case/format cannot ride it (caller falls back to the record path)."""
     spec = CASES.get(params.query.option)
     if spec is None or spec.mode != "window" or spec.latency:
+        return None
+    if params.window.type == "COUNT":
+        # count windows trigger on arrival ORDER; the bulk assemblers build
+        # event-time windows — the record path implements the mode
         return None
     if params.query.multi_query:
         # every range/kNN pair has a bulk multi-query evaluator (point

@@ -2,8 +2,9 @@
 
 Reference parity:
 - :class:`QueryType` — ``spatialOperators/QueryType.java:3-7`` (RealTime,
-  WindowBased, CountBased; CountBased is declared-but-unsupported in the
-  reference — here it raises the same way).
+  WindowBased, CountBased; the reference declares CountBased and throws
+  "Not yet support" everywhere except tAggregate — here sliding count
+  windows are IMPLEMENTED for every single-stream windowed operator).
 - :class:`QueryConfiguration` — ``spatialOperators/QueryConfiguration.java``
   plus the window/approximate fields the reference passes via ``Params``.
 - Real-time mode: the reference uses tiny tumbling windows with
@@ -33,7 +34,14 @@ from spatialflink_tpu.utils import IdInterner
 class QueryType(enum.Enum):
     RealTime = "realtime"
     WindowBased = "window"
-    CountBased = "count"  # declared but unsupported, like the reference
+    # the reference DECLARES CountBased and throws "Not yet support" in
+    # every operator except tAggregate (QueryType.java:6); here it is
+    # implemented: sliding count windows (every `slide` arrivals, the last
+    # `size` records) for every single-stream windowed operator — see
+    # SpatialOperator._windows. tAggregate keeps its per-cell counting
+    # (reference parity); two-stream joins and the apps with bespoke window
+    # logic still reject.
+    CountBased = "count"
 
 
 @dataclass
@@ -66,6 +74,14 @@ class QueryConfiguration:
     hosts: Optional[int] = None
 
     def window_spec(self) -> WindowSpec:
+        if self.query_type is QueryType.CountBased:
+            # count windows trigger on ARRIVAL ORDER (operators/base.py
+            # _count_windows); every caller of this method builds
+            # event-time windows (the bulk replay assemblers), which would
+            # silently reinterpret the count values as milliseconds
+            raise NotImplementedError(
+                "count windows are record-path only; bulk replay builds "
+                "event-time windows — run() implements CountBased")
         return WindowSpec.sliding(self.window_size_ms, self.slide_ms)
 
 
@@ -102,10 +118,13 @@ class WindowResult:
 class SpatialOperator:
     """Shared driver: turns a record stream into point-window batches."""
 
-    # CountBased is declared-but-unsupported in the reference for every
-    # operator EXCEPT tAggregate, which implements count windows
-    # (``tAggregate/TAggregateQuery.java:381-494``); operators opt in.
-    supports_count_windows = False
+    # CountBased: implemented for every single-stream windowed operator
+    # (the _windows assembler branches on it); the reference declares the
+    # mode and throws "Not yet support" everywhere except tAggregate's
+    # per-cell count windows (``TAggregateQuery.java:381-494``), which keep
+    # their keyed semantics. Two-stream joins (whose count trigger is
+    # ambiguous across sides) and apps with bespoke window logic opt OUT.
+    supports_count_windows = True
 
     def __init__(self, conf: QueryConfiguration, grid: UniformGrid,
                  grid2: Optional[UniformGrid] = None):
@@ -221,10 +240,37 @@ class SpatialOperator:
         return PointBatch.from_points(records, self.grid, self.interner, ts_base=ts_base)
 
     def _windows(self, stream: Iterable[Point]) -> Iterator[Tuple[int, int, List[Point]]]:
+        if self.conf.query_type is QueryType.CountBased:
+            yield from self._count_windows(stream)
+            return
         wa = WindowAssembler(self.conf.window_spec(), self.conf.allowed_lateness_ms)
         for rec in stream:
             yield from wa.add(rec.timestamp, rec)
         yield from wa.flush()
+
+    def _count_windows(self, stream: Iterable[Point]
+                       ) -> Iterator[Tuple[int, int, List[Point]]]:
+        """Sliding COUNT windows over the whole stream: every ``slide``
+        arrivals, evaluate the last ``size`` records (Flink
+        ``countWindow(size, slide)`` semantics on an un-keyed stream). In
+        count mode ``window_size_ms``/``slide_ms`` are COUNTS — the
+        reference hands the same config values to ``countWindow`` un-scaled
+        (the convention tAggregate's per-cell count windows already use).
+        Window bounds are the buffered records' min/max event times (count
+        windows have no wall-clock extent)."""
+        from collections import deque
+
+        size = max(1, int(self.conf.window_size_ms))
+        slide = max(1, int(self.conf.slide_ms))
+        buf: deque = deque(maxlen=size)
+        n = 0
+        for rec in stream:
+            buf.append(rec)
+            n += 1
+            if n % slide == 0:
+                records = list(buf)
+                yield (min(r.timestamp for r in records),
+                       max(r.timestamp for r in records), records)
 
     def _micro_batches(self, stream: Iterable[Point]) -> Iterator[List[Point]]:
         buf: List[Point] = []

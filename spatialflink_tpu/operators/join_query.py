@@ -71,6 +71,10 @@ def _merge_sorted_windows(gen_a, gen_b):
 
 
 class PointPointJoinQuery(SpatialOperator):
+    # a count trigger over TWO independently-arriving streams is ambiguous
+    # (whose arrivals count?); joins keep the reference's rejection
+    supports_count_windows = False
+
     prune_cells = True  # naive twins disable grid pruning (exact filter only)
 
     def run(self, ordinary: Iterable[Point], query_stream: Iterable[Point],

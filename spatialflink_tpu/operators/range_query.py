@@ -152,6 +152,10 @@ class PointPointRangeQuery(_PointStreamBulkSource, _RangeMultiBulkMixin,
         """Incremental sliding windows: carry the previous window's survivors
         and only evaluate records newer than the previous slide
         (``PointPointRangeQuery.queryIncremental``, ``:144-245``)."""
+        if self.conf.query_type is QueryType.CountBased:
+            raise NotImplementedError(
+                "run_incremental carries survivors by TIME cutoff; count "
+                "windows have no fixed temporal slide — use run()")
         prev: dict = {}  # id(record) -> record surviving from previous window
         prev_window_start = None
         for start, end, records in self._windows(stream):

@@ -313,7 +313,6 @@ class PointTAggregateQuery(SpatialOperator):
     ``slide_ms``-as-count arrivals.
     """
 
-    supports_count_windows = True
 
     def run(self, stream: Iterable[Point], aggregate: str = "SUM",
             traj_deletion_threshold_ms: int = 0, *,
@@ -650,6 +649,10 @@ class PointPointTJoinQuery(SpatialOperator):
     (``PointPointTJoinQuery.java:183-338``; the >=2-point rule is
     ``TJoinQuery.java:184``). Realtime mode emits point pairs.
     """
+
+    # two-stream join: the count trigger is ambiguous across sides — keep
+    # the construction-time rejection like the core joins
+    supports_count_windows = False
 
     def _inner(self, prune_cells: bool = True):
         from spatialflink_tpu.operators.join_query import PointPointJoinQuery

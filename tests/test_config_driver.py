@@ -238,14 +238,21 @@ def test_tsv_wkt_deser_uses_tab():
     assert CASES[601].delim == "\t" and CASES[501].delim is None
 
 
-def test_count_window_type_raises_like_reference():
-    """window.type COUNT maps to the declared-but-unsupported CountBased
-    query type (QueryType.java:6) and raises, not silently TIME windows."""
-    p = _params(1)
+def test_count_window_type_drives_count_mode():
+    """window.type COUNT runs sliding count windows through the driver
+    (implemented here; the reference declares CountBased and throws "Not
+    yet support", QueryType.java:6). Joins still raise — the count trigger
+    is ambiguous over two streams."""
+    p = _params(1, radius=0.5)
     p.window.type = "COUNT"
-    lines, _, _ = _synth_lines(n_traj=2, steps=2)
+    p.window.interval_s = 8   # COUNTS in count mode, like tAggregate
+    p.window.step_s = 4
+    lines, pts, _ = _synth_lines(n_traj=4, steps=6)
+    out = list(run_option(p, lines))
+    assert len(out) == len(pts) // 4
+    p.query.option = 101
     with pytest.raises(NotImplementedError):
-        list(run_option(p, lines))
+        list(run_option(p, lines, lines))
 
 
 def test_synthetic_harness_option99():

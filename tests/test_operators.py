@@ -75,11 +75,64 @@ class TestRangePipeline:
         assert results
         assert all(len(r.records) > 0 for r in results)
 
-    def test_count_based_raises(self):
-        with pytest.raises(NotImplementedError):
-            PointPointRangeQuery(
-                QueryConfiguration(query_type=QueryType.CountBased), GRID
-            )
+    def test_count_windows_match_deque_oracle(self):
+        """CountBased range (implemented here; the reference throws "Not
+        yet support", QueryType.java:6): every `slide` arrivals, the last
+        `size` records evaluate — oracle is a plain deque replay of the
+        same stream through the single-window evaluator semantics."""
+        from collections import deque
+
+        size, slide, r = 40, 15, 0.3
+        conf = QueryConfiguration(query_type=QueryType.CountBased,
+                                  window_size_ms=size, slide_ms=slide)
+        recs = list(source())
+        got = list(PointPointRangeQuery(conf, GRID).run(iter(recs), QUERY, r))
+        # oracle
+        import math
+
+        nb_mask = GRID.neighboring_cells_mask(r, QUERY.cell)
+
+        def within(p):
+            return bool(nb_mask[p.cell]) and \
+                math.hypot(p.x - QUERY.x, p.y - QUERY.y) <= r
+
+        buf, want = deque(maxlen=size), []
+        for i, p in enumerate(recs, 1):
+            buf.append(p)
+            if i % slide == 0:
+                want.append({q.obj_id for q in buf if q.cell >= 0
+                             and within(q)})
+        assert len(got) == len(want) > 0
+        for g, w in zip(got, want):
+            assert {p.obj_id for p in g.records} == w
+
+    def test_count_based_still_raises_for_joins(self):
+        """A count trigger over two independently-arriving streams is
+        ambiguous; joins (incl. the trajectory join) keep the reference's
+        construction-time rejection."""
+        from spatialflink_tpu.operators import (
+            PointPointJoinQuery,
+            PointPointTJoinQuery,
+        )
+
+        for cls in (PointPointJoinQuery, PointPointTJoinQuery):
+            with pytest.raises(NotImplementedError):
+                cls(QueryConfiguration(query_type=QueryType.CountBased),
+                    GRID)
+
+    def test_count_based_bulk_paths_refuse(self):
+        """Bulk replay assembles EVENT-TIME windows; under count mode its
+        window_spec() raises rather than silently reinterpreting counts as
+        milliseconds."""
+        conf = QueryConfiguration(query_type=QueryType.CountBased,
+                                  window_size_ms=40, slide_ms=15)
+        with pytest.raises(NotImplementedError, match="record-path only"):
+            conf.window_spec()
+        op = PointPointRangeQuery(conf, GRID)
+        with pytest.raises(NotImplementedError, match="record-path only"):
+            next(iter(op.run_multi_bulk(
+                __import__("types").SimpleNamespace(interner=None),
+                [QUERY], 0.3)))
 
     def test_incremental_matches_full(self):
         r = 0.3

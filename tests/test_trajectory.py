@@ -344,13 +344,23 @@ class TestTAggregateCountWindows:
         (res,) = list(op.run(iter(pts), "MAX"))
         assert res.records == [(pts[0].cell, "B", 100_000)]
 
-    def test_count_mode_rejected_for_other_operators(self):
+    def test_count_mode_rejected_for_joins_and_apps(self):
+        """Count windows are now implemented for single-stream operators
+        (range/kNN/trajectory); the two-stream joins and the bespoke-window
+        apps keep the rejection."""
         import pytest as _pytest
 
-        from spatialflink_tpu.operators import PointPointRangeQuery
+        from spatialflink_tpu.apps.check_in import CheckIn
+        from spatialflink_tpu.operators import (
+            PointPointJoinQuery,
+            PointPointRangeQuery,
+        )
 
+        PointPointRangeQuery(self._conf(4, 2), GRID)  # accepted now
         with _pytest.raises(NotImplementedError):
-            PointPointRangeQuery(self._conf(4, 2), GRID)
+            PointPointJoinQuery(self._conf(4, 2), GRID)
+        with _pytest.raises(NotImplementedError):
+            CheckIn(self._conf(4, 2))
 
     def test_driver_count_window_option_208(self):
         """window.type COUNT + option 208 runs count-window tAggregate with

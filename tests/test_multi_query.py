@@ -883,3 +883,35 @@ class TestOperatorMulti:
             f"{op_kind}: mesh degraded — distributed multi path broken"
         assert single == mesh, op_kind
 
+
+
+class TestCountModeComposition:
+    def test_count_windows_compose_with_multi_query(self):
+        """window.type COUNT + run_multi: Q queries per count window."""
+        conf = QueryConfiguration(QueryType.CountBased, window_size_ms=60,
+                                  slide_ms=30)
+        qs = [Point.create(116.3, 40.3, GRID), Point.create(116.7, 40.7, GRID)]
+        recs = _stream(300)
+        out = list(PointPointKNNQuery(conf, GRID).run_multi(
+            iter(recs), qs, RADIUS, K))
+        assert len(out) == len(recs) // 30
+        assert all(w.extras["queries"] == 2 for w in out)
+
+    def test_geom_stream_realtime_multi(self):
+        """Realtime micro-batch mode through a geometry-stream run_multi
+        (the empty-suppression gate applies to the per-query lists)."""
+        from spatialflink_tpu.operators import PolygonPointKNNQuery
+
+        conf = QueryConfiguration(QueryType.RealTime, 10_000, 5_000,
+                                  realtime_batch_size=64)
+        qs = [Point.create(116.3, 40.3, GRID), Point.create(116.7, 40.7, GRID)]
+        geoms = TestOperatorMulti()._geom_stream(150)
+        out = list(PolygonPointKNNQuery(conf, GRID).run_multi(
+            iter(geoms), qs, RADIUS, K))
+        assert out and all(len(w.records) == 2 for w in out)
+
+    def test_incremental_refuses_count_mode(self):
+        conf = QueryConfiguration(QueryType.CountBased, 40, 15)
+        with pytest.raises(NotImplementedError, match="temporal slide"):
+            next(iter(PointPointRangeQuery(conf, GRID).run_incremental(
+                iter(_stream(60)), Point.create(116.5, 40.5, GRID), 0.3)))

@@ -77,6 +77,31 @@ def main() -> int:
         got.dist[got.valid], np.asarray(want.dist)[np.asarray(want.valid)],
         atol=1e-6)
     print(f"DCN_OK {pid} {int(got.valid.sum())}", flush=True)
+
+    # multi-query over the same 2x2 mesh: per-query (Q, k) partials merge
+    # two-level (ICI then DCN) and must match the single-device vmapped
+    # kernel bit-for-bit in both processes
+    from spatialflink_tpu.ops.knn import knn_point_multi_stats
+    from spatialflink_tpu.parallel.ops import distributed_stream_knn_multi
+
+    mqx = jnp.asarray([116.3, 116.7], jnp.float32)
+    mqy = jnp.asarray([40.3, 40.7], jnp.float32)
+    mqc = jnp.asarray([int(grid.assign_cell(116.3, 40.3)[0]),
+                       int(grid.assign_cell(116.7, 40.7)[0])], jnp.int32)
+
+    def local(b):
+        return knn_point_multi_stats(b, mqx, mqy, mqc, radius, layers,
+                                     n=grid.n, k=10)
+
+    mgot, mevals = distributed_stream_knn_multi(mesh, sharded, local, k=10)
+    mgot = jax.tree.map(lambda a: np.asarray(jax.device_get(a)), mgot)
+    mwant, wevals = local(batch)
+    np.testing.assert_array_equal(mgot.obj_id, np.asarray(mwant.obj_id))
+    np.testing.assert_allclose(
+        mgot.dist[mgot.valid],
+        np.asarray(mwant.dist)[np.asarray(mwant.valid)], atol=1e-6)
+    assert int(np.asarray(mevals).sum()) == int(np.asarray(wevals).sum())
+    print(f"DCN_MULTI_OK {pid} {int(mgot.valid.sum())}", flush=True)
     return 0
 
 

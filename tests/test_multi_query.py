@@ -205,6 +205,28 @@ class TestMultiEdgeCases:
                     err_msg=f"trial={trial} n={n} q={q} k={k} {strategy}")
 
 
+def _geom_stream(n=200, seed=31):
+    from spatialflink_tpu.models import LineString, Polygon
+
+    rng = np.random.default_rng(seed)
+    t0 = 1_700_000_000_000
+    out = []
+    for i in range(n):
+        cx = float(rng.uniform(116.0, 117.0))
+        cy = float(rng.uniform(40.0, 41.0))
+        w = float(rng.uniform(0.01, 0.05))
+        if i % 3:
+            out.append(Polygon.create(
+                [[(cx - w, cy - w), (cx + w, cy - w), (cx + w, cy + w),
+                  (cx - w, cy + w), (cx - w, cy - w)]], GRID,
+                obj_id=f"g{i % 41}", timestamp=t0 + i * 60))
+        else:
+            out.append(LineString.create(
+                [(cx - w, cy), (cx, cy + w), (cx + w, cy)], GRID,
+                obj_id=f"g{i % 41}", timestamp=t0 + i * 60))
+    return out
+
+
 def _stream(n=600, seed=11):
     rng = np.random.default_rng(seed)
     t0 = 1_700_000_000_000
@@ -318,25 +340,7 @@ class TestOperatorMulti:
                 assert res.records[qi] == ref.records
 
     def _geom_stream(self, n=200, seed=31):
-        from spatialflink_tpu.models import LineString, Polygon
-
-        rng = np.random.default_rng(seed)
-        t0 = 1_700_000_000_000
-        out = []
-        for i in range(n):
-            cx = float(rng.uniform(116.0, 117.0))
-            cy = float(rng.uniform(40.0, 41.0))
-            w = float(rng.uniform(0.01, 0.05))
-            if i % 3:
-                out.append(Polygon.create(
-                    [[(cx - w, cy - w), (cx + w, cy - w), (cx + w, cy + w),
-                      (cx - w, cy + w), (cx - w, cy - w)]], GRID,
-                    obj_id=f"g{i % 41}", timestamp=t0 + i * 60))
-            else:
-                out.append(LineString.create(
-                    [(cx - w, cy), (cx, cy + w), (cx + w, cy)], GRID,
-                    obj_id=f"g{i % 41}", timestamp=t0 + i * 60))
-        return out
+        return _geom_stream(n, seed)
 
     @staticmethod
     def _assert_query_parity(multi_recs, single_recs, approximate):
@@ -905,7 +909,7 @@ class TestCountModeComposition:
         conf = QueryConfiguration(QueryType.RealTime, 10_000, 5_000,
                                   realtime_batch_size=64)
         qs = [Point.create(116.3, 40.3, GRID), Point.create(116.7, 40.7, GRID)]
-        geoms = TestOperatorMulti()._geom_stream(150)
+        geoms = _geom_stream(150)
         out = list(PolygonPointKNNQuery(conf, GRID).run_multi(
             iter(geoms), qs, RADIUS, K))
         assert out and all(len(w.records) == 2 for w in out)

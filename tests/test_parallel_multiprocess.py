@@ -48,3 +48,5 @@ def test_two_process_hierarchical_knn():
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"process {i} failed:\n{out[-3000:]}"
         assert f"DCN_OK {i}" in out, f"process {i} missing DCN_OK:\n{out[-3000:]}"
+        assert f"DCN_MULTI_OK {i}" in out, \
+            f"process {i} missing DCN_MULTI_OK:\n{out[-3000:]}"

@@ -293,6 +293,9 @@ def main() -> int:
                     help="comma-separated subset")
     args = ap.parse_args()
 
+    from benchmarks._common import settle_backend
+
+    settle_backend()  # a wedged tunnel downgrades to CPU instead of hanging
     import jax
 
     backend = jax.default_backend()
@@ -306,8 +309,13 @@ def main() -> int:
         row["scale"] = scale
         print(json.dumps(row), flush=True)
         rows.append(row)
+    # a SUBSET run must not silently replace the full ledger (compare the
+    # parsed sets — order/whitespace in --configs must not matter)
+    requested = {int(x) for x in args.configs.split(",")}
+    name = (f"RESULTS_{backend}.json" if requested >= set(fns)
+            else f"RESULTS_{backend}_partial.json")
     out = args.out or os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), f"RESULTS_{backend}.json")
+        os.path.dirname(os.path.abspath(__file__)), name)
     with open(out, "w") as f:
         json.dump({"backend": backend, "scale": scale, "rows": rows}, f,
                   indent=1)

@@ -23,6 +23,9 @@ def main() -> int:
     n_points = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
     k = 50
 
+    from benchmarks._common import settle_backend
+
+    settle_backend()  # a wedged tunnel downgrades to CPU instead of hanging
     import jax
     import jax.numpy as jnp
 

@@ -919,3 +919,23 @@ class TestCountModeComposition:
         with pytest.raises(NotImplementedError, match="temporal slide"):
             next(iter(PointPointRangeQuery(conf, GRID).run_incremental(
                 iter(_stream(60)), Point.create(116.5, 40.5, GRID), 0.3)))
+
+    def test_count_windows_compose_with_mesh(self):
+        """window.type COUNT + conf.devices: count-window batches shard over
+        the mesh like time windows — 8-dev ≡ 1-dev, no degradation."""
+        from spatialflink_tpu.utils.metrics import REGISTRY
+
+        def run(devices):
+            conf = QueryConfiguration(QueryType.CountBased, window_size_ms=60,
+                                      slide_ms=30, devices=devices)
+            qs = [Point.create(116.3, 40.3, GRID),
+                  Point.create(116.7, 40.7, GRID)]
+            return [w.records for w in
+                    PointPointKNNQuery(conf, GRID).run_multi(
+                        iter(_stream(300)), qs, RADIUS, K)]
+
+        single = run(None)
+        degr = REGISTRY.counter("mesh-degradations").count
+        mesh = run(8)
+        assert REGISTRY.counter("mesh-degradations").count == degr
+        assert single == mesh and len(single) == 10

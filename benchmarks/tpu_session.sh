@@ -49,6 +49,16 @@ except OSError:
 if row is None:
     print("# no valid TPU headline; banked row unchanged", file=sys.stderr)
     raise SystemExit(0)
+# ALWAYS record the latest valid run separately so a genuine TPU
+# regression is visible (the best-row bank below is a max statistic)
+latest = dict(row)
+latest.pop("banked_tpu_run", None)
+latest["measured_utc"] = datetime.datetime.now(
+    datetime.timezone.utc).strftime("%Y-%m-%dT%H:%MZ")
+with open("benchmarks/BENCH_tpu_latest.json", "w") as f:
+    json.dump(latest, f)
+print("# latest TPU headline -> benchmarks/BENCH_tpu_latest.json",
+      file=sys.stderr)
 # bench.py reads THIS fixed path (the script cd's to the repo root); only a
 # better number may replace the banked best
 path = "benchmarks/BENCH_tpu_r04_interactive.json"

@@ -46,11 +46,11 @@ def _symbol(device_id: str) -> str:
 
 
 class CheckIn(SpatialOperator):
+    """Occupancy pipeline. Grid-free: pass ``grid=None``."""
+
     # CheckIn owns its fixed countWindow(2,1)/countWindow(1) pipeline
     # (apps/CheckIn.java); the generic count mode does not apply
     supports_count_windows = False
-
-    """Occupancy pipeline. Grid-free: pass ``grid=None``."""
 
     def __init__(self, conf: QueryConfiguration, grid=None,
                  room_capacities: Optional[Dict[str, int]] = None):

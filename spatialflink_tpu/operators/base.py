@@ -107,7 +107,13 @@ class Deferred:
 
 @dataclass
 class WindowResult:
-    """One emitted result event: the records selected in [start, end)."""
+    """One emitted result event: the records selected in [start, end).
+
+    Count-window mode is the one exception to the half-open contract:
+    there the bounds are the buffered records' min/max event timestamps,
+    so ``window_end`` is INCLUSIVE (count windows have no wall-clock
+    extent — see ``SpatialOperator._count_windows``). Consumers that key
+    on spans must not mix the two conventions."""
 
     window_start: int
     window_end: int
@@ -257,7 +263,9 @@ class SpatialOperator:
         reference hands the same config values to ``countWindow`` un-scaled
         (the convention tAggregate's per-cell count windows already use).
         Window bounds are the buffered records' min/max event times (count
-        windows have no wall-clock extent)."""
+        windows have no wall-clock extent) — note ``window_end`` is
+        therefore INCLUSIVE here, unlike the half-open time windows; see
+        :class:`WindowResult`."""
         from collections import deque
 
         size = max(1, int(self.conf.window_size_ms))

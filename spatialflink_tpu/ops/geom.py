@@ -240,7 +240,13 @@ def range_points_to_geom_queries(points: PointBatch, queries: EdgeGeomBatch,
     -> (masks (Q, N), gn_bypassed (Q,), dist_evals (Q,)). Per query, a vmap
     of the single-query expressions — dense GN/CN masks + exact geometry
     distance (bbox distance in approximate mode, which still passes through
-    the radius check like the single path)."""
+    the radius check like the single path).
+
+    Exact mode computes distances via the (N, G) lattice while the
+    single-query path uses the static-``is_areal`` single-geom kernel, so
+    ``run()`` and ``run_multi()`` may disagree on radius-BOUNDARY records
+    in the last ulp on TPU (different reduction orders); CPU parity tests
+    cannot observe this. TPU_NOTES §7 carries the on-chip parity check."""
     from spatialflink_tpu.ops.range import range_filter_masks_stats
 
     if approximate:

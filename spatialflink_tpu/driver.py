@@ -680,6 +680,210 @@ def _enable_compilation_cache() -> None:
         print(f"note: compilation cache disabled ({e})", file=sys.stderr)
 
 
+def _parse_fn(cfg: StreamConfig, grid: UniformGrid, geometry: str):
+    """The per-record parse :func:`decode_stream` applies, as a plain
+    callable (the Kafka commit tap parses BEFORE the pipeline so it can read
+    event times; decode_stream then passes the parsed objects through)."""
+    def parse(rec):
+        if isinstance(rec, SpatialObject):
+            return rec
+        return parse_spatial(rec, cfg.format, grid, delimiter=cfg.delimiter,
+                             schema=cfg.csv_tsv_schema, geometry=geometry,
+                             **cfg.geojson_kwargs())
+    return parse
+
+
+def _preproduce(broker, topic: str, path: str, limit: Optional[int]) -> None:
+    """Produce the file to the topic EXACTLY ONCE across restarts: records
+    already in the topic count as the file's prefix (this mode assumes the
+    topic is fed only by this file), so a re-run of the same command after a
+    crash — even a crash mid-preproduce — resumes producing at the first
+    missing record instead of appending a duplicate copy (which would
+    corrupt every window still covered by uncommitted offsets) or silently
+    truncating the dataset."""
+    from spatialflink_tpu.streams.sources import FileReplaySource
+
+    have = broker.end_offset(topic)
+    lim = None if limit is None else max(0, limit - have)
+    n = 0
+    for line in FileReplaySource(path, limit=lim, skip=have):
+        broker.produce(topic, line)
+        n += 1
+    if have and n:
+        print(f"# topic '{topic}' already held {have} records (interrupted "
+              f"produce?); resumed {path} from record {have} (+{n})",
+              file=sys.stderr)
+    elif have:
+        print(f"# topic '{topic}' already holds {have} records; NOT "
+              f"re-producing {path} (restart detected — consumption resumes "
+              "from the group's committed offset)", file=sys.stderr)
+    else:
+        print(f"# produced {n} records from {path} -> topic '{topic}'",
+              file=sys.stderr)
+
+
+# the operator families whose window-mode pipelines run records through the
+# shared event-time WindowAssembler — eligible for window-aligned offset
+# commits and the marker-keyed output sink (apps/deser have bespoke result
+# shapes and commit only on full drain)
+_KAFKA_WINDOWED_FAMILIES = ("range", "knn", "join", "tfilter", "trange",
+                            "tstats", "taggregate", "tjoin", "tknn")
+
+
+@dataclass
+class _KafkaWiring:
+    """The driver's broker-backed I/O: sources (+ commit taps), the
+    marker-keyed window sink, the plain record sink, and the latency topic
+    (reference topology: ``StreamingJob.java:473,512`` +
+    ``HelperClass.java:455-529``)."""
+
+    broker: object
+    stream1: Iterable
+    stream2: Optional[Iterable]
+    sources: List
+    taps: List
+    win_sink: Optional[object]
+    plain_sink: object
+    latency_topic: str
+    group: str
+    #: for realtime single-stream cases: commit position minus this lag on
+    #: every emitted result — any record more than pipeline_depth+1
+    #: micro-batches behind the read head is in a long-emitted batch, so a
+    #: restart reprocesses a bounded tail instead of the whole topic
+    commit_lag: Optional[int] = None
+
+    def emit(self, result) -> None:
+        """Produce one pipeline result, then advance window-aligned commits
+        (produce-before-commit is the at-least-once ordering)."""
+        if isinstance(result, WindowResult) and self.win_sink is not None:
+            self.win_sink.emit(result)
+            for tap in self.taps:
+                tap.on_window_emitted(result.window_end)
+        elif isinstance(result, WindowResult):
+            for rec in result.flat_records():
+                self.plain_sink.emit(rec)
+        elif (isinstance(result, tuple) and len(result) == 2
+                and isinstance(result[0], SpatialObject)):
+            # deser-family (obj, serialized) conformance pairs
+            self.plain_sink.emit(result[0])
+        else:
+            self.plain_sink.emit(result)
+        lats = (result.extras.get("latency_ms")
+                if isinstance(result, WindowResult) else None)
+        if lats:
+            for v in lats:
+                self.broker.produce(self.latency_topic, v)
+        if self.commit_lag is not None:
+            for src in self.sources:
+                src.commit_to(max(0, src.position - self.commit_lag))
+
+    def finish(self) -> None:
+        """Bounded input fully drained + flushed: every consumed record is
+        reflected in produced output, so the full positions commit. NOT
+        called on a control-tuple stop or crash — the conservative
+        window-aligned commits stand, and restart re-delivers."""
+        tapped = {id(t.source) for t in self.taps}
+        for tap in self.taps:
+            tap.commit_all()
+        for src in self.sources:
+            if id(src) not in tapped:
+                src.commit_to(src.position)
+
+    def summary(self) -> str:
+        parts = []
+        if self.win_sink is not None:
+            parts.append(f"{self.win_sink.windows_produced} windows produced"
+                         f" (+{self.win_sink.duplicates_suppressed} "
+                         "re-delivered suppressed)")
+        parts.append("committed " + ", ".join(
+            f"{s.topic}@{s.broker.committed(s.topic, s.group)}"
+            for s in self.sources))
+        return "# kafka: " + "; ".join(parts)
+
+
+def _wire_kafka(params: Params, spec: CaseSpec, args, skip1: int
+                ) -> _KafkaWiring:
+    from spatialflink_tpu.streams.kafka import (KafkaSink, KafkaSource,
+                                                KafkaWindowSink,
+                                                WindowCommitTap,
+                                                resolve_broker)
+
+    bootstrap = args.kafka_bootstrap or params.kafka_bootstrap_servers
+    broker = resolve_broker(bootstrap)
+    group = args.kafka_group
+    t1, t2 = params.input1.topic_name, params.input2.topic_name
+    # bounded replay THROUGH the broker: file records become topic records
+    if args.input1:
+        _preproduce(broker, t1, args.input1, args.limit)
+    if args.input2:
+        _preproduce(broker, t2, args.input2, args.limit)
+    # a checkpointed resume seeks the group past the records the saved state
+    # already reflects — the file path's skip, as an offset commit (commit
+    # is monotone, so an older checkpoint can never rewind the group)
+    if skip1:
+        broker.commit(t1, group, skip1)
+    follow = bool(args.kafka_follow)
+    # --limit bounds THIS run's consumption per stream (from the group's
+    # resume point), mirroring the file path's record bound
+    src1 = KafkaSource(broker, t1, group, auto_commit=False,
+                       stop_at_end=not follow, limit=args.limit)
+    sources = [src1]
+    src2 = None
+    if (spec.family in ("join", "tjoin")
+            or (spec.family == "staytime" and spec.query == "Polygon")):
+        src2 = KafkaSource(broker, t2, group, auto_commit=False,
+                           stop_at_end=not follow, limit=args.limit)
+        sources.append(src2)
+
+    u_grid, q_grid = params.grids()
+    size_ms, step_ms = params.window_ms()
+    windowed = (spec.mode == "window" and params.window.type != "COUNT"
+                and spec.family in _KAFKA_WINDOWED_FAMILIES)
+    commit_lag = None
+    if spec.mode == "realtime" and spec.family in ("range", "knn"):
+        # stateless single-stream micro-batches: a lagged commit bounds
+        # restart reprocessing (join's rolling buffer and the stateful
+        # trajectory/app cases keep end-only commits — their records stay
+        # live past their own batch)
+        qc = _query_conf(params, spec)
+        commit_lag = (max(1, qc.pipeline_depth) + 1) * qc.realtime_batch_size
+    if follow and not windowed and commit_lag is None and not (
+            args.checkpoint and spec.family in ("tstats", "taggregate")):
+        raise ValueError(
+            "--kafka-follow needs a case with incremental commit support "
+            "(event-time windowed families, realtime range/kNN, or "
+            "checkpointed tStats/tAggregate with --checkpoint): an "
+            "unbounded run of this case would never advance the group "
+            "offset and a restart would reprocess the entire topic")
+    taps: List = []
+    stream1: Iterable = src1
+    stream2: Optional[Iterable] = src2
+    if windowed:
+        geom1 = spec.stream if spec.family in ("range", "knn", "join") \
+            else "Point"
+        stream1 = WindowCommitTap(src1, size_ms, step_ms,
+                                  parse=_parse_fn(params.input1, u_grid,
+                                                  geom1))
+        taps.append(stream1)
+        if src2 is not None:
+            geom2 = spec.query if spec.family == "join" else "Point"
+            stream2 = WindowCommitTap(src2, size_ms, step_ms,
+                                      parse=_parse_fn(params.input2, q_grid,
+                                                      geom2))
+            taps.append(stream2)
+
+    out = params.output.topic_name
+    sink_kw = dict(fmt=args.output_format,
+                   date_format=params.input1.date_format,
+                   delimiter=params.output.delimiter)
+    win_sink = KafkaWindowSink(broker, out, **sink_kw) if windowed else None
+    return _KafkaWiring(
+        broker=broker, stream1=stream1, stream2=stream2, sources=sources,
+        taps=taps, win_sink=win_sink,
+        plain_sink=KafkaSink(broker, out, **sink_kw),
+        latency_topic=out + "-latency", group=group, commit_lag=commit_lag)
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="spatialflink-tpu",
@@ -731,6 +935,26 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "one dispatch per window (run_multi; default keeps "
                          "reference parity: first query object only). "
                          "All nine range and kNN pairs, plus trajectory kNN")
+    ap.add_argument("--kafka", action="store_true",
+                    help="consume inputStream{1,2}.topicName and produce "
+                         "results to outputStream.topicName through the "
+                         "broker named by kafkaBootStrapServers "
+                         "('memory://<name>' = the in-process shim; anything "
+                         "else = a real cluster via kafka-python) — the "
+                         "reference's FlinkKafkaConsumer/Producer topology "
+                         "(StreamingJob.java:473,512). --input1/--input2 "
+                         "files, when given, are pre-produced to the input "
+                         "topics first (bounded replay through the broker)")
+    ap.add_argument("--kafka-group", default="spatialflink",
+                    help="consumer group id (restart resumes from the "
+                         "group's committed offsets; default 'spatialflink')")
+    ap.add_argument("--kafka-bootstrap", default=None,
+                    help="override kafkaBootStrapServers from the config")
+    ap.add_argument("--kafka-follow", action="store_true",
+                    help="live mode: keep polling past the current end of "
+                         "the input topic instead of stopping (a producer "
+                         "feeds the topic concurrently; stop with the "
+                         "control tuple)")
     args = ap.parse_args(argv)
 
     _enable_compilation_cache()
@@ -772,7 +996,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     if spec is None:
         print(f"unknown queryOption {params.query.option}", file=sys.stderr)
         return 2
-    if not args.input1 and spec.family not in ("synthetic",):
+    if args.kafka and args.bulk:
+        ap.error("--kafka and --bulk are mutually exclusive "
+                 "(bulk is whole-file replay, not a broker stream)")
+    if args.kafka and spec.family in ("shapefile", "synthetic"):
+        ap.error(f"--kafka does not apply to the {spec.family} cases "
+                 "(no input topic)")
+    if not args.input1 and not args.kafka and spec.family not in ("synthetic",):
         print("--input1 is required for this queryOption", file=sys.stderr)
         return 2
     # a resumed checkpointed run must not re-apply records the saved state
@@ -793,13 +1023,21 @@ def main(argv: Optional[List[str]] = None) -> int:
     limit1 = args.limit
     if skip1 and limit1 is not None:
         limit1 = max(0, limit1 - skip1)
-    if spec.family == "shapefile":
+    kafka = None
+    if args.kafka:
+        try:
+            kafka = _wire_kafka(params, spec, args, skip1)
+        except ValueError as e:
+            ap.error(str(e))
+        stream1, stream2 = kafka.stream1, kafka.stream2
+    elif spec.family == "shapefile":
         stream1 = args.input1
     elif spec.family == "synthetic":
         stream1 = []
     else:
         stream1 = FileReplaySource(args.input1, limit=limit1, skip=skip1)
-    stream2 = FileReplaySource(args.input2, limit=args.limit) if args.input2 else None
+    if not args.kafka:
+        stream2 = FileReplaySource(args.input2, limit=args.limit) if args.input2 else None
 
     from spatialflink_tpu.utils.metrics import ControlTupleExit
 
@@ -828,15 +1066,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         for result in results:
             _emit(result, sink)
             n += 1
+            if kafka is not None:
+                kafka.emit(result)
             if out_sink is not None:
                 if isinstance(result, WindowResult):
-                    recs = result.records
-                    if "queries" in result.extras:
-                        # multi-query windows: records is one list per
-                        # query; flatten so the file keeps its one-record-
-                        # per-line contract across queries
-                        recs = [r for per_query in recs for r in per_query]
-                    for rec in recs:
+                    for rec in result.flat_records():
                         out_sink.emit(rec)
                 elif (isinstance(result, tuple) and len(result) == 2
                         and isinstance(result[0], SpatialObject)):
@@ -853,6 +1087,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     finally:
         if out_sink is not None:
             out_sink.close()
+    if kafka is not None:
+        if not stopped:
+            # fully drained bounded topic: full positions are safe to commit.
+            # A control-tuple stop keeps the conservative window-aligned
+            # commits instead (buffered-but-unfired windows re-deliver).
+            kafka.finish()
+        print(kafka.summary(), file=sys.stderr)
     print(f"# emitted {n} results" + (" (control-tuple stop)" if stopped else ""),
           file=sys.stderr)
     if out_sink is not None:

@@ -120,6 +120,15 @@ class WindowResult:
     records: List = field(default_factory=list)
     extras: dict = field(default_factory=dict)
 
+    def flat_records(self) -> List:
+        """Records flattened across the multi-query axis: ``records`` is one
+        list per query when ``extras['queries']`` is set (run_multi
+        windows); every record sink flattens through here so the
+        one-record-per-line/message contract cannot drift per sink."""
+        if "queries" in self.extras:
+            return [r for per_query in self.records for r in per_query]
+        return self.records
+
 
 class SpatialOperator:
     """Shared driver: turns a record stream into point-window batches."""

@@ -22,6 +22,11 @@ from spatialflink_tpu.streams.kafka import (
     KafkaLatencySink,
     KafkaSink,
     KafkaSource,
+    KafkaWindowSink,
+    WindowCommitTap,
+    connect_kafka,
+    reset_memory_brokers,
+    resolve_broker,
 )
 
 __all__ = [
@@ -30,6 +35,11 @@ __all__ = [
     "KafkaLatencySink",
     "KafkaSink",
     "KafkaSource",
+    "KafkaWindowSink",
+    "WindowCommitTap",
+    "connect_kafka",
+    "reset_memory_brokers",
+    "resolve_broker",
     "parse_spatial",
     "serialize_spatial",
     "FileReplaySource",

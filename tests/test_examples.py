@@ -30,6 +30,7 @@ def _run(script: str) -> str:
     ("distributed_knn.py", "matches single-device bit-for-bit"),
     ("checkpoint_resume.py", "matches uninterrupted run"),
     ("multi_query_hotspots.py", "standing queries x"),
+    ("live_kafka_stream.py", "live latency p50="),
 ])
 def test_example_runs(script, expect):
     out = _run(script)

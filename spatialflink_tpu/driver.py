@@ -755,8 +755,11 @@ class _KafkaWiring:
     def emit(self, result) -> None:
         """Produce one pipeline result, then advance window-aligned commits
         (produce-before-commit is the at-least-once ordering)."""
+        suppressed = False
         if isinstance(result, WindowResult) and self.win_sink is not None:
+            before = self.win_sink.duplicates_suppressed
             self.win_sink.emit(result)
+            suppressed = self.win_sink.duplicates_suppressed > before
             for tap in self.taps:
                 tap.on_window_emitted(result.window_end)
         elif isinstance(result, WindowResult):
@@ -770,7 +773,10 @@ class _KafkaWiring:
             self.plain_sink.emit(result)
         lats = (result.extras.get("latency_ms")
                 if isinstance(result, WindowResult) else None)
-        if lats:
+        if lats and not suppressed:
+            # a window the sink suppressed as a re-delivered duplicate must
+            # not double its latency samples either (and restart-time
+            # re-deliveries would skew the distribution upward)
             for v in lats:
                 self.broker.produce(self.latency_topic, v)
         if self.commit_lag is not None:
@@ -809,9 +815,30 @@ def _wire_kafka(params: Params, spec: CaseSpec, args, skip1: int
                                                 resolve_broker)
 
     bootstrap = args.kafka_bootstrap or params.kafka_bootstrap_servers
-    broker = resolve_broker(bootstrap)
     group = args.kafka_group
     t1, t2 = params.input1.topic_name, params.input2.topic_name
+    windowed = (spec.mode == "window" and params.window.type != "COUNT"
+                and spec.family in _KAFKA_WINDOWED_FAMILIES)
+    commit_lag = None
+    if spec.mode == "realtime" and spec.family in ("range", "knn"):
+        # stateless single-stream micro-batches: a lagged commit bounds
+        # restart reprocessing (join's rolling buffer and the stateful
+        # trajectory/app cases keep end-only commits — their records stay
+        # live past their own batch)
+        qc = _query_conf(params, spec)
+        commit_lag = (max(1, qc.pipeline_depth) + 1) * qc.realtime_batch_size
+    # validate BEFORE any broker side effect (a rejected command must not
+    # leave records on a shared cluster's input topic)
+    if args.kafka_follow and not windowed and commit_lag is None and not (
+            args.checkpoint and spec.family in ("tstats", "taggregate")):
+        raise ValueError(
+            "--kafka-follow needs a case with incremental commit support "
+            "(event-time windowed families, realtime range/kNN, or "
+            "checkpointed tStats/tAggregate with --checkpoint): an "
+            "unbounded run of this case would never advance the group "
+            "offset and a restart would reprocess the entire topic")
+
+    broker = resolve_broker(bootstrap)
     # bounded replay THROUGH the broker: file records become topic records
     if args.input1:
         _preproduce(broker, t1, args.input1, args.limit)
@@ -837,24 +864,6 @@ def _wire_kafka(params: Params, spec: CaseSpec, args, skip1: int
 
     u_grid, q_grid = params.grids()
     size_ms, step_ms = params.window_ms()
-    windowed = (spec.mode == "window" and params.window.type != "COUNT"
-                and spec.family in _KAFKA_WINDOWED_FAMILIES)
-    commit_lag = None
-    if spec.mode == "realtime" and spec.family in ("range", "knn"):
-        # stateless single-stream micro-batches: a lagged commit bounds
-        # restart reprocessing (join's rolling buffer and the stateful
-        # trajectory/app cases keep end-only commits — their records stay
-        # live past their own batch)
-        qc = _query_conf(params, spec)
-        commit_lag = (max(1, qc.pipeline_depth) + 1) * qc.realtime_batch_size
-    if follow and not windowed and commit_lag is None and not (
-            args.checkpoint and spec.family in ("tstats", "taggregate")):
-        raise ValueError(
-            "--kafka-follow needs a case with incremental commit support "
-            "(event-time windowed families, realtime range/kNN, or "
-            "checkpointed tStats/tAggregate with --checkpoint): an "
-            "unbounded run of this case would never advance the group "
-            "offset and a restart would reprocess the entire topic")
     taps: List = []
     stream1: Iterable = src1
     stream2: Optional[Iterable] = src2
@@ -925,6 +934,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "lines)")
     ap.add_argument("--metrics", action="store_true",
                     help="print a metrics snapshot to stderr at exit")
+    ap.add_argument("--profile", metavar="DIR", default=None,
+                    help="capture a jax.profiler trace of the run to DIR "
+                         "(TensorBoard/XProf format) with per-operator "
+                         "dispatch/readback annotations — the reference's "
+                         "Flink web UI observability as a trace "
+                         "(StreamingJob.java:70-72)")
     ap.add_argument("--bulk", action="store_true",
                     help="vectorized replay fast path (native ingest + bulk "
                          "windows) for windowed Point/Point range, kNN and "
@@ -1060,6 +1075,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         out_sink = FileSink(args.output, args.output_format,
                             delimiter=params.output.delimiter,
                             date_format=params.input1.date_format)
+    import contextlib
+
+    stack = contextlib.ExitStack()
+    if args.profile:
+        from spatialflink_tpu.utils.metrics import profile_to
+
+        stack.enter_context(profile_to(args.profile))
+        print(f"# profiling to {args.profile} (view with TensorBoard/xprof)",
+              file=sys.stderr)
     n = 0
     stopped = False
     try:
@@ -1085,6 +1109,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         # a graceful shutdown, not an error: finish the summary and exit 0
         stopped = True
     finally:
+        stack.close()  # stop the profiler trace before the summary prints
         if out_sink is not None:
             out_sink.close()
     if kafka is not None:

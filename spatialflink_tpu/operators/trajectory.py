@@ -230,13 +230,67 @@ class PointTStatsQuery(SpatialOperator):
             for start, end, records in self._windows(stream):
                 if allowed:
                     records = [p for p in records if p.obj_id in allowed]
-                store = TrajStateStore()  # fresh per window
-                tuples = self._update(store, records, start)
-                # windowed mode reports one tuple per trajectory (final stats)
-                final: Dict[str, Tuple] = {}
-                for t in tuples:
-                    final[t[0]] = t
-                yield WindowResult(start, end, list(final.values()))
+                if self.distributed and records:
+                    tuples = self._window_tuples_distributed(records, start)
+                else:
+                    tuples = self._window_tuples_single(records, start)
+                yield WindowResult(start, end, tuples)
+
+    def _window_tuples_single(self, records: List[Point], start: int
+                              ) -> List[Tuple]:
+        from spatialflink_tpu.runtime.state import TrajStateStore
+
+        store = TrajStateStore()  # fresh per window
+        tuples = self._update(store, records, start)
+        # windowed mode reports one tuple per trajectory (final stats)
+        final: Dict[str, Tuple] = {}
+        for t in tuples:
+            final[t[0]] = t
+        return list(final.values())
+
+    def _sorted_dedup(self, records: List[Point]) -> List[Point]:
+        """Global (interned objID, ts) stable sort + exact-duplicate drop —
+        the precondition of the sharded window summary (each shard must hold
+        a contiguous slice of every trajectory's run, and the kernel's tie
+        rule must have nothing left to drop ACROSS a shard boundary).
+        Results are unchanged single-device: the kernel sorts and
+        tie-drops internally anyway."""
+        keyed = sorted((self.interner.intern(p.obj_id), p.timestamp, i)
+                       for i, p in enumerate(records))
+        out: List[Point] = []
+        last = None
+        for k_oid, k_ts, i in keyed:
+            if (k_oid, k_ts) == last:
+                continue
+            last = (k_oid, k_ts)
+            out.append(records[i])
+        return out
+
+    def _window_tuples_distributed(self, records: List[Point], start: int
+                                   ) -> List[Tuple]:
+        """Mesh-sharded windowed stats: per-shard summaries + boundary
+        stitch (parallel.ops.distributed_tstats_window); falls back to the
+        single-device path under elastic degradation. Emission order is
+        ascending interned id — the same first-seen order the single path's
+        dict preserves."""
+        from spatialflink_tpu.parallel.ops import distributed_tstats_window
+
+        recs = self._sorted_dedup(records)
+        batch = self._point_batch(recs, start)
+        m = len(self.interner)
+
+        def dist(mesh, sharded):
+            sp, tp, cnt = distributed_tstats_window(mesh, sharded, m=m)
+            sp, tp = np.asarray(sp), np.asarray(tp)
+            out: List[Tuple] = []
+            for o in np.nonzero(np.asarray(cnt) >= 2)[0]:
+                t, s = float(tp[o]), float(sp[o])
+                out.append((self.interner.lookup(int(o)), s,
+                            int(round(t)), s / t if t > 0 else 0.0))
+            return out
+
+        return self._eval_degradable(
+            lambda: self._window_tuples_single(records, start), dist, batch)
 
     def _save_checkpoint(self, store, ts_base: int, path: str,
                          consumed: int = 0) -> None:
@@ -336,8 +390,10 @@ class PointTAggregateQuery(SpatialOperator):
                 yield WindowResult(start, end, [])
                 continue
             batch = self._point_batch(records, start)
-            groups = taggregate_groups(batch, num_cells=self.grid.num_cells)
+            out = self._stream_dispatch(batch, self._window_local(agg),
+                                        self._window_dist(agg))
             if agg == "ALL":
+                groups = out
                 first = np.asarray(groups.first)
                 records_out = list(zip(
                     np.asarray(groups.cell)[first].tolist(),
@@ -347,8 +403,33 @@ class PointTAggregateQuery(SpatialOperator):
                 ))
                 yield WindowResult(start, end, records_out)
             else:
-                hm = taggregate_heatmap(groups, num_cells=self.grid.num_cells, agg=agg)
-                yield WindowResult(start, end, [], extras={"heatmap": np.asarray(hm)})
+                yield WindowResult(start, end, [],
+                                   extras={"heatmap": np.asarray(out)})
+
+    def _window_local(self, agg: str):
+        """Single-device window evaluator: groups for ALL, heatmap
+        otherwise."""
+        from spatialflink_tpu.ops.trajectory import (taggregate_groups,
+                                                     taggregate_heatmap)
+
+        def local(batch):
+            groups = taggregate_groups(batch, num_cells=self.grid.num_cells)
+            if agg == "ALL":
+                return groups
+            return taggregate_heatmap(groups, num_cells=self.grid.num_cells,
+                                      agg=agg)
+        return local
+
+    def _window_dist(self, agg: str):
+        """Mesh twin: per-shard group extents, gathered + extent-merged
+        (groups split at shard boundaries measure identically to the
+        single-device sort — parallel.ops.distributed_taggregate)."""
+        from spatialflink_tpu.parallel.ops import distributed_taggregate
+
+        def dist(mesh, sharded):
+            return distributed_taggregate(
+                mesh, sharded, num_cells=self.grid.num_cells, agg=agg)
+        return dist
 
     def _run_count_windows(self, stream, agg) -> Iterator[WindowResult]:
         """Per-cell sliding COUNT windows (Flink ``countWindow(size, slide)``

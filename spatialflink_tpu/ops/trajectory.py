@@ -167,6 +167,111 @@ def tstats_update(state: TrajStatsState, batch: PointBatch):
     return new_state, out
 
 
+class TStatsWindowSummary(NamedTuple):
+    """Per-trajectory (M,) shard summary of one WINDOW slice — the mergeable
+    form of the windowed tStats reduction: within-shard pair sums plus the
+    boundary data (first/last accepted point) a cross-shard stitch needs.
+    Requires the window's records to be globally sorted by (objID, ts) and
+    (objID, ts)-deduplicated BEFORE contiguous sharding, so each shard holds
+    a contiguous slice of every trajectory's global run and the stitch pair
+    (last of shard i, first of shard i+1) is exactly the pair the
+    single-device sorted cumsum would have linked."""
+
+    spatial: jnp.ndarray   # (M,) f32 within-shard consecutive-pair distance
+    count: jnp.ndarray     # (M,) i32 accepted points in this shard
+    min_ts: jnp.ndarray    # (M,) i32 (INT32_MAX where absent)
+    max_ts: jnp.ndarray    # (M,) i32 (INT32_MIN where absent)
+    first_x: jnp.ndarray   # (M,) f32 earliest accepted point
+    first_y: jnp.ndarray
+    last_x: jnp.ndarray    # (M,) f32 latest accepted point
+    last_y: jnp.ndarray
+
+
+@partial(jax.jit, static_argnames=("m",))
+def tstats_window_summary(batch: PointBatch, *, m: int) -> TStatsWindowSummary:
+    """Fresh-state (windowed) per-trajectory stats of one shard slice."""
+    n = batch.x.shape[0]
+    oid = jnp.where(batch.valid, batch.obj_id, _OID_SENTINEL)
+    order0 = jnp.arange(n, dtype=jnp.int32)
+    oid_s, ts_s, x_s, y_s, _ = jax.lax.sort(
+        (oid, batch.ts, batch.x, batch.y, order0), num_keys=2)
+    valid_s = oid_s != _OID_SENTINEL
+    safe_oid = jnp.where(valid_s, oid_s, 0)
+
+    prev_oid = jnp.concatenate([jnp.full((1,), -1, jnp.int32), oid_s[:-1]])
+    run_first = oid_s != prev_oid
+    prev_ts = jnp.concatenate([jnp.full((1,), INT32_MIN, jnp.int32), ts_s[:-1]])
+    # fresh state: drop only exact (oid, ts) duplicates (tstats_update's tie
+    # rule with st_last_ts uninitialized)
+    accepted = valid_s & ~((~run_first) & (ts_s == prev_ts))
+
+    pos = jnp.where(accepted, jnp.arange(n, dtype=jnp.int32), -1)
+    prev_acc_pos = jnp.concatenate([jnp.full((1,), -1, jnp.int32),
+                                    jax.lax.cummax(pos)[:-1]])
+    has_batch_prev = (prev_acc_pos >= 0) & (
+        oid_s[jnp.maximum(prev_acc_pos, 0)] == oid_s)
+    gp = jnp.maximum(prev_acc_pos, 0)
+    pair = accepted & has_batch_prev
+    contrib_d = jnp.where(pair, D.pp_dist(x_s[gp], y_s[gp], x_s, y_s), 0.0)
+
+    seg = safe_oid
+    spatial = jax.ops.segment_sum(jnp.where(accepted, contrib_d, 0.0), seg,
+                                  num_segments=m)
+    count = jax.ops.segment_sum(accepted.astype(jnp.int32), seg,
+                                num_segments=m)
+    min_ts = jax.ops.segment_min(
+        jnp.where(accepted, ts_s, _OID_SENTINEL), seg, num_segments=m)
+    max_ts = jax.ops.segment_max(
+        jnp.where(accepted, ts_s, INT32_MIN), seg, num_segments=m)
+
+    # boundary coords: the earliest / latest accepted point per trajectory
+    # (unique matches — accepted ts are strictly increasing within a run)
+    is_first = accepted & (ts_s == min_ts[safe_oid])
+    is_last = accepted & (ts_s == max_ts[safe_oid])
+    fx = jnp.zeros(m, jnp.float32).at[
+        jnp.where(is_first, safe_oid, m)].set(x_s, mode="drop")
+    fy = jnp.zeros(m, jnp.float32).at[
+        jnp.where(is_first, safe_oid, m)].set(y_s, mode="drop")
+    lx = jnp.zeros(m, jnp.float32).at[
+        jnp.where(is_last, safe_oid, m)].set(x_s, mode="drop")
+    ly = jnp.zeros(m, jnp.float32).at[
+        jnp.where(is_last, safe_oid, m)].set(y_s, mode="drop")
+    return TStatsWindowSummary(spatial=spatial, count=count, min_ts=min_ts,
+                               max_ts=max_ts, first_x=fx, first_y=fy,
+                               last_x=lx, last_y=ly)
+
+
+@jax.jit
+def tstats_stitch_summaries(tabs: TStatsWindowSummary):
+    """Merge (D, M) shard summaries (shard-major, in GLOBAL slice order) into
+    final per-trajectory stats: spatial = Σ within-shard sums + the boundary
+    links d(last of previous present shard, first of next); temporal =
+    global max_ts - min_ts. Returns (spatial (M,), temporal_ms (M,) i32,
+    count (M,)) — a trajectory emits iff count >= 2, matching the
+    single-device pair rule."""
+    m = tabs.spatial.shape[1]
+
+    def step(carry, row):
+        has, plx, ply = carry
+        present = row.count > 0
+        link = has & present
+        add = jnp.where(
+            link, D.pp_dist(plx, ply, row.first_x, row.first_y), 0.0)
+        nlx = jnp.where(present, row.last_x, plx)
+        nly = jnp.where(present, row.last_y, ply)
+        return (has | present, nlx, nly), add
+
+    init = (jnp.zeros(m, bool), jnp.zeros(m, jnp.float32),
+            jnp.zeros(m, jnp.float32))
+    _, adds = jax.lax.scan(step, init, tabs)
+    spatial = tabs.spatial.sum(0) + adds.sum(0)
+    count = tabs.count.sum(0)
+    mn = tabs.min_ts.min(0)
+    mx = tabs.max_ts.max(0)
+    temporal = jnp.where(count > 0, mx - mn, 0)
+    return spatial, temporal, count
+
+
 # ------------------------------------------------------------------------- #
 # TAggregate: per-cell heatmap of trajectory lengths
 
@@ -180,10 +285,24 @@ class TAggregateGroups(NamedTuple):
     first: jnp.ndarray    # (N,) bool marks group representatives
 
 
+class TAggregateExtents(NamedTuple):
+    """Per-(cell, objID) group ts-extents of a window, in sorted order — the
+    MERGEABLE form of :class:`TAggregateGroups` (min/max compose across
+    shards; a length does not, since a group split at a shard boundary must
+    merge extents before measuring)."""
+
+    cell: jnp.ndarray     # (N,) i32 group cell (sentinel num_cells where pad)
+    obj_id: jnp.ndarray   # (N,) i32 group object
+    min_ts: jnp.ndarray   # (N,) i32 group min timestamp
+    max_ts: jnp.ndarray   # (N,) i32 group max timestamp
+    first: jnp.ndarray    # (N,) bool marks group representatives
+
+
 @partial(jax.jit, static_argnames=("num_cells",))
-def taggregate_groups(batch: PointBatch, *, num_cells: int) -> TAggregateGroups:
-    """Group a window by (cell, objID); per-group trajectory length =
-    max - min timestamp (``tAggregate/TAggregateQuery.java:381-494``)."""
+def taggregate_group_extents(batch: PointBatch, *,
+                             num_cells: int) -> TAggregateExtents:
+    """Group a window by (cell, objID) with per-group [min_ts, max_ts]
+    extents (``tAggregate/TAggregateQuery.java:381-494``)."""
     n = batch.x.shape[0]
     ok = batch.valid & (batch.cell >= 0)
     cell = jnp.where(ok, batch.cell, num_cells)  # sentinel cell sorts last
@@ -198,8 +317,40 @@ def taggregate_groups(batch: PointBatch, *, num_cells: int) -> TAggregateGroups:
     gid = jnp.where(cell_s < num_cells, gid, n - 1)
     min_ts = jax.ops.segment_min(ts_s, gid, num_segments=n)
     max_ts = jax.ops.segment_max(ts_s, gid, num_segments=n)
-    length = (max_ts - min_ts)[gid]
-    return TAggregateGroups(cell=cell_s, obj_id=oid_s, length=length, first=first)
+    return TAggregateExtents(cell=cell_s, obj_id=oid_s, min_ts=min_ts[gid],
+                             max_ts=max_ts[gid], first=first)
+
+
+@partial(jax.jit, static_argnames=("num_cells",))
+def taggregate_groups(batch: PointBatch, *, num_cells: int) -> TAggregateGroups:
+    """Group a window by (cell, objID); per-group trajectory length =
+    max - min timestamp (``tAggregate/TAggregateQuery.java:381-494``)."""
+    e = taggregate_group_extents(batch, num_cells=num_cells)
+    return TAggregateGroups(cell=e.cell, obj_id=e.obj_id,
+                            length=e.max_ts - e.min_ts, first=e.first)
+
+
+@partial(jax.jit, static_argnames=("num_cells",))
+def taggregate_merge_extents(cell, oid, min_ts, max_ts, *,
+                             num_cells: int) -> TAggregateGroups:
+    """Merge (cell, objID) group-extent tables into final groups — the
+    second stage of the distributed window: per-shard representatives (with
+    non-representatives blanked to the sentinel cell) are gathered,
+    re-sorted, and extent-merged, so a group split across shards measures
+    max-over-shards minus min-over-shards exactly like the single-device
+    sort would have."""
+    n = cell.shape[0]
+    cell_s, oid_s, mn_s, mx_s = jax.lax.sort((cell, oid, min_ts, max_ts),
+                                             num_keys=2)
+    prev_cell = jnp.concatenate([jnp.full((1,), -1, jnp.int32), cell_s[:-1]])
+    prev_oid = jnp.concatenate([jnp.full((1,), -1, jnp.int32), oid_s[:-1]])
+    first = ((cell_s != prev_cell) | (oid_s != prev_oid)) & (cell_s < num_cells)
+    gid = jnp.cumsum(first.astype(jnp.int32)) - 1
+    gid = jnp.where(cell_s < num_cells, gid, n - 1)
+    g_min = jax.ops.segment_min(mn_s, gid, num_segments=n)
+    g_max = jax.ops.segment_max(mx_s, gid, num_segments=n)
+    return TAggregateGroups(cell=cell_s, obj_id=oid_s,
+                            length=(g_max - g_min)[gid], first=first)
 
 
 @partial(jax.jit, static_argnames=("num_cells", "agg"))

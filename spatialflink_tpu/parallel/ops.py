@@ -369,3 +369,92 @@ def distributed_join_counts(
         out_specs=(P(CELL_AXIS), P()),
     )
     return fn(a, b)
+
+
+def _gather_shard_major(x, axes):
+    """all_gather a per-shard array over the mesh's point axes into
+    shard-major order matching the batch's contiguous sharding: outer (DCN)
+    axis major, inner (ICI) axis minor — ``(D, *x.shape)``."""
+    g = jax.lax.all_gather(x, CELL_AXIS)              # (n_cell, ...)
+    if DCN_AXIS in axes:
+        g = jax.lax.all_gather(g, DCN_AXIS)           # (n_dcn, n_cell, ...)
+        g = g.reshape((-1,) + g.shape[2:])
+    return g
+
+
+def distributed_taggregate(mesh: Mesh, batch, *, num_cells: int, agg: str):
+    """Windowed tAggregate over the mesh (``TAggregateQuery.java:53-377``):
+    per-shard (cell, objID) group EXTENTS — the mergeable form; a length is
+    not, since a group split at a shard boundary must merge [min_ts, max_ts]
+    before measuring — then an all-gather of the shard representatives and
+    a replicated extent-merge re-sort. ``agg='ALL'`` returns the merged
+    :class:`TAggregateGroups` (size N, replicated — the same shape the
+    single-device path extracts records from); other aggregates return the
+    dense (num_cells,) heatmap, replicated."""
+    from spatialflink_tpu.ops.trajectory import (_OID_SENTINEL, INT32_MIN,
+                                                 taggregate_group_extents,
+                                                 taggregate_heatmap,
+                                                 taggregate_merge_extents)
+
+    axes = _point_axes(mesh)
+    int32_max = jnp.iinfo(jnp.int32).max
+
+    def per_shard(b):
+        e = taggregate_group_extents(b, num_cells=num_cells)
+        # blank non-representatives so only one extent row per local group
+        # survives the gather (sentinels sort last in the merge)
+        cell = jnp.where(e.first, e.cell, num_cells)
+        oid = jnp.where(e.first, e.obj_id, _OID_SENTINEL)
+        mn = jnp.where(e.first, e.min_ts, int32_max)
+        mx = jnp.where(e.first, e.max_ts, INT32_MIN)
+        merged = taggregate_merge_extents(
+            _gather_shard_major(cell, axes).reshape(-1),
+            _gather_shard_major(oid, axes).reshape(-1),
+            _gather_shard_major(mn, axes).reshape(-1),
+            _gather_shard_major(mx, axes).reshape(-1),
+            num_cells=num_cells)
+        if agg == "ALL":
+            return merged
+        return taggregate_heatmap(merged, num_cells=num_cells, agg=agg)
+
+    from spatialflink_tpu.ops.trajectory import TAggregateGroups
+
+    out_spec = (TAggregateGroups(P(), P(), P(), P())
+                if agg == "ALL" else P())
+    fn = shard_map(
+        per_shard,
+        mesh=mesh,
+        check_vma=False,
+        in_specs=(P(axes),),
+        out_specs=out_spec,
+    )
+    return fn(batch)
+
+
+def distributed_tstats_window(mesh: Mesh, batch, *, m: int):
+    """Windowed tStats over the mesh (``TStatsQuery.java:153-197``): the
+    window must be globally (objID, ts)-sorted and deduplicated BEFORE
+    contiguous sharding (the operator does this host-side), so each shard
+    summarizes a contiguous slice of every trajectory's run and the
+    replicated stitch adds exactly the boundary pairs the single-device
+    sorted cumsum would have linked. Returns (spatial (M,), temporal (M,)
+    i32 ms, count (M,)), replicated; trajectories emit iff count >= 2."""
+    from spatialflink_tpu.ops.trajectory import (tstats_stitch_summaries,
+                                                 tstats_window_summary)
+
+    axes = _point_axes(mesh)
+
+    def per_shard(b):
+        s = tstats_window_summary(b, m=m)
+        # tree-map preserves the NamedTuple structure: (D, M) tables
+        tabs = jax.tree.map(lambda x: _gather_shard_major(x, axes), s)
+        return tstats_stitch_summaries(tabs)
+
+    fn = shard_map(
+        per_shard,
+        mesh=mesh,
+        check_vma=False,
+        in_specs=(P(axes),),
+        out_specs=(P(), P(), P()),
+    )
+    return fn(batch)

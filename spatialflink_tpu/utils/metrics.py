@@ -146,14 +146,21 @@ def metered(stream: Iterable, meter: Meter,
 @contextlib.contextmanager
 def trace(name: str):
     """Named trace annotation visible in a jax.profiler capture; no-op when
-    profiling machinery is unavailable."""
+    profiling machinery is unavailable. Only the annotation SETUP is
+    guarded — an exception raised by the enclosed block must propagate
+    unchanged (a try around the yield would swallow it and break the
+    generator contract)."""
     try:
         import jax.profiler as _prof
 
-        with _prof.TraceAnnotation(name):
-            yield
+        cm = _prof.TraceAnnotation(name)
     except Exception:
+        cm = None
+    if cm is None:
         yield
+    else:
+        with cm:
+            yield
 
 
 @contextlib.contextmanager

@@ -366,6 +366,29 @@ def test_output_file_join_pairs_are_serialized(tmp_path):
     assert len(pair) == 2 and all("POINT" in s for s in pair)
 
 
+def test_cli_profile_writes_trace_with_operator_annotations(tmp_path):
+    """--profile DIR captures a jax.profiler trace of the run (SURVEY §5
+    tracing ≙ the reference's Flink web UI, StreamingJob.java:70-72) with
+    per-operator dispatch/readback spans."""
+    import glob
+    import gzip
+
+    lines, _, _ = _synth_lines(n_traj=4, steps=4)
+    inp = tmp_path / "in.jsonl"
+    inp.write_text("\n".join(lines) + "\n")
+    prof = tmp_path / "trace"
+    rc = main(["--config", CONF, "--input1", str(inp), "--option", "1",
+               "--profile", str(prof)])
+    assert rc == 0
+    assert glob.glob(str(prof / "plugins" / "profile" / "*" / "*.xplane.pb"))
+    js = glob.glob(str(prof / "plugins" / "profile" / "*" /
+                       "*.trace.json.gz"))
+    assert js
+    body = gzip.open(js[0], "rt", errors="replace").read()
+    assert "PointPointRangeQuery.dispatch" in body
+    assert "PointPointRangeQuery.readback" in body
+
+
 def test_cli_mesh_validation_after_overrides(tmp_path):
     import shutil
 

@@ -246,8 +246,10 @@ class SpatialOperator:
 
     def _eval_degradable(self, single_fn, dist_fn, batch=None):
         """Run ``dist_fn(mesh)`` — or ``dist_fn(mesh, sharded_batch)`` when
-        ``batch`` is given — with elastic retry, falling back to
-        ``single_fn()`` once the mesh is degraded to one device.
+        ``batch`` is given — with elastic retry at halved mesh widths;
+        ``single_fn()`` serves callers invoking this on a non-distributed
+        operator (degradation itself never reaches it: the final halving
+        to one device raises instead — see ``_degrade_mesh``).
 
         Catches ``RuntimeError`` (``XlaRuntimeError``'s base — device loss,
         transfer failures) raised at DISPATCH time. Two documented

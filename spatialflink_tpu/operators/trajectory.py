@@ -269,15 +269,21 @@ class PointTStatsQuery(SpatialOperator):
     def _window_tuples_distributed(self, records: List[Point], start: int
                                    ) -> List[Tuple]:
         """Mesh-sharded windowed stats: per-shard summaries + boundary
-        stitch (parallel.ops.distributed_tstats_window); falls back to the
-        single-device path under elastic degradation. Emission order is
-        ascending interned id — the same first-seen order the single path's
-        dict preserves."""
+        stitch (parallel.ops.distributed_tstats_window), with elastic
+        degraded retry at halved widths (a failure surviving every
+        multi-device width raises — see ``_degrade_mesh``). Emission order
+        is ascending interned id — the same first-seen order the single
+        path's dict preserves."""
         from spatialflink_tpu.parallel.ops import distributed_tstats_window
+        from spatialflink_tpu.utils import bucket_size
 
         recs = self._sorted_dedup(records)
         batch = self._point_batch(recs, start)
-        m = len(self.interner)
+        # bucketed capacity: the raw interner size grows with every new
+        # trajectory, and m is a STATIC jit arg — unbucketed it would
+        # recompile the whole shard_map program per churny window (padded
+        # ids have count 0 and fail the cnt >= 2 emit rule)
+        m = bucket_size(len(self.interner))
 
         def dist(mesh, sharded):
             sp, tp, cnt = distributed_tstats_window(mesh, sharded, m=m)
@@ -373,8 +379,6 @@ class PointTAggregateQuery(SpatialOperator):
             checkpoint_path: Optional[str] = None,
             checkpoint_every: int = 16, resume: bool = True
             ) -> Iterator[WindowResult]:
-        from spatialflink_tpu.ops.trajectory import taggregate_groups, taggregate_heatmap
-
         agg = aggregate.upper()
         if self.conf.query_type is QueryType.RealTime:
             yield from self._run_realtime(

@@ -32,10 +32,11 @@ join reduction.
 :func:`pip_dist` dispatch is by backend — pallas on TPU, the jnp twin
 (:func:`ops.geom.points_to_single_edges_raw`) elsewhere — overridable with
 ``SPATIALFLINK_PALLAS`` = ``off`` | ``interpret`` (CPU interpreter, used by
-the test suite) | ``auto``. Query geometries beyond ``_MAX_SMEM_EDGES``
-edges also take the jnp twin: the edge array is staged in SMEM, which is a
-few KB of scalar memory, and window-query geometries are small (a query
-polygon with >512 edges is already degenerate for grid pruning).
+the test suite) | ``auto``. The edge array is staged in SMEM (a few KB of
+scalar memory) in ``_EDGE_CHUNK``-edge blocks along a second grid
+dimension, accumulating into the revisited point-tile output — so a
+10k-vertex query polygon streams through the same kernel as a small
+building footprint (the round-4 512-edge fallback cap is gone).
 """
 
 from __future__ import annotations
@@ -59,8 +60,11 @@ _TPS = 128
 _LAN = 128
 # scalar edge loop unroll (measured: 4 is ~35% over 1, 8 is flat)
 _UNROLL = 4
-# edges are staged whole into SMEM; beyond this the jnp twin runs instead
-_MAX_SMEM_EDGES = 512
+# SMEM staging block: geometries up to this many edges load whole (8 KB of
+# scalar memory); bigger ones STREAM chunk by chunk through a second grid
+# dimension, with the point tile's partial cross-count/min-distance
+# accumulated in the revisited VMEM output block — no edge-count cap
+_EDGE_CHUNK = 512
 
 
 def pallas_mode() -> str:
@@ -92,13 +96,17 @@ def _ceil_to(n: int, m: int) -> int:
 
 
 def _pip_kernel(e_ref, m_ref, px_ref, py_ref, cross_ref, mind2_ref):
-    """One (TPS, LAN) point tile against every edge.
+    """One (TPS, LAN) point tile against one SMEM edge CHUNK.
 
-    Edges live in SMEM as (4, E) scalars; each loop step broadcasts one
+    Edges live in SMEM as (4, EC) scalars; each loop step broadcasts one
     edge's parameters against the whole point tile, so the divide (slope,
     inv_len) is scalar work done once per edge — the vector units only see
     multiply/add/compare (the same hoisting as ops.distances, one level
-    stronger: scalar instead of per-edge-lane).
+    stronger: scalar instead of per-edge-lane). Grid dim 1 walks the edge
+    chunks (innermost, so the output block stays VMEM-resident): chunk 0
+    initializes the tile's accumulators, later chunks add crossings and
+    take the running min — an even-odd count and a min compose exactly
+    across any chunking of the edge list.
     """
     px = px_ref[:]  # (TPS, LAN)
     py = py_ref[:]
@@ -143,17 +151,27 @@ def _pip_kernel(e_ref, m_ref, px_ref, py_ref, cross_ref, mind2_ref):
         (jnp.zeros((_TPS, _LAN), jnp.float32),
          jnp.full((_TPS, _LAN), _F_BIG, jnp.float32)),
     )
-    cross_ref[:] = cross
-    mind2_ref[:] = mind2
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        cross_ref[:] = cross
+        mind2_ref[:] = mind2
+
+    @pl.when(j > 0)
+    def _accumulate():
+        cross_ref[:] = cross_ref[:] + cross
+        mind2_ref[:] = jnp.minimum(mind2_ref[:], mind2)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def _pip_pallas(px, py, edges, edge_mask, *, interpret: bool):
     n = px.shape[0]
-    # edges arrive pre-bucketed to a multiple of 64 (pip_dist pads OUTSIDE
-    # this jit boundary, so distinct small query geometries land on the same
-    # (ep, 4) aval and share this compilation)
+    # edges arrive pre-bucketed by pip_dist OUTSIDE this jit boundary (to a
+    # multiple of 64 up to _EDGE_CHUNK, then of _EDGE_CHUNK), so distinct
+    # query geometries land on shared (ep, 4) avals and compilations
     ep = edges.shape[0]
+    ec = min(ep, _EDGE_CHUNK)
     rows = -(-n // _LAN)
     rpad = _ceil_to(rows, _TPS)
     npad = rpad * _LAN
@@ -163,16 +181,20 @@ def _pip_pallas(px, py, edges, edge_mask, *, interpret: bool):
     e4 = edges.astype(jnp.float32).T  # (4, ep)
     em = edge_mask.astype(jnp.int32).reshape(1, ep)
 
-    pt_spec = pl.BlockSpec((_TPS, _LAN), lambda i: (i, 0),
+    pt_spec = pl.BlockSpec((_TPS, _LAN), lambda i, j: (i, 0),
                            memory_space=pltpu.VMEM)
-    out_spec = pl.BlockSpec((_TPS, _LAN), lambda i: (i, 0),
+    out_spec = pl.BlockSpec((_TPS, _LAN), lambda i, j: (i, 0),
                             memory_space=pltpu.VMEM)
-    e_spec = pl.BlockSpec((4, ep), lambda i: (0, 0), memory_space=pltpu.SMEM)
-    m_spec = pl.BlockSpec((1, ep), lambda i: (0, 0), memory_space=pltpu.SMEM)
+    e_spec = pl.BlockSpec((4, ec), lambda i, j: (0, j),
+                          memory_space=pltpu.SMEM)
+    m_spec = pl.BlockSpec((1, ec), lambda i, j: (0, j),
+                          memory_space=pltpu.SMEM)
 
     cross, mind2 = pl.pallas_call(
         _pip_kernel,
-        grid=(rpad // _TPS,),
+        # edge chunks innermost: the point tile's output block is revisited
+        # across j while resident, accumulating count/min
+        grid=(rpad // _TPS, ep // ec),
         in_specs=[e_spec, m_spec, pt_spec, pt_spec],
         out_specs=(out_spec, out_spec),
         out_shape=(
@@ -190,19 +212,24 @@ def pip_dist(px, py, edges, edge_mask, is_areal: bool):
 
     Drop-in twin of ``ops.geom.points_to_single_geom_dist`` (same semantics:
     0 inside areal geometries, else min boundary distance); fused lane-major
-    pallas on TPU, jnp elsewhere (and for >_MAX_SMEM_EDGES-edge geometries,
-    whose edge array would not fit SMEM).
+    pallas on TPU (any edge count — big geometries stream through SMEM in
+    ``_EDGE_CHUNK``-edge chunks), jnp elsewhere.
     """
     mode = pallas_mode()
-    if mode == "off" or edges.shape[0] > _MAX_SMEM_EDGES:
+    if mode == "off":
         from spatialflink_tpu.ops.geom import points_to_single_edges_raw
 
         inside, mind2 = points_to_single_edges_raw(px, py, edges, edge_mask)
     else:
-        # bucket the edge count to multiples of 64 BEFORE the jit boundary so
-        # a pipeline's distinct query geometries share one compilation;
-        # padded slots are masked out in-kernel
-        ep = _ceil_to(edges.shape[0], 64)
+        # bucket the edge count BEFORE the jit boundary so a pipeline's
+        # distinct query geometries share one compilation: multiples of 64
+        # up to one SMEM chunk, whole chunks beyond (the chunked grid
+        # streams any edge count — a 10k-vertex query polygon runs the
+        # same kernel as a building footprint); padded slots are masked
+        # out in-kernel
+        ne = edges.shape[0]
+        ep = (_ceil_to(ne, 64) if ne <= _EDGE_CHUNK
+              else _ceil_to(ne, _EDGE_CHUNK))
         inside, mind2 = _pip_pallas(
             px, py, _pad_to(edges, ep, 0.0), _pad_to(edge_mask, ep, False),
             interpret=(mode == "interpret"))

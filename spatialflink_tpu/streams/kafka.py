@@ -490,6 +490,16 @@ class RealKafkaBroker:
     :class:`KafkaSource` committed — the same at-least-once contract the shim
     provides, with :class:`IdempotentWindowSink` upgrading it to effective
     exactly-once downstream.
+
+    VERIFICATION BOUNDARY (permanent, environmental): this adapter is
+    exercised against an injected fake of the kafka-python client API (see
+    ``connect_kafka``'s ``kafka_module`` seam and tests/test_kafka.py) —
+    the wire path has never run here, because the build environment has
+    neither the kafka-python package nor any broker process to speak the
+    Kafka protocol to (zero egress; vendoring a wire client would still
+    leave nothing real on the other end of the socket). First use against
+    a real cluster should smoke-test produce→fetch→commit→committed on a
+    scratch topic before trusting a pipeline to it.
     """
 
     def __init__(self, kafka_module, bootstrap_servers: str, *,

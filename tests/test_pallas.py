@@ -57,6 +57,47 @@ class TestPipDist:
         ls = LineString.create([(0.5, 0.5), (4, 7), (9, 3)], grid=grid)
         self._check(grid, ls, n=130, seed=3)
 
+    def _check_vs_raw(self, grid, poly, n, seed):
+        """Parity against the INDEPENDENT jnp oracle
+        (points_to_single_edges_raw): points_to_single_geom_dist delegates
+        back to pip_dist, so _check would compare the kernel with itself."""
+        from spatialflink_tpu.ops.geom import points_to_single_edges_raw
+
+        xs, ys, _ = _random_batch(grid, n, seed)
+        batch = PointBatch.from_arrays(xs, ys, grid=grid)
+        edges, mask = single_query_edges(poly)
+        edges, mask = jnp.asarray(edges), jnp.asarray(mask)
+        got = PK.pip_dist(batch.x, batch.y, edges, mask, True)
+        inside, mind2 = points_to_single_edges_raw(batch.x, batch.y, edges,
+                                                   mask)
+        want = jnp.where(inside, 0.0, jnp.sqrt(mind2))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_large_polygon_streams_edge_chunks(self, interpret_mode, grid):
+        """A polygon with more edges than one SMEM chunk (the round-4
+        512-edge fallback cap) streams through the chunked grid: multi-chunk
+        even-odd counts and min-distances must match the jnp oracle."""
+        th = np.linspace(0, 2 * np.pi, 1301, endpoint=False)
+        ring = [(5 + 3.5 * float(np.cos(t)) * (1 + 0.1 * float(np.sin(9 * t))),
+                 5 + 3.5 * float(np.sin(t)) * (1 + 0.1 * float(np.cos(7 * t))))
+                for t in th]
+        poly = Polygon.create([ring + [ring[0]]], grid=grid)
+        edges, _ = single_query_edges(poly)
+        assert edges.shape[0] > PK._EDGE_CHUNK  # actually exercises chunking
+        self._check_vs_raw(grid, poly, n=211, seed=9)
+
+    def test_chunk_boundary_edge_counts(self, interpret_mode, grid):
+        """Edge counts right at the chunk boundary (one full chunk, one
+        chunk + 1 edge) keep parity — the padded tail chunk is fully
+        masked."""
+        for n_vert in (PK._EDGE_CHUNK, PK._EDGE_CHUNK + 1):
+            th = np.linspace(0, 2 * np.pi, n_vert, endpoint=False)
+            ring = [(5 + 3 * float(np.cos(t)), 5 + 3 * float(np.sin(t)))
+                    for t in th]
+            poly = Polygon.create([ring + [ring[0]]], grid=grid)
+            self._check_vs_raw(grid, poly, n=97, seed=n_vert)
+
     def test_matches_off_mode(self, monkeypatch, grid):
         poly = Polygon.create([[(2, 2), (6, 2), (6, 6), (2, 6), (2, 2)]], grid=grid)
         xs, ys, _ = _random_batch(grid, 100, 4)

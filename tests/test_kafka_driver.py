@@ -236,6 +236,79 @@ def test_kafka_realtime_lagged_commits(tmp_path):
         main(["--config", cfg, "--kafka", "--option", "99"])
 
 
+@pytest.mark.parametrize("opt,needs2", [
+    (204, False),   # trange window (marker-keyed)
+    (206, False),   # tstats window (marker-keyed)
+    (208, False),   # taggregate window: heatmap rides the summary record
+    (210, True),    # tjoin window: two topics
+    (1010, False),  # StayTime app (plain sink)
+    (504, False),   # WKT deser conformance (plain sink)
+])
+def test_kafka_family_matrix(tmp_path, opt, needs2):
+    """Every family the driver serves runs through the broker topology end
+    to end: windowed trajectory ops produce marker-keyed windows, apps and
+    deser produce plain records, and all groups commit on drain."""
+    cfg, url = _conf(tmp_path, f"matrix-{opt}")
+    broker = resolve_broker(url)
+    if opt == 504:
+        records = ["GEOMETRYCOLLECTION (POINT (116.5 40.5), "
+                   "LINESTRING (116 40, 117 41))"]
+    else:
+        records = _lines()
+    for r in records:
+        broker.produce(IN1, r)
+    if needs2:
+        for r in _lines(seed=5):
+            broker.produce(IN2, r)
+    argv = ["--config", cfg, "--kafka", "--option", str(opt)]
+    if opt == 504:
+        argv += ["--format", "WKT"]
+    assert main(argv) == 0
+    assert broker.end_offset(OUT) > 0, "nothing reached the output topic"
+    assert broker.committed(IN1, "spatialflink") == len(records)
+    if needs2:
+        assert broker.committed(IN2, "spatialflink") == \
+            broker.end_offset(IN2)
+    if opt in (204, 206, 208, 210):
+        assert _markers(broker), "windowed family should produce markers"
+
+
+def test_kafka_composes_with_multi_query(tmp_path):
+    """--kafka + --multi-query: one marker-keyed window per window (not per
+    query), with the flattened per-query records under the window key and
+    the multi-query metadata riding the JSON summary record."""
+    cfg, url = _conf(tmp_path, "mq",
+                     queryPoints=[[116.3, 40.3], [116.7, 40.7]])
+    broker = resolve_broker(url)
+    lines = _lines()
+    for ln in lines:
+        broker.produce(IN1, ln)
+    assert main(["--config", cfg, "--kafka", "--option", "1",
+                 "--multi-query"]) == 0
+    marks = _markers(broker)
+    assert marks and len(marks) == len(set(marks))
+    assert broker.committed(IN1, "spatialflink") == len(lines)
+
+
+def test_kafka_composes_with_mesh(tmp_path):
+    """--kafka + --devices: broker-fed windows shard across the virtual
+    mesh and produce the same marker set as the single-device broker run."""
+    lines = _lines()
+    cfg1, url1 = _conf(tmp_path, "mesh-1", "c1.yml")
+    b1 = resolve_broker(url1)
+    cfg8, url8 = _conf(tmp_path, "mesh-8", "c8.yml")
+    b8 = resolve_broker(url8)
+    for ln in lines:
+        b1.produce(IN1, ln)
+        b8.produce(IN1, ln)
+    assert main(["--config", cfg1, "--kafka", "--option", "1"]) == 0
+    assert main(["--config", cfg8, "--kafka", "--option", "1",
+                 "--devices", "8"]) == 0
+    assert _markers(b1), "baseline run produced no windows"
+    assert sorted(_markers(b8)) == sorted(_markers(b1))
+    assert b8.committed(IN1, "spatialflink") == len(lines)
+
+
 # ------------------------------------------------------ crash / restart
 
 

@@ -1,0 +1,31 @@
+#!/bin/bash
+# Watch for a healthy axon TPU tunnel and fire the measurement session the
+# moment it answers (windows are short and unpredictable — see TPU_NOTES §4;
+# probing between work items by hand misses them).
+#
+#   bash benchmarks/tpu_watch.sh [probe_interval_s]
+#
+# One successful tpu_session.sh run, then exit. Designed to live in a tmux
+# session; progress in benchmarks/TPU_ATTEMPTS.log. The probe is a separate
+# short-lived python so a wedged tunnel never hangs the watcher itself.
+set -u
+cd "$(dirname "$0")/.."
+INTERVAL="${1:-420}"
+LOG=benchmarks/TPU_ATTEMPTS.log
+
+probe() {
+  timeout 50 python -c "import jax; assert jax.devices()[0].platform != 'cpu'" \
+    >/dev/null 2>&1
+}
+
+echo "$(date -u +%FT%TZ) watch: start (interval ${INTERVAL}s)" >> "$LOG"
+while true; do
+  if probe; then
+    echo "$(date -u +%FT%TZ) watch: tunnel ANSWERED - running session" >> "$LOG"
+    bash benchmarks/tpu_session.sh >> "$LOG" 2>&1
+    echo "$(date -u +%FT%TZ) watch: session finished - exiting" >> "$LOG"
+    exit 0
+  fi
+  echo "$(date -u +%FT%TZ) watch: wedged" >> "$LOG"
+  sleep "$INTERVAL"
+done

@@ -13,19 +13,17 @@ cd "$(dirname "$0")/.."
 INTERVAL="${1:-420}"
 LOG=benchmarks/TPU_ATTEMPTS.log
 
-probe() {
-  timeout 50 python -c "import jax; assert jax.devices()[0].platform != 'cpu'" \
-    >/dev/null 2>&1
-}
-
+# tpu_probe.sh is the single probe implementation: `env -u JAX_PLATFORMS`
+# (an inherited CPU guard would otherwise fail the probe forever on a
+# healthy tunnel), rejects JAX's silent CPU fallback, and logs each
+# attempt to TPU_ATTEMPTS.log itself
 echo "$(date -u +%FT%TZ) watch: start (interval ${INTERVAL}s)" >> "$LOG"
 while true; do
-  if probe; then
+  if bash benchmarks/tpu_probe.sh 50 >/dev/null 2>&1; then
     echo "$(date -u +%FT%TZ) watch: tunnel ANSWERED - running session" >> "$LOG"
     bash benchmarks/tpu_session.sh >> "$LOG" 2>&1
     echo "$(date -u +%FT%TZ) watch: session finished - exiting" >> "$LOG"
     exit 0
   fi
-  echo "$(date -u +%FT%TZ) watch: wedged" >> "$LOG"
   sleep "$INTERVAL"
 done

@@ -52,11 +52,20 @@ class ParsedPoints:
         )
 
     def to_points(self, grid: Optional[UniformGrid] = None) -> List[Point]:
+        """Per-record Point objects (the ONE ParsedPoints->records
+        conversion — the kafka chunked decode and tests share it); cell
+        assignment is vectorized over the whole batch (Point.create's
+        per-point assign would dominate the loop)."""
+        if grid is not None:
+            cells, _ = grid.assign_cell(self.x, self.y)
+        else:
+            cells = np.full(len(self), -1, np.int32)
+        lk = self.interner.lookup
         return [
-            Point.create(float(self.x[i]), float(self.y[i]), grid,
-                         self.interner.lookup(int(self.obj_id[i])),
-                         int(self.ts[i]))
-            for i in range(len(self))
+            Point(obj_id=lk(int(o)), timestamp=int(t), x=float(x),
+                  y=float(y), cell=int(c))
+            for o, t, x, y, c in zip(self.obj_id, self.ts, self.x, self.y,
+                                     cells)
         ]
 
 

@@ -107,9 +107,10 @@ def test_kafka_matches_file_replay(tmp_path, capsys):
     assert rc == 0
     import ast
 
-    file_windows = [ast.literal_eval(l)["window"] for l in
+    file_results = [ast.literal_eval(l) for l in
                     capsys.readouterr().out.strip().splitlines()
                     if l.startswith("{")]
+    file_windows = [r["window"] for r in file_results]
     broker = resolve_broker(url)
     for ln in lines:
         broker.produce(IN1, ln)
@@ -118,6 +119,69 @@ def test_kafka_matches_file_replay(tmp_path, capsys):
     kafka_windows = sorted(_markers(broker))
     assert kafka_windows == sorted(f"{w[0]}:{w[1]}:None"
                                    for w in file_windows)
+    # per-window record COUNTS also match the file path (the broker path's
+    # chunked native decode must select exactly the same records)
+    marker_counts = {
+        r.key[len(KafkaWindowSink.MARKER):]: int(r.value)
+        for r in broker.fetch(OUT, 0, 1_000_000)
+        if isinstance(r.key, str) and r.key.startswith(KafkaWindowSink.MARKER)
+    }
+    for r in file_results:
+        w = r["window"]
+        assert marker_counts[f"{w[0]}:{w[1]}:None"] == r["count"]
+
+
+def test_kafka_bulk_decode_csv_and_fallbacks(tmp_path, capsys):
+    """CSV records ride the chunked native decode; an embedded-newline
+    record falls back to the exact per-record parse (never dropped or
+    mis-attributed), and window counts match the file-path run."""
+    import ast
+
+    grid = UniformGrid(115.5, 117.6, 39.6, 41.1, num_grid_partitions=100)
+    pts = list(SyntheticPointSource(grid, num_trajectories=6, steps=8,
+                                    seed=4))
+    rows = [serialize_spatial(p, "CSV") for p in pts]
+    inp = tmp_path / "in.csv"
+    inp.write_text("\n".join(rows) + "\n")
+    cfg, url = _conf(tmp_path, "csvbulk")
+    rc = main(["--config", cfg, "--option", "1", "--format", "CSV",
+               "--input1", str(inp)])
+    assert rc == 0
+    file_windows = [ast.literal_eval(l) for l in
+                    capsys.readouterr().out.strip().splitlines()
+                    if l.startswith("{")]
+    broker = resolve_broker(url)
+    for r in rows:
+        broker.produce(IN1, r)
+    rc = main(["--config", cfg, "--kafka", "--option", "1",
+               "--format", "CSV"])
+    assert rc == 0
+    counts = {
+        r.key[len(KafkaWindowSink.MARKER):]: int(r.value)
+        for r in broker.fetch(OUT, 0, 1_000_000)
+        if isinstance(r.key, str) and r.key.startswith(KafkaWindowSink.MARKER)
+    }
+    assert counts == {f"{w['window'][0]}:{w['window'][1]}:None": w["count"]
+                      for w in file_windows}
+
+    # embedded newline: the whole chunk falls back to per-record parse
+    broker2 = resolve_broker(url + "-nl")
+    for r in rows[:10]:
+        broker2.produce(IN1, r)
+    broker2.produce(IN1, rows[10] + "\n")  # trailing newline, same record
+    for r in rows[11:]:
+        broker2.produce(IN1, r)
+    cfg2, _ = _conf(tmp_path, "csvbulk-nl", "c2.yml")
+    rc = main(["--config", cfg2, "--kafka", "--option", "1",
+               "--format", "CSV", "--kafka-bootstrap", url + "-nl"])
+    assert rc == 0
+    assert broker2.committed(IN1, "spatialflink") == len(rows)
+    counts2 = {
+        r.key[len(KafkaWindowSink.MARKER):]: int(r.value)
+        for r in broker2.fetch(OUT, 0, 1_000_000)
+        if isinstance(r.key, str) and r.key.startswith(KafkaWindowSink.MARKER)
+    }
+    assert counts2 == counts, "newline-carrying record was dropped/shifted"
 
 
 def test_kafka_preproduce_and_knn(tmp_path):

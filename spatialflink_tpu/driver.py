@@ -483,25 +483,49 @@ def _run_synthetic(params: Params, conf, grid) -> Iterator[WindowResult]:
 # CLI
 
 
-def _bulk_parse_stream(cfg: StreamConfig, input_path: str,
+def _read_src(src) -> Optional[bytes]:
+    """Bulk-input source to bytes: a replay file path, a ``bytes`` block, or
+    a zero-arg callable (the LAZY ``--kafka --bulk`` topic drain — called
+    only after the cheap case/format gates passed, so an ineligible
+    invocation never pays a full topic read). A callable returning None
+    means the source cannot ride the bulk path (caller falls back)."""
+    if callable(src):
+        return src()
+    if isinstance(src, bytes):
+        return src
+    with open(src, "rb") as f:
+        return f.read()
+
+
+def _bulk_parse_stream(cfg: StreamConfig, src,
                        allowed_lateness_s: int):
-    """Native-ingest one stream file + vectorized watermark dropping; None
-    when the format cannot ride the bulk path."""
+    """Native-ingest one POINT stream (see :func:`_read_src` for accepted
+    sources) + vectorized watermark dropping; None when the format/content
+    cannot ride the bulk path (e.g. a geometry feature in a declared point
+    stream — the record path dead-letters it instead)."""
     import dataclasses
 
     from spatialflink_tpu.runtime.watermarks import BoundedOutOfOrderness
-    from spatialflink_tpu.streams.bulk import bulk_parse_file
+    from spatialflink_tpu.streams.bulk import bulk_parse_csv, bulk_parse_geojson
 
     fmt = cfg.format.lower()
     if fmt not in ("csv", "tsv", "geojson"):
         return None
-    if fmt in ("csv", "tsv"):
-        delim = "\t" if fmt == "tsv" else cfg.delimiter
-        parsed = bulk_parse_file(
-            input_path, fmt, delimiter=delim, schema=_schema4(cfg),
-            date_format=cfg.date_format)
-    else:
-        parsed = bulk_parse_file(input_path, fmt, **cfg.geojson_kwargs())
+    data = _read_src(src)
+    if data is None:
+        return None
+    try:
+        if fmt in ("csv", "tsv"):
+            delim = "\t" if fmt == "tsv" else cfg.delimiter
+            parsed = bulk_parse_csv(
+                data, delimiter=delim, schema=_schema4(cfg),
+                date_format=cfg.date_format)
+        else:
+            parsed = bulk_parse_geojson(data, **cfg.geojson_kwargs())
+    except ValueError as e:
+        print(f"# --bulk: point stream not bulk-ingestible ({e}); "
+              "using the record path", file=sys.stderr)
+        return None
     # reproduce the record path's watermark dropping (same keep/late rule,
     # computed in one vectorized pass over the timestamp array)
     keep = BoundedOutOfOrderness.bulk_keep_mask(
@@ -605,15 +629,16 @@ def run_option_bulk(params: Params, input_path: str,
         parsed, q, params.query.radius, params.query.k)
 
 
-def _bulk_parse_geom_stream(params: Params, input_path: str):
-    """Native WKT/GeoJSON geometry ingest + the same vectorized watermark
-    dropping as the point path (ParsedGeoms carries its own subset
-    machinery). Returns None — honoring run_option_bulk's
-    fall-back-to-record-path contract — when the file holds geometry the
-    bulk path can't ride (e.g. a stray POINT or GEOMETRYCOLLECTION row in
-    a polygon stream)."""
+def _bulk_parse_geom_stream(params: Params, src):
+    """Native WKT/GeoJSON geometry ingest (file path or pre-drained bytes)
+    + the same vectorized watermark dropping as the point path (ParsedGeoms
+    carries its own subset machinery). Returns None — honoring
+    run_option_bulk's fall-back-to-record-path contract — when the input
+    holds geometry the bulk path can't ride (e.g. a stray POINT or
+    GEOMETRYCOLLECTION row in a polygon stream)."""
     from spatialflink_tpu.runtime.watermarks import BoundedOutOfOrderness
-    from spatialflink_tpu.streams.bulk import bulk_parse_geom_file
+    from spatialflink_tpu.streams.bulk import (bulk_parse_geojson_geoms,
+                                               bulk_parse_wkt)
 
     cfg = params.input1
     if cfg.format.lower() == "wkt":
@@ -621,7 +646,14 @@ def _bulk_parse_geom_stream(params: Params, input_path: str):
     else:
         kw = cfg.geojson_kwargs()
     try:
-        parsed = bulk_parse_geom_file(input_path, cfg.format, **kw)
+        data = _read_src(src)
+        if data is None:
+            return None
+        # format pre-gated to WKT/GeoJSON by run_option_bulk
+        if cfg.format.lower() == "wkt":
+            parsed = bulk_parse_wkt(data, **kw)
+        else:
+            parsed = bulk_parse_geojson_geoms(data, **kw)
     except ValueError as e:
         print(f"# --bulk: geometry file not bulk-ingestible ({e}); "
               "using the record path", file=sys.stderr)
@@ -786,6 +818,10 @@ class _KafkaWiring:
     #: micro-batches behind the read head is in a long-emitted batch, so a
     #: restart reprocesses a bounded tail instead of the whole topic
     commit_lag: Optional[int] = None
+    #: set by the --kafka --bulk drain: (topic, next_offset) pairs covering
+    #: the drained range; finish() commits exactly these (the sources were
+    #: never iterated, so their positions are meaningless)
+    bulk_offsets: Optional[List] = None
 
     def emit(self, result) -> None:
         """Produce one pipeline result, then advance window-aligned commits
@@ -823,6 +859,10 @@ class _KafkaWiring:
         reflected in produced output, so the full positions commit. NOT
         called on a control-tuple stop or crash — the conservative
         window-aligned commits stand, and restart re-delivers."""
+        if self.bulk_offsets is not None:
+            for topic, off in self.bulk_offsets:
+                self.broker.commit(topic, self.group, off)
+            return
         tapped = {id(t.source) for t in self.taps}
         for tap in self.taps:
             tap.commit_all()
@@ -840,6 +880,43 @@ class _KafkaWiring:
             f"{s.topic}@{s.broker.committed(s.topic, s.group)}"
             for s in self.sources))
         return "# kafka: " + "; ".join(parts)
+
+
+def _topic_reader(kafka: _KafkaWiring, topic: str, limit: Optional[int],
+                  offsets_out: List):
+    """Zero-arg LAZY drain of one topic for run_option_bulk (called only
+    after the cheap bulk gates pass): committed offset -> current end
+    (bounded by --limit) as newline-joined bytes, recording the drained
+    range in ``offsets_out`` for the post-run commit. Returns None — the
+    fall-back-to-streaming signal — when any record cannot ride the bulk
+    path: non-string values, embedded newlines (they would shift the
+    line<->record mapping), or a control tuple (the streaming path honors
+    its stop semantics)."""
+    def read() -> Optional[bytes]:
+        b = kafka.broker
+        off = b.committed(topic, kafka.group)
+        end = b.end_offset(topic)
+        if limit is not None:
+            end = min(end, off + limit)
+        vals: List[str] = []
+        while off < end:
+            batch = b.fetch(topic, off, min(65536, end - off))
+            if not batch:
+                break
+            for r in batch:
+                v = r.value
+                if not isinstance(v, str) or "\n" in v or '"control"' in v:
+                    print(f"# --kafka --bulk: topic '{topic}' not "
+                          "bulk-drainable (non-string/multiline/control "
+                          "records); using the streaming path",
+                          file=sys.stderr)
+                    return None
+                vals.append(v)
+            off = batch[-1].offset + 1
+        offsets_out.append((topic, off))
+        return "\n".join(vals).encode()
+
+    return read
 
 
 def _wire_kafka(params: Params, spec: CaseSpec, args, skip1: int
@@ -1055,9 +1132,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     if spec is None:
         print(f"unknown queryOption {params.query.option}", file=sys.stderr)
         return 2
-    if args.kafka and args.bulk:
-        ap.error("--kafka and --bulk are mutually exclusive "
-                 "(bulk is whole-file replay, not a broker stream)")
+    if args.kafka and args.bulk and args.kafka_follow:
+        ap.error("--kafka-follow and --bulk are mutually exclusive "
+                 "(bulk is a bounded vectorized drain, not a live stream)")
     if args.kafka and spec.family in ("shapefile", "synthetic"):
         ap.error(f"--kafka does not apply to the {spec.family} cases "
                  "(no input topic)")
@@ -1101,7 +1178,21 @@ def main(argv: Optional[List[str]] = None) -> int:
     from spatialflink_tpu.utils.metrics import ControlTupleExit
 
     results = None
-    if args.bulk:
+    if args.bulk and kafka is not None:
+        # vectorized TOPIC replay: the readers drain committed-offset..end
+        # LAZILY (only once run_option_bulk's cheap case/format gates
+        # pass); the drained offsets commit after the full run produced
+        offs: List = []
+        results = run_option_bulk(
+            params,
+            _topic_reader(kafka, params.input1.topic_name, args.limit, offs),
+            _topic_reader(kafka, params.input2.topic_name, args.limit, offs))
+        if results is None:
+            print("# --kafka --bulk not applicable to this case/format/"
+                  "topic content; using the streaming path", file=sys.stderr)
+        else:
+            kafka.bulk_offsets = offs
+    elif args.bulk:
         results = run_option_bulk(params, args.input1, args.input2)
         if results is None:
             print("--bulk not applicable to this case/format; "

@@ -355,7 +355,8 @@ class WindowCommitTap:
             yield self._track(obj, self.source.position)
 
     def _iter_bulk(self) -> Iterator[Any]:
-        from spatialflink_tpu.utils.metrics import check_exit_control_tuple
+        from spatialflink_tpu.utils.metrics import (ControlTupleExit,
+                                                    check_exit_control_tuple)
 
         raws: List[str] = []
         poss: List[int] = []
@@ -364,13 +365,19 @@ class WindowCommitTap:
             if not raws:
                 return
             # a record with an embedded newline would shift the native
-            # parser's line<->record mapping; so would any count mismatch —
-            # both fall back to the exact per-record parse (never silently
-            # drop or mis-attribute a record)
+            # parser's line<->record mapping; so would any count mismatch;
+            # and a record the POINT bulk parser rejects outright (e.g. a
+            # polygon feature in a point topic) raises ValueError — all
+            # three fall back to the exact per-record parse, which handles
+            # them the way the streaming path always did (never silently
+            # drop, mis-attribute, or crash on a record)
             objs = None
             if not any("\n" in r for r in raws):
-                objs = self.bulk_decode(raws)
-                if len(objs) != len(raws):
+                try:
+                    objs = self.bulk_decode(raws)
+                except ValueError:
+                    objs = None
+                if objs is not None and len(objs) != len(raws):
                     objs = None
             if objs is None:
                 objs = [self.parse(r) for r in raws]
@@ -380,7 +387,14 @@ class WindowCommitTap:
             poss.clear()
 
         for raw in self.source:
-            check_exit_control_tuple(raw)
+            try:
+                check_exit_control_tuple(raw)
+            except ControlTupleExit:
+                # records buffered BEFORE the control tuple must still reach
+                # the pipeline (the per-record path yielded every one of
+                # them before stopping)
+                yield from flush()
+                raise
             if not isinstance(raw, str):
                 # pre-parsed objects pass through; flush first (order)
                 yield from flush()

@@ -295,9 +295,126 @@ def test_kafka_realtime_lagged_commits(tmp_path):
     assert 0 < committed < len(pts)
     cfg, _ = _conf(tmp_path, "reject")
     with pytest.raises(SystemExit):
-        main(["--config", cfg, "--kafka", "--bulk"])
+        main(["--config", cfg, "--kafka", "--bulk", "--kafka-follow"])
     with pytest.raises(SystemExit):
         main(["--config", cfg, "--kafka", "--option", "99"])
+
+
+def test_kafka_bulk_topic_replay(tmp_path):
+    """--kafka --bulk: the topic drains once through the native bulk path;
+    marker-keyed windows match the streaming broker run record for record,
+    and the drained offsets commit (a re-run replays nothing)."""
+    lines = _lines()
+    cfg_s, url_s = _conf(tmp_path, "bulkdrain-stream", "cs.yml")
+    bs = resolve_broker(url_s)
+    cfg_b, url_b = _conf(tmp_path, "bulkdrain-bulk", "cb.yml")
+    bb = resolve_broker(url_b)
+    for ln in lines:
+        bs.produce(IN1, ln)
+        bb.produce(IN1, ln)
+    assert main(["--config", cfg_s, "--kafka", "--option", "1"]) == 0
+    assert main(["--config", cfg_b, "--kafka", "--option", "1",
+                 "--bulk"]) == 0
+
+    def window_table(broker):
+        out = {}
+        for r in broker.fetch(OUT, 0, 1_000_000):
+            if isinstance(r.key, str) and r.key.startswith(
+                    KafkaWindowSink.MARKER):
+                out[r.key[len(KafkaWindowSink.MARKER):]] = int(r.value)
+        return out
+
+    assert window_table(bb) == window_table(bs)
+    assert window_table(bb), "no windows produced"
+    assert bb.committed(IN1, "spatialflink") == len(lines)
+    # drained offsets committed: a re-run finds nothing new and suppresses
+    assert main(["--config", cfg_b, "--kafka", "--option", "1",
+                 "--bulk"]) == 0
+    assert window_table(bb) == window_table(bs)
+
+
+def test_kafka_bulk_join_two_topics(tmp_path):
+    """Join (101) through the topic drain: both topics drain, pair counts
+    match the streaming broker run, both groups commit."""
+    lines = _lines()
+    cfg_s, url_s = _conf(tmp_path, "bj-s", "cs.yml")
+    bs = resolve_broker(url_s)
+    cfg_b, url_b = _conf(tmp_path, "bj-b", "cb.yml")
+    bb = resolve_broker(url_b)
+    for ln in lines:
+        bs.produce(IN1, ln)
+        bb.produce(IN1, ln)
+    for ln in _lines(seed=8):
+        bs.produce(IN2, ln)
+        bb.produce(IN2, ln)
+    assert main(["--config", cfg_s, "--kafka", "--option", "101"]) == 0
+    assert main(["--config", cfg_b, "--kafka", "--option", "101",
+                 "--bulk"]) == 0
+    assert sorted(_markers(bb)) == sorted(_markers(bs))
+    assert bb.committed(IN1, "spatialflink") == len(lines)
+    assert bb.committed(IN2, "spatialflink") == bb.end_offset(IN2)
+
+
+def test_kafka_bulk_gates_before_draining(tmp_path, capsys):
+    """An invocation the cheap case gates reject (COUNT windows) never pays
+    the topic drain — the 'not bulk-drainable' reader message must NOT
+    appear, only the early 'not applicable' one."""
+    import yaml as _yaml
+
+    with open(CONF) as f:
+        d = _yaml.safe_load(f)
+    d["kafkaBootStrapServers"] = "memory://gate"
+    d["window"] = {"type": "COUNT", "interval": 16, "step": 8}
+    p = tmp_path / "count.yml"
+    p.write_text(_yaml.safe_dump(d))
+    broker = resolve_broker("memory://gate")
+    for ln in _lines():
+        broker.produce(IN1, ln)
+    rc = main(["--config", str(p), "--kafka", "--option", "1", "--bulk"])
+    assert rc == 0
+    err = capsys.readouterr().err
+    assert "not applicable" in err
+    assert "not bulk-drainable" not in err
+
+
+def test_kafka_mixed_geometry_record_resilience(tmp_path, capsys):
+    """A stray polygon feature in a declared point topic must not crash
+    either kafka mode: the chunked decode falls back to the per-record
+    parse (which dead-letters the off-type record), and --bulk falls back
+    to the streaming path — both keep producing windows."""
+    poly = json.dumps({
+        "geometry": {"type": "Polygon", "coordinates":
+                     [[[116.2, 40.2], [116.4, 40.2], [116.4, 40.4],
+                       [116.2, 40.2]]]},
+        "properties": {"oID": "px", "timestamp": 1_700_000_003_000}})
+    lines = _lines()
+    records = lines[:15] + [poly] + lines[15:]
+    for mode, extra in (("mixed-stream", []), ("mixed-bulk", ["--bulk"])):
+        cfg, url = _conf(tmp_path, mode, f"{mode}.yml")
+        broker = resolve_broker(url)
+        for r in records:
+            broker.produce(IN1, r)
+        rc = main(["--config", cfg, "--kafka", "--option", "1"] + extra)
+        assert rc == 0, mode
+        assert _markers(broker), mode
+        assert broker.committed(IN1, "spatialflink") == len(records), mode
+
+
+def test_kafka_bulk_bails_on_control_tuple(tmp_path, capsys):
+    """A control tuple in the topic makes the drain bail to the streaming
+    path, which honors the stop semantics."""
+    cfg, url = _conf(tmp_path, "bulk-control")
+    broker = resolve_broker(url)
+    lines = _lines()
+    for ln in lines[:20]:
+        broker.produce(IN1, ln)
+    broker.produce(IN1, json.dumps(
+        {"geometry": {"type": "control", "coordinates": []}}))
+    rc = main(["--config", cfg, "--kafka", "--option", "1", "--bulk"])
+    assert rc == 0
+    err = capsys.readouterr().err
+    assert "not bulk-drainable" in err
+    assert "control-tuple stop" in err
 
 
 @pytest.mark.parametrize("opt,needs2", [

@@ -11,6 +11,22 @@ import sys
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def test_bench_kafka_smoke(tmp_path):
+    out_path = tmp_path / "kafka.json"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "benchmarks", "bench_kafka.py"),
+         "--n", "3000", "--out", str(out_path)],
+        capture_output=True, text=True, timeout=420, env=env, cwd=_ROOT)
+    assert r.returncode == 0, r.stderr[-2000:]
+    rows = [json.loads(ln) for ln in r.stdout.splitlines()
+            if ln.startswith("{")]
+    assert [x["path"] for x in rows] == ["record", "chunked", "bulk", "file"]
+    assert all(x["windows"] == rows[0]["windows"] > 0 for x in rows)
+    assert json.load(open(out_path))["rows"]
+
+
 def test_bench_e2e_smoke(tmp_path):
     out_path = tmp_path / "e2e.json"
     env = dict(os.environ)

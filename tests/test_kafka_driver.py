@@ -547,6 +547,77 @@ def test_kafka_crash_restart_no_dup_no_missing(tmp_path, monkeypatch,
     assert broker.committed(IN1, "spatialflink") == len(lines)
 
 
+def test_kafka_checkpoint_resume_no_double_counting(tmp_path, monkeypatch):
+    """Stateful realtime tStats (205) through the broker with --checkpoint:
+    a crash after some state was checkpointed resumes from the
+    checkpoint's consumed offset (committed to the group at startup), so
+    no record is double-applied — final per-trajectory stats match an
+    uninterrupted oracle run."""
+    from spatialflink_tpu.streams.kafka import KafkaSink
+
+    grid = UniformGrid(115.5, 117.6, 39.6, 41.1, num_grid_partitions=100)
+    pts = list(SyntheticPointSource(grid, num_trajectories=5, steps=400,
+                                    seed=6))
+    lines = [serialize_spatial(p, "GeoJSON") for p in pts]
+
+    def last_per_traj(broker):
+        out = {}
+        for v in broker.topic_values(OUT):
+            if isinstance(v, tuple) and len(v) == 4:
+                out[v[0]] = v
+        return out
+
+    # oracle: one uninterrupted run
+    cfg_o, url_o = _conf(tmp_path, "ckpt-oracle", "o.yml")
+    bo = resolve_broker(url_o)
+    for ln in lines:
+        bo.produce(IN1, ln)
+    assert main(["--config", cfg_o, "--kafka", "--option", "205",
+                 "--checkpoint", str(tmp_path / "o.npz"),
+                 "--checkpoint-every", "2"]) == 0
+    oracle = last_per_traj(bo)
+    assert oracle, "oracle run emitted nothing"
+
+    # crashed run: KafkaSink dies mid-stream, restart resumes
+    cfg, url = _conf(tmp_path, "ckpt-crash", "c.yml")
+    broker = resolve_broker(url)
+    for ln in lines:
+        broker.produce(IN1, ln)
+    ck = str(tmp_path / "c.npz")
+    orig = KafkaSink.emit
+    state = {"n": 0}
+
+    def boom(self, record):
+        state["n"] += 1
+        # past the first checkpoint (checkpoint-every=2 micro-batches of
+        # 512 records ≈ 1024 tuples): the restart must resume from the
+        # checkpoint's consumed offset, not offset 0
+        if state["n"] == 1200:
+            raise RuntimeError("injected sink crash")
+        orig(self, record)
+
+    with monkeypatch.context() as m:
+        m.setattr(KafkaSink, "emit", boom)
+        with pytest.raises(RuntimeError, match="injected sink crash"):
+            main(["--config", cfg, "--kafka", "--option", "205",
+                  "--checkpoint", ck, "--checkpoint-every", "2"])
+    from spatialflink_tpu.runtime.state import checkpoint_consumed
+
+    consumed = checkpoint_consumed(ck)
+    assert consumed > 0, "crash must land after the first checkpoint"
+    assert main(["--config", cfg, "--kafka", "--option", "205",
+                 "--checkpoint", ck, "--checkpoint-every", "2"]) == 0
+    got = last_per_traj(broker)
+    assert got.keys() == oracle.keys()
+    for oid, t in oracle.items():
+        g = got[oid]
+        # cumulative state: identical final stats despite the different
+        # batch split across the restart
+        assert g[2] == t[2], (oid, g, t)          # temporal (int)
+        assert abs(g[1] - t[1]) < 1e-4, (oid, g, t)  # spatial
+    assert broker.committed(IN1, "spatialflink") == len(lines)
+
+
 # ------------------------------------------------------------- tap unit
 
 

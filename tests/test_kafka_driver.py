@@ -400,6 +400,56 @@ def test_kafka_mixed_geometry_record_resilience(tmp_path, capsys):
         assert broker.committed(IN1, "spatialflink") == len(records), mode
 
 
+def test_kafka_bulk_composes_with_multi_query(tmp_path):
+    """--kafka --bulk --multi-query: the lazy topic drain feeds the bulk
+    multi-query evaluators; markers match the streaming multi run."""
+    qp = {"queryPoints": [[116.3, 40.3], [116.7, 40.7]]}
+    lines = _lines()
+    cfg_s, url_s = _conf(tmp_path, "mqb-s", "cs.yml", **qp)
+    bs = resolve_broker(url_s)
+    cfg_b, url_b = _conf(tmp_path, "mqb-b", "cb.yml", **qp)
+    bb = resolve_broker(url_b)
+    for ln in lines:
+        bs.produce(IN1, ln)
+        bb.produce(IN1, ln)
+    assert main(["--config", cfg_s, "--kafka", "--option", "51",
+                 "--multi-query"]) == 0
+    assert main(["--config", cfg_b, "--kafka", "--option", "51",
+                 "--multi-query", "--bulk"]) == 0
+    assert sorted(_markers(bb)) == sorted(_markers(bs)) != []
+    assert bb.committed(IN1, "spatialflink") == len(lines)
+
+
+def test_kafka_bulk_geometry_stream(tmp_path):
+    """A WKT polygon STREAM (option 21, polygon-point range) drains through
+    the geometry bulk path; markers match the streaming broker run."""
+    import numpy as np
+
+    grid = UniformGrid(115.5, 117.6, 39.6, 41.1, num_grid_partitions=100)
+    rng = np.random.default_rng(3)
+    t0 = 1_700_000_000_000
+    rows = []
+    for i in range(120):
+        cx, cy = rng.uniform(115.7, 117.4), rng.uniform(39.8, 40.9)
+        w = rng.uniform(0.01, 0.05)
+        rows.append(f"g{i % 16}, {t0 + i * 200}, POLYGON (("
+                    f"{cx - w} {cy - w}, {cx + w} {cy - w}, "
+                    f"{cx + w} {cy + w}, {cx - w} {cy + w}, "
+                    f"{cx - w} {cy - w}))")
+    cfg_s, url_s = _conf(tmp_path, "geo-s", "cs.yml")
+    bs = resolve_broker(url_s)
+    cfg_b, url_b = _conf(tmp_path, "geo-b", "cb.yml")
+    bb = resolve_broker(url_b)
+    for r in rows:
+        bs.produce(IN1, r)
+        bb.produce(IN1, r)
+    argv = ["--kafka", "--option", "21", "--format", "WKT"]
+    assert main(["--config", cfg_s] + argv) == 0
+    assert main(["--config", cfg_b] + argv + ["--bulk"]) == 0
+    assert sorted(_markers(bb)) == sorted(_markers(bs)) != []
+    assert bb.committed(IN1, "spatialflink") == len(rows)
+
+
 def test_kafka_bulk_bails_on_control_tuple(tmp_path, capsys):
     """A control tuple in the topic makes the drain bail to the streaming
     path, which honors the stop semantics."""

@@ -473,6 +473,7 @@ def test_kafka_bulk_bails_on_control_tuple(tmp_path, capsys):
     (208, False),   # taggregate window: heatmap rides the summary record
     (210, True),    # tjoin window: two topics
     (1010, False),  # StayTime app (plain sink)
+    (2000, False),  # CheckIn app (DEIM CSV, plain sink)
     (504, False),   # WKT deser conformance (plain sink)
 ])
 def test_kafka_family_matrix(tmp_path, opt, needs2):
@@ -484,6 +485,11 @@ def test_kafka_family_matrix(tmp_path, opt, needs2):
     if opt == 504:
         records = ["GEOMETRYCOLLECTION (POINT (116.5 40.5), "
                    "LINESTRING (116 40, 117 41))"]
+    elif opt == 2000:
+        # DEIM check-in events: eventID,deviceID,userID,ts,x,y
+        records = [f"e{i},room{i % 3}-{'in' if i % 2 == 0 else 'out'},"
+                   f"u{i % 4},{1_700_000_000_000 + i * 1000},116.5,40.5"
+                   for i in range(24)]
     else:
         records = _lines()
     for r in records:

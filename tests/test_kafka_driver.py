@@ -603,6 +603,53 @@ def test_kafka_crash_restart_no_dup_no_missing(tmp_path, monkeypatch,
     assert broker.committed(IN1, "spatialflink") == len(lines)
 
 
+def test_kafka_realtime_crash_restart_no_missing_records(tmp_path,
+                                                         monkeypatch):
+    """Realtime range (option 2) with lagged commits: a crash mid-run and
+    restart may duplicate output (at-least-once, plain sink) but must never
+    MISS a matching record — the lag guarantees uncommitted records cover
+    every batch not fully produced."""
+    from spatialflink_tpu.streams.kafka import KafkaSink
+
+    grid = UniformGrid(115.5, 117.6, 39.6, 41.1, num_grid_partitions=100)
+    pts = list(SyntheticPointSource(grid, num_trajectories=20, steps=150,
+                                    seed=12))
+    lines = [serialize_spatial(p, "GeoJSON") for p in pts]
+
+    cfg_o, url_o = _conf(tmp_path, "rt-oracle", "o.yml")
+    bo = resolve_broker(url_o)
+    for ln in lines:
+        bo.produce(IN1, ln)
+    assert main(["--config", cfg_o, "--kafka", "--option", "2"]) == 0
+    oracle = set(bo.topic_values(OUT))
+    assert oracle
+
+    cfg, url = _conf(tmp_path, "rt-crash", "c.yml")
+    broker = resolve_broker(url)
+    for ln in lines:
+        broker.produce(IN1, ln)
+    orig = KafkaSink.emit
+    state = {"n": 0}
+
+    def boom(self, record):
+        state["n"] += 1
+        if state["n"] == len(oracle) // 2:
+            raise RuntimeError("injected realtime crash")
+        orig(self, record)
+
+    with monkeypatch.context() as m:
+        m.setattr(KafkaSink, "emit", boom)
+        with pytest.raises(RuntimeError, match="injected realtime crash"):
+            main(["--config", cfg, "--kafka", "--option", "2"])
+    committed_mid = broker.committed(IN1, "spatialflink")
+    assert committed_mid < len(lines)
+    assert main(["--config", cfg, "--kafka", "--option", "2"]) == 0
+    got = set(broker.topic_values(OUT))
+    missing = oracle - got
+    assert not missing, f"records lost across realtime restart: {missing}"
+    assert broker.committed(IN1, "spatialflink") == len(lines)
+
+
 def test_kafka_checkpoint_resume_no_double_counting(tmp_path, monkeypatch):
     """Stateful realtime tStats (205) through the broker with --checkpoint:
     a crash after some state was checkpointed resumes from the

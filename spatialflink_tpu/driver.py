@@ -962,44 +962,49 @@ def _wire_kafka(params: Params, spec: CaseSpec, args, skip1: int
     if skip1:
         broker.commit(t1, group, skip1)
     follow = bool(args.kafka_follow)
+    u_grid, q_grid = params.grids()
+    size_ms, step_ms = params.window_ms()
+    geom1 = spec.stream if spec.family in ("range", "knn", "join") \
+        else "Point"
+    geom2 = spec.query if spec.family == "join" else "Point"
+    # point streams batch the decode through the native bulk parser; in
+    # live (follow) mode the source's starvation sentinel bounds the chunk
+    # buffering latency to one poll cycle, and a smaller chunk keeps the
+    # per-flush work short
+    two_stream = (spec.family in ("join", "tjoin")
+                  or (spec.family == "staytime" and spec.query == "Polygon"))
+    bulk1 = (_kafka_bulk_decode(params.input1, u_grid)
+             if windowed and geom1 == "Point" else None)
+    bulk2 = (_kafka_bulk_decode(params.input2, q_grid)
+             if windowed and two_stream and geom2 == "Point" else None)
+    chunk = 512 if follow else 2048
     # --limit bounds THIS run's consumption per stream (from the group's
     # resume point), mirroring the file path's record bound
     src1 = KafkaSource(broker, t1, group, auto_commit=False,
-                       stop_at_end=not follow, limit=args.limit)
+                       stop_at_end=not follow, limit=args.limit,
+                       starvation_sentinel=follow and bulk1 is not None)
     sources = [src1]
     src2 = None
-    if (spec.family in ("join", "tjoin")
-            or (spec.family == "staytime" and spec.query == "Polygon")):
+    if two_stream:
         src2 = KafkaSource(broker, t2, group, auto_commit=False,
-                           stop_at_end=not follow, limit=args.limit)
+                           stop_at_end=not follow, limit=args.limit,
+                           starvation_sentinel=follow and bulk2 is not None)
         sources.append(src2)
 
-    u_grid, q_grid = params.grids()
-    size_ms, step_ms = params.window_ms()
     taps: List = []
     stream1: Iterable = src1
     stream2: Optional[Iterable] = src2
     if windowed:
-        geom1 = spec.stream if spec.family in ("range", "knn", "join") \
-            else "Point"
-        # bounded drains batch the decode through the native bulk parser
-        # (point streams only; live mode keeps the latency-optimal
-        # per-record path)
-        bulk1 = (None if follow or geom1 != "Point"
-                 else _kafka_bulk_decode(params.input1, u_grid))
         stream1 = WindowCommitTap(src1, size_ms, step_ms,
                                   parse=_parse_fn(params.input1, u_grid,
                                                   geom1),
-                                  bulk_decode=bulk1)
+                                  bulk_decode=bulk1, bulk_chunk=chunk)
         taps.append(stream1)
         if src2 is not None:
-            geom2 = spec.query if spec.family == "join" else "Point"
-            bulk2 = (None if follow or geom2 != "Point"
-                     else _kafka_bulk_decode(params.input2, q_grid))
             stream2 = WindowCommitTap(src2, size_ms, step_ms,
                                       parse=_parse_fn(params.input2, q_grid,
                                                       geom2),
-                                      bulk_decode=bulk2)
+                                      bulk_decode=bulk2, bulk_chunk=chunk)
             taps.append(stream2)
 
     out = params.output.topic_name

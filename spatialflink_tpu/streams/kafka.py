@@ -99,6 +99,13 @@ class InMemoryBroker:
             return [r.value for r in self._topics.get(topic, [])]
 
 
+#: yielded by a KafkaSource constructed with ``starvation_sentinel=True``
+#: whenever a live-mode poll comes up empty — a batching consumer (the
+#: commit tap's chunked decode) flushes on it so buffered records never
+#: wait out a quiet topic; it is NOT a record and never commits offsets
+STARVED = object()
+
+
 class KafkaSource:
     """Consumer-group iterator over a topic (reference:
     ``FlinkKafkaConsumer`` at ``StreamingJob.java:473``).
@@ -119,7 +126,8 @@ class KafkaSource:
     def __init__(self, broker: InMemoryBroker, topic: str, group: str,
                  poll_batch: int = 500, commit_every: int = 1,
                  stop_at_end: bool = True, auto_commit: bool = True,
-                 limit: Optional[int] = None):
+                 limit: Optional[int] = None,
+                 starvation_sentinel: bool = False):
         self.broker = broker
         self.topic = topic
         self.group = group
@@ -127,6 +135,9 @@ class KafkaSource:
         self.commit_every = max(1, commit_every)
         self.stop_at_end = stop_at_end
         self.auto_commit = auto_commit
+        #: live mode only: yield :data:`STARVED` before sleeping on an empty
+        #: poll (opt-in — only consumers that understand the marker set it)
+        self.starvation_sentinel = starvation_sentinel
         #: max records to hand out per iteration (None = unbounded) — the
         #: driver's --limit for broker-fed runs; counts THIS run's records,
         #: from the group's resume point
@@ -153,6 +164,8 @@ class KafkaSource:
             if not batch:
                 if self.stop_at_end:
                     break
+                if self.starvation_sentinel:
+                    yield STARVED
                 time.sleep(0.01)
                 continue
             for rec in batch:
@@ -307,9 +320,10 @@ class WindowCommitTap:
     native ingest: raw string records accumulate into chunks and decode in
     ONE native call (the bulk replay path's parser, applied to broker
     records) — per-record positions are snapshotted at pull time, so the
-    window-aligned commit bookkeeping is identical. Only for BOUNDED drains
-    (the driver keeps the per-record path in ``--kafka-follow`` live mode,
-    where buffering a chunk would add latency).
+    window-aligned commit bookkeeping is identical. In live mode the source
+    must be constructed with ``starvation_sentinel=True``: the tap flushes
+    its buffer on every :data:`STARVED` marker, bounding the added latency
+    to one poll cycle instead of one chunk fill.
     """
 
     def __init__(self, source: KafkaSource, size_ms: int, slide_ms: int,
@@ -350,6 +364,8 @@ class WindowCommitTap:
             yield from self._iter_bulk()
             return
         for raw in self.source:
+            if raw is STARVED:  # only batching consumers need the marker
+                continue
             check_exit_control_tuple(raw)
             obj = self.parse(raw) if self.parse is not None else raw
             yield self._track(obj, self.source.position)
@@ -387,6 +403,12 @@ class WindowCommitTap:
             poss.clear()
 
         for raw in self.source:
+            if raw is STARVED:
+                # quiet topic: hand everything buffered downstream so a
+                # chunk never waits out dead air (live-mode latency bound =
+                # one poll cycle, not one chunk fill)
+                yield from flush()
+                continue
             try:
                 check_exit_control_tuple(raw)
             except ControlTupleExit:

@@ -119,6 +119,39 @@ def test_live_realtime_latency_distribution(tmp_path):
     assert lat_recs and lat_recs[0].timestamp_ms <= done["at_ms"] + 60_000
 
 
+def test_starvation_sentinel_bounds_chunk_latency():
+    """Live-mode chunked decode: a quiet topic flushes the tap's buffer via
+    the source's STARVED marker — records never wait out a chunk fill (the
+    consume thread would hang without it)."""
+    from spatialflink_tpu.streams import InMemoryBroker, KafkaSource
+    from spatialflink_tpu.streams.formats import parse_spatial
+    from spatialflink_tpu.streams.kafka import WindowCommitTap
+
+    broker = InMemoryBroker()
+    for i in range(3):
+        broker.produce("t", serialize_spatial(
+            Point.create(116.5, 40.5, GRID, obj_id=f"a{i}",
+                         timestamp=1_700_000_000_000 + i), "GeoJSON"))
+    src = KafkaSource(broker, "t", "g", auto_commit=False,
+                      stop_at_end=False, starvation_sentinel=True)
+    parse = lambda r: parse_spatial(r, "GeoJSON", GRID)  # noqa: E731
+    tap = WindowCommitTap(src, 10_000, 5_000, parse=parse,
+                          bulk_decode=lambda raws: [parse(r) for r in raws],
+                          bulk_chunk=100)  # chunk >> records on the topic
+    out = []
+
+    def consume():
+        it = iter(tap)
+        for _ in range(3):
+            out.append(next(it))
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    t.join(timeout=15)
+    assert [getattr(o, "obj_id", None) for o in out] == ["a0", "a1", "a2"], \
+        "buffered records did not flush on starvation"
+
+
 # ------------------------------------------------------ overlap mechanism
 
 

@@ -3,8 +3,10 @@ through the three ``--kafka`` execution paths the driver offers, plus the
 file-replay reference point — quantifying what each decode/replay tier buys
 (the reference's pipelines are all Kafka-fed, ``StreamingJob.java:473``):
 
-- ``record``:  per-record ``parse_spatial`` in the commit tap (the live
-  ``--kafka-follow`` path's mechanism, forced here for a bounded drain)
+- ``record``:  per-record ``parse_spatial`` in the commit tap (the
+  fallback when a chunk cannot ride the native parser; live follow mode
+  also uses chunked decode, with starvation-sentinel flushes bounding the
+  buffering latency to one poll cycle)
 - ``chunked``: the default bounded drain — raw records batch through the
   native bulk parser in ``WindowCommitTap`` chunks
 - ``bulk``:    ``--kafka --bulk`` — one lazy topic drain through the

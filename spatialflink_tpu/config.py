@@ -344,3 +344,30 @@ class Params:
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
+
+    def job_fingerprint(self, group: str = "") -> str:
+        """Stable 8-hex digest of what makes two runs "the same job":
+        consumer group + the full query and window blocks. Folded into
+        KafkaWindowSink's idempotency keys so the dedup markers of one job
+        configuration never suppress the windows of a different one sharing
+        the output topic (two runs differing only in e.g. queryPoints or
+        the window size answer different questions and must both produce).
+        Transport and execution knobs (bootstrap servers, topic names,
+        formats, mesh shape) are deliberately excluded: moving the same job
+        to a different broker, re-encoding its input, or changing its
+        device parallelism does not change what its windows mean — a
+        sharded re-run must dedup against a single-device run's markers."""
+        import hashlib
+        import json
+
+        query = dataclasses.asdict(self.query)
+        query.pop("parallelism", None)
+        query.pop("hosts", None)
+        payload = {
+            "group": group,
+            "query": query,
+            "window": dataclasses.asdict(self.window),
+        }
+        return hashlib.sha1(
+            json.dumps(payload, sort_keys=True, default=str).encode()
+        ).hexdigest()[:8]

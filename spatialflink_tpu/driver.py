@@ -822,6 +822,10 @@ class _KafkaWiring:
     #: the drained range; finish() commits exactly these (the sources were
     #: never iterated, so their positions are meaningless)
     bulk_offsets: Optional[List] = None
+    #: degradation counters at wiring time: the summary reports the DELTA,
+    #: so a later in-process run doesn't inherit an earlier run's chaos/
+    #: retry/dlq counts (the registry is process-global)
+    deg_baseline: Optional[dict] = None
 
     def emit(self, result) -> None:
         """Produce one pipeline result, then advance window-aligned commits
@@ -871,6 +875,8 @@ class _KafkaWiring:
                 src.commit_to(src.position)
 
     def summary(self) -> str:
+        from spatialflink_tpu.utils.metrics import degradation_snapshot
+
         parts = []
         if self.win_sink is not None:
             parts.append(f"{self.win_sink.windows_produced} windows produced"
@@ -879,6 +885,15 @@ class _KafkaWiring:
         parts.append("committed " + ", ".join(
             f"{s.topic}@{s.broker.committed(s.topic, s.group)}"
             for s in self.sources))
+        base = self.deg_baseline or {}
+        deg = {k: v - base.get(k, 0) for k, v in
+               degradation_snapshot().items() if v > base.get(k, 0)}
+        if deg:
+            # injected faults + recovery activity (retries, breaker trips,
+            # verified produces, dead-lettered records) THIS run — the
+            # "how rough was the transport" digest
+            parts.append("degraded: " + ", ".join(
+                f"{k}={v}" for k, v in sorted(deg.items())))
         return "# kafka: " + "; ".join(parts)
 
 
@@ -898,12 +913,14 @@ def _topic_reader(kafka: _KafkaWiring, topic: str, limit: Optional[int],
         end = b.end_offset(topic)
         if limit is not None:
             end = min(end, off + limit)
+        from spatialflink_tpu.streams.kafka import resequence_batch
+
         vals: List[str] = []
         while off < end:
             batch = b.fetch(topic, off, min(65536, end - off))
             if not batch:
                 break
-            for r in batch:
+            for r in resequence_batch(batch, off):
                 v = r.value
                 if not isinstance(v, str) or "\n" in v or '"control"' in v:
                     print(f"# --kafka --bulk: topic '{topic}' not "
@@ -912,7 +929,7 @@ def _topic_reader(kafka: _KafkaWiring, topic: str, limit: Optional[int],
                           file=sys.stderr)
                     return None
                 vals.append(v)
-            off = batch[-1].offset + 1
+                off = r.offset + 1
         offsets_out.append((topic, off))
         return "\n".join(vals).encode()
 
@@ -926,8 +943,14 @@ def _wire_kafka(params: Params, spec: CaseSpec, args, skip1: int
                                                 WindowCommitTap,
                                                 resolve_broker)
 
+    from spatialflink_tpu.utils.metrics import degradation_snapshot
+
     bootstrap = args.kafka_bootstrap or params.kafka_bootstrap_servers
     group = args.kafka_group
+    chaos_spec = getattr(args, "chaos", None)
+    retry_spec = getattr(args, "retry", None)
+    use_dlq = bool(getattr(args, "dlq", False))
+    deg_baseline = degradation_snapshot()
     t1, t2 = params.input1.topic_name, params.input2.topic_name
     windowed = (spec.mode == "window" and params.window.type != "COUNT"
                 and spec.family in _KAFKA_WINDOWED_FAMILIES)
@@ -951,6 +974,17 @@ def _wire_kafka(params: Params, spec: CaseSpec, args, skip1: int
             "offset and a restart would reprocess the entire topic")
 
     broker = resolve_broker(bootstrap)
+    if chaos_spec is not None:
+        # fault injection UNDER the supervisor, so the recovery machinery
+        # (not the pipeline) eats the injected faults — the layering a real
+        # flaky cluster imposes
+        from spatialflink_tpu.runtime.faults import ChaosBroker, FaultPlan
+
+        broker = ChaosBroker(broker, FaultPlan.from_spec(chaos_spec))
+    if retry_spec is not None:
+        from spatialflink_tpu.runtime.supervisor import SupervisedBroker
+
+        broker = SupervisedBroker.from_spec(broker, retry_spec)
     # bounded replay THROUGH the broker: file records become topic records
     if args.input1:
         _preproduce(broker, t1, args.input1, args.limit)
@@ -982,15 +1016,31 @@ def _wire_kafka(params: Params, spec: CaseSpec, args, skip1: int
     # resume point), mirroring the file path's record bound
     src1 = KafkaSource(broker, t1, group, auto_commit=False,
                        stop_at_end=not follow, limit=args.limit,
-                       starvation_sentinel=follow and bulk1 is not None)
+                       starvation_sentinel=follow and bulk1 is not None,
+                       commit_lag=commit_lag)
     sources = [src1]
     src2 = None
     if two_stream:
         src2 = KafkaSource(broker, t2, group, auto_commit=False,
                            stop_at_end=not follow, limit=args.limit,
-                           starvation_sentinel=follow and bulk2 is not None)
+                           starvation_sentinel=follow and bulk2 is not None,
+                           commit_lag=commit_lag)
         sources.append(src2)
 
+    out = params.output.topic_name
+    dlq = None
+    if use_dlq and not windowed:
+        # the quarantine hook lives in the windowed commit tap's parse
+        # stage; realtime/app/deser cases parse inside their pipelines and
+        # a poison record still raises — say so instead of silently
+        # accepting a flag that protects nothing
+        print("warning: --dlq applies to event-time windowed --kafka "
+              "cases only; this case parses in-pipeline and poison "
+              "records will still fail the run", file=sys.stderr)
+    elif use_dlq:
+        from spatialflink_tpu.runtime.supervisor import DeadLetterQueue
+
+        dlq = DeadLetterQueue(broker, out + "-dlq")
     taps: List = []
     stream1: Iterable = src1
     stream2: Optional[Iterable] = src2
@@ -998,25 +1048,31 @@ def _wire_kafka(params: Params, spec: CaseSpec, args, skip1: int
         stream1 = WindowCommitTap(src1, size_ms, step_ms,
                                   parse=_parse_fn(params.input1, u_grid,
                                                   geom1),
-                                  bulk_decode=bulk1, bulk_chunk=chunk)
+                                  bulk_decode=bulk1, bulk_chunk=chunk,
+                                  dlq=dlq)
         taps.append(stream1)
         if src2 is not None:
             stream2 = WindowCommitTap(src2, size_ms, step_ms,
                                       parse=_parse_fn(params.input2, q_grid,
                                                       geom2),
-                                      bulk_decode=bulk2, bulk_chunk=chunk)
+                                      bulk_decode=bulk2, bulk_chunk=chunk,
+                                      dlq=dlq)
             taps.append(stream2)
 
-    out = params.output.topic_name
     sink_kw = dict(fmt=args.output_format,
                    date_format=params.input1.date_format,
                    delimiter=params.output.delimiter)
-    win_sink = KafkaWindowSink(broker, out, **sink_kw) if windowed else None
+    win_sink = KafkaWindowSink(broker, out,
+                               job_id=params.job_fingerprint(group),
+                               seed_scan_limit=getattr(
+                                   args, "seed_scan_limit", None),
+                               **sink_kw) if windowed else None
     return _KafkaWiring(
         broker=broker, stream1=stream1, stream2=stream2, sources=sources,
         taps=taps, win_sink=win_sink,
         plain_sink=KafkaSink(broker, out, **sink_kw),
-        latency_topic=out + "-latency", group=group, commit_lag=commit_lag)
+        latency_topic=out + "-latency", group=group, commit_lag=commit_lag,
+        deg_baseline=deg_baseline)
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -1096,6 +1152,37 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "the input topic instead of stopping (a producer "
                          "feeds the topic concurrently; stop with the "
                          "control tuple)")
+    ap.add_argument("--chaos", metavar="SPEC", default=None,
+                    help="fault-inject the broker transport from a seeded "
+                         "deterministic plan: comma-joined key=value pairs "
+                         "over seed / produce_fail / ack_lost / fetch_fail "
+                         "/ duplicate / reorder / torn / latency(+_ms) / "
+                         "fail_next_produces / fail_next_fetches, e.g. "
+                         "'seed=7,fetch_fail=0.2,torn=0.1'. Pair with "
+                         "--retry (and --dlq for torn payloads) or the "
+                         "injected faults will crash the run — that "
+                         "contrast is the point")
+    ap.add_argument("--retry", metavar="SPEC", nargs="?", const="",
+                    default=None,
+                    help="supervise broker produce/fetch with retry + "
+                         "backoff + a circuit breaker (idempotent produce "
+                         "retries: ambiguous failures re-check the log "
+                         "before re-sending). Optional SPEC tunes it: "
+                         "attempts / base_ms / max_ms / multiplier / "
+                         "jitter / attempt_timeout_ms / deadline_ms / "
+                         "seed / breaker_threshold / cooldown_ms")
+    ap.add_argument("--dlq", action="store_true",
+                    help="quarantine poison records (parse failures that "
+                         "survive redelivery) to '<outputTopic>-dlq' with "
+                         "failure metadata instead of crashing the "
+                         "pipeline (windowed --kafka cases)")
+    ap.add_argument("--seed-scan-limit", type=int, default=None,
+                    metavar="N",
+                    help="bound the output-topic dedup seed scan to the "
+                         "last N records (default: full scan; the scan "
+                         "warns when an uncompacted topic makes it large) "
+                         "— accepts that windows committed before the "
+                         "scanned tail can be re-produced on re-delivery")
     args = ap.parse_args(argv)
 
     _enable_compilation_cache()
@@ -1140,6 +1227,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.kafka and args.bulk and args.kafka_follow:
         ap.error("--kafka-follow and --bulk are mutually exclusive "
                  "(bulk is a bounded vectorized drain, not a live stream)")
+    if not args.kafka and (args.chaos is not None or args.retry is not None
+                           or args.dlq or args.seed_scan_limit is not None):
+        ap.error("--chaos/--retry/--dlq/--seed-scan-limit wrap the broker "
+                 "transport and need --kafka")
     if args.kafka and spec.family in ("shapefile", "synthetic"):
         ap.error(f"--kafka does not apply to the {spec.family} cases "
                  "(no input topic)")

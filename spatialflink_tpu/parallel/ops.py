@@ -33,7 +33,34 @@ from spatialflink_tpu.ops.knn import KnnResult, knn_point, topk_by_distance
 from spatialflink_tpu.ops.range import range_filter_point
 from spatialflink_tpu.parallel.mesh import CELL_AXIS, DCN_AXIS
 
-shard_map = jax.shard_map
+def _compat_shard_map():
+    """jax.shard_map across jax versions: < 0.5 ships it under
+    experimental, and the replication-check kwarg was named check_rep
+    before the check_vma rename — keyed on the actual signature, not the
+    attribute location, so the middle range (top-level fn, old kwarg) works
+    too."""
+    import functools
+    import inspect
+
+    fn = getattr(jax, "shard_map", None)
+    if fn is None:
+        from jax.experimental.shard_map import shard_map as fn
+    try:
+        if "check_vma" in inspect.signature(fn).parameters:
+            return fn
+    except (TypeError, ValueError):  # uninspectable: assume current API
+        return fn
+
+    @functools.wraps(fn)
+    def renamed(*args, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return fn(*args, **kwargs)
+
+    return renamed
+
+
+shard_map = _compat_shard_map()
 
 
 def distributed_knn(

@@ -14,5 +14,31 @@ stamping arrival time as the event time at the source.
 
 from spatialflink_tpu.runtime.watermarks import BoundedOutOfOrderness
 from spatialflink_tpu.runtime.windows import WindowSpec, WindowAssembler
+from spatialflink_tpu.runtime.faults import (
+    ChaosBroker,
+    FaultPlan,
+    TransientBrokerError,
+)
+from spatialflink_tpu.runtime.supervisor import (
+    CircuitBreaker,
+    CircuitOpenError,
+    DeadLetterQueue,
+    RetryError,
+    RetryPolicy,
+    SupervisedBroker,
+)
 
-__all__ = ["BoundedOutOfOrderness", "WindowSpec", "WindowAssembler"]
+__all__ = [
+    "BoundedOutOfOrderness",
+    "WindowSpec",
+    "WindowAssembler",
+    "ChaosBroker",
+    "FaultPlan",
+    "TransientBrokerError",
+    "CircuitBreaker",
+    "CircuitOpenError",
+    "DeadLetterQueue",
+    "RetryError",
+    "RetryPolicy",
+    "SupervisedBroker",
+]

@@ -145,7 +145,12 @@ class TrajStateStore:
         import jax.numpy as jnp
 
         store = cls(capacity=int(cp.meta["capacity"]))
+        # jnp.array (copy) rather than jnp.asarray: the restored state is
+        # DONATED on the first tstats_update, and asarray may zero-copy
+        # alias the checkpoint's numpy buffers on CPU — donation would then
+        # free memory numpy still owns (observed as nondeterministic heap
+        # corruption/aborts on the first post-restore update)
         store.state = TrajStatsState(
-            **{k: jnp.asarray(v) for k, v in cp.arrays.items()}
+            **{k: jnp.array(v) for k, v in cp.arrays.items()}
         )
         return store

@@ -1,0 +1,531 @@
+"""Supervised recovery: retry/backoff, circuit breaking, dead-lettering.
+
+The transport contract this layer restores (over a degraded broker — see
+:mod:`spatialflink_tpu.runtime.faults` for the fault model):
+
+- :class:`RetryPolicy` — exponential backoff with decorrelating jitter, an
+  optional per-attempt timeout and an overall deadline, for the transient
+  produce/fetch errors a retry can fix.
+- :class:`CircuitBreaker` — after N *consecutive* failures the circuit
+  opens and calls fail fast until a cool-down elapses; the first call after
+  the cool-down half-opens the circuit as a probe (success closes it,
+  failure re-opens). Protects a struggling broker from a retry storm and
+  gives operators a single counter (``breaker-trips``) that says "the
+  transport was down, not slow".
+- :class:`DeadLetterQueue` — poison records (parse failures that survive
+  redelivery) are quarantined to a dead-letter topic with failure metadata
+  instead of wedging the pipeline; the reference's Flink job simply crashed
+  (``HelperClass.checkExitControlTuple`` aside, any malformed tuple threw).
+- :class:`SupervisedBroker` — the composition: any broker implementing the
+  :class:`~spatialflink_tpu.streams.kafka.InMemoryBroker` surface, with
+  produce/fetch routed through retry + breaker, and produce retries made
+  IDEMPOTENT by verification: an ambiguous produce failure (raised after
+  the record may have landed — a lost ack) re-reads the log tail before
+  retrying, so the blind-retry duplicate never reaches the topic.
+
+Nothing here imports JAX or touches device state — supervision is a host
+concern, and the same shapes (backoff, breaker, quarantine) transfer
+directly to a model-serving stack's RPC edges.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass, fields
+from typing import Any, Callable, Iterator, List, Optional, Tuple
+
+from spatialflink_tpu.runtime.faults import TransientBrokerError, parse_spec
+
+
+class RetryError(Exception):
+    """Attempts or deadline exhausted; ``__cause__`` is the last failure."""
+
+
+class CircuitOpenError(Exception):
+    """Raised by :meth:`CircuitBreaker.check` while the circuit is open and
+    the cool-down has not elapsed (fail-fast, no broker call made)."""
+
+
+class AttemptTimeout(TimeoutError):
+    """A per-attempt timeout fired; the stranded attempt keeps running on
+    its worker thread. ``future`` lets the retry loop wait for it to settle
+    (and adopt a late success) instead of blindly re-running the call."""
+
+    def __init__(self, msg: str, future):
+        super().__init__(msg)
+        self.future = future
+
+
+class _Attempt:
+    """One timed attempt on a DAEMON thread — a genuinely hung broker call
+    must never block interpreter shutdown (a pooled non-daemon worker
+    would be joined at exit). Future-shaped: done/wait/exception/result."""
+
+    def __init__(self, fn: Callable, args, kwargs):
+        import threading
+
+        self._done = threading.Event()
+        self._result = None
+        self._error: Optional[BaseException] = None
+        threading.Thread(target=self._run, args=(fn, args, kwargs),
+                         daemon=True, name="retry-attempt").start()
+
+    def _run(self, fn, args, kwargs):
+        try:
+            self._result = fn(*args, **kwargs)
+        except BaseException as e:  # delivered via exception()/result()
+            self._error = e
+        finally:
+            self._done.set()
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+    def exception(self) -> Optional[BaseException]:
+        return self._error
+
+    def result(self):
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+@dataclass
+class RetryPolicy:
+    """Exponential backoff with jitter, bounded by attempts and a deadline.
+
+    Delay for attempt ``i`` (0-based failures) is
+    ``min(max_delay_s, base_delay_s * multiplier**i)`` scaled by a jitter
+    factor drawn uniformly from ``[1 - jitter, 1 + jitter]`` — seeded, so a
+    test replays the exact schedule. ``attempt_timeout_s`` (optional) bounds
+    a single attempt by running it on a worker thread; a timed-out attempt
+    counts as a retryable failure, and the backoff before the next attempt
+    is spent WAITING for the stranded attempt to settle — a late success is
+    adopted rather than re-run (re-running would double-apply the side
+    effect). An attempt still running after that wait is the residual
+    ambiguous case :class:`SupervisedBroker`'s verified produce exists for.
+    ``deadline_s`` bounds the whole call: no retry is scheduled that would
+    start past the deadline.
+    """
+
+    max_attempts: int = 8
+    base_delay_s: float = 0.01
+    max_delay_s: float = 2.0
+    multiplier: float = 2.0
+    jitter: float = 0.1
+    attempt_timeout_s: Optional[float] = None
+    deadline_s: Optional[float] = None
+    seed: int = 0
+    retryable: Tuple[type, ...] = (TransientBrokerError, TimeoutError,
+                                   ConnectionError)
+
+    def __post_init__(self):
+        if self.max_attempts < 1:
+            raise ValueError("RetryPolicy.max_attempts must be >= 1")
+        import random
+
+        self._rng = random.Random(self.seed)
+        self._stranded: list = []  # timed-out attempts still running
+
+    @classmethod
+    def from_spec(cls, spec: str) -> "RetryPolicy":
+        """Parse the CLI's ``--retry`` spec (``key=value`` pairs, ms units
+        for delays): ``"attempts=10,base_ms=5,max_ms=500,deadline_ms=30000,
+        jitter=0.2"``. Breaker fields in the same spec are consumed by
+        :meth:`CircuitBreaker.from_spec` and ignored here."""
+        kw = parse_spec(spec, dict(cls._SPEC_KEYS), "--retry")
+        kw.pop("breaker_threshold", None)
+        kw.pop("cooldown_ms", None)
+        rename = {"attempts": "max_attempts", "base_ms": "base_delay_s",
+                  "max_ms": "max_delay_s",
+                  "attempt_timeout_ms": "attempt_timeout_s",
+                  "deadline_ms": "deadline_s"}
+        out = {}
+        for k, v in kw.items():
+            if k.endswith("_ms"):
+                out[rename[k]] = v / 1000.0
+            else:
+                out[rename.get(k, k)] = v
+        return cls(**out)
+
+    _SPEC_KEYS = (("attempts", int), ("base_ms", float), ("max_ms", float),
+                  ("multiplier", float), ("jitter", float),
+                  ("attempt_timeout_ms", float), ("deadline_ms", float),
+                  ("seed", int), ("breaker_threshold", int),
+                  ("cooldown_ms", float))
+
+    def delays(self) -> Iterator[float]:
+        """The backoff schedule after each failed attempt (jittered)."""
+        d = self.base_delay_s
+        while True:
+            j = 1.0 + self.jitter * (2.0 * self._rng.random() - 1.0)
+            yield min(self.max_delay_s, d) * max(0.0, j)
+            d *= self.multiplier
+
+    def _attempt(self, fn: Callable, args, kwargs):
+        if self.attempt_timeout_s is None:
+            return fn(*args, **kwargs)
+        # bound the attempt on a daemon thread; a timeout strands the
+        # attempt (it may still complete — callers that mutate state pair
+        # this with verification, see SupervisedBroker.produce)
+        att = _Attempt(fn, args, kwargs)
+        if att.wait(self.attempt_timeout_s):
+            return att.result()
+        self._stranded.append(att)
+        raise AttemptTimeout(
+            f"attempt exceeded {self.attempt_timeout_s}s", att)
+
+    def call(self, fn: Callable, *args,
+             on_failure: Optional[Callable[[BaseException, int], None]] = None,
+             on_success: Optional[Callable[[], None]] = None,
+             before_attempt: Optional[Callable[[], None]] = None,
+             clock: Callable[[], float] = time.monotonic,
+             sleep: Callable[[float], None] = time.sleep,
+             **kwargs) -> Any:
+        """Run ``fn`` under the policy. ``on_failure(exc, attempt)`` /
+        ``on_success()`` are the circuit breaker's observation hooks (called
+        per attempt, not per call); ``before_attempt()`` runs OUTSIDE the
+        per-attempt timeout — it is where the breaker's cool-down wait
+        belongs (inside the timed attempt, the wait itself would time out
+        and each timeout would re-open the breaker). Non-retryable
+        exceptions propagate unchanged; exhausted attempts/deadline raise
+        :class:`RetryError` chained to the last failure."""
+        from spatialflink_tpu.utils.metrics import REGISTRY
+
+        start = clock()
+        delays = self.delays()
+        last: Optional[BaseException] = None
+        for attempt in range(1, self.max_attempts + 1):
+            try:
+                if before_attempt is not None:
+                    before_attempt()
+                result = self._attempt(fn, args, kwargs)
+            except self.retryable as e:
+                last = e
+                REGISTRY.counter("retry-attempts").inc()
+                if on_failure is not None:
+                    on_failure(e, attempt)
+            else:
+                if on_success is not None:
+                    on_success()
+                return result
+            if attempt >= self.max_attempts:
+                break
+            delay = next(delays)
+            if (self.deadline_s is not None
+                    and clock() - start + delay > self.deadline_s):
+                REGISTRY.counter("retry-deadline-exceeded").inc()
+                raise RetryError(
+                    f"deadline {self.deadline_s}s would be exceeded after "
+                    f"{attempt} attempts") from last
+            if isinstance(last, AttemptTimeout):
+                # spend the backoff waiting for the stranded attempt to
+                # settle instead of sleeping blind: a late SUCCESS is
+                # adopted (re-running it would double-apply the side
+                # effect), a late failure just confirms the retry. An
+                # attempt still running after the wait falls back to a
+                # plain retry — stateful callers pair the policy with
+                # verification (SupervisedBroker.produce) for that tail.
+                last.future.wait(delay)
+                if last.future.done():
+                    exc = last.future.exception()
+                    if exc is None:
+                        if on_success is not None:
+                            on_success()
+                        return last.future.result()
+            else:
+                sleep(delay)
+        REGISTRY.counter("retry-give-ups").inc()
+        raise RetryError(
+            f"{self.max_attempts} attempts exhausted") from last
+
+    def settle(self, timeout: Optional[float] = None) -> bool:
+        """Bounded wait for attempts stranded by per-attempt timeouts to
+        finish; True when none remain running. Callers with order-dependent
+        side effects (SupervisedBroker.produce) settle BEFORE starting the
+        next operation; a False return means a straggler is STILL running
+        and its append could land at any time — the caller must verify
+        accordingly (unkeyed records verify by value, never key alone)."""
+        budget = self.attempt_timeout_s if timeout is None else timeout
+        deadline = time.monotonic() + (budget or 0.0)
+        self._stranded = [a for a in self._stranded if not a.done()]
+        for a in list(self._stranded):
+            a.wait(max(0.0, deadline - time.monotonic()))
+        self._stranded = [a for a in self._stranded if not a.done()]
+        return not self._stranded
+
+
+class CircuitBreaker:
+    """Consecutive-failure circuit breaker with half-open probing.
+
+    States: ``closed`` (normal), ``open`` (failing fast until the cool-down
+    elapses), ``half-open`` (cool-down elapsed; the next call is a probe —
+    success closes the circuit, failure re-opens it and restarts the
+    cool-down). The clock is injectable so tests drive transitions
+    deterministically.
+    """
+
+    def __init__(self, failure_threshold: int = 5, cooldown_s: float = 1.0,
+                 clock: Callable[[], float] = time.monotonic):
+        if failure_threshold < 1:
+            raise ValueError("failure_threshold must be >= 1")
+        self.failure_threshold = failure_threshold
+        self.cooldown_s = cooldown_s
+        self._clock = clock
+        self._consecutive = 0
+        self._opened_at: Optional[float] = None
+        self._half_open = False
+        self.trips = 0
+
+    @classmethod
+    def from_spec(cls, spec: str) -> "CircuitBreaker":
+        kw = parse_spec(spec, dict(RetryPolicy._SPEC_KEYS), "--retry")
+        return cls(failure_threshold=int(kw.get("breaker_threshold", 5)),
+                   cooldown_s=float(kw.get("cooldown_ms", 1000.0)) / 1000.0)
+
+    @property
+    def state(self) -> str:
+        if self._opened_at is None:
+            return "closed"
+        if self._half_open or self.remaining_cooldown() <= 0.0:
+            return "half-open"
+        return "open"
+
+    def remaining_cooldown(self) -> float:
+        if self._opened_at is None:
+            return 0.0
+        return max(0.0, self.cooldown_s - (self._clock() - self._opened_at))
+
+    def allow(self) -> bool:
+        """May a call proceed right now? Open + cool-down remaining → no.
+        Open + cool-down elapsed → yes, as the half-open probe."""
+        if self._opened_at is None:
+            return True
+        if self.remaining_cooldown() > 0.0:
+            return False
+        self._half_open = True
+        return True
+
+    def check(self) -> None:
+        """:meth:`allow` as an exception (fail-fast call sites)."""
+        if not self.allow():
+            raise CircuitOpenError(
+                f"circuit open for another {self.remaining_cooldown():.3f}s "
+                f"after {self._consecutive} consecutive failures")
+
+    def record_success(self) -> None:
+        self._consecutive = 0
+        self._opened_at = None
+        self._half_open = False
+
+    def record_failure(self) -> None:
+        from spatialflink_tpu.utils.metrics import REGISTRY
+
+        self._consecutive += 1
+        if self._opened_at is not None:
+            # half-open probe failed (or a straggler while open): re-open
+            # and restart the cool-down
+            self._opened_at = self._clock()
+            self._half_open = False
+            return
+        if self._consecutive >= self.failure_threshold:
+            self._opened_at = self._clock()
+            self._half_open = False
+            self.trips += 1
+            REGISTRY.counter("breaker-trips").inc()
+
+
+class DeadLetterQueue:
+    """Quarantine for poison records: a dead-letter topic of JSON metadata
+    records, one per quarantined input record.
+
+    Record value schema (all JSON-safe)::
+
+        {"topic": <source topic>, "offset": <source offset>,
+         "error": <repr of the last failure>, "error_type": <class name>,
+         "attempts": <parse attempts incl. redeliveries>,
+         "raw": <source record, stringified, truncated to raw_limit>}
+
+    keyed ``__dlq__:<topic>:<offset>`` so a compacted dead-letter topic
+    keeps one entry per poison record. ``redelivery_limit`` is how many
+    times a parse failure is retried against a FRESH fetch of the same
+    offset before quarantining — transport corruption (torn payloads) heals
+    on redelivery; records that are poison in the log do not.
+    """
+
+    KEY_PREFIX = "__dlq__:"
+
+    def __init__(self, broker, topic: str, redelivery_limit: int = 4,
+                 raw_limit: int = 2048):
+        self.broker = broker
+        self.topic = topic
+        self.redelivery_limit = max(0, int(redelivery_limit))
+        self.raw_limit = raw_limit
+
+    def quarantine(self, *, source_topic: str, offset: int, raw,
+                   error: BaseException, attempts: int) -> None:
+        from spatialflink_tpu.utils.metrics import REGISTRY
+
+        self.broker.produce(
+            self.topic,
+            json.dumps({
+                "topic": source_topic,
+                "offset": int(offset),
+                "error": repr(error),
+                "error_type": type(error).__name__,
+                "attempts": int(attempts),
+                "raw": str(raw)[: self.raw_limit],
+            }),
+            key=f"{self.KEY_PREFIX}{source_topic}:{offset}")
+        REGISTRY.counter("dlq-records").inc()
+
+    def entries(self) -> List[dict]:
+        """Parsed dead-letter records (tests / operator tooling)."""
+        return [json.loads(v) for v in self.broker.topic_values(self.topic)]
+
+    def __len__(self) -> int:
+        return self.broker.end_offset(self.topic)
+
+
+class SupervisedBroker:
+    """Retry + circuit breaking + idempotent produce over any broker.
+
+    ``produce`` and ``fetch`` run under the :class:`RetryPolicy`; every
+    attempt is gated by the :class:`CircuitBreaker` (while open, the
+    supervisor SLEEPS out the remaining cool-down instead of failing the
+    pipeline — a driver must keep making progress, and the half-open probe
+    is the next attempt). Control-plane calls (commit/committed/end_offset)
+    pass through untouched.
+
+    Idempotent produce: before the first attempt the current ``end_offset``
+    is snapshotted; after an ambiguous failure (the produce raised — the
+    record may or may not have landed, e.g. a lost ack or a timed-out
+    attempt) the log tail past the snapshot is scanned for an identical
+    ``(key, value)`` record. Found ⇒ the produce SUCCEEDED and its offset is
+    returned without re-appending (counter ``produce-verified``); not found
+    ⇒ the retry is safe. This is the shim-level analogue of Kafka's
+    idempotent-producer sequence numbers, and what keeps at-least-once
+    retries from double-writing window records into the output topic.
+    """
+
+    def __init__(self, inner, retry: Optional[RetryPolicy] = None,
+                 breaker: Optional[CircuitBreaker] = None,
+                 sleep: Callable[[float], None] = time.sleep):
+        self.inner = inner
+        self.retry = retry or RetryPolicy()
+        self.breaker = breaker or CircuitBreaker()
+        self._sleep = sleep
+
+    @classmethod
+    def from_spec(cls, inner, spec: str) -> "SupervisedBroker":
+        """Build retry + breaker from one ``--retry`` spec string (empty
+        spec = defaults)."""
+        return cls(inner, RetryPolicy.from_spec(spec),
+                   CircuitBreaker.from_spec(spec))
+
+    # ------------------------------ internals ------------------------- #
+
+    def _wait_for_circuit(self, call_start: float) -> None:
+        """Sleep out an open circuit before an attempt (runs OUTSIDE the
+        per-attempt timeout — the wait must not count as attempt time, or
+        every attempt on an open circuit would time out and re-open it).
+        The retry deadline DOES bound this wait, measured from the START
+        of the whole call (not per attempt): a deadline-bounded call on a
+        circuit that stays open past it fails fast with
+        :class:`CircuitOpenError` instead of overshooting the deadline by
+        a cool-down per attempt."""
+        budget = self.retry.deadline_s
+        while not self.breaker.allow():
+            step = min(self.breaker.remaining_cooldown(),
+                       self.retry.max_delay_s)
+            if (budget is not None
+                    and time.monotonic() - call_start + step > budget):
+                raise CircuitOpenError(
+                    f"circuit still open past the {budget}s deadline")
+            self._sleep(step)
+
+    def _call(self, fn: Callable, *args, **kwargs):
+        start = time.monotonic()
+        return self.retry.call(
+            fn, *args,
+            before_attempt=lambda: self._wait_for_circuit(start),
+            on_failure=lambda e, a: self.breaker.record_failure(),
+            on_success=self.breaker.record_success,
+            sleep=self._sleep, **kwargs)
+
+    # ------------------------------ broker surface --------------------- #
+
+    def produce(self, topic: str, value, key: Optional[str] = None,
+                timestamp_ms: Optional[int] = None) -> int:
+        from spatialflink_tpu.streams.kafka import _values_equal
+        from spatialflink_tpu.utils.metrics import REGISTRY
+
+        # settle timed-out stragglers from PREVIOUS calls before taking the
+        # baseline: a late append landing past this snapshot could
+        # key-match this call's verification and swallow the new record.
+        # If a straggler is STILL running after the bounded wait, drop to
+        # strict (key AND value) matching — a torn verification copy may
+        # then re-produce (a duplicate, which at-least-once tolerates)
+        # but a straggler's append can no longer be adopted as ours (a
+        # silent loss, which it does not).
+        strict = not self.retry.settle()
+        baseline = self.inner.end_offset(topic)
+        attempts = {"n": 0}
+
+        def verified_produce():
+            # ambiguous-failure check after a FAILED attempt only (the
+            # fault-free hot path pays no extra end_offset/fetch round
+            # trips): did that attempt land? The only appends in
+            # [baseline, end) are this call's own attempts (one producer
+            # thread per topic — the driver's model), so a KEY match there
+            # is ours. Keys are matched rather than values because the
+            # verification read itself crosses the degraded transport: a
+            # torn COPY of our landed record must still verify, or the
+            # retry double-writes.
+            attempts["n"] += 1
+            if attempts["n"] > 1:
+                end = self.inner.end_offset(topic)
+                if end > baseline:
+                    for rec in self.inner.fetch(topic, baseline,
+                                                end - baseline):
+                        if rec.offset < baseline or rec.key != key:
+                            continue
+                        # unkeyed records must ALWAYS also match by value
+                        # (key=None would otherwise match ANY unkeyed
+                        # record); keyed records match by value too when a
+                        # straggler could have appended under our key
+                        if ((key is None or strict)
+                                and not _values_equal(rec.value, value)):
+                            continue
+                        REGISTRY.counter("produce-verified").inc()
+                        if not _values_equal(rec.value, value):
+                            REGISTRY.counter(
+                                "produce-verified-value-mismatch").inc()
+                        return rec.offset
+            return self.inner.produce(topic, value, key=key,
+                                      timestamp_ms=timestamp_ms)
+
+        return self._call(verified_produce)
+
+    def fetch(self, topic: str, offset: int, max_records: int = 500):
+        return self._call(self.inner.fetch, topic, offset, max_records)
+
+    def commit(self, topic: str, group: str, next_offset: int) -> None:
+        self.inner.commit(topic, group, next_offset)
+
+    def committed(self, topic: str, group: str) -> int:
+        return self.inner.committed(topic, group)
+
+    def end_offset(self, topic: str) -> int:
+        return self.inner.end_offset(topic)
+
+    def topic_values(self, topic: str):
+        return self.inner.topic_values(topic)
+
+    def close(self) -> None:
+        if hasattr(self.inner, "close"):
+            self.inner.close()

@@ -99,6 +99,23 @@ class InMemoryBroker:
             return [r.value for r in self._topics.get(topic, [])]
 
 
+def resequence_batch(batch: List[BrokerRecord], next_offset: int
+                     ) -> List[BrokerRecord]:
+    """Restore single-log order over a degraded transport: sort a fetched
+    batch by offset and drop records already delivered (offset below
+    ``next_offset``) or re-delivered within the batch. What a real
+    consumer's fetch-session dedup does; a no-op on clean transports.
+    Shared by :class:`KafkaSource` and the driver's ``--bulk`` topic drain
+    — both assume offset-ordered, exactly-once-per-position hand-off."""
+    cleaned: List[BrokerRecord] = []
+    last = next_offset - 1
+    for rec in sorted(batch, key=lambda r: r.offset):
+        if rec.offset > last:
+            cleaned.append(rec)
+            last = rec.offset
+    return cleaned
+
+
 #: yielded by a KafkaSource constructed with ``starvation_sentinel=True``
 #: whenever a live-mode poll comes up empty — a batching consumer (the
 #: commit tap's chunked decode) flushes on it so buffered records never
@@ -127,7 +144,8 @@ class KafkaSource:
                  poll_batch: int = 500, commit_every: int = 1,
                  stop_at_end: bool = True, auto_commit: bool = True,
                  limit: Optional[int] = None,
-                 starvation_sentinel: bool = False):
+                 starvation_sentinel: bool = False,
+                 commit_lag: Optional[int] = None):
         self.broker = broker
         self.topic = topic
         self.group = group
@@ -135,6 +153,14 @@ class KafkaSource:
         self.commit_every = max(1, commit_every)
         self.stop_at_end = stop_at_end
         self.auto_commit = auto_commit
+        #: when set (and auto_commit is off), commit ``position - lag``
+        #: after every consumed poll batch — progress-driven commits from
+        #: the CONSUMPTION side, so an unbounded sparse-match stream (a
+        #: --kafka-follow run whose micro-batches rarely emit) still bounds
+        #: restart reprocessing. The lag must cover every record that can
+        #: be in flight (batcher + device pipeline); the driver computes it
+        #: as (pipeline_depth + 1) * realtime_batch_size.
+        self.commit_lag = commit_lag
         #: live mode only: yield :data:`STARVED` before sleeping on an empty
         #: poll (opt-in — only consumers that understand the marker set it)
         self.starvation_sentinel = starvation_sentinel
@@ -159,8 +185,6 @@ class KafkaSource:
             if self.limit is not None and yielded >= self.limit:
                 break
             batch = self.broker.fetch(self.topic, pos, self.poll_batch)
-            if self.limit is not None:
-                batch = batch[:self.limit - yielded]
             if not batch:
                 if self.stop_at_end:
                     break
@@ -168,7 +192,17 @@ class KafkaSource:
                     yield STARVED
                 time.sleep(0.01)
                 continue
-            for rec in batch:
+            # a degraded transport (retried fetch sessions — see
+            # runtime/faults.py) may deliver a batch permuted or with
+            # records re-delivered, including from before ``pos``; the
+            # window-aligned commit tap's prefix bookkeeping is unsound
+            # under reordered positions, so disorder stops here
+            cleaned = resequence_batch(batch, pos)
+            if not cleaned:
+                continue  # all duplicates of already-delivered records
+            if self.limit is not None:
+                cleaned = cleaned[:self.limit - yielded]
+            for rec in cleaned:
                 # position advances BEFORE the hand-off so a tap reading it
                 # right after receiving the record sees "offset past me"
                 pos = self.position = rec.offset + 1
@@ -178,6 +212,13 @@ class KafkaSource:
                 if self.auto_commit and uncommitted >= self.commit_every:
                     self.broker.commit(self.topic, self.group, pos)
                     uncommitted = 0
+            if self.commit_lag is not None and not self.auto_commit:
+                # consumption-driven lagged commit, once per poll batch: a
+                # stream that consumes without emitting (sparse realtime
+                # matches) still advances the group offset (commit is
+                # monotone, so the emit-time lagged commit composes)
+                self.broker.commit(self.topic, self.group,
+                                   max(0, pos - self.commit_lag))
         if self.auto_commit and uncommitted:
             self.broker.commit(self.topic, self.group, pos)
 
@@ -329,7 +370,8 @@ class WindowCommitTap:
     def __init__(self, source: KafkaSource, size_ms: int, slide_ms: int,
                  parse: Optional[Callable[[Any], Any]] = None,
                  bulk_decode: Optional[Callable[[List[str]], List[Any]]]
-                 = None, bulk_chunk: int = 2048):
+                 = None, bulk_chunk: int = 2048,
+                 dlq=None):
         from collections import deque
 
         if bulk_decode is not None and parse is None:
@@ -344,7 +386,61 @@ class WindowCommitTap:
         self.parse = parse
         self.bulk_decode = bulk_decode
         self.bulk_chunk = max(1, bulk_chunk)
+        #: optional runtime.supervisor.DeadLetterQueue: parse failures are
+        #: retried against FRESH fetches of the same offset (transport
+        #: corruption heals on redelivery) and quarantined — with failure
+        #: metadata, before any commit can pass them — when they persist.
+        #: Without a DLQ a parse failure propagates, as it always did.
+        self.dlq = dlq
         self._pending = deque()
+
+    def _parse_or_dlq(self, raw, position: int):
+        """Parse one record; on failure, redeliver-and-retry, then
+        quarantine to the DLQ and return None (caller skips the record).
+        A quarantined record does not enter the commit bookkeeping: its
+        dead-letter entry IS its reflection in produced output, so commits
+        may pass it."""
+        if self.parse is None:
+            return raw
+        try:
+            return self.parse(raw)
+        except Exception as e:
+            if self.dlq is None:
+                raise
+            from spatialflink_tpu.utils.metrics import (
+                REGISTRY, check_exit_control_tuple)
+
+            offset = position - 1
+            attempts = 1
+            last: BaseException = e
+            for _ in range(self.dlq.redelivery_limit):
+                try:
+                    fresh = self.source.broker.fetch(
+                        self.source.topic, offset, 1)
+                except Exception as fe:  # transport down past retry budget
+                    last = fe
+                    break
+                rec = next((r for r in fresh if r.offset == offset), None)
+                if rec is None:
+                    break
+                attempts += 1
+                # a STOP tuple torn in transport parses as garbage; its
+                # healed redelivery must honor the remote-stop contract,
+                # not be quarantined as poison (ControlTupleExit
+                # propagates — it is a control-flow signal, not a parse
+                # failure)
+                check_exit_control_tuple(rec.value)
+                try:
+                    obj = self.parse(rec.value)
+                except Exception as e2:
+                    last = e2
+                    continue
+                REGISTRY.counter("dlq-redelivery-healed").inc()
+                return obj
+            self.dlq.quarantine(source_topic=self.source.topic,
+                                offset=offset, raw=raw, error=last,
+                                attempts=attempts)
+            return None
 
     def _track(self, obj, position: int):
         ts = getattr(obj, "timestamp", None)
@@ -367,7 +463,9 @@ class WindowCommitTap:
             if raw is STARVED:  # only batching consumers need the marker
                 continue
             check_exit_control_tuple(raw)
-            obj = self.parse(raw) if self.parse is not None else raw
+            obj = self._parse_or_dlq(raw, self.source.position)
+            if obj is None:  # quarantined poison record
+                continue
             yield self._track(obj, self.source.position)
 
     def _iter_bulk(self) -> Iterator[Any]:
@@ -395,12 +493,28 @@ class WindowCommitTap:
                     objs = None
                 if objs is not None and len(objs) != len(raws):
                     objs = None
+            stop = None
             if objs is None:
-                objs = [self.parse(r) for r in raws]
+                # a torn STOP tuple healing mid-fallback raises
+                # ControlTupleExit; records parsed BEFORE it in the chunk
+                # must still reach the pipeline (same contract as the
+                # intact-control path below), so defer the stop until the
+                # parsed prefix has been yielded
+                objs = []
+                for r, p in zip(raws, poss):
+                    try:
+                        objs.append(self._parse_or_dlq(r, p))
+                    except ControlTupleExit as e:
+                        stop = e
+                        break
             for obj, pos in zip(objs, poss):
+                if obj is None:  # quarantined poison record
+                    continue
                 yield self._track(obj, pos)
             raws.clear()
             poss.clear()
+            if stop is not None:
+                raise stop
 
         for raw in self.source:
             if raw is STARVED:
@@ -420,9 +534,9 @@ class WindowCommitTap:
             if not isinstance(raw, str):
                 # pre-parsed objects pass through; flush first (order)
                 yield from flush()
-                yield self._track(raw if self.parse is None
-                                  else self.parse(raw),
-                                  self.source.position)
+                obj = self._parse_or_dlq(raw, self.source.position)
+                if obj is not None:
+                    yield self._track(obj, self.source.position)
                 continue
             raws.append(raw)
             poss.append(self.source.position)
@@ -487,9 +601,25 @@ class KafkaWindowSink:
     MARKER = "__window_commit__:"
 
     def __init__(self, broker, topic: str, fmt: Optional[str] = None,
-                 date_format: Optional[str] = None, delimiter: str = ","):
+                 date_format: Optional[str] = None, delimiter: str = ",",
+                 job_id: Optional[str] = None,
+                 seed_scan_limit: Optional[int] = None,
+                 seed_scan_warn: int = 100_000):
         self.broker = broker
         self.topic = topic
+        #: job/query fingerprint folded into every window key: without it,
+        #: re-running a DIFFERENT query/config against the same output
+        #: topic would find the old run's markers and silently suppress
+        #: every window of the new run (an output topic is otherwise bound
+        #: to one job configuration forever). None keeps the legacy
+        #: un-prefixed keys for single-job topics.
+        self.job_id = job_id
+        #: bound/flag the startup scan (see _seed_from_log): scan at most
+        #: the last ``seed_scan_limit`` records (None = full scan), and
+        #: warn once past ``seed_scan_warn`` scanned records — the
+        #: uncompacted-topic signal.
+        self.seed_scan_limit = seed_scan_limit
+        self.seed_scan_warn = seed_scan_warn
         self._enc = KafkaSink(broker, topic, fmt, date_format, delimiter)
         self.delivered = self._seed_from_log()
         self.duplicates_suppressed = 0
@@ -502,27 +632,65 @@ class KafkaWindowSink:
         the output topic log-COMPACTED keeps the scan bounded by the live
         window count; that is the intended production configuration (the
         alternative — trusting only recent markers — could re-produce an
-        old window after an unusually long outage)."""
+        old window after an unusually long outage). A scan past
+        ``seed_scan_warn`` records warns about the compaction risk;
+        ``seed_scan_limit`` hard-bounds the scan to the topic TAIL for
+        operators who accept the old-window re-produce risk explicitly."""
+        import sys as _sys
+
         seen: set = set()
+        end = self.broker.end_offset(self.topic)
         off = 0
+        if self.seed_scan_limit is not None and end > self.seed_scan_limit:
+            off = end - self.seed_scan_limit
+            print(f"warning: output topic '{self.topic}' holds {end} "
+                  f"records; seeding the dedup set from the last "
+                  f"{self.seed_scan_limit} only — windows committed before "
+                  f"offset {off} can be re-produced on re-delivery",
+                  file=_sys.stderr)
+        scanned = 0
+        warned = False
         while True:
             batch = self.broker.fetch(self.topic, off)
             if not batch:
+                from spatialflink_tpu.utils.metrics import REGISTRY
+
+                REGISTRY.counter("sink-seed-scan-records").inc(scanned)
                 return seen
             for r in batch:
                 if isinstance(r.key, str) and r.key.startswith(self.MARKER):
                     seen.add(r.key[len(self.MARKER):])
-                off = r.offset + 1
+                # max(): a degraded transport can deliver the batch
+                # permuted — never let the scan cursor move backward
+                off = max(off, r.offset + 1)
+                scanned += 1
+            if scanned > self.seed_scan_warn and not warned:
+                warned = True
+                print(f"warning: dedup seed scan of output topic "
+                      f"'{self.topic}' passed {self.seed_scan_warn} records "
+                      "and is still going — the topic looks uncompacted; "
+                      "run it log-compacted (marker records are keyed) or "
+                      "bound the scan with seed_scan_limit",
+                      file=_sys.stderr)
 
-    @staticmethod
-    def window_key(result) -> str:
+    def window_key(self, result) -> str:
         cell = result.extras.get("cell") if hasattr(result, "extras") else None
-        return (f"{getattr(result, 'window_start', None)}:"
+        base = (f"{getattr(result, 'window_start', None)}:"
                 f"{getattr(result, 'window_end', None)}:{cell}")
+        return f"{self.job_id}:{base}" if self.job_id else base
 
     def emit(self, result) -> None:
         wk = self.window_key(result)
         if wk in self.delivered:
+            self.duplicates_suppressed += 1
+            return
+        if self.job_id and wk.split(":", 1)[1] in self.delivered:
+            # upgrade continuity: a PRE-fingerprint marker (bare
+            # start:end:cell, written before job prefixes existed) still
+            # covers this window — without this, the first restart after
+            # an upgrade would re-produce every window already in the
+            # topic. New markers are always written prefixed, so the
+            # legacy cross-job ambiguity dies out with the old markers.
             self.duplicates_suppressed += 1
             return
         # flatten across the multi-query axis (one list per query)

@@ -131,6 +131,23 @@ class MetricsRegistry:
 #: process-wide default registry (the reference's per-job metric group)
 REGISTRY = MetricsRegistry()
 
+#: counter-name prefixes that mean "the transport or pipeline degraded and
+#: recovery machinery engaged" — injected faults (runtime/faults.py), retry
+#: and breaker activity, verified-produce recoveries, and dead-lettered
+#: records (runtime/supervisor.py). One namespace so the driver's run
+#: summary can surface every degradation event without naming each counter.
+DEGRADATION_PREFIXES = ("chaos-", "retry-", "breaker-", "dlq-",
+                        "produce-verified")
+
+
+def degradation_snapshot(registry: Optional[MetricsRegistry] = None
+                         ) -> Dict[str, int]:
+    """Non-zero degradation counters (see :data:`DEGRADATION_PREFIXES`) —
+    the summary line's "how rough was the transport" digest."""
+    reg = REGISTRY if registry is None else registry
+    return {n: c.count for n, c in sorted(reg.counters.items())
+            if c.count and n.startswith(DEGRADATION_PREFIXES)}
+
 
 def metered(stream: Iterable, meter: Meter,
             control_check: bool = False) -> Iterator:

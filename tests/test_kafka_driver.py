@@ -59,6 +59,12 @@ def _markers(broker, topic=OUT):
             if isinstance(r.key, str) and r.key.startswith(pre)]
 
 
+def _strip_job(key):
+    """Window key without the leading job fingerprint (the driver folds
+    params.job_fingerprint(group) into every window key)."""
+    return key.split(":", 1)[1]
+
+
 # ------------------------------------------------------------ end-to-end
 
 
@@ -116,13 +122,13 @@ def test_kafka_matches_file_replay(tmp_path, capsys):
         broker.produce(IN1, ln)
     rc = main(["--config", cfg, "--kafka", "--option", "1"])
     assert rc == 0
-    kafka_windows = sorted(_markers(broker))
+    kafka_windows = sorted(_strip_job(m) for m in _markers(broker))
     assert kafka_windows == sorted(f"{w[0]}:{w[1]}:None"
                                    for w in file_windows)
     # per-window record COUNTS also match the file path (the broker path's
     # chunked native decode must select exactly the same records)
     marker_counts = {
-        r.key[len(KafkaWindowSink.MARKER):]: int(r.value)
+        _strip_job(r.key[len(KafkaWindowSink.MARKER):]): int(r.value)
         for r in broker.fetch(OUT, 0, 1_000_000)
         if isinstance(r.key, str) and r.key.startswith(KafkaWindowSink.MARKER)
     }
@@ -157,7 +163,7 @@ def test_kafka_bulk_decode_csv_and_fallbacks(tmp_path, capsys):
                "--format", "CSV"])
     assert rc == 0
     counts = {
-        r.key[len(KafkaWindowSink.MARKER):]: int(r.value)
+        _strip_job(r.key[len(KafkaWindowSink.MARKER):]): int(r.value)
         for r in broker.fetch(OUT, 0, 1_000_000)
         if isinstance(r.key, str) and r.key.startswith(KafkaWindowSink.MARKER)
     }
@@ -177,7 +183,7 @@ def test_kafka_bulk_decode_csv_and_fallbacks(tmp_path, capsys):
     assert rc == 0
     assert broker2.committed(IN1, "spatialflink") == len(rows)
     counts2 = {
-        r.key[len(KafkaWindowSink.MARKER):]: int(r.value)
+        _strip_job(r.key[len(KafkaWindowSink.MARKER):]): int(r.value)
         for r in broker2.fetch(OUT, 0, 1_000_000)
         if isinstance(r.key, str) and r.key.startswith(KafkaWindowSink.MARKER)
     }
@@ -777,6 +783,111 @@ def test_kafka_crash_restart_out_of_order_fuzz(tmp_path, monkeypatch, seed):
     assert main(["--config", cfg, "--kafka", "--option", "1"]) == 0
     assert sorted(_markers(broker)) == expected
     assert broker.committed(IN1, "spatialflink") == len(pts)
+
+
+# ------------------------------------------------- robustness satellites
+
+
+def test_output_topic_shared_across_different_queries(tmp_path):
+    """Regression (ADVICE #1): a DIFFERENT query against the same output
+    topic must not be suppressed by the first job's dedup markers — the job
+    fingerprint in the window key isolates them. Same window bounds, two
+    jobs, two marker sets."""
+    lines = _lines()
+    cfg_a, url = _conf(tmp_path, "fpshare", "a.yml")
+    broker = resolve_broker(url)
+    for ln in lines:
+        broker.produce(IN1, ln)
+    assert main(["--config", cfg_a, "--kafka", "--option", "1"]) == 0
+    m1 = set(_markers(broker))
+    assert m1
+
+    # same broker/output topic, different query (radius changed) — the
+    # group already committed, so feed the input again for the second job
+    cfg_b, _ = _conf(tmp_path, "fpshare", "b.yml", radius=0.123)
+    for ln in lines:
+        broker.produce(IN1, ln)
+    assert main(["--config", cfg_b, "--kafka", "--option", "1"]) == 0
+    m2 = set(_markers(broker)) - m1
+    assert m2, "second job's windows were suppressed by the first job's " \
+               "markers (fingerprint regression)"
+    # same event times -> same window bounds; only the job prefix differs
+    assert {_strip_job(k) for k in m2} == {_strip_job(k) for k in m1}
+
+    # and an identical re-run of job A (after re-feeding) IS suppressed
+    for ln in lines:
+        broker.produce(IN1, ln)
+    assert main(["--config", cfg_a, "--kafka", "--option", "1"]) == 0
+    assert set(_markers(broker)) == m1 | m2
+
+
+def test_kafka_follow_sparse_stream_commits_on_consumption(tmp_path, capsys):
+    """Regression (ADVICE #2): a realtime --kafka-follow stream whose
+    query matches NOTHING (zero emissions, so the emit-time lagged commit
+    never runs) must still advance the group offset from consumption
+    progress, and a restart resumes from it instead of reprocessing the
+    whole topic."""
+    grid = UniformGrid(115.5, 117.6, 39.6, 41.1, num_grid_partitions=100)
+    pts = list(SyntheticPointSource(grid, num_trajectories=20, steps=150,
+                                    seed=5))
+    # query pinned to a corner with a tiny radius: no point matches
+    cfg, url = _conf(tmp_path, "sparse", "c.yml",
+                     queryPoints=[[115.51, 39.61]], radius=1e-6)
+    broker = resolve_broker(url)
+    for p in pts:
+        broker.produce(IN1, serialize_spatial(p, "GeoJSON"))
+    broker.produce(IN1, json.dumps(
+        {"geometry": {"type": "control", "coordinates": []}}))
+    argv = ["--config", cfg, "--kafka", "--kafka-follow", "--option", "2"]
+    assert main(argv) == 0
+    err = capsys.readouterr().err
+    assert "# emitted 0 results" in err
+    c1 = broker.committed(IN1, "spatialflink")
+    assert 0 < c1 < len(pts), \
+        "sparse stream must commit consumption progress (lagged)"
+    # restart: resumes from c1, re-reads only the tail, still commits
+    assert main(argv) == 0
+    assert "# emitted 0 results" in capsys.readouterr().err
+    assert broker.committed(IN1, "spatialflink") >= c1
+
+
+def test_window_sink_honors_pre_fingerprint_markers():
+    """Upgrade continuity: markers written before job fingerprints existed
+    (bare start:end:cell keys) still suppress re-delivery of the same
+    window, so the first post-upgrade restart does not re-produce the
+    topic's history."""
+    from spatialflink_tpu.operators import WindowResult
+
+    broker = InMemoryBroker()
+    broker.produce(OUT, "1", key=f"{KafkaWindowSink.MARKER}1000:2000:None")
+    sink = KafkaWindowSink(broker, OUT, job_id="deadbeef")
+    sink.emit(WindowResult(1000, 2000, [Point.create(0.0, 0.0)]))
+    assert sink.duplicates_suppressed == 1
+    assert sink.windows_produced == 0
+    # a genuinely new window still produces, prefixed
+    sink.emit(WindowResult(2000, 3000, [Point.create(0.0, 0.0)]))
+    assert sink.windows_produced == 1
+    assert "deadbeef:2000:3000:None" in sink.delivered
+
+
+def test_window_sink_seed_scan_warns_and_bounds(capsys):
+    """Regression (ADVICE #4): the startup dedup-seed scan warns when it
+    crosses the record threshold (uncompacted-topic risk), and
+    seed_scan_limit bounds it to the topic tail with an explicit warning."""
+    broker = InMemoryBroker()
+    for i in range(60):
+        broker.produce(OUT, "1", key=f"{KafkaWindowSink.MARKER}w{i}")
+    sink = KafkaWindowSink(broker, OUT, seed_scan_warn=10)
+    assert len(sink.delivered) == 60
+    assert "uncompacted" in capsys.readouterr().err
+
+    sink2 = KafkaWindowSink(broker, OUT, seed_scan_limit=10)
+    assert sink2.delivered == {f"w{i}" for i in range(50, 60)}
+    assert "last 10" in capsys.readouterr().err
+
+    # quiet default: small topics scan silently
+    KafkaWindowSink(broker, OUT)
+    assert "warning" not in capsys.readouterr().err
 
 
 # ------------------------------------------------------------- tap unit

@@ -279,10 +279,20 @@ def main():
 
     import jax
 
+    from spatialflink_tpu.utils.telemetry import telemetry_session
+
     backend = jax.default_backend()
-    grid, batch, xs, ys, oid = build_inputs()
-    device_tput, p50_ms, strategy, _pick = bench_device(grid, batch)
-    cpu_tput = bench_cpu_numpy(grid, xs, ys, oid)
+    # in-memory telemetry session (no reporter): per-stage spans + grid
+    # occupancy ride the result row, so BENCH_* files carry a breakdown of
+    # where the wall clock went, not just the headline number
+    with telemetry_session() as tel:
+        with tel.span("inputs", query="bench"):
+            grid, batch, xs, ys, oid = build_inputs()
+        with tel.span("device", query="bench"):
+            device_tput, p50_ms, strategy, _pick = bench_device(grid, batch)
+        with tel.span("cpu-baseline", query="bench"):
+            cpu_tput = bench_cpu_numpy(grid, xs, ys, oid)
+        telemetry = tel.snapshot()
 
     row = {
         "metric": "knn_k50_1M_window_points_per_sec_per_chip",
@@ -295,6 +305,8 @@ def main():
         "valid_for_target": backend == "tpu",
         "p50_window_latency_ms": round(p50_ms, 3),
         "strategy": strategy,
+        # final telemetry snapshot: bench.* stage spans, grid occupancy/skew
+        "telemetry": telemetry,
     }
     if backend != "tpu":
         # the tunnel wedges for hours; if a real-TPU measurement was banked

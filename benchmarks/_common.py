@@ -10,6 +10,24 @@ import os
 import sys
 
 
+def bench_telemetry():
+    """An in-memory telemetry session (no reporter thread) for harness
+    scripts: ``with bench_telemetry() as tel: ...; attach_telemetry(row,
+    tel)``. Spans recorded by the pipeline under test (ingest / window /
+    kernel / merge / sink) land in the session automatically."""
+    from spatialflink_tpu.utils.telemetry import telemetry_session
+
+    return telemetry_session()
+
+
+def attach_telemetry(row: dict, tel) -> dict:
+    """Attach the final telemetry snapshot to a bench result row, so
+    BENCH_*/RESULTS_* files carry per-stage breakdowns next to the
+    end-to-end numbers."""
+    row["telemetry"] = tel.snapshot()
+    return row
+
+
 def settle_backend() -> None:
     """The axon sitecustomize force-sets jax_platforms='axon,cpu' in every
     interpreter, so the JAX_PLATFORMS env var alone cannot keep a process

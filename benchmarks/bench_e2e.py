@@ -197,6 +197,8 @@ def main() -> int:
     backend = jax.default_backend()
     n = args.n or (1_000_000 if backend == "tpu" else 100_000)
 
+    from benchmarks._common import bench_telemetry
+
     rows = []
     with tempfile.TemporaryDirectory() as td:
         path = os.path.join(td, "stream1.csv")
@@ -204,7 +206,15 @@ def main() -> int:
         _write_stream(path, n, seed=0)
         _write_stream(path2, max(n // 64, 1), seed=1)  # small query stream
         for opt in (int(x) for x in args.options.split(",")):
-            for row in bench_option(opt, path, path2, n):
+            # one telemetry session — and ONE snapshot — per option: the
+            # snapshot is cumulative across the option's rows, so attaching
+            # the same object (not one per row) keeps the output honest
+            # about that and avoids N near-identical copies in the file
+            with bench_telemetry() as tel:
+                opt_rows = list(bench_option(opt, path, path2, n))
+                snap = tel.snapshot()
+            for row in opt_rows:
+                row["telemetry"] = snap
                 row["backend"] = backend
                 print(json.dumps(row), flush=True)
                 rows.append(row)

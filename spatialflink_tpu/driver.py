@@ -140,13 +140,20 @@ def decode_stream(records: Iterable, cfg: StreamConfig, grid: UniformGrid,
     crashing the pipeline in the operator's batcher: dead-lettering
     malformed tuples is the streaming norm, and the typed operator pipelines
     (like the reference's per-type streams) cannot batch them."""
+    from spatialflink_tpu.utils import telemetry as _telemetry
     from spatialflink_tpu.utils.metrics import REGISTRY, metered
 
     meter = REGISTRY.meter("ingest-throughput")
     dropped = REGISTRY.counter("off-type-dropped")
     needs_edges = geometry in ("Polygon", "LineString")
     warned = False
+    # checked ONCE per stream: telemetry off = the uninstrumented loop
+    # (no span/histogram calls per record), on = per-record parse time
+    # accumulates under the "ingest" stage via observe() (no context-
+    # manager churn on the hot path)
+    tel = _telemetry.active()
     for rec in metered(records, meter, control_check=True):
+        t0 = time.perf_counter() if tel is not None else 0.0
         obj = rec if isinstance(rec, SpatialObject) else parse_spatial(
             rec, cfg.format, grid,
             delimiter=cfg.delimiter,
@@ -156,6 +163,8 @@ def decode_stream(records: Iterable, cfg: StreamConfig, grid: UniformGrid,
             geometry=geometry,
             **cfg.geojson_kwargs(),
         )
+        if tel is not None:
+            tel.observe("ingest", time.perf_counter() - t0)
         off_type = ((needs_edges and not hasattr(obj, "edge_array"))
                     or (geometry == "Point" and not hasattr(obj, "x")))
         if off_type:
@@ -254,7 +263,13 @@ def _run_multi_case(params: Params, spec: CaseSpec, op, s1,
 def _with_latency(results: Iterator[WindowResult]) -> Iterator[WindowResult]:
     """Annotate each result with per-record latency millis (reference:
     ``now - ingestionTime`` shipped to a Kafka topic,
-    ``utils/HelperClass.java:455-529``)."""
+    ``utils/HelperClass.java:455-529``). With telemetry active the same
+    values feed the session's ``record-latency-ms`` streaming histogram so
+    the snapshots carry p50/p95/p99."""
+    from spatialflink_tpu.utils import telemetry as _telemetry
+
+    tel = _telemetry.active()
+    hist = tel.histogram("record-latency-ms") if tel is not None else None
     for r in results:
         now = int(time.time() * 1000)
         lats = []
@@ -263,6 +278,9 @@ def _with_latency(results: Iterator[WindowResult]) -> Iterator[WindowResult]:
             base = getattr(obj, "ingestion_time", None)
             if isinstance(base, (int, float)) and base > 0:
                 lats.append(now - int(base))
+        if hist is not None:
+            for v in lats:
+                hist.record(v)
         r.extras["latency_ms"] = lats
         yield r
 
@@ -507,6 +525,7 @@ def _bulk_parse_stream(cfg: StreamConfig, src,
 
     from spatialflink_tpu.runtime.watermarks import BoundedOutOfOrderness
     from spatialflink_tpu.streams.bulk import bulk_parse_csv, bulk_parse_geojson
+    from spatialflink_tpu.utils.telemetry import span as _tel_span
 
     fmt = cfg.format.lower()
     if fmt not in ("csv", "tsv", "geojson"):
@@ -515,13 +534,17 @@ def _bulk_parse_stream(cfg: StreamConfig, src,
     if data is None:
         return None
     try:
-        if fmt in ("csv", "tsv"):
-            delim = "\t" if fmt == "tsv" else cfg.delimiter
-            parsed = bulk_parse_csv(
-                data, delimiter=delim, schema=_schema4(cfg),
-                date_format=cfg.date_format)
-        else:
-            parsed = bulk_parse_geojson(data, **cfg.geojson_kwargs())
+        # one span covers the whole native parse (the bulk path's "ingest"
+        # stage — a single call, so the module-level nullcontext-when-off
+        # helper is fine here)
+        with _tel_span("ingest", query="bulk"):
+            if fmt in ("csv", "tsv"):
+                delim = "\t" if fmt == "tsv" else cfg.delimiter
+                parsed = bulk_parse_csv(
+                    data, delimiter=delim, schema=_schema4(cfg),
+                    date_format=cfg.date_format)
+            else:
+                parsed = bulk_parse_geojson(data, **cfg.geojson_kwargs())
     except ValueError as e:
         print(f"# --bulk: point stream not bulk-ingestible ({e}); "
               "using the record path", file=sys.stderr)
@@ -639,6 +662,7 @@ def _bulk_parse_geom_stream(params: Params, src):
     from spatialflink_tpu.runtime.watermarks import BoundedOutOfOrderness
     from spatialflink_tpu.streams.bulk import (bulk_parse_geojson_geoms,
                                                bulk_parse_wkt)
+    from spatialflink_tpu.utils.telemetry import span as _tel_span
 
     cfg = params.input1
     if cfg.format.lower() == "wkt":
@@ -649,11 +673,12 @@ def _bulk_parse_geom_stream(params: Params, src):
         data = _read_src(src)
         if data is None:
             return None
-        # format pre-gated to WKT/GeoJSON by run_option_bulk
-        if cfg.format.lower() == "wkt":
-            parsed = bulk_parse_wkt(data, **kw)
-        else:
-            parsed = bulk_parse_geojson_geoms(data, **kw)
+        with _tel_span("ingest", query="bulk"):
+            # format pre-gated to WKT/GeoJSON by run_option_bulk
+            if cfg.format.lower() == "wkt":
+                parsed = bulk_parse_wkt(data, **kw)
+            else:
+                parsed = bulk_parse_geojson_geoms(data, **kw)
     except ValueError as e:
         print(f"# --bulk: geometry file not bulk-ingestible ({e}); "
               "using the record path", file=sys.stderr)
@@ -907,7 +932,7 @@ def _topic_reader(kafka: _KafkaWiring, topic: str, limit: Optional[int],
     path: non-string values, embedded newlines (they would shift the
     line<->record mapping), or a control tuple (the streaming path honors
     its stop semantics)."""
-    def read() -> Optional[bytes]:
+    def drain() -> Optional[bytes]:
         b = kafka.broker
         off = b.committed(topic, kafka.group)
         end = b.end_offset(topic)
@@ -932,6 +957,13 @@ def _topic_reader(kafka: _KafkaWiring, topic: str, limit: Optional[int],
                 off = r.offset + 1
         offsets_out.append((topic, off))
         return "\n".join(vals).encode()
+
+    def read() -> Optional[bytes]:
+        from spatialflink_tpu.utils.telemetry import span as _tel_span
+
+        # the drain is the --kafka --bulk path's ingest stage (one call)
+        with _tel_span("ingest", query="kafka-drain"):
+            return drain()
 
     return read
 
@@ -1115,7 +1147,22 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "non-spatial result tuples are written as JSON "
                          "lines)")
     ap.add_argument("--metrics", action="store_true",
-                    help="print a metrics snapshot to stderr at exit")
+                    help="print a sorted-JSON metrics snapshot (counters, "
+                         "meters, degradation digest) to stderr at exit")
+    ap.add_argument("--telemetry-dir", metavar="DIR", default=None,
+                    help="enable structured telemetry: per-stage spans "
+                         "(ingest/window/kernel/merge/sink), latency "
+                         "histograms, watermark-lag/backlog/grid-skew "
+                         "gauges, and the degradation counters, emitted as "
+                         "JSONL snapshots to DIR/telemetry.jsonl (one "
+                         "immediately, one per --telemetry-interval, one at "
+                         "exit) plus a final Prometheus text dump "
+                         "DIR/metrics.prom. Off by default — the record "
+                         "loop runs uninstrumented")
+    ap.add_argument("--telemetry-interval", type=float, default=5.0,
+                    metavar="SECONDS",
+                    help="seconds between periodic telemetry snapshots "
+                         "(default 5.0)")
     ap.add_argument("--profile", metavar="DIR", default=None,
                     help="capture a jax.profiler trace of the run to DIR "
                          "(TensorBoard/XProf format) with per-operator "
@@ -1217,9 +1264,6 @@ def main(argv: Optional[List[str]] = None) -> int:
                   "(tStats 205 / tAggregate 207); ignored for this case",
                   file=sys.stderr)
 
-    from spatialflink_tpu.streams.sinks import StdoutSink
-    from spatialflink_tpu.streams.sources import FileReplaySource
-
     spec = CASES.get(params.query.option)
     if spec is None:
         print(f"unknown queryOption {params.query.option}", file=sys.stderr)
@@ -1255,6 +1299,29 @@ def main(argv: Optional[List[str]] = None) -> int:
     limit1 = args.limit
     if skip1 and limit1 is not None:
         limit1 = max(0, limit1 - skip1)
+
+    if args.telemetry_dir:
+        from spatialflink_tpu.utils.telemetry import telemetry_session
+
+        # the session must wrap the KAFKA WIRING too (taps/sinks capture
+        # their gauges at construction), not just the result loop
+        with telemetry_session(args.telemetry_dir, args.telemetry_interval):
+            print(f"# telemetry: JSONL snapshots every "
+                  f"{args.telemetry_interval:g}s -> "
+                  f"{os.path.join(args.telemetry_dir, 'telemetry.jsonl')}",
+                  file=sys.stderr)
+            return _run_cli(ap, args, params, spec, skip1, limit1)
+    return _run_cli(ap, args, params, spec, skip1, limit1)
+
+
+def _run_cli(ap, args, params: Params, spec: CaseSpec, skip1: int,
+             limit1: Optional[int]) -> int:
+    """The post-validation half of :func:`main`: wire transport, run the
+    pipeline, drain results into the sinks, print summaries. Split out so
+    the telemetry session can scope the whole run."""
+    from spatialflink_tpu.streams.sinks import StdoutSink
+    from spatialflink_tpu.streams.sources import FileReplaySource
+
     kafka = None
     if args.kafka:
         try:
@@ -1315,26 +1382,50 @@ def main(argv: Optional[List[str]] = None) -> int:
         stack.enter_context(profile_to(args.profile))
         print(f"# profiling to {args.profile} (view with TensorBoard/xprof)",
               file=sys.stderr)
+    from spatialflink_tpu.utils import telemetry as _telemetry
+
+    tel = _telemetry.active()
+    # per-window pipeline latency: wall clock from asking the pipeline for
+    # the next result to receiving it (assembly + kernel + readback for
+    # that window — the end-to-end number per emitted window)
+    win_hist = (tel.histogram("window-latency-ms")
+                if tel is not None else None)
+
+    def emit_result(result) -> None:
+        _emit(result, sink)
+        if kafka is not None:
+            kafka.emit(result)
+        if out_sink is not None:
+            if isinstance(result, WindowResult):
+                for rec in result.flat_records():
+                    out_sink.emit(rec)
+            elif (isinstance(result, tuple) and len(result) == 2
+                    and isinstance(result[0], SpatialObject)):
+                # deser-family results are (obj, serialized) pairs —
+                # the reference produces exactly these to the output
+                # topic (StreamingJob.java:1289-1545)
+                out_sink.emit(result[0])
+            else:
+                out_sink.emit(result)
+
     n = 0
     stopped = False
+    it = iter(results)
     try:
-        for result in results:
-            _emit(result, sink)
+        while True:
+            t0 = time.perf_counter() if tel is not None else 0.0
+            try:
+                result = next(it)
+            except StopIteration:
+                break
+            if win_hist is not None:
+                win_hist.record((time.perf_counter() - t0) * 1e3)
+            if tel is not None:
+                with tel.span("sink"):
+                    emit_result(result)
+            else:
+                emit_result(result)
             n += 1
-            if kafka is not None:
-                kafka.emit(result)
-            if out_sink is not None:
-                if isinstance(result, WindowResult):
-                    for rec in result.flat_records():
-                        out_sink.emit(rec)
-                elif (isinstance(result, tuple) and len(result) == 2
-                        and isinstance(result[0], SpatialObject)):
-                    # deser-family results are (obj, serialized) pairs —
-                    # the reference produces exactly these to the output
-                    # topic (StreamingJob.java:1289-1545)
-                    out_sink.emit(result[0])
-                else:
-                    out_sink.emit(result)
     except ControlTupleExit:
         # the remote-stop hook (HelperClass.checkExitControlTuple:441-453) is
         # a graceful shutdown, not an error: finish the summary and exit 0
@@ -1356,9 +1447,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"# wrote {out_sink.records_written} records to {args.output} "
               f"({args.output_format})", file=sys.stderr)
     if args.metrics:
-        from spatialflink_tpu.utils.metrics import REGISTRY
+        import json
 
-        print(f"# metrics: {REGISTRY.snapshot()}", file=sys.stderr)
+        from spatialflink_tpu.utils.metrics import (REGISTRY,
+                                                    degradation_snapshot)
+
+        # machine-readable: ONE sorted-JSON object on stderr (the old
+        # Python-dict repr was neither parseable nor stable), with the
+        # degradation digest alongside the raw counters
+        print(json.dumps({"metrics": REGISTRY.snapshot(),
+                          "degradation": degradation_snapshot()},
+                         sort_keys=True), file=sys.stderr)
     return 0
 
 

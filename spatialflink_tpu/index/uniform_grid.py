@@ -26,9 +26,16 @@ Layer math mirrors the reference exactly:
 from __future__ import annotations
 
 import math
-from typing import Iterable, NamedTuple, Set, Tuple, Union
+from typing import Callable, Iterable, NamedTuple, Optional, Set, Tuple, Union
 
 import numpy as np
+
+#: telemetry hook: when a telemetry session is active
+#: (:func:`spatialflink_tpu.utils.telemetry.telemetry_session`), this is the
+#: session's cell-occupancy recorder and every :meth:`UniformGrid.assign_cell`
+#: result feeds the hottest-cell/skew gauges. None (the default) keeps the
+#: assignment path exactly as before — one module-global None check.
+_CELL_OBSERVER: Optional[Callable[[np.ndarray], None]] = None
 
 
 class GridParams(NamedTuple):
@@ -136,6 +143,8 @@ class UniformGrid:
         cx, cy = self.cell_indices(x, y)
         valid = self.valid_indices(cx, cy)
         cell = np.where(valid, cx * self.n + cy, -1).astype(np.int32)
+        if _CELL_OBSERVER is not None:
+            _CELL_OBSERVER(cell)
         return cell, valid
 
     def cell_id(self, cx: int, cy: int) -> int:

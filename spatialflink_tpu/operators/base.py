@@ -149,6 +149,12 @@ class SpatialOperator:
     # ambiguous across sides) and apps with bespoke window logic opt OUT.
     supports_count_windows = True
 
+    #: query-family label scoping telemetry span names (``knn.kernel`` vs a
+    #: flat namespace) so multi-family / --multi-query runs stay separable
+    #: in one snapshot stream; subclasses set "range"/"knn"/"join"/"tknn"/…
+    #: (None falls back to the class name)
+    telemetry_label: Optional[str] = None
+
     def __init__(self, conf: QueryConfiguration, grid: UniformGrid,
                  grid2: Optional[UniformGrid] = None):
         if (conf.query_type is QueryType.CountBased
@@ -626,6 +632,7 @@ class SpatialOperator:
         """Pipelined evaluation over pre-assembled (start, end, payload)
         triples (record lists from _drive, or index/batch payloads from the
         bulk path). ``count(payload)`` feeds the records-evaluated metric."""
+        from spatialflink_tpu.utils import telemetry as _telemetry
         from spatialflink_tpu.utils.metrics import REGISTRY, trace
 
         batches = REGISTRY.counter("batches-evaluated")
@@ -635,8 +642,16 @@ class SpatialOperator:
         # named per-operator trace annotations (≙ the reference's named
         # operators in the Flink web UI, StreamingJob.java:70-72): visible
         # in a jax.profiler capture (--profile / utils.metrics.profile_to),
-        # no-ops otherwise
+        # no-ops otherwise. With a telemetry session active they upgrade to
+        # stage SPANS (window/kernel/merge under the family label) which
+        # still carry the trace annotation inside; checked ONCE here so a
+        # disabled run drives the exact pre-telemetry loop.
         op_name = type(self).__name__
+        tel = _telemetry.active()
+        label = self.telemetry_label or op_name
+        if tel is not None:
+            backlog = tel.gauge("window-backlog")
+            batched = self._spanned_batches(batched, tel, label)
 
         def emit(start, end, sel) -> Iterator[WindowResult]:
             # realtime mode only fires on non-empty selections (the
@@ -648,22 +663,43 @@ class SpatialOperator:
         def drain(n: int) -> Iterator[WindowResult]:
             while len(pending) > n:
                 start, end, dfd = pending.popleft()
-                with trace(f"{op_name}.readback"):
+                with (tel.span("merge", query=label) if tel is not None
+                      else trace(f"{op_name}.readback")):
                     sel = dfd.finish()
+                if tel is not None:
+                    backlog.set(len(pending))
                 yield from emit(start, end, sel)
 
         for start, end, payload in batched:
             batches.inc()
             records_c.inc(count(payload))
-            with trace(f"{op_name}.dispatch"):
+            with (tel.span("kernel", query=label) if tel is not None
+                  else trace(f"{op_name}.dispatch")):
                 sel = eval_batch(payload, start)
             if isinstance(sel, Deferred):
                 pending.append((start, end, sel))
+                if tel is not None:
+                    backlog.set(len(pending))
                 yield from drain(depth - 1)
             else:
                 yield from drain(0)  # keep window order
                 yield from emit(start, end, sel)
         yield from drain(0)
+
+    @staticmethod
+    def _spanned_batches(batched: Iterable, tel, label: str) -> Iterator:
+        """Wrap a (start, end, payload) source so each pull is timed as the
+        ``window`` stage (assembly/buffering time — the host-side half the
+        kernel spans don't see). The span is class-based, so the final
+        StopIteration passes through it without being miscounted."""
+        it = iter(batched)
+        while True:
+            try:
+                with tel.span("window", query=label):
+                    item = next(it)
+            except StopIteration:
+                return
+            yield item
 
 
 class GeomQueryMixin:

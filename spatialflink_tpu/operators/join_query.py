@@ -71,6 +71,8 @@ def _merge_sorted_windows(gen_a, gen_b):
 
 
 class PointPointJoinQuery(SpatialOperator):
+    telemetry_label = "join"
+
     # a count trigger over TWO independently-arriving streams is ambiguous
     # (whose arrivals count?); joins keep the reference's rejection
     supports_count_windows = False

@@ -29,6 +29,8 @@ from spatialflink_tpu.ops.knn import knn_point_stats
 
 
 class PointPointKNNQuery(SpatialOperator):
+    telemetry_label = "knn"
+
     def run(self, stream: Iterable[Point], query_point: Point, radius: float,
             k: Optional[int] = None) -> Iterator[WindowResult]:
         k = k or self.conf.k
@@ -168,6 +170,8 @@ class PointPointKNNQuery(SpatialOperator):
 
 
 class _GenericKnn(SpatialOperator, GeomQueryMixin):
+    telemetry_label = "knn"
+
     """Shared kNN driver: subclasses provide the batch builder and the
     per-batch (eligible, dists) closure.
 

@@ -59,6 +59,8 @@ class _PointStreamBulkSource:
 
 class PointPointRangeQuery(_PointStreamBulkSource, _RangeMultiBulkMixin,
                            SpatialOperator):
+    telemetry_label = "range"
+
     def run(self, stream: Iterable[Point], query_point: Point, radius: float
             ) -> Iterator[WindowResult]:
         return self._drive(
@@ -179,6 +181,8 @@ class PointPointRangeQuery(_PointStreamBulkSource, _RangeMultiBulkMixin,
 
 class PointGeomRangeQuery(_PointStreamBulkSource, _RangeMultiBulkMixin,
                           SpatialOperator, GeomQueryMixin):
+    telemetry_label = "range"
+
     """Point stream x polygon/linestring query
     (``range/PointPolygonRangeQuery.java``, ``PointLineStringRangeQuery``).
 
@@ -277,6 +281,8 @@ class _GeomStreamBulkMixin:
 
 class GeomPointRangeQuery(SpatialOperator, GeomQueryMixin,
                           _GeomStreamBulkMixin, _RangeMultiBulkMixin):
+    telemetry_label = "range"
+
     """Polygon/linestring stream x point query
     (``range/PolygonPointRangeQuery.java``, ``LineStringPointRangeQuery``).
     GN-subset rule: a geometry passes without distance math only if ALL its
@@ -343,6 +349,8 @@ class GeomPointRangeQuery(SpatialOperator, GeomQueryMixin,
 
 class GeomGeomRangeQuery(SpatialOperator, GeomQueryMixin,
                          _GeomStreamBulkMixin, _RangeMultiBulkMixin):
+    telemetry_label = "range"
+
     """Polygon/linestring stream x polygon/linestring query
     (``range/PolygonPolygonRangeQuery.java`` and the 3 sibling pairs)."""
 

@@ -61,6 +61,8 @@ def assemble_subtrajectories(records: List[Point]) -> Dict[str, object]:
 
 
 class PointTFilterQuery(SpatialOperator):
+    telemetry_label = "tfilter"
+
     """Keep only trajectories whose objID is in ``traj_ids`` (empty => all)."""
 
     def run(self, stream: Iterable[Point], traj_ids: Set[str]
@@ -85,6 +87,8 @@ class PointTFilterQuery(SpatialOperator):
 
 
 class PointPolygonTRangeQuery(SpatialOperator, GeomQueryMixin):
+    telemetry_label = "trange"
+
     """Trajectories passing through any of a set of query polygons."""
 
     def _prepare(self, polygons):
@@ -172,6 +176,8 @@ class PointPolygonTRangeQuery(SpatialOperator, GeomQueryMixin):
 
 
 class PointTStatsQuery(SpatialOperator):
+    telemetry_label = "tstats"
+
     """Per-trajectory spatial length / temporal length / average speed.
 
     Realtime mode carries device state across micro-batches (the reference's
@@ -360,6 +366,8 @@ class PointTStatsQuery(SpatialOperator):
 
 
 class PointTAggregateQuery(SpatialOperator):
+    telemetry_label = "taggregate"
+
     """Per-cell heatmap of trajectory lengths.
 
     ``aggregate`` in {SUM, AVG, MIN, MAX, COUNT, ALL}. Realtime mode merges
@@ -723,6 +731,8 @@ class _ExtentStore:
 
 
 class PointPointTJoinQuery(SpatialOperator):
+    telemetry_label = "tjoin"
+
     """Trajectory-trajectory proximity join: one output per
     (trajectory, partner) pair per window, keeping the LATEST co-located
     timestamp (``tJoin/PointPointTJoinQuery.java:133-177``).
@@ -830,6 +840,8 @@ class PointPointTJoinQuery(SpatialOperator):
 
 
 class PointPointTKNNQuery(SpatialOperator):
+    telemetry_label = "tknn"
+
     """k nearest trajectories to a query point within ``radius`` (exact
     radius enforced, unlike plain kNN)."""
 

@@ -448,14 +448,42 @@ class SupervisedBroker:
                     f"circuit still open past the {budget}s deadline")
             self._sleep(step)
 
-    def _call(self, fn: Callable, *args, **kwargs):
+    #: breaker state as a gauge value (telemetry snapshots are numeric)
+    _BREAKER_STATES = {"closed": 0.0, "half-open": 0.5, "open": 1.0}
+
+    def _note_breaker(self, tel) -> None:
+        if tel is not None:
+            tel.gauge("broker.breaker-state").set(
+                self._BREAKER_STATES[self.breaker.state])
+
+    def _call(self, fn: Callable, *args, label: str = "call", **kwargs):
+        from spatialflink_tpu.utils import telemetry as _telemetry
+
         start = time.monotonic()
-        return self.retry.call(
-            fn, *args,
-            before_attempt=lambda: self._wait_for_circuit(start),
-            on_failure=lambda e, a: self.breaker.record_failure(),
-            on_success=self.breaker.record_success,
-            sleep=self._sleep, **kwargs)
+        # one span per supervised call (retries/backoff included — the
+        # span measures what the pipeline WAITED, which is the number that
+        # correlates with the degradation counters in the same snapshot)
+        tel = _telemetry.active()
+
+        def on_failure(e, a):
+            self.breaker.record_failure()
+            self._note_breaker(tel)
+
+        def on_success():
+            self.breaker.record_success()
+            self._note_breaker(tel)
+
+        def run():
+            return self.retry.call(
+                fn, *args,
+                before_attempt=lambda: self._wait_for_circuit(start),
+                on_failure=on_failure, on_success=on_success,
+                sleep=self._sleep, **kwargs)
+
+        if tel is None:
+            return run()
+        with tel.span(label, query="broker"):
+            return run()
 
     # ------------------------------ broker surface --------------------- #
 
@@ -509,10 +537,11 @@ class SupervisedBroker:
             return self.inner.produce(topic, value, key=key,
                                       timestamp_ms=timestamp_ms)
 
-        return self._call(verified_produce)
+        return self._call(verified_produce, label="produce")
 
     def fetch(self, topic: str, offset: int, max_records: int = 500):
-        return self._call(self.inner.fetch, topic, offset, max_records)
+        return self._call(self.inner.fetch, topic, offset, max_records,
+                          label="fetch")
 
     def commit(self, topic: str, group: str, next_offset: int) -> None:
         self.inner.commit(topic, group, next_offset)

@@ -32,6 +32,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional, Tuple
 
 from spatialflink_tpu.streams.formats import serialize_spatial
+from spatialflink_tpu.utils import telemetry as _telemetry
 
 
 @dataclass
@@ -181,10 +182,18 @@ class KafkaSource:
         pos = self.position = self.broker.committed(self.topic, self.group)
         uncommitted = 0
         yielded = 0
+        # telemetry is per-poll (never per record): one span around each
+        # fetch when a session is active, the bare call otherwise
+        tel = _telemetry.active()
         while True:
             if self.limit is not None and yielded >= self.limit:
                 break
-            batch = self.broker.fetch(self.topic, pos, self.poll_batch)
+            if tel is not None:
+                with tel.span("fetch", query="kafka"):
+                    batch = self.broker.fetch(self.topic, pos,
+                                              self.poll_batch)
+            else:
+                batch = self.broker.fetch(self.topic, pos, self.poll_batch)
             if not batch:
                 if self.stop_at_end:
                     break
@@ -393,6 +402,15 @@ class WindowCommitTap:
         #: Without a DLQ a parse failure propagates, as it always did.
         self.dlq = dlq
         self._pending = deque()
+        # telemetry gauges (watermark lag = wall clock minus newest event
+        # time; commit backlog = records awaiting a covering window), set
+        # per tracked record — cheap float stores, and only when a session
+        # was active when the driver wired the tap
+        tel = _telemetry.active()
+        self._lag_gauge = (tel.gauge("kafka.watermark-lag-ms")
+                           if tel is not None else None)
+        self._backlog_gauge = (tel.gauge("kafka.commit-backlog")
+                               if tel is not None else None)
 
     def _parse_or_dlq(self, raw, position: int):
         """Parse one record; on failure, redeliver-and-retry, then
@@ -446,11 +464,15 @@ class WindowCommitTap:
         ts = getattr(obj, "timestamp", None)
         if isinstance(ts, (int, float)):
             lwe = int(ts) - int(ts) % self.slide_ms + self.size_ms
+            if self._lag_gauge is not None:
+                self._lag_gauge.set(time.time() * 1000 - ts)
         else:
             # unknown event time: block commits behind it until the
             # end-of-stream commit_all (conservative, never unsafe)
             lwe = float("inf")
         self._pending.append((position, lwe))
+        if self._backlog_gauge is not None:
+            self._backlog_gauge.set(len(self._pending))
         return obj
 
     def __iter__(self) -> Iterator[Any]:
@@ -621,6 +643,7 @@ class KafkaWindowSink:
         self.seed_scan_limit = seed_scan_limit
         self.seed_scan_warn = seed_scan_warn
         self._enc = KafkaSink(broker, topic, fmt, date_format, delimiter)
+        self._tel = _telemetry.active()
         self.delivered = self._seed_from_log()
         self.duplicates_suppressed = 0
         self.windows_produced = 0
@@ -680,6 +703,15 @@ class KafkaWindowSink:
         return f"{self.job_id}:{base}" if self.job_id else base
 
     def emit(self, result) -> None:
+        if self._tel is not None:
+            # per-window producing time under the sink stage (the span also
+            # covers the dedup check — both are the sink's cost)
+            with self._tel.span("sink", query="kafka"):
+                self._emit(result)
+        else:
+            self._emit(result)
+
+    def _emit(self, result) -> None:
         wk = self.window_key(result)
         if wk in self.delivered:
             self.duplicates_suppressed += 1

@@ -76,22 +76,39 @@ class FileSink:
 
 class LatencySink:
     """Per-record latency in millis: now - ingestion_time (or event ts),
-    mirroring ``HelperClass.LatencySinkPoint`` et al. Collects values and
-    exposes percentiles for the bench harness."""
+    mirroring ``HelperClass.LatencySinkPoint`` et al.
+
+    Backed by a constant-memory :class:`~spatialflink_tpu.utils.telemetry.
+    StreamingHistogram` — the old per-record Python list grew without bound
+    on long-running streams (and its ``percentile()`` imported numpy per
+    call). The ``percentile()`` API is unchanged; when a telemetry session
+    is active the same values also feed its ``record-latency-ms``
+    histogram so they appear in the JSONL snapshots."""
 
     def __init__(self, use_event_time: bool = False):
+        from spatialflink_tpu.utils import telemetry as _telemetry
+        from spatialflink_tpu.utils.telemetry import StreamingHistogram
+
         self.use_event_time = use_event_time
-        self.latencies_ms: List[float] = []
+        self.hist = StreamingHistogram("record-latency-ms")
+        tel = _telemetry.active()
+        self._tel_hist = (tel.histogram("record-latency-ms")
+                          if tel is not None else None)
+
+    @property
+    def count(self) -> int:
+        return self.hist.count
 
     def emit(self, record):
         now = time.time() * 1000
         base = record.timestamp if self.use_event_time else record.ingestion_time
-        self.latencies_ms.append(now - base)
+        v = now - base
+        self.hist.record(v)
+        if self._tel_hist is not None:
+            self._tel_hist.record(v)
 
     def percentile(self, p: float) -> float:
-        import numpy as np
-
-        return float(np.percentile(self.latencies_ms, p)) if self.latencies_ms else 0.0
+        return self.hist.percentile(p)
 
     def close(self):
         pass

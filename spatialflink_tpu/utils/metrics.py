@@ -108,6 +108,13 @@ class MetricsRegistry:
         self.counters: Dict[str, Counter] = {}
         self.meters: Dict[str, Meter] = {}
 
+    def reset(self) -> None:
+        """Drop every counter and meter. Handles created before the reset
+        stay usable but are no longer scraped — callers that cache a
+        counter across a reset should re-fetch it."""
+        self.counters.clear()
+        self.meters.clear()
+
     def counter(self, name: str) -> Counter:
         if name not in self.counters:
             self.counters[name] = Counter(name)
@@ -128,8 +135,36 @@ class MetricsRegistry:
         return out
 
 
-#: process-wide default registry (the reference's per-job metric group)
+#: process-wide default registry (the reference's per-job metric group).
+#: Pipelines read it through ``metrics.REGISTRY`` at CALL time (function-
+#: level imports), so :func:`scoped_registry` can swap it for a run/test
+#: without process-global counter bleed-through; the driver's kafka summary
+#: keeps its baseline-delta logic only for true cross-run accumulation in
+#: this default registry.
 REGISTRY = MetricsRegistry()
+
+
+def set_registry(registry: MetricsRegistry) -> MetricsRegistry:
+    """Install ``registry`` as the ambient default; returns the previous
+    one. Prefer :func:`scoped_registry` — it restores on exit."""
+    global REGISTRY
+    old = REGISTRY
+    REGISTRY = registry
+    return old
+
+
+@contextlib.contextmanager
+def scoped_registry(registry: Optional[MetricsRegistry] = None
+                    ) -> Iterator[MetricsRegistry]:
+    """Run the enclosed block against a fresh (or given) registry, restoring
+    the previous one on exit — the test/driver isolation hook, so counters
+    from one run cannot bleed into the next's snapshot."""
+    reg = MetricsRegistry() if registry is None else registry
+    old = set_registry(reg)
+    try:
+        yield reg
+    finally:
+        set_registry(old)
 
 #: counter-name prefixes that mean "the transport or pipeline degraded and
 #: recovery machinery engaged" — injected faults (runtime/faults.py), retry

@@ -1,0 +1,540 @@
+"""Structured telemetry: spans, streaming histograms, gauges, reporter.
+
+The reference exposes its pipeline through Flink's web UI and Dropwizard
+meters (SURVEY §5); the rebuild's counters (:mod:`.metrics`) say *how much*
+work happened but not *where the time went*. This layer adds the missing
+dimensions, all host-side and all O(1) per observation:
+
+- :meth:`Telemetry.span` — a context manager recording count / total / max /
+  self (minus-children) wall-clock per named stage, nesting-aware via a
+  thread-local stack, composing with :func:`~.metrics.trace` so every span
+  is also a jax.profiler annotation when a ``--profile`` capture is running.
+  Stage names are query-scoped (``knn.kernel`` vs one flat namespace) so
+  ``--multi-query`` and multi-family runs stay separable.
+- :class:`StreamingHistogram` — fixed log-bucket histogram (geometric
+  buckets, O(1) record, constant memory) exposing p50/p95/p99/max; the
+  per-record and per-window latency distributions ride it instead of an
+  unbounded sample list.
+- :class:`Gauge` — last-value (or callable) gauges: watermark lag, window
+  backlog, breaker state.
+- :class:`CellOccupancy` — grid-cell assignment counts from
+  :meth:`~spatialflink_tpu.index.uniform_grid.UniformGrid.assign_cell`
+  (installed as the grid module's observer hook only while a session is
+  active): top-k hottest cells and a max/mean skew factor — the keyBy(grid)
+  hot-spot signal the reference reads off Flink's backpressure UI.
+- :class:`TelemetryReporter` — a daemon thread emitting one JSONL snapshot
+  to ``--telemetry-dir`` immediately, every ``--telemetry-interval``
+  seconds, and at close (so even a short run yields >= 2 snapshots), plus a
+  final Prometheus text-format dump (``metrics.prom``). Snapshots embed the
+  ambient registry's counters AND :func:`~.metrics.degradation_snapshot`,
+  so PR 1's retry/breaker/DLQ events correlate with stage timings by
+  timestamp in one stream.
+
+OFF BY DEFAULT: :func:`active` returns None until a
+:func:`telemetry_session` is entered, and every instrumented hot path
+checks that once per stream/loop (not per record) — a disabled run executes
+the exact pre-telemetry code.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import math
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from spatialflink_tpu.utils import metrics as _metrics
+from spatialflink_tpu.utils.metrics import trace
+
+
+class SpanStats:
+    """Aggregate wall-clock stats for one named stage."""
+
+    __slots__ = ("name", "count", "total_s", "max_s", "self_s", "errors")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.count = 0
+        self.total_s = 0.0
+        self.max_s = 0.0
+        #: total minus time spent in CHILD spans (the nesting-aware part:
+        #: an outer "window" span wrapping a "kernel" span reports how much
+        #: of the window was NOT kernel)
+        self.self_s = 0.0
+        self.errors = 0
+
+    def to_dict(self) -> dict:
+        return {
+            "count": self.count,
+            "total_ms": round(self.total_s * 1e3, 3),
+            "max_ms": round(self.max_s * 1e3, 3),
+            "self_ms": round(self.self_s * 1e3, 3),
+            "errors": self.errors,
+        }
+
+
+class _Span:
+    """One span activation. Class-based (not a generator contextmanager) so
+    a StopIteration raised INSIDE the block propagates normally — spans wrap
+    ``next()`` calls on the window assembly path."""
+
+    __slots__ = ("tel", "name", "t0", "child_s", "_trace")
+
+    def __init__(self, tel: "Telemetry", name: str):
+        self.tel = tel
+        self.name = name
+        self.child_s = 0.0
+
+    def __enter__(self) -> "_Span":
+        self._trace = trace(self.name)
+        self._trace.__enter__()
+        self.tel._stack().append(self)
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, et, ev, tb) -> bool:
+        dt = time.perf_counter() - self.t0
+        stack = self.tel._stack()
+        if stack and stack[-1] is self:
+            stack.pop()
+        if stack:
+            stack[-1].child_s += dt
+        st = self.tel._span_stats(self.name)
+        st.count += 1
+        st.total_s += dt
+        st.self_s += max(0.0, dt - self.child_s)
+        if dt > st.max_s:
+            st.max_s = dt
+        # StopIteration through a span is normal control flow (the span
+        # times the pull from an exhausted iterator), not a stage failure
+        if et is not None and et is not StopIteration:
+            st.errors += 1
+        self._trace.__exit__(et, ev, tb)
+        return False
+
+
+class StreamingHistogram:
+    """Fixed log-bucket streaming histogram: O(1) per record, constant
+    memory, percentiles by cumulative bucket walk.
+
+    Bucket ``i >= 1`` covers ``[lo * growth**(i-1), lo * growth**i)``;
+    bucket 0 is the underflow bucket (values <= lo, including zeros and
+    negatives); the last bucket absorbs overflow. A percentile returns the
+    geometric midpoint of its bucket clamped to the observed [min, max], so
+    the relative error is bounded by ``sqrt(growth)`` (~4.4% at the default
+    8-buckets-per-octave growth) — the Dropwizard-reservoir answer without
+    sampling jitter or per-record allocation.
+    """
+
+    __slots__ = ("name", "lo", "growth", "_log_lo", "_log_g", "_nb",
+                 "counts", "count", "total", "min", "max")
+
+    def __init__(self, name: str = "", lo: float = 1e-3, hi: float = 1e7,
+                 growth: float = 2.0 ** 0.125):
+        if not (lo > 0 and hi > lo and growth > 1.0):
+            raise ValueError("need 0 < lo < hi and growth > 1")
+        self.name = name
+        self.lo = lo
+        self.growth = growth
+        self._log_lo = math.log(lo)
+        self._log_g = math.log(growth)
+        self._nb = int(math.ceil((math.log(hi) - self._log_lo) / self._log_g))
+        self.counts: List[int] = [0] * (self._nb + 2)
+        self.count = 0
+        self.total = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+
+    def record(self, value: float) -> None:
+        self.count += 1
+        self.total += value
+        if value < self.min:
+            self.min = value
+        if value > self.max:
+            self.max = value
+        if value <= self.lo:
+            idx = 0
+        else:
+            idx = int((math.log(value) - self._log_lo) / self._log_g) + 1
+            if idx > self._nb + 1:
+                idx = self._nb + 1
+        self.counts[idx] += 1
+
+    def _bucket_value(self, idx: int) -> float:
+        if idx == 0:
+            return self.min if self.min < math.inf else self.lo
+        if idx == self._nb + 1:
+            # overflow bucket: the midpoint would lie about anything past
+            # hi; the observed max is the honest representative
+            return self.max
+        # geometric midpoint of the bucket
+        return math.exp(self._log_lo + (idx - 0.5) * self._log_g)
+
+    def percentile(self, p: float) -> float:
+        if not self.count:
+            return 0.0
+        target = max(1, math.ceil(self.count * min(max(p, 0.0), 100.0) / 100.0))
+        cum = 0
+        for idx, n in enumerate(self.counts):
+            cum += n
+            if cum >= target:
+                v = self._bucket_value(idx)
+                return float(min(max(v, self.min), self.max))
+        return float(self.max)  # pragma: no cover - cum always reaches count
+
+    def to_dict(self) -> dict:
+        if not self.count:
+            return {"count": 0}
+        return {
+            "count": self.count,
+            "sum": round(self.total, 3),
+            "min": round(self.min, 3),
+            "max": round(self.max, 3),
+            "p50": round(self.percentile(50), 3),
+            "p95": round(self.percentile(95), 3),
+            "p99": round(self.percentile(99), 3),
+        }
+
+
+class Gauge:
+    """Last-value gauge; construct with ``fn`` for pull-style gauges that
+    are read at snapshot time."""
+
+    __slots__ = ("name", "fn", "_value")
+
+    def __init__(self, name: str, fn: Optional[Callable[[], float]] = None):
+        self.name = name
+        self.fn = fn
+        self._value = 0.0
+
+    def set(self, value: float) -> None:
+        self._value = float(value)
+
+    def get(self) -> float:
+        if self.fn is not None:
+            try:
+                return float(self.fn())
+            except Exception:
+                return float("nan")
+        return self._value
+
+
+class CellOccupancy:
+    """Grid-cell assignment counts: top-k hottest cells + skew (max/mean
+    over occupied cells). Fed int arrays (or scalars) of cell ids; invalid
+    cells (-1) are dropped. Vectorized bincount accumulation — cheap even
+    on the 1M-point bulk ingest paths."""
+
+    def __init__(self):
+        import numpy as np
+
+        self._np = np
+        self._counts = np.zeros(0, dtype=np.int64)
+
+    def _ensure(self, hi: int) -> None:
+        if hi > self._counts.size:
+            np = self._np
+            grown = np.zeros(max(hi, 2 * self._counts.size), dtype=np.int64)
+            grown[: self._counts.size] = self._counts
+            self._counts = grown
+
+    def record(self, cells) -> None:
+        np = self._np
+        # scalar fast path: the per-record streaming ingest assigns one
+        # cell at a time — a single bounds check + increment, O(1), no
+        # array construction (the vectorized branch below would cost
+        # O(num_cells) per record and dwarf the parse it observes)
+        if isinstance(cells, (int, np.integer)) or (
+                isinstance(cells, np.ndarray) and cells.ndim == 0):
+            ci = int(cells)
+            if ci < 0:
+                return
+            self._ensure(ci + 1)
+            self._counts[ci] += 1
+            return
+        c = np.asarray(cells).ravel()
+        c = c[c >= 0]
+        if c.size == 0:
+            return
+        hi = int(c.max()) + 1
+        self._ensure(hi)
+        self._counts[:hi] += np.bincount(c, minlength=hi).astype(np.int64)
+
+    def top_k(self, k: int = 8) -> List[Tuple[int, int]]:
+        np = self._np
+        nz = np.nonzero(self._counts)[0]
+        if nz.size == 0:
+            return []
+        order = nz[np.argsort(self._counts[nz])[::-1][:k]]
+        return [(int(c), int(self._counts[c])) for c in order]
+
+    def skew(self) -> float:
+        """max/mean over occupied cells; 1.0 = perfectly uniform."""
+        np = self._np
+        nz = self._counts[self._counts > 0]
+        if nz.size == 0:
+            return 0.0
+        return float(nz.max() / nz.mean())
+
+    def to_dict(self, k: int = 8) -> dict:
+        occ = int((self._counts > 0).sum())
+        return {"occupied_cells": occ, "skew": round(self.skew(), 3),
+                "top_cells": self.top_k(k)}
+
+
+class Telemetry:
+    """One session's span/histogram/gauge/occupancy state.
+
+    ``registry`` pins the metrics registry whose counters ride the
+    snapshots; None reads the ambient :data:`~.metrics.REGISTRY` at
+    snapshot time (so :func:`~.metrics.scoped_registry` composes).
+    Mutations on the hot path are single attribute bumps under the GIL;
+    only entry creation and snapshotting take the lock, so a reporter
+    thread reading mid-window sees a consistent-enough view (telemetry,
+    not accounting).
+    """
+
+    def __init__(self, registry: Optional[_metrics.MetricsRegistry] = None):
+        self.registry = registry
+        self.spans: Dict[str, SpanStats] = {}
+        self.histograms: Dict[str, StreamingHistogram] = {}
+        self.gauges: Dict[str, Gauge] = {}
+        self.cells = CellOccupancy()
+        self.started_at = time.time()
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+
+    # ------------------------------ spans ---------------------------- #
+
+    def _stack(self) -> list:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def _span_stats(self, name: str) -> SpanStats:
+        st = self.spans.get(name)
+        if st is None:
+            with self._lock:
+                st = self.spans.setdefault(name, SpanStats(name))
+        return st
+
+    def span(self, stage: str, query: Optional[str] = None) -> _Span:
+        """Context manager timing one activation of ``stage``; ``query``
+        scopes the stage name (``knn.kernel``) so families/queries stay
+        separable. Exceptions propagate (and bump ``errors``)."""
+        return _Span(self, f"{query}.{stage}" if query else stage)
+
+    def observe(self, stage: str, dt_s: float,
+                query: Optional[str] = None) -> None:
+        """Record one pre-timed observation — the per-record loops use this
+        instead of a context manager (no object churn on the ingest path)."""
+        st = self._span_stats(f"{query}.{stage}" if query else stage)
+        st.count += 1
+        st.total_s += dt_s
+        st.self_s += dt_s
+        if dt_s > st.max_s:
+            st.max_s = dt_s
+
+    # --------------------------- histograms/gauges -------------------- #
+
+    def histogram(self, name: str, **kw) -> StreamingHistogram:
+        h = self.histograms.get(name)
+        if h is None:
+            with self._lock:
+                h = self.histograms.setdefault(
+                    name, StreamingHistogram(name, **kw))
+        return h
+
+    def gauge(self, name: str,
+              fn: Optional[Callable[[], float]] = None) -> Gauge:
+        g = self.gauges.get(name)
+        if g is None:
+            with self._lock:
+                g = self.gauges.setdefault(name, Gauge(name, fn))
+        elif fn is not None and g.fn is None:
+            g.fn = fn
+        return g
+
+    def record_cells(self, cells) -> None:
+        self.cells.record(cells)
+
+    # ------------------------------ snapshot -------------------------- #
+
+    def _registry(self) -> _metrics.MetricsRegistry:
+        return self.registry if self.registry is not None else _metrics.REGISTRY
+
+    def snapshot(self) -> dict:
+        """One JSON-safe snapshot: stage spans, histogram percentiles,
+        gauges, the registry's counters/meters, the degradation digest
+        (PR 1's retry/breaker/DLQ/chaos counters — same stream, same
+        timestamp, correlation for free), and grid occupancy."""
+        reg = self._registry()
+        with self._lock:
+            spans = {n: s.to_dict() for n, s in self.spans.items()}
+            hists = {n: h.to_dict() for n, h in self.histograms.items()}
+            gauges = {n: g.get() for n, g in self.gauges.items()}
+        return {
+            "ts_ms": int(time.time() * 1000),
+            "uptime_s": round(time.time() - self.started_at, 3),
+            "spans": spans,
+            "histograms": hists,
+            "gauges": gauges,
+            "counters": reg.snapshot(),
+            "degradation": _metrics.degradation_snapshot(reg),
+            "grid": self.cells.to_dict(),
+        }
+
+
+# --------------------------------------------------------------------- #
+# the active session (module-global, like metrics.REGISTRY)
+
+_ACTIVE: Optional[Telemetry] = None
+_NULL_CM = contextlib.nullcontext()
+
+
+def active() -> Optional[Telemetry]:
+    """The active session's :class:`Telemetry`, or None when telemetry is
+    off. Hot paths call this ONCE per stream/loop and branch to the
+    uninstrumented code when it is None."""
+    return _ACTIVE
+
+
+def set_active(tel: Optional[Telemetry]) -> Optional[Telemetry]:
+    global _ACTIVE
+    old = _ACTIVE
+    _ACTIVE = tel
+    return old
+
+
+def span(stage: str, query: Optional[str] = None):
+    """Module-level convenience for call-once sites (stage boundaries, CLI
+    plumbing): a real span when a session is active, a shared nullcontext
+    otherwise. Per-record loops should capture :func:`active` instead."""
+    tel = _ACTIVE
+    return tel.span(stage, query) if tel is not None else _NULL_CM
+
+
+# --------------------------------------------------------------------- #
+# reporter
+
+def prometheus_text(tel: Telemetry) -> str:
+    """Prometheus text exposition of a session: spans as count/total/max
+    seconds, histograms as count/sum plus p50/p95/p99 quantile gauges,
+    gauges and registry counters as-is. Metric names are fixed; the
+    span/histogram/counter name rides a label (dots and dashes are legal
+    in label VALUES, so the query-scoped names survive unmangled)."""
+    lines: List[str] = []
+
+    def emit(metric: str, mtype: str, rows: List[Tuple[str, float]]):
+        lines.append(f"# TYPE {metric} {mtype}")
+        for labels, v in rows:
+            lines.append(f"{metric}{{{labels}}} {v}")
+
+    snap_reg = tel._registry()
+    with tel._lock:
+        spans = dict(tel.spans)
+        hists = dict(tel.histograms)
+        gauges = dict(tel.gauges)
+    emit("spatialflink_span_count", "counter",
+         [(f'stage="{n}"', s.count) for n, s in sorted(spans.items())])
+    emit("spatialflink_span_seconds_total", "counter",
+         [(f'stage="{n}"', round(s.total_s, 6))
+          for n, s in sorted(spans.items())])
+    emit("spatialflink_span_seconds_max", "gauge",
+         [(f'stage="{n}"', round(s.max_s, 6))
+          for n, s in sorted(spans.items())])
+    emit("spatialflink_histogram_count", "counter",
+         [(f'name="{n}"', h.count) for n, h in sorted(hists.items())])
+    emit("spatialflink_histogram_sum", "counter",
+         [(f'name="{n}"', round(h.total, 6))
+          for n, h in sorted(hists.items())])
+    qrows = []
+    for n, h in sorted(hists.items()):
+        for q in (50, 95, 99):
+            qrows.append((f'name="{n}",quantile="0.{q}"',
+                          round(h.percentile(q), 6)))
+    emit("spatialflink_histogram_quantile", "gauge", qrows)
+    emit("spatialflink_gauge", "gauge",
+         [(f'name="{n}"', g.get()) for n, g in sorted(gauges.items())])
+    emit("spatialflink_counter", "counter",
+         [(f'name="{n}"', v) for n, v in sorted(snap_reg.snapshot().items())])
+    return "\n".join(lines) + "\n"
+
+
+class TelemetryReporter:
+    """Daemon thread writing JSONL snapshots to ``<out_dir>/telemetry.jsonl``:
+    one immediately at :meth:`start`, one per ``interval_s``, one final at
+    :meth:`close` (so every run yields >= 2), then a Prometheus text dump to
+    ``<out_dir>/metrics.prom``."""
+
+    def __init__(self, telemetry: Telemetry, out_dir: str,
+                 interval_s: float = 5.0):
+        os.makedirs(out_dir, exist_ok=True)
+        self.telemetry = telemetry
+        self.interval_s = max(0.01, float(interval_s))
+        self.jsonl_path = os.path.join(out_dir, "telemetry.jsonl")
+        self.prom_path = os.path.join(out_dir, "metrics.prom")
+        self.snapshots_written = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _emit(self) -> None:
+        snap = self.telemetry.snapshot()
+        with open(self.jsonl_path, "a") as f:
+            f.write(json.dumps(snap, sort_keys=True) + "\n")
+        self.snapshots_written += 1
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self._emit()
+
+    def start(self) -> "TelemetryReporter":
+        self._emit()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="telemetry-reporter")
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.interval_s + 5.0)
+            self._thread = None
+        self._emit()
+        with open(self.prom_path, "w") as f:
+            f.write(prometheus_text(self.telemetry))
+
+
+@contextlib.contextmanager
+def telemetry_session(out_dir: Optional[str] = None, interval_s: float = 5.0,
+                      registry: Optional[_metrics.MetricsRegistry] = None):
+    """Activate telemetry for the enclosed block: installs the
+    :class:`Telemetry` as the active session, hooks the grid's cell-
+    assignment observer, and (when ``out_dir`` is given) runs a
+    :class:`TelemetryReporter`. Everything is restored on exit — including
+    after an exception — so a crashed run still gets its final snapshot."""
+    from spatialflink_tpu.index import uniform_grid as _ug
+
+    tel = Telemetry(registry)
+    old = set_active(tel)
+    old_obs = _ug._CELL_OBSERVER
+    _ug._CELL_OBSERVER = tel.record_cells
+    reporter = None
+    if out_dir:
+        reporter = TelemetryReporter(tel, out_dir, interval_s).start()
+    try:
+        yield tel
+    finally:
+        try:
+            if reporter is not None:
+                reporter.close()
+        finally:
+            # restore the globals even when the final snapshot/prom write
+            # fails (disk full, dir deleted mid-run): a dead session left
+            # active would instrument every later run in the process
+            _ug._CELL_OBSERVER = old_obs
+            set_active(old)

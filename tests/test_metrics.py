@@ -39,6 +39,24 @@ class TestCounterMeter:
         assert m.rate(now=5.0) > 0
         assert len(m._buckets) == 1
 
+    def test_meter_eviction_with_injected_clock(self):
+        # drive the bucket eviction purely off injected monotonic nows:
+        # marks land in per-second buckets; advancing the clock past the
+        # window drops exactly the stale buckets and the rate reflects only
+        # the surviving ones
+        m = Meter("tput", window_s=5.0)
+        for sec in range(10):                 # one mark at t=0..9
+            m.mark(now=float(sec))
+        assert m.count == 10
+        # at t=9 the 5s window covers t in [3, 9] (horizon = now-window-1)
+        m.rate(now=9.0)
+        assert [b[0] for b in m._buckets] == [3, 4, 5, 6, 7, 8, 9]
+        # far future: everything evicts, rate decays to 0
+        assert m.rate(now=100.0) == 0.0
+        assert len(m._buckets) == 0
+        # count is cumulative and survives eviction (Dropwizard semantics)
+        assert m.count == 10
+
     def test_meter_memory_is_bounded(self):
         # one bucket per second regardless of event count (hot-path safety)
         m = Meter("tput", window_s=60.0)

@@ -186,6 +186,11 @@ class QueryConfig:
     # 2-D (hosts x parallelism/hosts) with two-level ICI->DCN merges; must
     # divide parallelism. 0/1 = flat 1-D mesh.
     hosts: int = 0
+    # pane-incremental sliding-window execution (the --panes driver switch):
+    # kernel partials computed once per slide-aligned pane and merged across
+    # overlapping windows. Execution knob only — results are identical to
+    # full-window evaluation (and tumbling/undecomposable specs bypass it).
+    panes: bool = False
     radius: float = 0.0
     aggregate_function: str = "SUM"
     k: int = 10
@@ -222,6 +227,7 @@ class QueryConfig:
             multi_query=bool(_opt(d, "multiQuery", False)),
             parallelism=parallelism,
             hosts=hosts,
+            panes=bool(_opt(d, "panes", False)),
             radius=float(_opt(d, "radius", 0.0)),
             aggregate_function=agg,
             k=int(_opt(d, "k", 10)),
@@ -363,6 +369,9 @@ class Params:
         query = dataclasses.asdict(self.query)
         query.pop("parallelism", None)
         query.pop("hosts", None)
+        # pane mode is an execution strategy, not a semantic change: a
+        # panes-on re-run must dedup against a panes-off run's markers
+        query.pop("panes", None)
         payload = {
             "group": group,
             "query": query,

@@ -72,6 +72,19 @@ class QueryConfiguration:
     # window-size independent), filters/joins shard over both axes. Must be
     # a power of two dividing ``devices``.
     hosts: Optional[int] = None
+    # pane-incremental execution (the --panes driver switch): sliding-window
+    # batches are sliced into non-overlapping slide-aligned PANES, the
+    # device kernel runs once per sealed pane, and each window merges its
+    # size/slide cached pane partials instead of re-evaluating the full
+    # window — at overlap o the per-slide kernel work drops ~o-fold. OFF by
+    # default; bypassed (full recompute, identical results) for tumbling
+    # windows (overlap 1: nothing to share), non-pane-decomposable specs
+    # (slide must divide size), realtime/count modes, and operators without
+    # a mergeable partial (run_incremental, tKnn's sub-trajectory windows).
+    # Composes with pipeline_depth (pane kernels dispatch async and merge at
+    # readback) and with the device mesh (each pane batch shards like a
+    # window batch would).
+    panes: bool = False
     # elastic-degradation bound: at most this many mesh halvings may absorb
     # dispatch failures before the operator raises instead of retrying
     # narrower. None = halvings down to TWO devices; the final halving to 1
@@ -111,6 +124,61 @@ class Deferred:
 
     def finish(self) -> List:
         return self.collect(self.device_result)
+
+
+class PaneCache:
+    """Shared pane-partial cache bookkeeping: get-or-evaluate with the
+    ``pane-cache-hits``/``pane-cache-misses`` registry counters and
+    ascending-window eviction — ONE implementation for the generic driver
+    (:meth:`SpatialOperator._pane_eval`), the trajectory pane loops, and
+    the join pane-pair blocks (whose keys are (pane_a, pane_b) tuples:
+    ``key_floor`` maps a key to the pane start its eviction hinges on).
+
+    Eviction contract: windows arrive in ascending start order, so once
+    window ``s`` has looked up its panes, no later window can need a key
+    whose floor is below ``s + slide``. ``None`` is a legitimate cached
+    value (an empty-after-filter pane), hence the ``in`` check."""
+
+    __slots__ = ("slide", "cache", "hits", "misses", "key_floor")
+
+    def __init__(self, slide_ms: int, key_floor=None):
+        from spatialflink_tpu.utils.metrics import REGISTRY
+
+        self.slide = slide_ms
+        self.cache: dict = {}
+        self.hits = REGISTRY.counter("pane-cache-hits")
+        self.misses = REGISTRY.counter("pane-cache-misses")
+        self.key_floor = key_floor if key_floor is not None else (lambda k: k)
+
+    def get(self, key, evaluate):
+        if key in self.cache:
+            self.hits.inc()
+            return self.cache[key]
+        self.misses.inc()
+        value = self.cache[key] = evaluate()
+        return value
+
+    def evict_before(self, window_start: int) -> None:
+        limit = window_start + self.slide
+        for dead in [k for k in self.cache if self.key_floor(k) < limit]:
+            del self.cache[dead]
+
+
+class PanePartial:
+    """One pane's cached kernel partial. Holds the raw evaluator output —
+    a :class:`Deferred` (device work in flight) or an already-final host
+    value — and memoizes the readback so every window sharing the pane pays
+    the device→host transfer once."""
+
+    __slots__ = ("value",)
+
+    def __init__(self, value):
+        self.value = value
+
+    def resolve(self):
+        if isinstance(self.value, Deferred):
+            self.value = self.value.finish()
+        return self.value
 
 
 @dataclass
@@ -294,9 +362,82 @@ class SpatialOperator:
             yield from self._count_windows(stream)
             return
         wa = WindowAssembler(self.conf.window_spec(), self.conf.allowed_lateness_ms)
+        # chunk-vectorized assignment (WindowSpec.assign_bulk under the
+        # hood): identical window tables, late drops, and emission timing to
+        # the per-record add loop, minus its per-record assign/seal cost
+        yield from wa.assemble(stream)
+
+    # ------------------------- pane-incremental ----------------------- #
+
+    def _panes_active(self) -> bool:
+        """Pane-incremental mode applies: the ``--panes`` switch is on, the
+        query runs event-time windows, and the spec is pane-decomposable
+        (slide divides size; tumbling bypasses — overlap 1 shares
+        nothing)."""
+        return (self.conf.panes
+                and self.conf.query_type is QueryType.WindowBased
+                and self.conf.window_spec().pane_decomposable())
+
+    def _pane_windows(self, stream: Iterable[Point]
+                      ) -> Iterator[Tuple[int, int, List]]:
+        """Pane-sliced window source: same window set/sealing as
+        :meth:`_windows`, but each window's payload is its list of
+        ``(pane_start, records)`` panes and every record is buffered ONCE
+        (not ``size/slide`` times)."""
+        from spatialflink_tpu.runtime.windows import PaneBuffer
+
+        pb = PaneBuffer(self.conf.window_spec(),
+                        self.conf.allowed_lateness_ms)
         for rec in stream:
-            yield from wa.add(rec.timestamp, rec)
-        yield from wa.flush()
+            yield from pb.add(rec.timestamp, rec)
+        yield from pb.flush()
+
+    def _pane_eval(self, pane_partial, merge_partials):
+        """The partial-cache evaluator for pane-window payloads: the window
+        kernel (``pane_partial(payload, pane_start)`` — the same eval_batch
+        the full-window path uses) runs ONCE per sealed pane; windows merge
+        their cached partials via ``merge_partials(parts)`` at readback.
+        Cache hits/misses ride the ``pane-cache-hits``/``pane-cache-misses``
+        registry counters and the merge is a ``pane-merge`` telemetry span,
+        so snapshots show both the reuse rate and where the merge time
+        goes. Eviction: windows arrive in ascending start order, so once
+        window ``s`` dispatches, no later window can need a pane below
+        ``s + slide``."""
+        from spatialflink_tpu.utils import telemetry as _telemetry
+
+        cache = PaneCache(self.conf.slide_ms)
+        tel = _telemetry.active()
+        label = self.telemetry_label or type(self).__name__
+
+        def eval_batch(panes, ts_base):
+            parts = [
+                cache.get(p_start,
+                          lambda: PanePartial(pane_partial(payload, p_start)))
+                for p_start, payload in panes
+            ]
+            cache.evict_before(ts_base)
+
+            def collect(_):
+                if tel is not None:
+                    with tel.span("pane-merge", query=label):
+                        return merge_partials([h.resolve() for h in parts])
+                return merge_partials([h.resolve() for h in parts])
+
+            return Deferred(None, collect)
+
+        return eval_batch
+
+    @staticmethod
+    def _pane_concat(parts: List[List]) -> List:
+        """Default merge for filter-shaped partials: panes are disjoint, so
+        the window's selection is the concatenation (pane-time order)."""
+        return [r for part in parts for r in part]
+
+    @staticmethod
+    def _pane_count(panes) -> int:
+        """records-evaluated metric for a pane-window payload: the window's
+        record count, like the full-window paths report."""
+        return sum(len(rs) for _, rs in panes)
 
     def _count_windows(self, stream: Iterable[Point]
                        ) -> Iterator[Tuple[int, int, List[Point]]]:
@@ -488,6 +629,15 @@ class SpatialOperator:
             lambda mesh, sb: distributed_stream_knn_multi(
                 mesh, sb, local_fn, k=k))
 
+    @staticmethod
+    def _pane_concat_multi(n_queries: int):
+        """Per-query concat merge for multi-query filter partials (each
+        partial is a list of Q per-query lists)."""
+        def merge(parts):
+            return [[r for part in parts for r in part[q]]
+                    for q in range(n_queries)]
+        return merge
+
     def _run_multi_filter(self, stream: Iterable, n_queries: int,
                           multi_mask_stats, batch_builder
                           ) -> Iterator["WindowResult"]:
@@ -516,7 +666,9 @@ class SpatialOperator:
             return self._defer_with_stats(
                 masks, (jnp.sum(gn_c), jnp.sum(evals)), rows)
 
-        for result in self._multi_results(stream, eval_batch):
+        for result in self._multi_results(
+                stream, eval_batch,
+                pane_merge=self._pane_concat_multi(n_queries)):
             result.extras["queries"] = n_queries
             yield result
 
@@ -565,7 +717,7 @@ class SpatialOperator:
             result.extras["queries"] = n_queries
             yield result
 
-    def _multi_results(self, stream: Iterable, eval_batch
+    def _multi_results(self, stream: Iterable, eval_batch, *, pane_merge=None
                        ) -> Iterator["WindowResult"]:
         """_drive for multi-query evaluators, whose per-window result is a
         list of Q per-query lists — always truthy, so _drive_batched's
@@ -573,7 +725,7 @@ class SpatialOperator:
         re-apply it on the per-query contents (the reference's
         fire-per-element trigger never emits empties)."""
         realtime = self.conf.query_type is QueryType.RealTime
-        for result in self._drive(stream, eval_batch):
+        for result in self._drive(stream, eval_batch, pane_merge=pane_merge):
             if realtime and not any(result.records):
                 continue
             yield result
@@ -595,13 +747,23 @@ class SpatialOperator:
         """
         return "approx" if self.conf.approximate else "auto"
 
-    def _drive_bulk(self, parsed, eval_batch, *, pad: Optional[int] = None
-                    ) -> Iterator["WindowResult"]:
+    def _drive_bulk(self, parsed, eval_batch, *, pad: Optional[int] = None,
+                    pane_merge=None) -> Iterator["WindowResult"]:
         """Bulk-replay driver: vectorized window batches
         (``streams.bulk.bulk_window_batches``) through the pipelined
-        evaluator. eval_batch((idx, PointBatch), ts_base) as in _drive."""
-        from spatialflink_tpu.streams.bulk import bulk_window_batches
+        evaluator. eval_batch((idx, PointBatch), ts_base) as in _drive.
+        With ``pane_merge`` and pane mode active, per-pane batches are built
+        ONCE (``bulk_pane_window_batches``), the same eval_batch runs once
+        per pane, and windows merge cached partials."""
+        from spatialflink_tpu.streams.bulk import (bulk_pane_window_batches,
+                                                   bulk_window_batches)
 
+        if pane_merge is not None and self._panes_active():
+            pane_windows = bulk_pane_window_batches(
+                parsed, self.conf.window_spec(), self.grid, pad=pad)
+            return self._drive_batched(
+                pane_windows, self._pane_eval(eval_batch, pane_merge),
+                count=lambda panes: sum(len(p[1][0]) for p in panes))
         batched = (
             (start, end, (idx, batch))
             for start, end, idx, batch in bulk_window_batches(
@@ -610,18 +772,30 @@ class SpatialOperator:
         return self._drive_batched(batched, eval_batch,
                                    count=lambda p: len(p[0]))
 
-    def _drive(self, stream: Iterable, eval_batch) -> Iterator["WindowResult"]:
+    def _drive(self, stream: Iterable, eval_batch, *, pane_merge=None
+               ) -> Iterator["WindowResult"]:
         """Shared window/realtime driver.
 
         eval_batch(records, ts_base) returns either the final record list or
         a :class:`Deferred`; deferred results are pipelined — up to
         ``conf.pipeline_depth`` windows stay in flight on device while the
         host assembles the next batch — and emitted in window order.
+
+        ``pane_merge(parts) -> records`` opts the operator into the
+        pane-incremental mode (``conf.panes``): eval_batch then runs once
+        per sealed PANE and each window's result is the merge of its cached
+        pane partials. None = family has no mergeable partial; pane mode
+        silently falls back to full-window evaluation (identical results).
         """
         realtime = self.conf.query_type is QueryType.RealTime
         if realtime:
             batched = ((r[0].timestamp, r[-1].timestamp, r)
                        for r in self._micro_batches(stream) if r)
+        elif pane_merge is not None and self._panes_active():
+            return self._drive_batched(
+                self._pane_windows(stream),
+                self._pane_eval(eval_batch, pane_merge),
+                count=self._pane_count)
         else:
             batched = self._windows(stream)
         return self._drive_batched(batched, eval_batch, realtime=realtime)

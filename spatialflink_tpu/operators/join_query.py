@@ -83,6 +83,8 @@ class PointPointJoinQuery(SpatialOperator):
             radius: float) -> Iterator[WindowResult]:
         if self.conf.query_type is QueryType.RealTime:
             results = self._run_realtime(ordinary, query_stream, radius)
+        elif self._panes_active():
+            results = self._run_windowed_panes(ordinary, query_stream, radius)
         else:
             results = self._run_windowed(ordinary, query_stream, radius)
         return self._pipeline(results)
@@ -219,6 +221,88 @@ class PointPointJoinQuery(SpatialOperator):
                 sealed_a.pop(start, []), sealed_b.pop(start, []), radius,
             )
 
+    def _run_windowed_panes(self, ordinary, query_stream, radius
+                            ) -> Iterator[WindowResult]:
+        """Pane-incremental windowed join (``--panes``): both sides buffer
+        into slide-aligned panes, and each window's pair set is the union of
+        its PANE-PAIR BLOCKS ``A_i x B_j`` — each block's lattice kernel
+        runs once and is reused by every window containing both panes, so a
+        slide adds only the O(overlap) new blocks touching the freshest
+        pane instead of recomputing the O(overlap^2) full lattice. Window
+        set/sealing/late-drops are identical to :meth:`_run_windowed`
+        (same watermark sweep, pane-grouped); pair ORDER within a window is
+        block order rather than full-lattice order — the pair SET is
+        identical. Block results stay deferred until the window's readback,
+        so pane mode composes with ``pipeline_depth``."""
+        from spatialflink_tpu.operators.base import PaneCache, PanePartial
+        from spatialflink_tpu.runtime.windows import PaneBuffer
+
+        spec = self.conf.window_spec()
+        slide = spec.slide_ms
+        pb_a = PaneBuffer(spec, self.conf.allowed_lateness_ms)
+        pb_b = PaneBuffer(spec, self.conf.allowed_lateness_ms)
+        sealed_a: Dict[int, List] = {}  # start -> [(pane_start, records)]
+        sealed_b: Dict[int, List] = {}
+        # block cache keyed (pane_a, pane_b); a block is needed only while
+        # BOTH its panes can appear in a future window, so eviction hinges
+        # on the earlier pane
+        cache = PaneCache(slide, key_floor=min)
+        # per-side pane BATCH memo: a pane's device batch is built once and
+        # shared by every block touching it — without this each new pane
+        # would rebuild its batch O(overlap) times (once per block) and the
+        # host batch-building cost would match full-window recompute
+        bcache_a: Dict[int, object] = {}
+        bcache_b: Dict[int, object] = {}
+
+        def block(pa: int, ra: List, pb_s: int, rb: List) -> PanePartial:
+            def evaluate():
+                if pa not in bcache_a:
+                    bcache_a[pa] = self._batch_a(ra, pa)
+                if pb_s not in bcache_b:
+                    bcache_b[pb_s] = self._batch_b(rb, pb_s)
+                return PanePartial(self._join_block(
+                    bcache_a[pa], ra, bcache_b[pb_s], rb, radius))
+
+            return cache.get((pa, pb_s), evaluate)
+
+        def join_panes(start: int, panes_a: List, panes_b: List
+                       ) -> WindowResult:
+            blocks = [block(pa, ra, pb_s, rb)
+                      for pa, ra in panes_a for pb_s, rb in panes_b]
+            cache.evict_before(start)
+            for bc in (bcache_a, bcache_b):
+                for dead in [p for p in bc if p < start + slide]:
+                    del bc[dead]
+
+            def collect(_):
+                return [pair for h in blocks for pair in h.resolve()]
+
+            return WindowResult(start, start + spec.size_ms,
+                                Deferred(None, collect))
+
+        def sweep() -> Iterator[WindowResult]:
+            wm = min(pb_a.watermarker.watermark, pb_b.watermarker.watermark)
+            for start in sorted(set(sealed_a) | set(sealed_b)):
+                end = start + spec.size_ms
+                both = start in sealed_a and start in sealed_b
+                if both or end <= wm:
+                    yield join_panes(start, sealed_a.pop(start, []),
+                                     sealed_b.pop(start, []))
+
+        for ts, side, rec in _merge_by_time(ordinary, query_stream):
+            pb = pb_a if side == 0 else pb_b
+            sealed = sealed_a if side == 0 else sealed_b
+            for start, _end, panes in pb.add(ts, rec):
+                sealed[start] = panes
+            yield from sweep()
+        for start, _end, panes in pb_a.flush():
+            sealed_a[start] = panes
+        for start, _end, panes in pb_b.flush():
+            sealed_b[start] = panes
+        for start in sorted(set(sealed_a) | set(sealed_b)):
+            yield join_panes(start, sealed_a.pop(start, []),
+                             sealed_b.pop(start, []))
+
     def run_bulk(self, parsed_a, parsed_b, radius: float, *,
                  pad: int = None) -> Iterator[WindowResult]:
         """Bulk-replay fast path: both sides go through the vectorized window
@@ -281,6 +365,28 @@ class PointPointJoinQuery(SpatialOperator):
                 return
         yield from join_pairs_host(batch_a, batch_b, radius, self.grid,
                                    nb_layers=nb_layers)
+
+    def _batch_a(self, recs, ts_base):
+        return self._point_batch(recs, ts_base)
+
+    _batch_b = _batch_a
+
+    def _join_block(self, batch_a, recs_a: List[Point], batch_b,
+                    recs_b: List[Point], radius) -> List[Tuple[Point, Point]]:
+        """One pane-pair block from PRE-BUILT pane batches — the pane
+        path's :meth:`_join_window` twin (windowed semantics only: no
+        realtime rolling-prefix/max_dt filters). Taking batches lets the
+        pane driver build each pane's batch once per SIDE instead of once
+        per block; the mixed ts bases are harmless (the join predicates
+        read positions and cells, never the batch ts offsets)."""
+        pairs: List[Tuple[Point, Point]] = []
+        for ai, bi in self._join_pairs(batch_a, batch_b, radius):
+            pairs.extend(
+                (recs_a[i], recs_b[j])
+                for i, j in zip(ai.tolist(), bi.tolist())
+                if i < len(recs_a) and j < len(recs_b)
+            )
+        return pairs
 
     def _join_window(self, start, end, recs_a: List[Point], recs_b: List[Point],
                      radius, *, old_a: int = 0, old_b: int = 0,
@@ -350,6 +456,37 @@ class _GenericStreamJoin(PointPointJoinQuery):
     def _nb_layers(self, radius):
         # radius 0 => all cells neighbors (UniformGrid.java:264-266)
         return self.grid.n if radius == 0 else self.grid.candidate_layers(radius)
+
+    def _join_block(self, batch_a, recs_a, batch_b, recs_b, radius):
+        """Pane-pair block for the geometry pairs: the same lattice kernel
+        (single-device or broadcast-sharded) over pre-built pane batches,
+        with the pair extraction DEFERRED — blocks stay in flight on device
+        until the first covering window's readback."""
+        import numpy as np
+
+        if self.distributed:
+            from spatialflink_tpu.parallel.ops import (
+                distributed_stream_join_lattice,
+            )
+
+            m_dev = self._eval_degradable(
+                lambda: self._lattice(batch_a, batch_b, radius),
+                lambda mesh, sa: distributed_stream_join_lattice(
+                    mesh, sa, batch_b,
+                    lambda a_s, b_r: self._lattice(a_s, b_r, radius)),
+                batch_a)
+        else:
+            m_dev = self._lattice(batch_a, batch_b, radius)
+
+        def collect(m):
+            ai, bi = np.nonzero(np.asarray(m))
+            return [
+                (recs_a[i], recs_b[j])
+                for i, j in zip(ai.tolist(), bi.tolist())
+                if i < len(recs_a) and j < len(recs_b)
+            ]
+
+        return Deferred(m_dev, collect)
 
 
 class PointGeomJoinQuery(_GenericStreamJoin):

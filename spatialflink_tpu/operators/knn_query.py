@@ -28,6 +28,27 @@ from spatialflink_tpu.operators.base import (
 from spatialflink_tpu.ops.knn import knn_point_stats
 
 
+def merge_partials(parts, k: int, interner):
+    """Pane-incremental merge for every kNN pair: per-pane top-k partial
+    lists -> the window's exact top-k (``ops.knn.merge_topk_host`` — the
+    host twin of the distributed gather+re-top-k merge). ``interner`` is
+    the one the partials' ids were resolved through: its ``intern`` is the
+    tie key that reproduces the device top-k's equal-distance order, so
+    pane windows stay identical to full recompute even when two objects
+    tie at the k-th place."""
+    from spatialflink_tpu.ops.knn import merge_topk_host
+
+    return merge_topk_host(parts, k, tie_key=interner.intern)
+
+
+def _merge_partials_multi(n_queries: int, k: int, interner):
+    """Per-query pane merge for the multi-query kNN paths."""
+    def merge(parts):
+        return [merge_partials([p[q] for p in parts], k, interner)
+                for q in range(n_queries)]
+    return merge
+
+
 class PointPointKNNQuery(SpatialOperator):
     telemetry_label = "knn"
 
@@ -36,7 +57,8 @@ class PointPointKNNQuery(SpatialOperator):
         k = k or self.conf.k
         for result in self._drive(
             stream, lambda records, ts_base: self._eval(records, query_point,
-                                                        radius, k, ts_base)
+                                                        radius, k, ts_base),
+            pane_merge=lambda parts: merge_partials(parts, k, self.interner),
         ):
             result.extras["k"] = k
             yield result
@@ -95,7 +117,10 @@ class PointPointKNNQuery(SpatialOperator):
             return self._defer_knn(res, interner=parsed.interner,
                                    dist_evals=dist_evals)
 
-        for result in self._drive_bulk(parsed, eval_batch, pad=pad):
+        for result in self._drive_bulk(
+                parsed, eval_batch, pad=pad,
+                pane_merge=lambda parts: merge_partials(parts, k,
+                                                        parsed.interner)):
             result.extras["k"] = k
             yield result
 
@@ -142,7 +167,10 @@ class PointPointKNNQuery(SpatialOperator):
             res, evals = self._knn_multi_result(batch, local, k)
             return self._defer_knn_multi(res, jnp.sum(evals))
 
-        for result in self._multi_results(stream, eval_batch):
+        for result in self._multi_results(
+                stream, eval_batch,
+                pane_merge=_merge_partials_multi(len(query_points), k,
+                                                self.interner)):
             result.extras["k"] = k
             result.extras["queries"] = len(query_points)
             yield result
@@ -219,7 +247,10 @@ class _GenericKnn(SpatialOperator, GeomQueryMixin):
                 self._batch(records, ts_base), elig_dists, k)
             return self._defer_knn(res, dist_evals=dist_evals)
 
-        for result in self._drive(stream, eval_batch):
+        for result in self._drive(
+                stream, eval_batch,
+                pane_merge=lambda parts: merge_partials(parts, k,
+                                                        self.interner)):
             result.extras["k"] = k
             yield result
 
@@ -267,7 +298,10 @@ class _GenericKnn(SpatialOperator, GeomQueryMixin):
             res, evals = self._knn_multi_result(batch, local, k)
             return self._defer_knn_multi(res, jnp.sum(evals))
 
-        for result in self._multi_results(stream, eval_batch):
+        for result in self._multi_results(
+                stream, eval_batch,
+                pane_merge=_merge_partials_multi(n_queries, k,
+                                                self.interner)):
             result.extras["k"] = k
             result.extras["queries"] = n_queries
             yield result

@@ -61,11 +61,18 @@ class PointPointRangeQuery(_PointStreamBulkSource, _RangeMultiBulkMixin,
                            SpatialOperator):
     telemetry_label = "range"
 
+    #: pane-incremental hooks (``--panes``): the window evaluator IS the
+    #: per-pane partial evaluator (the same mask kernel over a pane-sized
+    #: batch), and disjoint panes union by concatenation — one definition
+    #: for every filter-shaped range pair.
+    merge_partials = staticmethod(SpatialOperator._pane_concat)
+
     def run(self, stream: Iterable[Point], query_point: Point, radius: float
             ) -> Iterator[WindowResult]:
         return self._drive(
             stream, lambda records, ts_base: self._eval(records, query_point,
-                                                        radius, ts_base)
+                                                        radius, ts_base),
+            pane_merge=self.merge_partials,
         )
 
     # ---------------------------------------------------------------- #
@@ -116,7 +123,7 @@ class PointPointRangeQuery(_PointStreamBulkSource, _RangeMultiBulkMixin,
         """
         return self._drive_bulk(
             parsed, self._bulk_mask_eval(self._mask_stats_fn(query_point, radius)),
-            pad=pad)
+            pad=pad, pane_merge=self.merge_partials)
 
     def _multi_mask_stats(self, query_points, radius: float):
         """The per-batch multi-mask closure shared by run_multi and
@@ -183,6 +190,8 @@ class PointGeomRangeQuery(_PointStreamBulkSource, _RangeMultiBulkMixin,
                           SpatialOperator, GeomQueryMixin):
     telemetry_label = "range"
 
+    merge_partials = staticmethod(SpatialOperator._pane_concat)
+
     """Point stream x polygon/linestring query
     (``range/PointPolygonRangeQuery.java``, ``PointLineStringRangeQuery``).
 
@@ -222,7 +231,7 @@ class PointGeomRangeQuery(_PointStreamBulkSource, _RangeMultiBulkMixin,
             mask, gn_c, evals = self._filter_stream(batch, mask_stats)
             return self._defer_mask_select(mask, records, (gn_c, evals))
 
-        return self._drive(stream, eval_batch)
+        return self._drive(stream, eval_batch, pane_merge=self.merge_partials)
 
     def run_bulk(self, parsed, query_geom, radius: float, *,
                  pad: Optional[int] = None) -> Iterator[WindowResult]:
@@ -230,7 +239,7 @@ class PointGeomRangeQuery(_PointStreamBulkSource, _RangeMultiBulkMixin,
         results are original-record index lists)."""
         return self._drive_bulk(
             parsed, self._bulk_mask_eval(self._mask_stats_fn(query_geom, radius)),
-            pad=pad)
+            pad=pad, pane_merge=self.merge_partials)
 
     def _multi_mask_stats(self, query_geoms, radius: float):
         from spatialflink_tpu.ops.geom import range_points_to_geom_queries
@@ -283,6 +292,8 @@ class GeomPointRangeQuery(SpatialOperator, GeomQueryMixin,
                           _GeomStreamBulkMixin, _RangeMultiBulkMixin):
     telemetry_label = "range"
 
+    merge_partials = staticmethod(SpatialOperator._pane_concat)
+
     """Polygon/linestring stream x point query
     (``range/PolygonPointRangeQuery.java``, ``LineStringPointRangeQuery``).
     GN-subset rule: a geometry passes without distance math only if ALL its
@@ -324,7 +335,7 @@ class GeomPointRangeQuery(SpatialOperator, GeomQueryMixin,
             mask, gn_c, evals = self._filter_stream(geoms, mask_stats)
             return self._defer_mask_select(mask, records, (gn_c, evals))
 
-        return self._drive(stream, eval_batch)
+        return self._drive(stream, eval_batch, pane_merge=self.merge_partials)
 
     def _multi_mask_stats(self, query_points, radius: float):
         from spatialflink_tpu.ops.geom import range_geoms_to_point_queries
@@ -350,6 +361,8 @@ class GeomPointRangeQuery(SpatialOperator, GeomQueryMixin,
 class GeomGeomRangeQuery(SpatialOperator, GeomQueryMixin,
                          _GeomStreamBulkMixin, _RangeMultiBulkMixin):
     telemetry_label = "range"
+
+    merge_partials = staticmethod(SpatialOperator._pane_concat)
 
     """Polygon/linestring stream x polygon/linestring query
     (``range/PolygonPolygonRangeQuery.java`` and the 3 sibling pairs)."""
@@ -390,7 +403,7 @@ class GeomGeomRangeQuery(SpatialOperator, GeomQueryMixin,
             mask, gn_c, evals = self._filter_stream(geoms, mask_stats)
             return self._defer_mask_select(mask, records, (gn_c, evals))
 
-        return self._drive(stream, eval_batch)
+        return self._drive(stream, eval_batch, pane_merge=self.merge_partials)
 
     def _multi_mask_stats(self, query_geoms, radius: float):
         from spatialflink_tpu.ops.geom import range_geoms_to_geom_queries

@@ -145,6 +145,8 @@ class PointPolygonTRangeQuery(SpatialOperator, GeomQueryMixin):
                 if sel:
                     yield WindowResult(records[0].timestamp,
                                        records[-1].timestamp, sel)
+        elif self._panes_active():
+            yield from self._run_windowed_panes(stream, gb, cell_mask)
         else:
             # windowed: find matched trajectory ids, then emit those
             # trajectories' FULL window points as sub-trajectories
@@ -161,6 +163,49 @@ class PointPolygonTRangeQuery(SpatialOperator, GeomQueryMixin):
                     start, end, list(assemble_subtrajectories(sel).values()),
                     extras={"matched_ids": matched_ids},
                 )
+
+    def _run_windowed_panes(self, stream, gb, cell_mask
+                            ) -> Iterator[WindowResult]:
+        """Pane-incremental windowed tRange (``--panes``): the containment
+        kernel runs once per sealed PANE producing a matched trajectory-ID
+        SET (``pane_partial``); a window's matched set is the UNION of its
+        cached pane sets (``merge_partials`` = set union) and its
+        sub-trajectories re-assemble from the pane record buffers —
+        identical output to the full-window path (assembly time-sorts per
+        object, so pane concatenation order is immaterial)."""
+        from spatialflink_tpu.operators.base import PaneCache
+        from spatialflink_tpu.runtime.windows import PaneBuffer
+
+        cache = PaneCache(self.conf.slide_ms)
+
+        def pane_partial(precs, pstart):
+            cand = self._cell_prefilter(precs, cell_mask)
+            if not cand:
+                return set()
+            m = self._match_mask(cand, gb, pstart)
+            return {cand[i].obj_id
+                    for i in np.nonzero(m)[0] if i < len(cand)}
+
+        pb = PaneBuffer(self.conf.window_spec(),
+                        self.conf.allowed_lateness_ms)
+
+        def results(windows):
+            for start, end, panes in windows:
+                matched_ids: Set[str] = set()
+                for pstart, precs in panes:
+                    matched_ids |= cache.get(
+                        pstart, lambda: pane_partial(precs, pstart))
+                cache.evict_before(start)
+                sel = [p for _, precs in panes for p in precs
+                       if p.obj_id in matched_ids]
+                yield WindowResult(
+                    start, end, list(assemble_subtrajectories(sel).values()),
+                    extras={"matched_ids": matched_ids},
+                )
+
+        for rec in stream:
+            yield from results(pb.add(rec.timestamp, rec))
+        yield from results(pb.flush())
 
     def run_naive(self, stream: Iterable[Point], polygons: Sequence[Polygon]
                   ) -> Iterator[WindowResult]:
@@ -232,6 +277,12 @@ class PointTStatsQuery(SpatialOperator):
                                        records[-1].timestamp, tuples)
             if checkpoint_path and n_batches:
                 self._save_checkpoint(store, ts_base, checkpoint_path, consumed)
+        elif self._panes_active() and not self.distributed:
+            # pane-incremental windowed stats; the distributed path keeps
+            # its shard-stitch plan (pane partials would stitch the same
+            # way, but per-pane sharding of already-small batches buys
+            # nothing over the existing whole-window shards)
+            yield from self._run_windowed_panes(stream, allowed)
         else:
             for start, end, records in self._windows(stream):
                 if allowed:
@@ -241,6 +292,75 @@ class PointTStatsQuery(SpatialOperator):
                 else:
                     tuples = self._window_tuples_single(records, start)
                 yield WindowResult(start, end, tuples)
+
+    def _run_windowed_panes(self, stream, allowed
+                            ) -> Iterator[WindowResult]:
+        """Pane-incremental windowed tStats (``--panes``): one
+        ``tstats_window_summary`` kernel per sealed PANE (``pane_partial`` —
+        per-trajectory pair sums, counts, ts extents, boundary coords), and
+        per-window stitching of the cached pane tables in time order
+        (``merge_partials`` = ``ops.trajectory.tstats_stitch_host``) —
+        exactly the contiguous-slice boundary merge the sharded window path
+        already does, with panes in place of shards. Pane extents rebase to
+        absolute ms at readback (per-pane batches have different int32
+        offset bases). Emission: ascending interned id, count >= 2 — the
+        same rule/order as the single and distributed paths."""
+        from spatialflink_tpu.operators.base import PaneCache
+        from spatialflink_tpu.ops.trajectory import (tstats_stitch_host,
+                                                     tstats_window_summary)
+        from spatialflink_tpu.runtime.windows import PaneBuffer
+        from spatialflink_tpu.utils import bucket_size
+
+        cache = PaneCache(self.conf.slide_ms)
+        i64 = np.int64
+
+        def pane_partial(precs, pstart) -> Optional[dict]:
+            recs = ([p for p in precs if p.obj_id in allowed]
+                    if allowed else precs)
+            if not recs:
+                return None
+            batch = self._point_batch(recs, pstart)
+            m = bucket_size(len(self.interner))
+            s = tstats_window_summary(batch, m=m)
+            cnt = np.asarray(s.count).astype(i64)
+            present = cnt > 0
+            return dict(
+                spatial=np.asarray(s.spatial), count=cnt,
+                min_ts=np.where(present,
+                                np.asarray(s.min_ts).astype(i64) + pstart,
+                                np.iinfo(i64).max),
+                max_ts=np.where(present,
+                                np.asarray(s.max_ts).astype(i64) + pstart,
+                                np.iinfo(i64).min),
+                first_x=np.asarray(s.first_x), first_y=np.asarray(s.first_y),
+                last_x=np.asarray(s.last_x), last_y=np.asarray(s.last_y),
+            )
+
+        pb = PaneBuffer(self.conf.window_spec(),
+                        self.conf.allowed_lateness_ms)
+
+        def results(windows):
+            for start, end, panes in windows:
+                parts = []
+                for pstart, precs in panes:
+                    part = cache.get(pstart,
+                                     lambda: pane_partial(precs, pstart))
+                    if part is not None:
+                        parts.append(part)
+                cache.evict_before(start)
+                tuples: List[Tuple] = []
+                if parts:
+                    sp, tm, cnt = tstats_stitch_host(parts)
+                    for o in np.nonzero(cnt >= 2)[0]:
+                        t, s = float(tm[o]), float(sp[o])
+                        tuples.append((self.interner.lookup(int(o)), s,
+                                       int(round(t)),
+                                       s / t if t > 0 else 0.0))
+                yield WindowResult(start, end, tuples)
+
+        for rec in stream:
+            yield from results(pb.add(rec.timestamp, rec))
+        yield from results(pb.flush())
 
     def _window_tuples_single(self, records: List[Point], start: int
                               ) -> List[Tuple]:
@@ -397,6 +517,9 @@ class PointTAggregateQuery(SpatialOperator):
         if self.conf.query_type is QueryType.CountBased:
             yield from self._run_count_windows(stream, agg)
             return
+        if self._panes_active() and not self.distributed:
+            yield from self._run_windowed_panes(stream, agg)
+            return
         for start, end, records in self._windows(stream):
             if not records:
                 yield WindowResult(start, end, [])
@@ -417,6 +540,97 @@ class PointTAggregateQuery(SpatialOperator):
             else:
                 yield WindowResult(start, end, [],
                                    extras={"heatmap": np.asarray(out)})
+
+    def _run_windowed_panes(self, stream, agg: str) -> Iterator[WindowResult]:
+        """Pane-incremental windowed tAggregate (``--panes``): one
+        ``taggregate_group_extents`` kernel per sealed PANE, read back as
+        (cell, objID, min_ts, max_ts) rows rebased to absolute ms
+        (``pane_partial``); windows extent-merge the cached pane rows
+        (``merge_partials`` = ``ops.trajectory.taggregate_merge_extents_host``
+        — the pane twin of the distributed shard merge: a group split across
+        panes must merge [min, max] BEFORE measuring its length) and derive
+        the heatmap/ALL records from the merged groups."""
+        from spatialflink_tpu.operators.base import PaneCache
+        from spatialflink_tpu.ops.trajectory import (
+            taggregate_group_extents, taggregate_merge_extents_host)
+        from spatialflink_tpu.runtime.windows import PaneBuffer
+
+        if agg not in ("ALL", "SUM", "AVG", "MIN", "MAX", "COUNT"):
+            # fail fast like the device path's first window would
+            raise ValueError(f"unknown aggregate {agg!r}")
+        cache = PaneCache(self.conf.slide_ms)
+
+        def pane_partial(precs, pstart):
+            batch = self._point_batch(precs, pstart)
+            e = taggregate_group_extents(batch,
+                                         num_cells=self.grid.num_cells)
+            first = np.asarray(e.first)
+            return (np.asarray(e.cell)[first],
+                    np.asarray(e.obj_id)[first],
+                    np.asarray(e.min_ts)[first].astype(np.int64) + pstart,
+                    np.asarray(e.max_ts)[first].astype(np.int64) + pstart)
+
+        pb = PaneBuffer(self.conf.window_spec(),
+                        self.conf.allowed_lateness_ms)
+
+        def results(windows):
+            for start, end, panes in windows:
+                parts = [cache.get(pstart,
+                                   lambda: pane_partial(precs, pstart))
+                         for pstart, precs in panes]
+                cache.evict_before(start)
+                merged = taggregate_merge_extents_host(parts)
+                if agg == "ALL":
+                    records_out = [
+                        (c, self.interner.lookup(int(o)), int(mx - mn))
+                        for (c, o), (mn, mx) in sorted(merged.items())
+                    ]
+                    yield WindowResult(start, end, records_out)
+                else:
+                    yield WindowResult(
+                        start, end, [],
+                        extras={"heatmap": self._heatmap_from_groups(
+                            merged, agg)})
+
+        for rec in stream:
+            yield from results(pb.add(rec.timestamp, rec))
+        yield from results(pb.flush())
+
+    def _heatmap_from_groups(self, merged: Dict, agg: str) -> np.ndarray:
+        """Dense (num_cells,) float32 heatmap from merged (cell, objID) ->
+        extent groups — the host mirror of ``ops.trajectory
+        .taggregate_heatmap`` over pane-merged groups."""
+        num_cells = self.grid.num_cells
+        hm = np.zeros(num_cells, np.float32)
+        if not merged:
+            return hm
+        cells = np.fromiter((k[0] for k in merged), np.int64, len(merged))
+        lengths = np.fromiter((mx - mn for mn, mx in merged.values()),
+                              np.float64, len(merged))
+        if agg in ("AVG", "COUNT"):
+            counts = np.zeros(num_cells, np.int64)
+            np.add.at(counts, cells, 1)
+        if agg in ("SUM", "AVG"):
+            acc = np.zeros(num_cells, np.float64)
+            np.add.at(acc, cells, lengths)
+            if agg == "AVG":
+                acc = np.where(counts > 0, acc / np.maximum(counts, 1), 0.0)
+            hm = acc.astype(np.float32)
+        elif agg == "COUNT":
+            hm = counts.astype(np.float32)
+        elif agg == "MIN":
+            acc = np.full(num_cells, np.inf)
+            np.minimum.at(acc, cells, lengths)
+            hm = np.where(np.isfinite(acc), acc, 0.0).astype(np.float32)
+        elif agg == "MAX":
+            acc = np.full(num_cells, -np.inf)
+            np.maximum.at(acc, cells, lengths)
+            hm = np.where(np.isfinite(acc), acc, 0.0).astype(np.float32)
+        else:
+            # same error surface as the device twin (taggregate_heatmap):
+            # --panes must not turn a typo'd aggregate into a silent SUM
+            raise ValueError(f"unknown aggregate {agg!r}")
+        return hm
 
     def _window_local(self, agg: str):
         """Single-device window evaluator: groups for ALL, heatmap
@@ -769,6 +983,12 @@ class PointPointTJoinQuery(SpatialOperator):
         inner = _CapturingJoin(self.conf, self.grid)
         inner.interner = self.interner
         inner.prune_cells = prune_cells
+        # windowed tJoin re-assembles each window's FULL per-side record
+        # lists into trajectories; the pane-pair block path evaluates
+        # _join_window per pane pair, so the captured extras would hold
+        # pane fragments — keep the inner join on full windows (pane mode
+        # has no mergeable partial for this family)
+        inner.conf.panes = False
         return inner, windowed
 
     def run(self, ordinary: Iterable[Point], query_stream: Iterable[Point],

@@ -487,6 +487,36 @@ def knn_with_dists(
     return topk_by_distance(obj_id, dists, eligible, k, strategy)
 
 
+def merge_topk_host(parts, k: int, tie_key=None):
+    """Host-side merge of per-pane top-k PARTIAL result lists — the pane
+    engine's twin of :func:`merge_knn` / ``parallel.ops._gather_topk``
+    (concatenate, dedup by id keeping the min distance, re-top-k), operating
+    on the already-collected ``[(obj_id, dist), ...]`` lists the operators
+    emit instead of device arrays. Exact by the same covering argument as
+    the shard merge: a global top-k object's minimum-distance point lies in
+    some pane; either it survives that pane's top-k distinct minima, or k
+    distinct objects in that pane alone beat it (the argument needs a
+    consistent total order, hence the tie rule below). The merge operands
+    are tiny (``overlap * k`` tuples), so a dict + sort is the right tool —
+    no device dispatch for the merge itself.
+
+    ``tie_key(obj_id)`` MUST reproduce the device tie order for the
+    windows to be identical to full recompute: the device top-k breaks
+    equal distances by ascending INTERNED id (the post-dedup (oid, dist)
+    sort position), so operators pass their interner's ``intern`` —
+    falling back to string order would let two objects at the exact same
+    distance resolve differently at the k-th place."""
+    best: dict = {}
+    for part in parts:
+        for oid, d in part:
+            cur = best.get(oid)
+            if cur is None or d < cur:
+                best[oid] = d
+    tie_key = tie_key if tie_key is not None else str
+    out = sorted(best.items(), key=lambda kv: (kv[1], tie_key(kv[0])))[:k]
+    return [(oid, d) for oid, d in out]
+
+
 def merge_knn(results, k: int) -> KnnResult:
     """Merge per-shard/per-window partial KnnResults (the reference's
     ``kNNWinAllEvaluationPointStream`` dedup+merge, without the

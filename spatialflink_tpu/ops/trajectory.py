@@ -272,6 +272,81 @@ def tstats_stitch_summaries(tabs: TStatsWindowSummary):
     return spatial, temporal, count
 
 
+def tstats_stitch_host(parts):
+    """NumPy stitch of per-PANE window summaries (the pane engine's twin of
+    :func:`tstats_stitch_summaries`): ``parts`` is a time-ordered list of
+    dicts with keys ``spatial``/``count``/``min_ts``/``max_ts`` (absolute
+    int64 ms) /``first_x``/``first_y``/``last_x``/``last_y``, each sized to
+    its pane's interner bucket — shorter tables are padded with
+    absent-trajectory defaults (later panes can only ADD trajectories).
+    Returns ``(spatial (M,) f32, temporal_ms (M,) i64, count (M,))``;
+    trajectories emit iff count >= 2, like the single-device pair rule.
+
+    Panes partition event time, so each pane's (objID, ts)-sorted run is a
+    contiguous slice of the window's global sorted run and the boundary
+    link d(last of previous present pane, first of next) is exactly the
+    consecutive pair the single-device cumsum would have measured — the
+    same argument as the contiguous shard stitch, with panes in place of
+    shards. Host numpy because pane extents are ABSOLUTE ms (per-pane
+    batches have different int32 offset bases) and overlap-many tiny tables
+    don't warrant a dispatch."""
+    i64 = np.int64
+    M = max(p["count"].shape[0] for p in parts)
+
+    def pad(a, fill, dtype=None):
+        if a.shape[0] == M:
+            return a
+        out = np.full(M, fill, dtype or a.dtype)
+        out[: a.shape[0]] = a
+        return out
+
+    spatial = np.zeros(M, np.float32)
+    count = np.zeros(M, i64)
+    min_ts = np.full(M, np.iinfo(i64).max, i64)
+    max_ts = np.full(M, np.iinfo(i64).min, i64)
+    has = np.zeros(M, bool)
+    plx = np.zeros(M, np.float32)
+    ply = np.zeros(M, np.float32)
+    for p in parts:
+        cnt = pad(p["count"], 0)
+        present = cnt > 0
+        link = has & present
+        fx, fy = pad(p["first_x"], 0.0), pad(p["first_y"], 0.0)
+        dx = (fx - plx).astype(np.float32)
+        dy = (fy - ply).astype(np.float32)
+        spatial += pad(p["spatial"], 0.0) + np.where(
+            link, np.hypot(dx, dy).astype(np.float32), np.float32(0.0))
+        count += cnt
+        min_ts = np.minimum(min_ts, pad(p["min_ts"], np.iinfo(i64).max))
+        max_ts = np.maximum(max_ts, pad(p["max_ts"], np.iinfo(i64).min))
+        lx, ly = pad(p["last_x"], 0.0), pad(p["last_y"], 0.0)
+        plx = np.where(present, lx, plx)
+        ply = np.where(present, ly, ply)
+        has |= present
+    temporal = np.where(count > 0, max_ts - min_ts, 0)
+    return spatial, temporal, count
+
+
+def taggregate_merge_extents_host(parts):
+    """Merge per-pane (cell, objID, min_ts, max_ts) extent ROWS into the
+    window's final per-group extents — the pane twin of
+    :func:`taggregate_merge_extents`, on host because pane extents carry
+    absolute int64 timestamps. ``parts`` is a list of ``(cells, oids,
+    min_ts, max_ts)`` array tuples; returns the merged dict
+    ``{(cell, oid): (min_ts, max_ts)}``."""
+    merged: dict = {}
+    for cells, oids, mns, mxs in parts:
+        for c, o, mn, mx in zip(cells.tolist(), oids.tolist(),
+                                mns.tolist(), mxs.tolist()):
+            key = (c, o)
+            cur = merged.get(key)
+            if cur is None:
+                merged[key] = (mn, mx)
+            else:
+                merged[key] = (min(cur[0], mn), max(cur[1], mx))
+    return merged
+
+
 # ------------------------------------------------------------------------- #
 # TAggregate: per-cell heatmap of trajectory lengths
 

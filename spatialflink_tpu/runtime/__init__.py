@@ -13,7 +13,8 @@ stamping arrival time as the event time at the source.
 """
 
 from spatialflink_tpu.runtime.watermarks import BoundedOutOfOrderness
-from spatialflink_tpu.runtime.windows import WindowSpec, WindowAssembler
+from spatialflink_tpu.runtime.windows import (PaneBuffer, WindowAssembler,
+                                              WindowSpec)
 from spatialflink_tpu.runtime.faults import (
     ChaosBroker,
     FaultPlan,
@@ -32,6 +33,7 @@ __all__ = [
     "BoundedOutOfOrderness",
     "WindowSpec",
     "WindowAssembler",
+    "PaneBuffer",
     "ChaosBroker",
     "FaultPlan",
     "TransientBrokerError",

@@ -42,6 +42,40 @@ class WindowSpec:
             start -= self.slide_ms
         return starts
 
+    @property
+    def overlap(self) -> int:
+        """Windows each record belongs to (``size // slide`` when slide
+        divides size — the pane count per window)."""
+        return -(-self.size_ms // self.slide_ms)
+
+    def pane_of(self, ts_ms: int) -> int:
+        """Start of the slide-aligned pane containing ``ts_ms``: the
+        non-overlapping [p, p + slide) interval every sliding window
+        decomposes into when ``slide`` divides ``size``."""
+        return ts_ms - (ts_ms % self.slide_ms)
+
+    def pane_decomposable(self) -> bool:
+        """True when every window [s, s + size) is exactly a union of
+        slide-aligned panes — the precondition of the pane-incremental
+        execution mode (slide must divide size, and slide < size: tumbling
+        windows have overlap 1, so there is nothing to share)."""
+        return (self.slide_ms < self.size_ms
+                and self.size_ms % self.slide_ms == 0)
+
+    def pane_starts(self, window_start: int) -> List[int]:
+        """The pane starts covering window ``[window_start, +size)``."""
+        return list(range(window_start, window_start + self.size_ms,
+                          self.slide_ms))
+
+    def earliest_end(self, ts_ms: int) -> int:
+        """End of the EARLIEST window containing ``ts_ms`` (O(1)) — the
+        first moment a watermark passing it could seal one of the record's
+        windows. Lets the chunked assembler flush exactly when a per-record
+        ``add`` would have sealed something."""
+        last_start = ts_ms - (ts_ms % self.slide_ms)
+        k_max = (last_start - ts_ms + self.size_ms - 1) // self.slide_ms
+        return last_start - k_max * self.slide_ms + self.size_ms
+
     def assign_bulk(self, ts_ms) -> "Tuple[object, object]":
         """Vectorized :meth:`assign` over an array of event times.
 
@@ -112,6 +146,81 @@ class WindowAssembler:
         wm = self.watermarker.on_event(ts_ms)
         yield from self._seal_until(wm)
 
+    def add_chunk(self, ts_list, records
+                  ) -> Iterator[Tuple[int, int, List]]:
+        """Vectorized :meth:`add` for a chunk of records: the lateness check
+        runs against the per-record prefix watermark (identical keep/drop
+        decisions to feeding the chunk one record at a time) and window
+        assignment rides :meth:`WindowSpec.assign_bulk` — no per-record
+        Python assign loop, one watermark update, one seal sweep."""
+        import numpy as np
+
+        if not records:
+            return
+        ts = np.asarray(ts_list, np.int64)
+        # watermark BEFORE each record = max of prior state and the chunk
+        # prefix (clamped: the uninitialized int64-min state would wrap
+        # under the lateness subtraction)
+        prior = max(self.watermarker._max_ts, -(2 ** 62))
+        run_max = np.maximum.accumulate(ts)
+        wm_before = np.empty_like(ts)
+        wm_before[0] = prior
+        np.maximum(run_max[:-1], prior, out=wm_before[1:])
+        keep = ts >= wm_before - self.watermarker.allowed_lateness_ms
+        self.late_dropped += int((~keep).sum())
+        kept_idx = np.nonzero(keep)[0]
+        if kept_idx.size:
+            kept = [records[int(i)] for i in kept_idx]
+            win, rec = self.spec.assign_bulk(ts[kept_idx])
+            bounds = np.flatnonzero(np.r_[True, win[1:] != win[:-1], True])
+            for i in range(len(bounds) - 1):
+                lo, hi = int(bounds[i]), int(bounds[i + 1])
+                buf = self._buffers.setdefault(int(win[lo]), [])
+                buf.extend(kept[j] for j in rec[lo:hi].tolist())
+        wm = self.watermarker.on_event(int(ts.max()))
+        yield from self._seal_until(wm)
+
+    def assemble(self, stream, ts_of=None, chunk: int = 4096
+                 ) -> Iterator[Tuple[int, int, List]]:
+        """Drive a whole record stream through chunk-vectorized assignment
+        (:meth:`add_chunk`) + the end-of-stream :meth:`flush`.
+
+        Emission timing matches the per-record :meth:`add` loop exactly: a
+        chunk flushes the moment its running watermark reaches the earliest
+        pending window end (``WindowSpec.earliest_end`` per record, O(1)),
+        so sealed windows are never held back behind a fill count — live
+        sources emit mid-stream just like before. ``chunk`` only bounds
+        memory between seal points."""
+        ts_of = ts_of if ts_of is not None else (lambda r: r.timestamp)
+        lateness = self.watermarker.allowed_lateness_ms
+        buf_r: List = []
+        buf_t: List[int] = []
+        chunk_max = -(2 ** 62)
+        min_end: Optional[int] = None  # earliest end among chunk records
+        base_end: Optional[int] = (
+            min(self._buffers) + self.spec.size_ms if self._buffers else None)
+        for rec in stream:
+            ts = ts_of(rec)
+            buf_r.append(rec)
+            buf_t.append(ts)
+            if ts > chunk_max:
+                chunk_max = ts
+            e = self.spec.earliest_end(ts)
+            if min_end is None or e < min_end:
+                min_end = e
+            cur_min = min_end if base_end is None else min(min_end, base_end)
+            wm = max(chunk_max, self.watermarker._max_ts) - lateness
+            if len(buf_r) >= chunk or wm >= cur_min:
+                yield from self.add_chunk(buf_t, buf_r)
+                buf_r, buf_t = [], []
+                chunk_max = -(2 ** 62)
+                min_end = None
+                base_end = (min(self._buffers) + self.spec.size_ms
+                            if self._buffers else None)
+        if buf_r:
+            yield from self.add_chunk(buf_t, buf_r)
+        yield from self.flush()
+
     def _seal_until(self, watermark: int) -> Iterator[Tuple[int, int, List]]:
         ready = sorted(
             s for s in self._buffers if s + self.spec.size_ms <= watermark
@@ -125,3 +234,86 @@ class WindowAssembler:
         for start in sorted(self._buffers):
             records = self._buffers.pop(start)
             yield (start, start + self.spec.size_ms, records)
+
+
+class PaneBuffer:
+    """Pane-sliced window assembly: each record is buffered ONCE into its
+    slide-aligned pane; sealed windows are yielded as *pane lists* instead
+    of flat record lists, so the operator layer can kernel-process each pane
+    once and share the partial across every window containing it.
+
+    Yields ``(start, end, [(pane_start, records), ...])`` with the exact
+    same window set, sealing times, and late-drop decisions as
+    :class:`WindowAssembler` (same watermarker): a window exists iff at
+    least one of its panes is non-empty, and seals when the watermark passes
+    its end. Panes are evicted once the watermark passes ``pane + size``
+    (their last covering window has sealed; any record that could still
+    land in the pane would be late, because sealing and the late check share
+    one watermark — see the eviction proof in ARCHITECTURE.md).
+
+    Requires ``spec.pane_decomposable()``: slide must divide size (a window
+    must be exactly a union of panes) and slide < size (tumbling windows
+    have nothing to share — callers bypass panes there).
+    """
+
+    def __init__(self, spec: WindowSpec, allowed_lateness_ms: int = 0):
+        if not spec.pane_decomposable():
+            raise ValueError(
+                f"PaneBuffer needs slide | size and slide < size, got "
+                f"size={spec.size_ms} slide={spec.slide_ms}")
+        self.spec = spec
+        self.watermarker = BoundedOutOfOrderness(allowed_lateness_ms)
+        self._panes: Dict[int, List] = {}
+        self.late_dropped = 0
+        #: every window start below this has been emitted or is final-empty
+        self._next: Optional[int] = None
+
+    def add(self, ts_ms: int, record) -> Iterator[Tuple[int, int, List]]:
+        if self.watermarker.is_late(ts_ms):
+            self.late_dropped += 1
+        else:
+            self._panes.setdefault(self.spec.pane_of(ts_ms), []).append(record)
+        wm = self.watermarker.on_event(ts_ms)
+        yield from self._seal_until(wm)
+
+    def _seal_until(self, watermark: int) -> Iterator[Tuple[int, int, List]]:
+        if not self._panes:
+            return
+        limit = watermark - self.spec.size_ms  # starts <= limit seal
+        lo = min(self._panes) - self.spec.size_ms + self.spec.slide_ms
+        if self._next is not None:
+            lo = max(lo, self._next)
+        if lo > limit:
+            return  # O(1) common case: nothing sealable yet
+        yield from self._emit_range(lo, limit)
+        # every start <= limit is now emitted or final-empty (a kept record
+        # always has ts >= watermark, so its windows end past the watermark
+        # and start past `limit`); record that and drop dead panes
+        slide = self.spec.slide_ms
+        self._next = limit - (limit % slide) + slide
+        for p in [p for p in self._panes if p < self._next]:
+            del self._panes[p]
+
+    def _emit_range(self, lo: int, limit) -> Iterator[Tuple[int, int, List]]:
+        size, slide = self.spec.size_ms, self.spec.slide_ms
+        starts = set()
+        for p in self._panes:
+            s = max(p - size + slide, lo)
+            s1 = p if limit is None else min(p, limit)
+            while s <= s1:
+                starts.add(s)
+                s += slide
+        for s in sorted(starts):
+            panes = [(p, self._panes[p])
+                     for p in range(s, s + size, slide) if p in self._panes]
+            yield (s, s + size, panes)
+
+    def flush(self) -> Iterator[Tuple[int, int, List]]:
+        """Seal every remaining window (end of bounded stream)."""
+        if not self._panes:
+            return
+        lo = min(self._panes) - self.spec.size_ms + self.spec.slide_ms
+        if self._next is not None:
+            lo = max(lo, self._next)
+        yield from self._emit_range(lo, None)
+        self._panes.clear()

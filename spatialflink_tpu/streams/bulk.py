@@ -294,6 +294,58 @@ def bulk_window_batches(parsed: ParsedPoints, spec, grid=None, *,
         yield start, start + spec.size_ms, idx, batch
 
 
+def bulk_pane_window_batches(parsed: ParsedPoints, spec, grid=None, *,
+                             pad: Optional[int] = None):
+    """Pane-sliced twin of :func:`bulk_window_batches` for the
+    ``--panes`` execution mode: each record lands in exactly ONE
+    slide-aligned pane batch (built once — not ``size/slide`` times), and
+    windows are yielded as ``(start, end, [(pane_start, (idx, batch)),
+    ...])`` pane lists covering the same window set ``assign_bulk`` would
+    produce. Requires ``spec.pane_decomposable()`` (callers gate)."""
+    if not len(parsed):
+        return
+    size, slide = spec.size_ms, spec.slide_ms
+    ts = np.asarray(parsed.ts, np.int64)
+    pane = ts - ts % slide
+    order = np.argsort(pane, kind="stable")  # record order kept within pane
+    pane_s = pane[order]
+    if grid is not None:
+        cells, _ = grid.assign_cell(parsed.x, parsed.y)
+        cells = np.asarray(cells, np.int32)
+    else:
+        cells = np.full(len(parsed), -1, np.int32)
+    bounds = np.flatnonzero(np.r_[True, pane_s[1:] != pane_s[:-1], True])
+    # index slices now (cheap views of `order`); pane BATCHES build lazily
+    # on first use and evict once no later window can cover them, so peak
+    # host memory is O(overlap panes), not a second full copy of the replay
+    slices = {int(pane_s[int(bounds[i])]):
+              order[int(bounds[i]): int(bounds[i + 1])]
+              for i in range(len(bounds) - 1)}
+    built: dict = {}
+    # window set: every aligned start covered by >= 1 non-empty pane — the
+    # same set assign_bulk derives record-by-record
+    starts = sorted({int(s)
+                     for p in slices
+                     for s in range(p - size + slide, p + slide, slide)})
+    for s in starts:
+        panes = []
+        for p in range(s, s + size, slide):
+            idx = slices.get(p)
+            if idx is None:
+                continue
+            batch = built.get(p)
+            if batch is None:
+                batch = built[p] = PointBatch.from_arrays(
+                    parsed.x[idx], parsed.y[idx], grid=grid,
+                    obj_id=parsed.obj_id[idx], ts=parsed.ts[idx],
+                    ts_base=p, pad=pad, cell=cells[idx],
+                )
+            panes.append((p, (idx, batch)))
+        for dead in [p for p in built if p < s + slide]:
+            del built[dead]
+        yield s, s + size, panes
+
+
 def bulk_parse_file(path: str, fmt: str, **kw) -> ParsedPoints:
     """Bulk-parse a whole replay file of points."""
     with open(path, "rb") as f:

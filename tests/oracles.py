@@ -111,6 +111,50 @@ def polygon_polygon_dist(rings_a, rings_b) -> float:
     return d
 
 
+def sliding_window_table(ts_list, size, slide, lateness=0):
+    """Independent re-derivation of the event-time sliding-window tables
+    (Flink semantics + bounded out-of-orderness late drops): feeds the
+    timestamps in order, drops records older than the running watermark
+    (max seen - lateness), and assigns survivors to every aligned window
+    containing them. Returns {window_start: [record_index, ...]} — the
+    oracle the pane-incremental engine's window sets are checked against
+    (it must match BOTH the per-record assembler and the pane buffer)."""
+    out = {}
+    max_ts = None
+    for i, ts in enumerate(ts_list):
+        ts = int(ts)
+        if max_ts is not None and ts < max_ts - lateness:
+            continue  # late
+        if max_ts is None or ts > max_ts:
+            max_ts = ts
+        start = ts - (ts % slide)
+        while start > ts - size:
+            out.setdefault(start, []).append(i)
+            start -= slide
+    return out
+
+
+def canon_windows(results, canon_record=None):
+    """Canonical, order-insensitive window table from an iterator of
+    WindowResults: [(start, end, sorted records)] — the shared shape every
+    pane-equivalence assertion compares (pane merges may reorder records
+    within a window; the SET per window is the contract)."""
+    canon_record = canon_record or (lambda r: r)
+    return [(r.window_start, r.window_end,
+             sorted(canon_record(rec) for rec in r.records))
+            for r in results]
+
+
+def canon_point(p):
+    """(obj_id, timestamp, rounded coords) — Point canonicalizer."""
+    return (p.obj_id, p.timestamp, round(p.x, 9), round(p.y, 9))
+
+
+def canon_knn_pair(t):
+    """(obj_id, rounded distance) — kNN result-record canonicalizer."""
+    return (t[0], round(float(t[1]), 6))
+
+
 def knn(qx, qy, xs, ys, obj_ids, k, radius=None):
     """Top-k nearest objects with per-object dedup (keep min distance),
     mirroring KNNQuery's PQ + objID-dedup merge (knn/KNNQuery.java:204-300).

@@ -7,8 +7,80 @@ import json
 import os
 import subprocess
 import sys
+import time
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_window_assign_vectorized_guard():
+    """Micro-benchmark guard for the chunked streaming assignment
+    (``WindowAssembler.assemble`` riding ``WindowSpec.assign_bulk``): on a
+    high-overlap stream it must produce IDENTICAL window tables to the
+    per-record ``add`` loop and must not be slower (it removes the
+    per-record Python assign loop and the per-record seal sweep, so the
+    margin is generous — a regression to per-record cost trips this)."""
+    import types
+
+    import numpy as np
+
+    from spatialflink_tpu.runtime.windows import WindowAssembler, WindowSpec
+
+    n = 120_000
+    rng = np.random.default_rng(0)
+    ts = (1_700_000_000_000 + np.sort(rng.integers(0, 100_000, n))).tolist()
+    recs = [types.SimpleNamespace(timestamp=t) for t in ts]
+    spec = WindowSpec.sliding(40_000, 5_000)  # overlap 8
+
+    def per_record():
+        wa = WindowAssembler(spec)
+        out = []
+        for r in recs:
+            out += [(s, e, len(rr)) for s, e, rr in wa.add(r.timestamp, r)]
+        out += [(s, e, len(rr)) for s, e, rr in wa.flush()]
+        return out
+
+    def chunked():
+        wa = WindowAssembler(spec)
+        return [(s, e, len(rr)) for s, e, rr in wa.assemble(iter(recs))]
+
+    per_record(), chunked()  # warm (allocator, numpy import paths)
+    t0 = time.perf_counter()
+    ref = per_record()
+    dt_record = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    fast = chunked()
+    dt_chunk = time.perf_counter() - t0
+    assert fast == ref
+    # loose bound (CI noise tolerance); measured locally the chunked path
+    # is several times faster
+    assert dt_chunk < dt_record * 1.2, (dt_chunk, dt_record)
+
+
+import pytest
+
+
+@pytest.mark.slow
+def test_sweep_panes_smoke(tmp_path):
+    """Pane scaling-sweep harness (VERDICT #4) at tiny scale: row contract +
+    the in-run window-table identity assertions. Slow: the sweep runs each
+    (family, overlap) config in both modes."""
+    out_path = tmp_path / "panes.json"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "benchmarks", "sweep_panes.py"),
+         "--sizes", "4000", "--overlaps", "1,4", "--families", "knn,join",
+         "--join-divisor", "4", "--out", str(out_path)],
+        capture_output=True, text=True, timeout=420, env=env, cwd=_ROOT)
+    assert r.returncode == 0, r.stderr[-2000:]
+    rows = [json.loads(ln) for ln in r.stdout.splitlines()
+            if ln.startswith("{")]
+    assert [(x["family"], x["overlap"], x["panes"]) for x in rows] == [
+        ("knn", 1, "off"), ("knn", 1, "on"), ("knn", 4, "off"),
+        ("knn", 4, "on"), ("join", 1, "off"), ("join", 1, "on"),
+        ("join", 4, "off"), ("join", 4, "on")]
+    assert all(x["identical"] and x["windows"] > 0 for x in rows)
+    assert json.load(open(out_path))["rows"]
 
 
 def test_bench_kafka_smoke(tmp_path):

@@ -192,6 +192,65 @@ def bench_panes(option: int, path: str, n: int, overlap: int) -> list:
     ]
 
 
+def bench_checkpoint(option: int, path: str, n: int, every: int) -> list:
+    """Coordinated-checkpoint overhead (the robustness cost BASELINE.md
+    tracks): the record path with checkpointing OFF vs a coordinator
+    snapshotting every ``every`` windows — sustained throughput plus the
+    per-window latency distribution (a checkpoint writes at a window
+    barrier, so its cost lands on individual windows' p99, not the mean)."""
+    import shutil
+
+    from spatialflink_tpu import driver
+
+    def run(ckpt_dir):
+        p = _params(option)
+        if ckpt_dir is not None:
+            from spatialflink_tpu.runtime.checkpoint import (
+                CheckpointCoordinator)
+
+            p.checkpointer = CheckpointCoordinator(
+                ckpt_dir, every_batches=every, job="bench")
+        lat = []
+        with open(path) as f1:
+            t0 = time.perf_counter()
+            it = iter(driver.run_option(p, f1))
+            while True:
+                w0 = time.perf_counter()
+                try:
+                    next(it)
+                except StopIteration:
+                    break
+                lat.append(time.perf_counter() - w0)
+            dt = time.perf_counter() - t0
+        return dt, lat
+
+    def pct(lat, q):
+        return round(float(np.percentile(np.asarray(lat) * 1e3, q)), 2)
+
+    run(None)  # warm the jit caches both modes share
+    dt_off, lat_off = run(None)
+    td = tempfile.mkdtemp(prefix="bench-ckpt-")
+    try:
+        dt_on, lat_on = run(td)
+        n_ckpt = len([f for f in os.listdir(td) if f.endswith(".npz")])
+    finally:
+        shutil.rmtree(td, ignore_errors=True)
+    base = dict(option=option, records=n, windows=len(lat_off),
+                checkpoint_every=every)
+    return [
+        dict(base, path="checkpoint_off", wall_s=round(dt_off, 3),
+             records_per_sec=round(n / dt_off),
+             window_latency_ms=dict(p50=pct(lat_off, 50),
+                                    p99=pct(lat_off, 99))),
+        dict(base, path="checkpoint_on", wall_s=round(dt_on, 3),
+             records_per_sec=round(n / dt_on),
+             checkpoints_written=n_ckpt,
+             window_latency_ms=dict(p50=pct(lat_on, 50),
+                                    p99=pct(lat_on, 99)),
+             overhead_vs_off=round(dt_on / dt_off - 1.0, 4)),
+    ]
+
+
 def bench_multi_vs_jobs(option: int, path: str, n: int, q: int) -> list:
     """ONE multiQuery pipeline vs Q sequential single-query pipelines over
     the same replay — the end-to-end form of the 'Q standing queries cost Q
@@ -253,6 +312,10 @@ def main() -> int:
                     help="query count for the multi-query-vs-sequential-"
                          "jobs rows (values < 2 disable them — a 1-query "
                          "'batch' measures nothing the single rows don't)")
+    ap.add_argument("--checkpoint-every", type=int, default=0,
+                    help="coordinated-checkpoint overhead rows (record "
+                         "path, checkpointing off vs every N windows) over "
+                         "the range option. 0 (default) disables them")
     ap.add_argument("--pane-overlap", type=int, default=0,
                     help="sliding overlap (window = overlap * slide) for "
                          "the pane-incremental vs full-recompute rows over "
@@ -300,6 +363,15 @@ def main() -> int:
                 except _BulkDeclined:
                     continue
                 for row in multi_rows:
+                    row["backend"] = backend
+                    print(json.dumps(row), flush=True)
+                    rows.append(row)
+        if args.checkpoint_every > 0:
+            for opt in (1,):
+                if opt not in [int(x) for x in args.options.split(",")]:
+                    continue
+                for row in bench_checkpoint(opt, path, n,
+                                            args.checkpoint_every):
                     row["backend"] = backend
                     print(json.dumps(row), flush=True)
                     rows.append(row)

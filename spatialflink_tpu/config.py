@@ -277,6 +277,10 @@ class Params:
     # checkpointing for stateful realtime queries
     checkpoint_path: Optional[str] = None
     checkpoint_every: int = 16
+    # job fingerprint stored in (and verified against) checkpoint meta so a
+    # resume under a different query/window config refuses instead of
+    # producing wrong state; set by the driver from job_fingerprint()
+    checkpoint_job: Optional[str] = None
 
     # ------------------------------------------------------------------ #
 

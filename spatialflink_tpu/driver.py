@@ -178,6 +178,38 @@ def decode_stream(records: Iterable, cfg: StreamConfig, grid: UniformGrid,
         yield obj
 
 
+#: (family, mode) combinations the coordinated checkpointer covers: their
+#: drive loops register every piece of cross-record state with the
+#: coordinator and barrier between processing units. Families with
+#: unregistered cross-batch state (realtime join's rolling buffers, tJoin/
+#: tKnn's bespoke loops, the apps) are refused — a checkpoint that misses
+#: live state would LOSE records on resume, which is worse than no
+#: checkpoint.
+_CKPT_WINDOW_FAMILIES = ("range", "knn", "join", "tfilter", "trange",
+                         "tstats", "taggregate")
+_CKPT_REALTIME_FAMILIES = ("range", "knn", "tstats", "taggregate")
+
+
+def _checkpoint_dir_unsupported(params: Params,
+                                spec: CaseSpec) -> Optional[str]:
+    """None when --checkpoint-dir covers this case; else the reason it
+    doesn't (the driver warns and runs without the coordinator)."""
+    if spec.naive:
+        return "naive-twin oracles keep the plain path"
+    if spec.mode == "window":
+        if params.window.type == "COUNT":
+            return ("count windows buffer by arrival order outside the "
+                    "checkpointable assemblers")
+        if spec.family not in _CKPT_WINDOW_FAMILIES:
+            return (f"windowed {spec.family} has no registered "
+                    "checkpoint state")
+        return None
+    if spec.family not in _CKPT_REALTIME_FAMILIES:
+        return (f"realtime {spec.family} keeps cross-batch state outside "
+                "the checkpointable participants")
+    return None
+
+
 def _query_conf(params: Params, spec: CaseSpec) -> QueryConfiguration:
     size_ms, step_ms = params.window_ms()
     if spec.mode == "realtime":
@@ -210,6 +242,9 @@ def _query_conf(params: Params, spec: CaseSpec) -> QueryConfiguration:
         # it the 2-D multi-host (DCN x ICI) shape
         devices=params.query.parallelism or None,
         hosts=params.query.hosts or None,
+        # coordinated checkpointing (--checkpoint-dir): operators register
+        # their window/pane/trajectory state and barrier through this
+        checkpointer=getattr(params, "checkpointer", None),
     )
 
 
@@ -399,13 +434,15 @@ def _run_trajectory(params, spec, conf, u_grid, q_grid, stream1, stream2):
         return ops.PointTStatsQuery(conf, u_grid).run(
             s1, set(q.traj_ids) or None,
             checkpoint_path=params.checkpoint_path,
-            checkpoint_every=params.checkpoint_every)
+            checkpoint_every=params.checkpoint_every,
+            checkpoint_job=params.checkpoint_job)
     if spec.family == "taggregate":
         return ops.PointTAggregateQuery(conf, u_grid).run(
             s1, q.aggregate_function,
             traj_deletion_threshold_ms=q.traj_deletion_threshold_s * 1000,
             checkpoint_path=params.checkpoint_path,
-            checkpoint_every=params.checkpoint_every)
+            checkpoint_every=params.checkpoint_every,
+            checkpoint_job=params.checkpoint_job)
     if spec.family == "tjoin":
         if stream2 is None:
             raise ValueError("trajectory join needs stream2")
@@ -1031,6 +1068,28 @@ def _wire_kafka(params: Params, spec: CaseSpec, args, skip1: int
     # is monotone, so an older checkpoint can never rewind the group)
     if skip1:
         broker.commit(t1, group, skip1)
+    coord = getattr(params, "checkpointer", None)
+    if coord is not None and retry_spec is not None:
+        # carry the circuit breaker across restarts: a resume into a still-
+        # degraded transport starts with the checkpointed failure history
+        # instead of re-learning the outage from scratch
+        coord.register("supervisor", lambda: ({}, broker.snapshot()),
+                       lambda _arrays, meta: broker.restore(meta))
+    if coord is not None and coord.restored:
+        from spatialflink_tpu.utils import telemetry as _telemetry
+
+        depth = 0
+        for topic in dict.fromkeys([t1, t2]):
+            pos = coord.position(f"kafka:{topic}", 0)
+            if pos:
+                broker.commit(topic, group, pos)
+                depth += max(0, broker.end_offset(topic) - pos)
+        print(f"# resume: consumer group sought to checkpointed offsets; "
+              f"{depth} records past the checkpoint to (re)process",
+              file=sys.stderr)
+        tel = _telemetry.active()
+        if tel is not None:
+            tel.gauge("recovery.replay-depth").set(depth)
     follow = bool(args.kafka_follow)
     u_grid, q_grid = params.grids()
     size_ms, step_ms = params.window_ms()
@@ -1085,15 +1144,26 @@ def _wire_kafka(params: Params, spec: CaseSpec, args, skip1: int
                                   parse=_parse_fn(params.input1, u_grid,
                                                   geom1),
                                   bulk_decode=bulk1, bulk_chunk=chunk,
-                                  dlq=dlq)
+                                  dlq=dlq, checkpointer=coord)
         taps.append(stream1)
         if src2 is not None:
             stream2 = WindowCommitTap(src2, size_ms, step_ms,
                                       parse=_parse_fn(params.input2, q_grid,
                                                       geom2),
                                       bulk_decode=bulk2, bulk_chunk=chunk,
-                                      dlq=dlq)
+                                      dlq=dlq, checkpointer=coord)
             taps.append(stream2)
+    elif coord is not None:
+        # non-windowed (realtime) supported cases: a pass-through tap
+        # reports the live source position at each record hand-off, so
+        # coordinated checkpoints can seek the group on resume
+        from spatialflink_tpu.runtime.checkpoint import CheckpointTap
+
+        stream1 = CheckpointTap(src1, coord, f"kafka:{t1}",
+                                position_fn=lambda: src1.position)
+        if src2 is not None:
+            stream2 = CheckpointTap(src2, coord, f"kafka:{t2}",
+                                    position_fn=lambda: src2.position)
 
     sink_kw = dict(fmt=args.output_format,
                    date_format=params.input1.date_format,
@@ -1131,7 +1201,39 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="state checkpoint file for stateful realtime queries "
                          "(tStats): saved periodically, restored at startup")
     ap.add_argument("--checkpoint-every", type=int, default=16,
-                    help="micro-batches between checkpoints (default 16)")
+                    help="micro-batches between checkpoints (default 16); "
+                         "with --checkpoint-dir, processing units (windows/"
+                         "micro-batches) between coordinated checkpoints")
+    ap.add_argument("--checkpoint-dir", metavar="DIR", default=None,
+                    help="coordinated pipeline checkpointing: periodically "
+                         "snapshot source positions, watermarks, open "
+                         "window/pane buffers, pane-kernel partials, "
+                         "trajectory state, and circuit-breaker state into "
+                         "one atomic checksummed manifest under DIR "
+                         "(retaining the last --checkpoint-retain, falling "
+                         "back past corrupt ones). Resume with --resume: "
+                         "sources seek to the checkpointed offsets and "
+                         "re-emitted windows are suppressed (--kafka: the "
+                         "marker-seeded window sink; stdout/--output: a "
+                         "durable emitted-window journal in DIR) — bounded "
+                         "replay, exactly-once windowed output. Realtime "
+                         "results on the plain sink stay at-least-once "
+                         "across a resume. Windowed + realtime range/kNN, "
+                         "windowed join/trajectory, realtime tStats/"
+                         "tAggregate; record path only (not --bulk)")
+    ap.add_argument("--resume", action="store_true",
+                    help="restore the newest valid checkpoint from "
+                         "--checkpoint-dir before running (refuses a "
+                         "checkpoint written by a different query/window "
+                         "config or consumer group)")
+    ap.add_argument("--checkpoint-interval", type=float, default=None,
+                    metavar="SECONDS",
+                    help="also checkpoint when this much wall time passed "
+                         "since the last one (default: batch cadence only)")
+    ap.add_argument("--checkpoint-retain", type=int, default=3,
+                    help="retained checkpoint manifests in --checkpoint-dir "
+                         "(default 3); older ones are pruned, corrupt newest "
+                         "falls back to the previous")
     ap.add_argument("--devices", type=int, default=None,
                     help="shard window batches across this many devices "
                          "(power of two; overrides query.parallelism)")
@@ -1274,12 +1376,34 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.checkpoint:
         params.checkpoint_path = args.checkpoint
         params.checkpoint_every = args.checkpoint_every
+        # the job fingerprint rides the checkpoint meta so a resume under a
+        # DIFFERENT query/window config is refused instead of silently
+        # producing wrong state (the old silent-footgun UX)
+        params.checkpoint_job = params.job_fingerprint(args.kafka_group)
         cp_spec = CASES.get(params.query.option)
         if cp_spec and not (cp_spec.family in ("tstats", "taggregate")
                             and cp_spec.mode == "realtime"):
             print("--checkpoint only applies to stateful realtime queries "
                   "(tStats 205 / tAggregate 207); ignored for this case",
                   file=sys.stderr)
+        elif os.path.exists(args.checkpoint):
+            # pre-flight: fail at arg-parse time with the SAME shared guard
+            # the restore path enforces (fast, before any broker/source
+            # side effect)
+            from spatialflink_tpu.runtime.checkpoint import (
+                CheckpointMismatch, check_job_fingerprint)
+            from spatialflink_tpu.runtime.state import (CheckpointCorrupt,
+                                                        checkpoint_meta)
+
+            try:
+                check_job_fingerprint(
+                    checkpoint_meta(args.checkpoint).get("job"),
+                    params.checkpoint_job, args.checkpoint)
+            except CheckpointCorrupt as e:
+                ap.error(f"--checkpoint: {e} (delete the file, or restore "
+                         "a retained copy, to start over)")
+            except CheckpointMismatch as e:
+                ap.error(str(e))
 
     spec = CASES.get(params.query.option)
     if spec is None:
@@ -1288,6 +1412,65 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.kafka and args.bulk and args.kafka_follow:
         ap.error("--kafka-follow and --bulk are mutually exclusive "
                  "(bulk is a bounded vectorized drain, not a live stream)")
+    if args.resume and not args.checkpoint_dir:
+        ap.error("--resume requires --checkpoint-dir")
+    if args.checkpoint_dir:
+        if args.checkpoint:
+            ap.error("--checkpoint-dir and --checkpoint are mutually "
+                     "exclusive (the directory coordinator subsumes the "
+                     "single-file tStats/tAggregate checkpoint)")
+        if args.bulk:
+            ap.error("--checkpoint-dir does not compose with --bulk "
+                     "(bulk is a whole-replay; coordinated checkpoints "
+                     "apply to the record path)")
+        reason = _checkpoint_dir_unsupported(params, spec)
+        if reason:
+            print(f"--checkpoint-dir ignored: {reason}", file=sys.stderr)
+        else:
+            from spatialflink_tpu.runtime.checkpoint import (
+                CheckpointCoordinator, CheckpointMismatch)
+
+            # source identity: the sink-dedup job fingerprint deliberately
+            # excludes transport/source (a sharded or re-encoded re-run must
+            # dedup against the original's markers), but a CHECKPOINT is
+            # bound to the exact source its positions index into — resuming
+            # against a different file/topic/broker would seek into records
+            # that were never processed
+            if args.kafka:
+                src_id = ("kafka:" + (args.kafka_bootstrap
+                                      or params.kafka_bootstrap_servers)
+                          + f"/{params.input1.topic_name}"
+                          + f",{params.input2.topic_name}")
+            else:
+                src_id = f"file:{args.input1},{args.input2}"
+            coord = CheckpointCoordinator(
+                args.checkpoint_dir,
+                every_batches=args.checkpoint_every,
+                every_seconds=args.checkpoint_interval,
+                retain=args.checkpoint_retain,
+                job=params.job_fingerprint(args.kafka_group),
+                # execution knobs the job fingerprint deliberately excludes
+                # but the manifest's component layout + positions depend on
+                layout=(f"{spec.family}:{spec.mode}"
+                        f":panes={int(bool(params.query.panes))}"
+                        f":multi={int(bool(params.query.multi_query))}"
+                        f":{src_id}"))
+            if args.resume:
+                try:
+                    restored = coord.load()
+                except CheckpointMismatch as e:
+                    ap.error(str(e))
+                if restored:
+                    print(f"# resuming from checkpoint seq {coord.seq} "
+                          f"(source positions: {coord.positions() or '{}'})",
+                          file=sys.stderr)
+                else:
+                    print("# --resume: no valid checkpoint in "
+                          f"{args.checkpoint_dir}; starting fresh",
+                          file=sys.stderr)
+            # dynamic attribute (not a dataclass field): the coordinator
+            # must not leak into Params.to_dict()/fingerprints
+            params.checkpointer = coord
     if not args.kafka and (args.chaos is not None or args.retry is not None
                            or args.dlq or args.seed_scan_limit is not None):
         ap.error("--chaos/--retry/--dlq/--seed-scan-limit wrap the broker "
@@ -1339,6 +1522,7 @@ def _run_cli(ap, args, params: Params, spec: CaseSpec, skip1: int,
     from spatialflink_tpu.streams.sinks import StdoutSink
     from spatialflink_tpu.streams.sources import FileReplaySource
 
+    coord = getattr(params, "checkpointer", None)
     kafka = None
     if args.kafka:
         try:
@@ -1350,10 +1534,38 @@ def _run_cli(ap, args, params: Params, spec: CaseSpec, skip1: int,
         stream1 = args.input1
     elif spec.family == "synthetic":
         stream1 = []
+    elif coord is not None:
+        # coordinated checkpointing over file replay: resume skips the
+        # records the checkpoint already reflects (bounded replay, like a
+        # consumer-group seek), and the tap reports the live position so
+        # later checkpoints carry it. --limit keeps bounding the ORIGINAL
+        # record range across the resume.
+        from spatialflink_tpu.runtime.checkpoint import CheckpointTap
+
+        skip_a = coord.position("file:1", 0)
+        lim_a = (max(0, args.limit - skip_a)
+                 if args.limit is not None else None)
+        stream1 = CheckpointTap(
+            FileReplaySource(args.input1, limit=lim_a, skip=skip_a),
+            coord, "file:1", base=skip_a)
+        if skip_a:
+            print(f"# resume: skipping {skip_a} already-reflected records "
+                  "of --input1", file=sys.stderr)
     else:
         stream1 = FileReplaySource(args.input1, limit=limit1, skip=skip1)
     if not args.kafka:
-        stream2 = FileReplaySource(args.input2, limit=args.limit) if args.input2 else None
+        stream2 = None
+        if args.input2 and coord is not None:
+            from spatialflink_tpu.runtime.checkpoint import CheckpointTap
+
+            skip_b = coord.position("file:2", 0)
+            lim_b = (max(0, args.limit - skip_b)
+                     if args.limit is not None else None)
+            stream2 = CheckpointTap(
+                FileReplaySource(args.input2, limit=lim_b, skip=skip_b),
+                coord, "file:2", base=skip_b)
+        elif args.input2:
+            stream2 = FileReplaySource(args.input2, limit=args.limit)
 
     from spatialflink_tpu.utils.metrics import ControlTupleExit
 
@@ -1425,6 +1637,21 @@ def _run_cli(ap, args, params: Params, spec: CaseSpec, skip1: int,
             else:
                 out_sink.emit(result)
 
+    journal = None
+    if coord is not None and kafka is None and spec.mode == "window":
+        # the Kafka window sink recovers its delivered-set from the topic's
+        # commit markers; stdout/--output have no such log, so a durable
+        # emitted-window journal in the checkpoint dir suppresses the
+        # windows a resumed run would otherwise re-print — exactly-once on
+        # the file path too
+        from spatialflink_tpu.runtime.checkpoint import EmittedWindowJournal
+
+        # a fresh run — including --resume that found no valid manifest —
+        # must not inherit a previous run's emitted history
+        journal = EmittedWindowJournal(coord.dir,
+                                       fresh=not (args.resume
+                                                  and coord.restored))
+
     n = 0
     stopped = False
     it = iter(results)
@@ -1437,11 +1664,16 @@ def _run_cli(ap, args, params: Params, spec: CaseSpec, skip1: int,
                 break
             if win_hist is not None:
                 win_hist.record((time.perf_counter() - t0) * 1e3)
+            if (journal is not None and isinstance(result, WindowResult)
+                    and journal.seen(result)):
+                continue  # delivered by the pre-crash process
             if tel is not None:
                 with tel.span("sink"):
                     emit_result(result)
             else:
                 emit_result(result)
+            if journal is not None and isinstance(result, WindowResult):
+                journal.record(result)
             n += 1
     except ControlTupleExit:
         # the remote-stop hook (HelperClass.checkExitControlTuple:441-453) is
@@ -1451,6 +1683,8 @@ def _run_cli(ap, args, params: Params, spec: CaseSpec, skip1: int,
         stack.close()  # stop the profiler trace before the summary prints
         if out_sink is not None:
             out_sink.close()
+        if journal is not None:
+            journal.close()
     if kafka is not None:
         if not stopped:
             # fully drained bounded topic: full positions are safe to commit.
@@ -1460,6 +1694,10 @@ def _run_cli(ap, args, params: Params, spec: CaseSpec, skip1: int,
         print(kafka.summary(), file=sys.stderr)
     print(f"# emitted {n} results" + (" (control-tuple stop)" if stopped else ""),
           file=sys.stderr)
+    if journal is not None and journal.suppressed:
+        print(f"# resume: suppressed {journal.suppressed} window(s) the "
+              "crashed run already emitted (journal "
+              f"{journal.path})", file=sys.stderr)
     if out_sink is not None:
         print(f"# wrote {out_sink.records_written} records to {args.output} "
               f"({args.output_format})", file=sys.stderr)

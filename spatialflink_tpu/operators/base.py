@@ -93,6 +93,12 @@ class QueryConfiguration:
     # single-device forever hides it (the tradeoff VERDICT r4 flagged).
     # Deliberate single-device operation is devices=1/None, not degradation.
     max_degradations: Optional[int] = None
+    # coordinated-checkpointing hook (the --checkpoint-dir driver switch):
+    # a runtime.checkpoint.CheckpointCoordinator the operator registers its
+    # window/pane state with and barriers against between processing units.
+    # None (default) = no checkpointing — every hot path checks once.
+    checkpointer: Optional[Any] = field(default=None, repr=False,
+                                        compare=False)
 
     def window_spec(self) -> WindowSpec:
         if self.query_type is QueryType.CountBased:
@@ -162,6 +168,42 @@ class PaneCache:
         limit = window_start + self.slide
         for dead in [k for k in self.cache if self.key_floor(k) < limit]:
             del self.cache[dead]
+
+    def snapshot(self, encode_value) -> dict:
+        """JSON-able cache contents for the checkpoint coordinator, so a
+        resumed run does not redo pane kernels. ``encode_value`` is the
+        pane-partial codec (``runtime.checkpoint.value_codec``); entries it
+        cannot encode are SKIPPED with a counter — on resume they are plain
+        cache misses and recompute, never wrong. :class:`PanePartial`
+        wrappers are resolved first (forcing the device readback — snapshot
+        time is off the critical path) and re-wrapped on restore."""
+        import json as _json
+
+        from spatialflink_tpu.utils.metrics import REGISTRY
+
+        entries = []
+        skipped = REGISTRY.counter("pane-cache-snapshot-skipped")
+        for key, value in self.cache.items():
+            wrapped = isinstance(value, PanePartial)
+            try:
+                enc = encode_value(value.resolve() if wrapped else value)
+            except TypeError:
+                skipped.inc()
+                continue
+            entries.append([_json.dumps(key), wrapped, enc])
+        return {"entries": entries}
+
+    def restore(self, state: dict, decode_value) -> None:
+        """Inverse of :meth:`snapshot` (keys round-trip through JSON:
+        list-form keys — the join path's pane pairs — become tuples)."""
+        import json as _json
+
+        for raw_key, wrapped, enc in state.get("entries", []):
+            key = _json.loads(raw_key)
+            if isinstance(key, list):
+                key = tuple(key)
+            value = decode_value(enc)
+            self.cache[key] = PanePartial(value) if wrapped else value
 
 
 class PanePartial:
@@ -352,6 +394,70 @@ class SpatialOperator:
                 self._degrade_mesh(e)
         return single_fn()
 
+    # ------------------------- checkpointing -------------------------- #
+
+    @property
+    def _ckpt(self):
+        """The run's CheckpointCoordinator (None = checkpointing off)."""
+        return self.conf.checkpointer
+
+    def _record_codec(self):
+        from spatialflink_tpu.runtime.checkpoint import record_codec
+
+        return record_codec(self.grid)
+
+    def _register_ckpt_windows(self, name: str, wa) -> None:
+        """Register a WindowAssembler/PaneBuffer (both expose the same
+        ``snapshot(encode)``/``restore(state, decode)`` shape) with the
+        coordinator; loaded state restores the moment it registers."""
+        coord = self._ckpt
+        if coord is None:
+            return
+        enc, dec = self._record_codec()
+        coord.register(name, lambda: ({}, wa.snapshot(enc)),
+                       lambda _arrays, meta: wa.restore(meta, dec))
+
+    def _register_ckpt_pane_cache(self, name: str, cache: "PaneCache"
+                                  ) -> None:
+        coord = self._ckpt
+        if coord is None:
+            return
+        from spatialflink_tpu.runtime.checkpoint import value_codec
+
+        enc, dec = value_codec(self.grid)
+        coord.register(name, lambda: ({}, cache.snapshot(enc)),
+                       lambda _arrays, meta: cache.restore(meta, dec))
+        # pane partials of the trajectory families index by INTERNED object
+        # id across windows — restored partials are only meaningful against
+        # the interner that minted those ids, so it checkpoints alongside
+        # every pane cache (harmless for families whose partials carry
+        # resolved string ids)
+        self._register_ckpt_interner()
+
+    def _register_ckpt_interner(self) -> None:
+        coord = self._ckpt
+        if coord is None:
+            return
+
+        def restore(_arrays, meta):
+            from spatialflink_tpu.utils import IdInterner
+
+            self.interner = IdInterner.from_list(meta["ids"])
+
+        coord.register("interner",
+                       lambda: ({}, {"ids": self.interner.to_list()}),
+                       restore)
+
+    def _checkpoint_barrier(self) -> None:
+        """Barrier for the NON-pipelined drive loops (no deferred windows in
+        flight): call at the end of a loop body, after any ``yield`` — at
+        that point the yielded result has been fully consumed downstream,
+        so every snapshotted structure is consistent with the noted source
+        positions."""
+        coord = self._ckpt
+        if coord is not None:
+            coord.barrier()
+
     # ---------------------------------------------------------------- #
 
     def _point_batch(self, records: List[Point], ts_base: int) -> PointBatch:
@@ -362,6 +468,7 @@ class SpatialOperator:
             yield from self._count_windows(stream)
             return
         wa = WindowAssembler(self.conf.window_spec(), self.conf.allowed_lateness_ms)
+        self._register_ckpt_windows("windows", wa)
         # chunk-vectorized assignment (WindowSpec.assign_bulk under the
         # hood): identical window tables, late drops, and emission timing to
         # the per-record add loop, minus its per-record assign/seal cost
@@ -388,6 +495,7 @@ class SpatialOperator:
 
         pb = PaneBuffer(self.conf.window_spec(),
                         self.conf.allowed_lateness_ms)
+        self._register_ckpt_windows("panes", pb)
         for rec in stream:
             yield from pb.add(rec.timestamp, rec)
         yield from pb.flush()
@@ -406,6 +514,7 @@ class SpatialOperator:
         from spatialflink_tpu.utils import telemetry as _telemetry
 
         cache = PaneCache(self.conf.slide_ms)
+        self._register_ckpt_pane_cache("pane-cache", cache)
         tel = _telemetry.active()
         label = self.telemetry_label or type(self).__name__
 
@@ -844,6 +953,7 @@ class SpatialOperator:
                     backlog.set(len(pending))
                 yield from emit(start, end, sel)
 
+        coord = self.conf.checkpointer
         for start, end, payload in batched:
             batches.inc()
             records_c.inc(count(payload))
@@ -858,6 +968,17 @@ class SpatialOperator:
             else:
                 yield from drain(0)  # keep window order
                 yield from emit(start, end, sel)
+            if coord is not None:
+                # coordinated-checkpoint barrier: when a checkpoint is due,
+                # drain every in-flight window first (each drained yield
+                # returns only after the consumer sank it), so the manifest
+                # never captures an assembler missing a sealed-but-unsunk
+                # window's records. Off the critical path otherwise — one
+                # int compare per batch.
+                coord.note_batch()
+                if coord.due():
+                    yield from drain(0)
+                    coord.commit()
         yield from drain(0)
 
     @staticmethod

@@ -97,6 +97,7 @@ class PointPointJoinQuery(SpatialOperator):
         the device works on the previous one."""
         depth = max(1, self.conf.pipeline_depth)
         pending: deque = deque()
+        coord = self.conf.checkpointer
 
         def force(r: WindowResult) -> WindowResult:
             if isinstance(r.records, Deferred):
@@ -115,10 +116,69 @@ class PointPointJoinQuery(SpatialOperator):
                 while pending:
                     yield force(pending.popleft())
                 yield r
+            if coord is not None:
+                # coordinated-checkpoint barrier (see base._drive_batched):
+                # drain the in-flight lattices first — their windows' records
+                # are no longer in the snapshotted assemblers/sealed maps,
+                # so they must be fully emitted before the manifest writes
+                coord.note_batch()
+                if coord.due():
+                    while pending:
+                        yield force(pending.popleft())
+                    coord.commit()
         while pending:
             yield force(pending.popleft())
 
     # ---------------------------------------------------------------- #
+
+    def _register_ckpt_join(self, wa_a, wa_b, sealed_a, sealed_b,
+                            panes: bool) -> None:
+        """Coordinator participant for the two-stream windowed join: both
+        sides' assemblers (or pane buffers) plus the sealed-on-one-side
+        maps awaiting the other watermark. ``panes`` switches the sealed
+        payload shape: record lists vs ``[(pane_start, records)]`` lists."""
+        coord = self.conf.checkpointer
+        if coord is None:
+            return
+        from spatialflink_tpu.runtime.checkpoint import record_codec
+
+        # side b decodes against grid2 — the query-side grid the driver
+        # parses stream2 into; decoding both sides with grid would mint
+        # wrong cell ids whenever the two grids differ
+        enc, dec_a = record_codec(self.grid)
+        _, dec_b = record_codec(self.grid2)
+
+        if panes:
+            def enc_sealed(sealed):
+                return {str(s): [[p, [enc(r) for r in recs]]
+                                 for p, recs in pane_list]
+                        for s, pane_list in sealed.items()}
+
+            def dec_sealed(state, sealed, dec):
+                sealed.update({int(s): [(int(p), [dec(r) for r in recs])
+                                        for p, recs in pl]
+                               for s, pl in state.items()})
+        else:
+            def enc_sealed(sealed):
+                return {str(s): [enc(r) for r in recs]
+                        for s, recs in sealed.items()}
+
+            def dec_sealed(state, sealed, dec):
+                sealed.update({int(s): [dec(r) for r in recs]
+                               for s, recs in state.items()})
+
+        def snap():
+            return ({}, {"a": wa_a.snapshot(enc), "b": wa_b.snapshot(enc),
+                         "sealed_a": enc_sealed(sealed_a),
+                         "sealed_b": enc_sealed(sealed_b)})
+
+        def restore(_arrays, meta):
+            wa_a.restore(meta["a"], dec_a)
+            wa_b.restore(meta["b"], dec_b)
+            dec_sealed(meta["sealed_a"], sealed_a, dec_a)
+            dec_sealed(meta["sealed_b"], sealed_b, dec_b)
+
+        coord.register("join-windows", snap, restore)
 
     def _run_realtime(self, ordinary, query_stream, radius) -> Iterator[WindowResult]:
         """Micro-batched realtime join over a *rolling* window.
@@ -191,6 +251,7 @@ class PointPointJoinQuery(SpatialOperator):
         # once BOTH sides' watermarks have passed its end)
         sealed_a: Dict[int, List[Point]] = {}
         sealed_b: Dict[int, List[Point]] = {}
+        self._register_ckpt_join(wa_a, wa_b, sealed_a, sealed_b, panes=False)
 
         def sweep() -> Iterator[WindowResult]:
             # Empty windows never appear in an assembler's buffers, so a
@@ -243,10 +304,12 @@ class PointPointJoinQuery(SpatialOperator):
         pb_b = PaneBuffer(spec, self.conf.allowed_lateness_ms)
         sealed_a: Dict[int, List] = {}  # start -> [(pane_start, records)]
         sealed_b: Dict[int, List] = {}
+        self._register_ckpt_join(pb_a, pb_b, sealed_a, sealed_b, panes=True)
         # block cache keyed (pane_a, pane_b); a block is needed only while
         # BOTH its panes can appear in a future window, so eviction hinges
         # on the earlier pane
         cache = PaneCache(slide, key_floor=min)
+        self._register_ckpt_pane_cache("pane-cache", cache)
         # per-side pane BATCH memo: a pane's device batch is built once and
         # shared by every block touching it — without this each new pane
         # would rebuild its batch O(overlap) times (once per block) and the

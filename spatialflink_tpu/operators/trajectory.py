@@ -84,6 +84,7 @@ class PointTFilterQuery(SpatialOperator):
                 yield WindowResult(
                     start, end, list(assemble_subtrajectories(sel).values())
                 )
+                self._checkpoint_barrier()
 
 
 class PointPolygonTRangeQuery(SpatialOperator, GeomQueryMixin):
@@ -163,6 +164,7 @@ class PointPolygonTRangeQuery(SpatialOperator, GeomQueryMixin):
                     start, end, list(assemble_subtrajectories(sel).values()),
                     extras={"matched_ids": matched_ids},
                 )
+                self._checkpoint_barrier()
 
     def _run_windowed_panes(self, stream, gb, cell_mask
                             ) -> Iterator[WindowResult]:
@@ -177,6 +179,7 @@ class PointPolygonTRangeQuery(SpatialOperator, GeomQueryMixin):
         from spatialflink_tpu.runtime.windows import PaneBuffer
 
         cache = PaneCache(self.conf.slide_ms)
+        self._register_ckpt_pane_cache("pane-cache", cache)
 
         def pane_partial(precs, pstart):
             cand = self._cell_prefilter(precs, cell_mask)
@@ -188,6 +191,7 @@ class PointPolygonTRangeQuery(SpatialOperator, GeomQueryMixin):
 
         pb = PaneBuffer(self.conf.window_spec(),
                         self.conf.allowed_lateness_ms)
+        self._register_ckpt_windows("panes", pb)
 
         def results(windows):
             for start, end, panes in windows:
@@ -202,6 +206,7 @@ class PointPolygonTRangeQuery(SpatialOperator, GeomQueryMixin):
                     start, end, list(assemble_subtrajectories(sel).values()),
                     extras={"matched_ids": matched_ids},
                 )
+                self._checkpoint_barrier()
 
         for rec in stream:
             yield from results(pb.add(rec.timestamp, rec))
@@ -232,7 +237,8 @@ class PointTStatsQuery(SpatialOperator):
 
     def run(self, stream: Iterable[Point], traj_ids: Optional[Set[str]] = None,
             *, checkpoint_path: Optional[str] = None,
-            checkpoint_every: int = 16, resume: bool = True
+            checkpoint_every: int = 16, resume: bool = True,
+            checkpoint_job: Optional[str] = None
             ) -> Iterator[WindowResult]:
         """``checkpoint_path`` makes the realtime run durable: every
         ``checkpoint_every`` micro-batches the device state, the interner, and
@@ -241,42 +247,59 @@ class PointTStatsQuery(SpatialOperator):
         the previous one stopped (the source replays from its own offset —
         e.g. a Kafka consumer group — this restores the operator state the
         reference would have gotten from Flink checkpointing, were it
-        configured; SURVEY §5)."""
+        configured; SURVEY §5). ``checkpoint_job`` (the driver's job
+        fingerprint) is stored in the checkpoint meta; restoring under a
+        DIFFERENT fingerprint refuses instead of producing wrong state."""
         from spatialflink_tpu.runtime.state import TrajStateStore
 
         allowed = set(traj_ids or ())
 
         if self.conf.query_type is QueryType.RealTime:
-            store = TrajStateStore()
             # per-batch base, with carried last_ts offsets rebased between
             # batches — offsets stay comparable AND bounded (no int32 wrap
             # on unbounded runs). Batches spanning more event time than the
             # device's int32-offset horizon are split host-side first.
-            ts_base = None
-            consumed = 0  # source records fully processed (the resume offset)
+            # (mutable cell so the coordinator's snapshot/restore closures
+            # see the loop's live store/ts_base/consumed)
+            st = {"store": TrajStateStore(), "ts_base": None, "consumed": 0}
             if checkpoint_path and resume and os.path.exists(checkpoint_path):
-                store, ts_base, consumed = self._restore_checkpoint(checkpoint_path)
+                (st["store"], st["ts_base"],
+                 st["consumed"]) = self._restore_checkpoint(
+                     checkpoint_path, job=checkpoint_job)
+            self._register_ckpt_tstats(st)
             n_batches = 0
-            for records in self._split_by_span(self._micro_batches(stream)):
-                consumed += len(records)
+            for records, tail_pending in self._split_by_span_flagged(
+                    self._micro_batches(stream)):
+                st["consumed"] += len(records)
                 if allowed:
                     records = [p for p in records if p.obj_id in allowed]
-                if not records:
-                    continue
-                if ts_base is None:
-                    ts_base = records[0].timestamp
-                elif records[0].timestamp != ts_base:
-                    store.rebase_ts(records[0].timestamp - ts_base)
-                    ts_base = records[0].timestamp
-                tuples = self._update(store, records, ts_base)
-                n_batches += 1
-                if checkpoint_path and n_batches % max(1, checkpoint_every) == 0:
-                    self._save_checkpoint(store, ts_base, checkpoint_path, consumed)
+                tuples = []
+                if records:
+                    if st["ts_base"] is None:
+                        st["ts_base"] = records[0].timestamp
+                    elif records[0].timestamp != st["ts_base"]:
+                        st["store"].rebase_ts(
+                            records[0].timestamp - st["ts_base"])
+                        st["ts_base"] = records[0].timestamp
+                    tuples = self._update(st["store"], records, st["ts_base"])
+                    n_batches += 1
+                    if checkpoint_path and \
+                            n_batches % max(1, checkpoint_every) == 0:
+                        self._save_checkpoint(st["store"], st["ts_base"],
+                                              checkpoint_path, st["consumed"],
+                                              job=checkpoint_job)
                 if tuples:
                     yield WindowResult(records[0].timestamp,
                                        records[-1].timestamp, tuples)
+                if not tail_pending:
+                    # a span-split batch still holds unprocessed records in
+                    # the splitter's frame — a coordinator checkpoint there
+                    # would lose them; barrier only at true batch bounds
+                    self._checkpoint_barrier()
             if checkpoint_path and n_batches:
-                self._save_checkpoint(store, ts_base, checkpoint_path, consumed)
+                self._save_checkpoint(st["store"], st["ts_base"],
+                                      checkpoint_path, st["consumed"],
+                                      job=checkpoint_job)
         elif self._panes_active() and not self.distributed:
             # pane-incremental windowed stats; the distributed path keeps
             # its shard-stitch plan (pane partials would stitch the same
@@ -292,6 +315,38 @@ class PointTStatsQuery(SpatialOperator):
                 else:
                     tuples = self._window_tuples_single(records, start)
                 yield WindowResult(start, end, tuples)
+                self._checkpoint_barrier()
+
+    def _register_ckpt_tstats(self, st: dict) -> None:
+        """Coordinator participant for the realtime device state: the
+        TrajStatsState arrays plus capacity/ts_base/consumed/interner meta
+        (the same payload the legacy single-file checkpoint carries)."""
+        coord = self._ckpt
+        if coord is None:
+            return
+
+        def snap():
+            cp = st["store"].snapshot()
+            meta = {"capacity": st["store"].capacity,
+                    "ts_base": st["ts_base"], "consumed": st["consumed"],
+                    "interner": self.interner.to_list()}
+            return ({k: np.asarray(v) for k, v in cp.arrays.items()}, meta)
+
+        def restore(arrays, meta):
+            from spatialflink_tpu.runtime.state import (CheckpointableState,
+                                                        TrajStateStore)
+            from spatialflink_tpu.utils import IdInterner
+
+            cp = CheckpointableState()
+            cp.arrays.update(arrays)
+            cp.meta["capacity"] = int(meta["capacity"])
+            st["store"] = TrajStateStore.restore(cp)
+            st["ts_base"] = (None if meta["ts_base"] is None
+                             else int(meta["ts_base"]))
+            st["consumed"] = int(meta.get("consumed", 0))
+            self.interner = IdInterner.from_list(meta["interner"])
+
+        coord.register("tstats", snap, restore)
 
     def _run_windowed_panes(self, stream, allowed
                             ) -> Iterator[WindowResult]:
@@ -312,6 +367,7 @@ class PointTStatsQuery(SpatialOperator):
         from spatialflink_tpu.utils import bucket_size
 
         cache = PaneCache(self.conf.slide_ms)
+        self._register_ckpt_pane_cache("pane-cache", cache)
         i64 = np.int64
 
         def pane_partial(precs, pstart) -> Optional[dict]:
@@ -338,6 +394,7 @@ class PointTStatsQuery(SpatialOperator):
 
         pb = PaneBuffer(self.conf.window_spec(),
                         self.conf.allowed_lateness_ms)
+        self._register_ckpt_windows("panes", pb)
 
         def results(windows):
             for start, end, panes in windows:
@@ -357,6 +414,7 @@ class PointTStatsQuery(SpatialOperator):
                                        int(round(t)),
                                        s / t if t > 0 else 0.0))
                 yield WindowResult(start, end, tuples)
+                self._checkpoint_barrier()
 
         for rec in stream:
             yield from results(pb.add(rec.timestamp, rec))
@@ -425,7 +483,8 @@ class PointTStatsQuery(SpatialOperator):
             lambda: self._window_tuples_single(records, start), dist, batch)
 
     def _save_checkpoint(self, store, ts_base: int, path: str,
-                         consumed: int = 0) -> None:
+                         consumed: int = 0,
+                         job: Optional[str] = None) -> None:
         cp = store.snapshot()
         cp.meta["ts_base"] = int(ts_base)
         cp.meta["interner"] = self.interner.to_list()
@@ -434,13 +493,25 @@ class PointTStatsQuery(SpatialOperator):
         # already-applied records double-count (offset-managed sources such
         # as a Kafka consumer group seek instead and can ignore it)
         cp.meta["consumed"] = int(consumed)
+        if job:
+            # the job fingerprint guards resume-under-a-different-config:
+            # restoring tStats state into a query it was not accumulated
+            # for silently produces wrong numbers (see _check_job)
+            cp.meta["job"] = job
         cp.save(path)
 
-    def _restore_checkpoint(self, path: str):
+    @staticmethod
+    def _check_job(meta: dict, path: str, job: Optional[str]) -> None:
+        from spatialflink_tpu.runtime.checkpoint import check_job_fingerprint
+
+        check_job_fingerprint(meta.get("job"), job, path)
+
+    def _restore_checkpoint(self, path: str, job: Optional[str] = None):
         from spatialflink_tpu.runtime.state import CheckpointableState, TrajStateStore
         from spatialflink_tpu.utils import IdInterner
 
         cp = CheckpointableState.load(path)
+        self._check_job(cp.meta, path, job)
         self.interner = IdInterner.from_list(cp.meta["interner"])
         return (TrajStateStore.restore(cp), int(cp.meta["ts_base"]),
                 int(cp.meta.get("consumed", 0)))
@@ -455,6 +526,16 @@ class PointTStatsQuery(SpatialOperator):
     _SPAN_HORIZON_MS = 2**30  # device ts offsets are int32; stay well inside
 
     def _split_by_span(self, batches) -> Iterator[List[Point]]:
+        for records, _tail_pending in self._split_by_span_flagged(batches):
+            yield records
+
+    def _split_by_span_flagged(self, batches
+                               ) -> Iterator[Tuple[List[Point], bool]]:
+        """``(records, tail_pending)`` — ``tail_pending`` marks a span-split
+        yield whose source batch still holds unprocessed records in this
+        frame; a checkpoint barrier there would snapshot state missing
+        records the source taps already reported (and lose them on
+        resume)."""
         for records in batches:
             cur: List[Point] = []
             base = None
@@ -462,11 +543,11 @@ class PointTStatsQuery(SpatialOperator):
                 if base is None:
                     base = p.timestamp
                 elif abs(p.timestamp - base) > self._SPAN_HORIZON_MS:
-                    yield cur
+                    yield cur, True
                     cur, base = [], p.timestamp
                 cur.append(p)
             if cur:
-                yield cur
+                yield cur, False
 
     def _update(self, store, records: List[Point], ts_base: int) -> List[Tuple]:
         from spatialflink_tpu.ops.trajectory import tstats_update
@@ -505,14 +586,16 @@ class PointTAggregateQuery(SpatialOperator):
     def run(self, stream: Iterable[Point], aggregate: str = "SUM",
             traj_deletion_threshold_ms: int = 0, *,
             checkpoint_path: Optional[str] = None,
-            checkpoint_every: int = 16, resume: bool = True
+            checkpoint_every: int = 16, resume: bool = True,
+            checkpoint_job: Optional[str] = None
             ) -> Iterator[WindowResult]:
         agg = aggregate.upper()
         if self.conf.query_type is QueryType.RealTime:
             yield from self._run_realtime(
                 stream, agg, traj_deletion_threshold_ms,
                 checkpoint_path=checkpoint_path,
-                checkpoint_every=checkpoint_every, resume=resume)
+                checkpoint_every=checkpoint_every, resume=resume,
+                checkpoint_job=checkpoint_job)
             return
         if self.conf.query_type is QueryType.CountBased:
             yield from self._run_count_windows(stream, agg)
@@ -540,6 +623,7 @@ class PointTAggregateQuery(SpatialOperator):
             else:
                 yield WindowResult(start, end, [],
                                    extras={"heatmap": np.asarray(out)})
+            self._checkpoint_barrier()
 
     def _run_windowed_panes(self, stream, agg: str) -> Iterator[WindowResult]:
         """Pane-incremental windowed tAggregate (``--panes``): one
@@ -559,6 +643,7 @@ class PointTAggregateQuery(SpatialOperator):
             # fail fast like the device path's first window would
             raise ValueError(f"unknown aggregate {agg!r}")
         cache = PaneCache(self.conf.slide_ms)
+        self._register_ckpt_pane_cache("pane-cache", cache)
 
         def pane_partial(precs, pstart):
             batch = self._point_batch(precs, pstart)
@@ -572,6 +657,7 @@ class PointTAggregateQuery(SpatialOperator):
 
         pb = PaneBuffer(self.conf.window_spec(),
                         self.conf.allowed_lateness_ms)
+        self._register_ckpt_windows("panes", pb)
 
         def results(windows):
             for start, end, panes in windows:
@@ -591,6 +677,7 @@ class PointTAggregateQuery(SpatialOperator):
                         start, end, [],
                         extras={"heatmap": self._heatmap_from_groups(
                             merged, agg)})
+                self._checkpoint_barrier()
 
         for rec in stream:
             yield from results(pb.add(rec.timestamp, rec))
@@ -735,7 +822,8 @@ class PointTAggregateQuery(SpatialOperator):
         return WindowResult(start, end, records, extras)
 
     def _run_realtime(self, stream, agg, eviction_ms, *,
-                      checkpoint_path=None, checkpoint_every=16, resume=True
+                      checkpoint_path=None, checkpoint_every=16, resume=True,
+                      checkpoint_job=None
                       ) -> Iterator[WindowResult]:
         # host state: (cell, objID) -> [min_ts, max_ts, last_seen], held in
         # the array-backed _ExtentStore. The reference's MapState does a full
@@ -748,20 +836,22 @@ class PointTAggregateQuery(SpatialOperator):
         # This is exactly the unbounded state most in need of checkpointing:
         # checkpoint_path snapshots the extent map (+ consumed offset)
         # every checkpoint_every micro-batches, like tStats.
-        store = _ExtentStore()
-        consumed = 0
+        st = {"store": _ExtentStore(), "consumed": 0}
         if checkpoint_path and resume and os.path.exists(checkpoint_path):
-            store, consumed = self._restore_checkpoint(checkpoint_path)
+            st["store"], st["consumed"] = self._restore_checkpoint(
+                checkpoint_path, job=checkpoint_job)
+        self._register_ckpt_taggregate(st)
         n_batches = 0
         for records in self._micro_batches(stream):
-            consumed += len(records)
+            st["consumed"] += len(records)
             n_batches += 1
-            latest = store.update_batch(records)
+            latest = st["store"].update_batch(records)
             if eviction_ms > 0:
-                store.evict(latest, eviction_ms)
+                st["store"].evict(latest, eviction_ms)
             if checkpoint_path and n_batches % max(1, checkpoint_every) == 0:
-                self._save_checkpoint(store, checkpoint_path, consumed)
-            heatmap = store.aggregate(agg, self.grid.num_cells)
+                self._save_checkpoint(st["store"], checkpoint_path,
+                                      st["consumed"], job=checkpoint_job)
+            heatmap = st["store"].aggregate(agg, self.grid.num_cells)
             extras = {"heatmap": heatmap, "aggregate": agg}
             if agg == "ALL":
                 # the realtime heatmap form has no per-(cell, objID) record
@@ -773,12 +863,35 @@ class PointTAggregateQuery(SpatialOperator):
                 records[0].timestamp, records[-1].timestamp, [],
                 extras=extras,
             )
+            self._checkpoint_barrier()
         if checkpoint_path and n_batches:
-            self._save_checkpoint(store, checkpoint_path, consumed)
+            self._save_checkpoint(st["store"], checkpoint_path,
+                                  st["consumed"], job=checkpoint_job)
+
+    def _register_ckpt_taggregate(self, st: dict) -> None:
+        """Coordinator participant for the realtime extent map (the same
+        rows the legacy single-file checkpoint persists)."""
+        coord = self._ckpt
+        if coord is None:
+            return
+
+        def snap():
+            cells, oids, extents = st["store"].rows()
+            return ({"cell": cells, "extent": extents},
+                    {"obj_id": oids, "consumed": st["consumed"]})
+
+        def restore(arrays, meta):
+            st["store"] = _ExtentStore.from_rows(
+                arrays.get("cell", np.empty(0, np.int64)),
+                meta.get("obj_id", []),
+                arrays.get("extent", np.empty((0, 3), np.int64)))
+            st["consumed"] = int(meta.get("consumed", 0))
+
+        coord.register("taggregate", snap, restore)
 
     @staticmethod
     def _save_checkpoint(store: "_ExtentStore", path: str,
-                         consumed: int) -> None:
+                         consumed: int, job: Optional[str] = None) -> None:
         from spatialflink_tpu.runtime.state import CheckpointableState
 
         cells, oids, extents = store.rows()
@@ -787,13 +900,16 @@ class PointTAggregateQuery(SpatialOperator):
         cp.arrays["extent"] = extents
         cp.meta["obj_id"] = oids
         cp.meta["consumed"] = int(consumed)
+        if job:
+            cp.meta["job"] = job
         cp.save(path)
 
     @staticmethod
-    def _restore_checkpoint(path: str):
+    def _restore_checkpoint(path: str, job: Optional[str] = None):
         from spatialflink_tpu.runtime.state import CheckpointableState
 
         cp = CheckpointableState.load(path)
+        PointTStatsQuery._check_job(cp.meta, path, job)
         cells = cp.arrays.get("cell", np.empty(0, np.int64))
         extents = cp.arrays.get("extent", np.empty((0, 3), np.int64))
         oids = cp.meta.get("obj_id", [])

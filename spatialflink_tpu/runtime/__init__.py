@@ -28,8 +28,24 @@ from spatialflink_tpu.runtime.supervisor import (
     RetryPolicy,
     SupervisedBroker,
 )
+from spatialflink_tpu.runtime.checkpoint import (
+    CheckpointCoordinator,
+    CheckpointMismatch,
+    CheckpointTap,
+)
+from spatialflink_tpu.runtime.state import (
+    CheckpointableState,
+    CheckpointCorrupt,
+    TrajStateStore,
+)
 
 __all__ = [
+    "CheckpointCoordinator",
+    "CheckpointMismatch",
+    "CheckpointTap",
+    "CheckpointableState",
+    "CheckpointCorrupt",
+    "TrajStateStore",
     "BoundedOutOfOrderness",
     "WindowSpec",
     "WindowAssembler",

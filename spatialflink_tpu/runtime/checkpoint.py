@@ -1,0 +1,485 @@
+"""Coordinated pipeline checkpointing with exactly-once crash recovery.
+
+The reference inherits coordinated snapshots from Flink for free (GeoFlink
+never configures them — SURVEY §5); the rebuild's ``--checkpoint`` covered
+only the stateful realtime trajectory queries. This module generalizes that
+into ONE coordinator that periodically snapshots the whole pipeline — source
+positions, watermarks, open ``WindowAssembler``/``PaneBuffer`` windows,
+``PaneCache`` partials, trajectory state, and supervision (circuit-breaker)
+state — into a single atomic, checksummed, versioned manifest under a
+checkpoint DIRECTORY, retaining the last K manifests with automatic
+fallback to the previous one on corruption.
+
+Consistency model (the "barrier"): participants are snapshotted only at
+points where
+
+1. every result yielded so far has been fully consumed downstream (sinks
+   produced, window markers written, offsets committed) — guaranteed by
+   generator semantics: code after a ``yield`` runs only once the consumer
+   pulled the next item; and
+2. no sealed-window payload is in flight — the pipelined drivers drain
+   their deferred windows to zero before committing a checkpoint.
+
+At such a point every record the source taps have reported is either (a)
+buffered in a snapshotted structure (assembler/pane buffer/trajectory
+state), (b) reflected in an already-produced result, or (c) dropped as
+late/off-type — so ``restore + seek sources to the checkpointed positions``
+reproduces the uninterrupted run exactly. Windows emitted between the last
+checkpoint and a crash are re-emitted on resume with identical contents and
+suppressed by the marker-seeded :class:`~spatialflink_tpu.streams.kafka
+.KafkaWindowSink`, which is what upgrades bounded at-least-once replay to
+exactly-once output.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import re
+import sys
+import time
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from spatialflink_tpu.runtime.state import (CheckpointableState,
+                                            CheckpointCorrupt)
+
+#: manifest layout version (independent of the npz envelope version in
+#: runtime.state): bump on incompatible changes to the component layout
+MANIFEST_SCHEMA_VERSION = 1
+
+_CKPT_RE = re.compile(r"^ckpt-(\d{8})\.npz$")
+
+
+class CheckpointMismatch(RuntimeError):
+    """A checkpoint was written by a DIFFERENT job configuration (query /
+    window / group fingerprint). Restoring it would silently produce wrong
+    state, so the resume refuses instead."""
+
+
+def check_job_fingerprint(saved: Optional[str], current: Optional[str],
+                          path: str) -> None:
+    """The ONE job-fingerprint guard every resume path shares (the legacy
+    single-file checkpoint, the driver's pre-flight check, and the
+    coordinator's manifest load): raises :class:`CheckpointMismatch` when
+    both fingerprints are known and differ."""
+    if current and saved and saved != current:
+        raise CheckpointMismatch(
+            f"{path} was written by job fingerprint {saved!r} but this run "
+            f"is {current!r} (different query/window config or consumer "
+            "group); restoring it would produce wrong state. Use a fresh "
+            "checkpoint location, or rerun with the original "
+            "configuration.")
+
+
+# --------------------------------------------------------------------- #
+# codecs
+
+
+def record_codec(grid):
+    """(encode, decode) for stream records (SpatialObjects): GeoJSON with
+    raw epoch-ms timestamps (``date_format=None`` serializes the timestamp
+    as an integer, which the parser passes through unchanged — a lossless
+    round trip; ``ingestion_time`` is transport metadata and is not
+    carried)."""
+    from spatialflink_tpu.streams.formats import (parse_spatial,
+                                                  serialize_spatial)
+
+    def encode(obj) -> str:
+        return serialize_spatial(obj, "GeoJSON")
+
+    def decode(s: str):
+        return parse_spatial(s, "GeoJSON", grid)
+
+    return encode, decode
+
+
+def value_codec(grid):
+    """(encode, decode) for pane-partial values: a tagged JSON projection
+    covering every partial shape the operator families cache — record
+    lists (range/tRange), (objID, distance) tuples (kNN), matched-ID sets
+    (tRange), per-trajectory numpy summaries (tStats/tAggregate), and
+    pane-pair blocks of (record, record, distance) tuples (join). Raises
+    ``TypeError`` for anything else so an unencodable partial is skipped
+    loudly at snapshot time (it simply recomputes on resume) rather than
+    silently mangled."""
+    enc_rec, dec_rec = record_codec(grid)
+
+    def enc(v):
+        if v is None or isinstance(v, (bool, int, str)):
+            return v
+        if isinstance(v, float):
+            return v
+        if isinstance(v, np.generic):
+            return v.item()
+        if isinstance(v, np.ndarray):
+            return {"__nd__": [str(v.dtype), list(v.shape),
+                               base64.b64encode(
+                                   np.ascontiguousarray(v).tobytes()
+                               ).decode("ascii")]}
+        if isinstance(v, tuple):
+            return {"__t__": [enc(x) for x in v]}
+        if isinstance(v, list):
+            return {"__l__": [enc(x) for x in v]}
+        if isinstance(v, (set, frozenset)):
+            return {"__s__": sorted(enc(x) for x in v)}
+        if isinstance(v, dict):
+            if not all(isinstance(k, str) for k in v):
+                raise TypeError("only str-keyed dicts are encodable")
+            return {"__d__": {k: enc(x) for k, x in v.items()}}
+        if hasattr(v, "obj_id") and hasattr(v, "timestamp"):
+            return {"__geo__": enc_rec(v)}
+        raise TypeError(f"unencodable partial value {type(v).__name__}")
+
+    def dec(v):
+        if not isinstance(v, dict):
+            return v
+        if "__nd__" in v:
+            dtype, shape, data = v["__nd__"]
+            return np.frombuffer(
+                base64.b64decode(data), dtype=np.dtype(dtype)
+            ).reshape(shape).copy()
+        if "__t__" in v:
+            return tuple(dec(x) for x in v["__t__"])
+        if "__l__" in v:
+            return [dec(x) for x in v["__l__"]]
+        if "__s__" in v:
+            return {dec(x) for x in v["__s__"]}
+        if "__d__" in v:
+            return {k: dec(x) for k, x in v["__d__"].items()}
+        if "__geo__" in v:
+            return dec_rec(v["__geo__"])
+        return v
+
+    return enc, dec
+
+
+# --------------------------------------------------------------------- #
+# source taps
+
+
+class CheckpointTap:
+    """Pass-through source wrapper that reports the pipeline's live source
+    position to the coordinator as records are handed downstream.
+
+    ``position_fn`` (e.g. ``lambda: kafka_source.position``) reports the
+    source's own next-offset; without one the tap counts records from
+    ``base`` (the file-replay case). Positions are noted BEFORE the yield:
+    at any coordinator barrier the wrapping generator is suspended at its
+    ``yield`` and the yielded record has been fully processed, so the last
+    noted position is exactly "everything before this is reflected
+    downstream"."""
+
+    def __init__(self, source, coordinator: "CheckpointCoordinator",
+                 key: str, base: int = 0,
+                 position_fn: Optional[Callable[[], int]] = None):
+        self.source = source
+        self.coordinator = coordinator
+        self.key = key
+        self.base = int(base)
+        self.position_fn = position_fn
+
+    def __iter__(self) -> Iterator:
+        note = self.coordinator.note_position
+        n = self.base
+        for rec in self.source:
+            if self.position_fn is not None:
+                note(self.key, self.position_fn())
+            else:
+                n += 1
+                note(self.key, n)
+            yield rec
+
+
+class EmittedWindowJournal:
+    """Durable append-only log of emitted window keys for sinks without
+    recovery state of their own (the driver's stdout/``--output`` file
+    path): on resume, windows already journaled are suppressed instead of
+    re-emitted, upgrading the file path to exactly-once output across a
+    process crash — the role the commit markers in the output topic play
+    for the Kafka sink.
+
+    Keys are ``start:end:cell`` (the idempotent window-sink key). Lines are
+    flushed per window: a ``kill -9`` cannot lose them (the OS owns the
+    buffer once written); only a machine crash can drop the un-fsynced
+    tail, in which case the affected windows re-emit with identical
+    contents (at-least-once, never wrong)."""
+
+    FILENAME = "emitted.log"
+
+    def __init__(self, directory: str, fresh: bool = False):
+        self.path = os.path.join(directory, self.FILENAME)
+        if fresh and os.path.exists(self.path):
+            os.unlink(self.path)  # a non-resume run starts a new history
+        self._seen = set()
+        if os.path.exists(self.path):
+            with open(self.path) as f:
+                self._seen = {ln.rstrip("\n") for ln in f if ln.strip()}
+        self._f = open(self.path, "a")
+        self.suppressed = 0
+
+    @staticmethod
+    def key(result) -> str:
+        cell = (getattr(result, "extras", {}).get("cell")
+                if hasattr(result, "extras") else None)
+        return (f"{getattr(result, 'window_start', None)}:"
+                f"{getattr(result, 'window_end', None)}:{cell}")
+
+    def seen(self, result) -> bool:
+        if self.key(result) in self._seen:
+            self.suppressed += 1
+            return True
+        return False
+
+    def record(self, result) -> None:
+        k = self.key(result)
+        if k not in self._seen:
+            self._seen.add(k)
+            self._f.write(k + "\n")
+            self._f.flush()
+
+    def close(self) -> None:
+        self._f.close()
+
+
+# --------------------------------------------------------------------- #
+# coordinator
+
+
+class CheckpointCoordinator:
+    """Periodic whole-pipeline snapshots into one atomic manifest per
+    checkpoint, written off the drive loop's critical path (a cheap
+    counter/clock check per batch; the write itself happens at a drained
+    barrier every ``every_batches`` processing units or ``every_seconds``
+    wall seconds, whichever fires first).
+
+    Participants register ``(snapshot_fn, restore_fn)`` under a stable
+    name; ``snapshot_fn() -> (arrays, meta)`` returns numpy arrays plus
+    JSON-able metadata, and ``restore_fn(arrays, meta)`` applies a loaded
+    component. Registration auto-restores when a loaded manifest holds
+    state for that name, so participants created lazily (assemblers built
+    when the pipeline first iterates) pick up their state the moment they
+    exist.
+
+    Manifests are ``ckpt-<seq>.npz`` files riding
+    :class:`~spatialflink_tpu.runtime.state.CheckpointableState`'s
+    fsync+rename+checksum discipline; the newest ``retain`` are kept and
+    :meth:`load` falls back to the previous manifest when the newest is
+    truncated/corrupt (counter ``checkpoint-fallbacks``)."""
+
+    def __init__(self, directory: str, *, every_batches: int = 16,
+                 every_seconds: Optional[float] = None, retain: int = 3,
+                 job: Optional[str] = None, layout: Optional[str] = None):
+        os.makedirs(directory, exist_ok=True)
+        self.dir = directory
+        self.every_batches = max(1, int(every_batches))
+        self.every_seconds = every_seconds
+        self.retain = max(1, int(retain))
+        self.job = job
+        #: execution-layout tag (family:mode:panes:multi). The job
+        #: fingerprint deliberately EXCLUDES execution knobs like --panes
+        #: (a panes-on re-run must dedup against a panes-off run's sink
+        #: markers), but the checkpoint's component layout depends on them:
+        #: restoring a panes-on manifest into a panes-off run would leave
+        #: the pane components unclaimed and lose their buffered records.
+        #: Layout mismatch therefore refuses at load.
+        self.layout = layout
+        self.restored = False
+        self.written = 0
+        self._snapshots: Dict[str, Callable[[], Tuple[dict, Any]]] = {}
+        self._pending: Dict[str, Tuple[dict, Any]] = {}
+        self._positions: Dict[str, int] = {}
+        self._batches = 0
+        self._last_batches = 0
+        self._last_time = time.monotonic()
+        self._age_gauge_installed = False
+        # continue numbering past any existing manifests: a fresh run (no
+        # --resume) into a non-empty directory must sort NEWER than the
+        # stale files so retention prunes them, not the new checkpoints
+        existing = self._manifests()
+        self.seq = existing[-1][0] if existing else 0
+
+    # ------------------------------ participants ---------------------- #
+
+    def register(self, name: str,
+                 snapshot_fn: Callable[[], Tuple[dict, Any]],
+                 restore_fn: Optional[Callable[[dict, Any], None]] = None
+                 ) -> bool:
+        """Register a participant; returns True when pending loaded state
+        was applied through ``restore_fn``."""
+        self._snapshots[name] = snapshot_fn
+        if restore_fn is not None and name in self._pending:
+            arrays, meta = self._pending.pop(name)
+            restore_fn(arrays, meta)
+            return True
+        return False
+
+    def note_position(self, key: str, next_pos: int) -> None:
+        self._positions[key] = int(next_pos)
+
+    def position(self, key: str, default: int = 0) -> int:
+        return int(self._positions.get(key, default))
+
+    def positions(self) -> Dict[str, int]:
+        return dict(self._positions)
+
+    # ------------------------------ cadence --------------------------- #
+
+    def note_batch(self) -> None:
+        self._batches += 1
+
+    def due(self) -> bool:
+        if self._batches - self._last_batches >= self.every_batches:
+            return True
+        return (self.every_seconds is not None
+                and time.monotonic() - self._last_time >= self.every_seconds)
+
+    def barrier(self) -> bool:
+        """One processing unit completed at a consistent point (all yielded
+        results consumed, nothing in flight): count it and checkpoint if
+        due. The per-call cost when not due is one int compare."""
+        self.note_batch()
+        if self.due():
+            self.commit()
+            return True
+        return False
+
+    # ------------------------------ write ----------------------------- #
+
+    def _path(self, seq: int) -> str:
+        return os.path.join(self.dir, f"ckpt-{seq:08d}.npz")
+
+    def commit(self) -> str:
+        """Snapshot every participant + the live source positions into one
+        atomic manifest; prune retained files. Must only be called at a
+        barrier (see the module docstring)."""
+        from spatialflink_tpu.utils import telemetry as _telemetry
+        from spatialflink_tpu.utils.metrics import REGISTRY
+
+        t0 = time.perf_counter()
+        cp = CheckpointableState()
+        components: Dict[str, Any] = {}
+        for name, fn in self._snapshots.items():
+            arrays, meta = fn()
+            for k, a in (arrays or {}).items():
+                cp.arrays[f"{name}/{k}"] = np.asarray(a)
+            components[name] = meta
+        self.seq += 1
+        cp.meta = {
+            "manifest_schema": MANIFEST_SCHEMA_VERSION,
+            "job": self.job,
+            "layout": self.layout,
+            "seq": self.seq,
+            "wall_ms": int(time.time() * 1000),
+            "positions": dict(self._positions),
+            "components": components,
+        }
+        path = self._path(self.seq)
+        cp.save(path)
+        self._prune()
+        self.written += 1
+        self._last_batches = self._batches
+        self._last_time = time.monotonic()
+        REGISTRY.counter("checkpoints-written").inc()
+        tel = _telemetry.active()
+        if tel is not None:
+            tel.histogram("checkpoint-write-ms").record(
+                (time.perf_counter() - t0) * 1e3)
+            tel.histogram("checkpoint-size-bytes").record(
+                os.path.getsize(path))
+            if not self._age_gauge_installed:
+                # callable gauge: snapshots always report the CURRENT age
+                tel.gauge("checkpoint.age-s",
+                          lambda: time.monotonic() - self._last_time)
+                tel.gauge("checkpoint.seq", lambda: float(self.seq))
+                self._age_gauge_installed = True
+        return path
+
+    def _manifests(self) -> List[Tuple[int, str]]:
+        out = []
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return out
+        for n in names:
+            m = _CKPT_RE.match(n)
+            if m:
+                out.append((int(m.group(1)), os.path.join(self.dir, n)))
+        out.sort()
+        return out
+
+    def _prune(self) -> None:
+        manifests = self._manifests()
+        for _seq, path in manifests[:-self.retain]:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        # a crash mid-save leaves a ckpt-*.npz.tmp behind; we are the only
+        # writer, so any tmp present outside an in-progress save is dead
+        for n in os.listdir(self.dir):
+            if n.endswith(".npz.tmp") and not os.path.exists(
+                    os.path.join(self.dir, n[:-4])):
+                try:
+                    os.unlink(os.path.join(self.dir, n))
+                except OSError:
+                    pass
+
+    # ------------------------------ load ------------------------------ #
+
+    def load(self) -> bool:
+        """Restore from the newest VALID retained manifest: corrupt or
+        truncated manifests (including a torn mid-write tmp that was never
+        renamed — those are invisible by construction) fall back to the
+        previous one with a warning. Returns False when no valid manifest
+        exists. Raises :class:`CheckpointMismatch` when the manifest was
+        written by a different job fingerprint."""
+        from spatialflink_tpu.utils.metrics import REGISTRY
+
+        for seq, path in reversed(self._manifests()):
+            try:
+                cp = CheckpointableState.load(path)
+                meta = cp.meta
+                schema = meta.get("manifest_schema")
+                if schema != MANIFEST_SCHEMA_VERSION:
+                    raise CheckpointCorrupt(
+                        f"{path}: manifest schema {schema!r} != "
+                        f"{MANIFEST_SCHEMA_VERSION}")
+            except CheckpointCorrupt as e:
+                REGISTRY.counter("checkpoint-fallbacks").inc()
+                print(f"warning: {e}; falling back to the previous "
+                      "retained checkpoint", file=sys.stderr)
+                continue
+            check_job_fingerprint(meta.get("job"), self.job, path)
+            layout = meta.get("layout")
+            if self.layout and layout and layout != self.layout:
+                raise CheckpointMismatch(
+                    f"{path} was written under execution layout {layout!r} "
+                    f"but this run is {self.layout!r} (e.g. --panes, the "
+                    "query mode, or the input source/topics changed); its "
+                    "components and source positions would not restore "
+                    "into this pipeline and records would be lost. Resume "
+                    "with the original flags and sources, or use a fresh "
+                    "--checkpoint-dir.")
+            grouped: Dict[str, dict] = {}
+            for k, arr in cp.arrays.items():
+                name, _, sub = k.partition("/")
+                grouped.setdefault(name, {})[sub] = arr
+            self._pending = {
+                name: (grouped.get(name, {}), comp_meta)
+                for name, comp_meta in meta.get("components", {}).items()
+            }
+            self._positions = {k: int(v) for k, v in
+                               meta.get("positions", {}).items()}
+            self.seq = int(meta.get("seq", seq))
+            self.restored = True
+            REGISTRY.counter("checkpoint-restores").inc()
+            return True
+        return False
+
+    def pending_components(self) -> List[str]:
+        """Names of loaded components not yet claimed by a registration —
+        non-empty after the run means some state was never restored."""
+        return sorted(self._pending)

@@ -10,11 +10,65 @@ snapshot-able: device state pytrees hop to host numpy for serialization, and
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 from typing import Any, Dict, Optional
 
 import numpy as np
+
+#: bumped when the on-disk layout of a CheckpointableState changes
+#: incompatibly; a reader seeing a NEWER version refuses loudly instead of
+#: misinterpreting the arrays
+STATE_SCHEMA_VERSION = 1
+
+
+class CheckpointCorrupt(RuntimeError):
+    """A checkpoint file is unreadable: truncated, checksum-mismatched, or
+    written by an incompatible schema version. Raised instead of the raw
+    ``zipfile``/``np.load``/``json`` traceback so callers (and the
+    checkpoint coordinator's retained-file fallback) can distinguish "this
+    file is bad" from a bug."""
+
+
+def _content_checksum(host: Dict[str, np.ndarray], meta: Dict) -> str:
+    """sha256 over the meta JSON and every array's dtype/shape/bytes, in
+    sorted key order — any torn/truncated/bit-flipped payload changes it."""
+    h = hashlib.sha256()
+    h.update(json.dumps(meta, sort_keys=True, default=str).encode())
+    for k in sorted(host):
+        a = np.ascontiguousarray(host[k])
+        h.update(k.encode())
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def _parse_meta_envelope(raw: str, path: str, host=None, verify=True):
+    """User meta from the ``__meta__`` entry. New-format checkpoints wrap it
+    in an envelope ``{"schema": v, "checksum": hex, "meta": {...}}`` that is
+    verified; legacy files (bare meta dict) load without verification."""
+    try:
+        env = json.loads(raw)
+    except (ValueError, TypeError) as e:
+        raise CheckpointCorrupt(f"{path}: __meta__ is not JSON ({e})") from e
+    if not (isinstance(env, dict) and "schema" in env and "meta" in env):
+        return env if isinstance(env, dict) else {}
+    schema = env.get("schema")
+    if not isinstance(schema, int) or schema > STATE_SCHEMA_VERSION:
+        raise CheckpointCorrupt(
+            f"{path}: checkpoint schema version {schema!r} is newer than "
+            f"this build understands ({STATE_SCHEMA_VERSION})")
+    if verify and host is not None:
+        want = env.get("checksum")
+        got = _content_checksum(host, env["meta"])
+        if want != got:
+            raise CheckpointCorrupt(
+                f"{path}: content checksum mismatch (file says "
+                f"{str(want)[:12]}…, payload hashes to {got[:12]}…) — "
+                "truncated or corrupt checkpoint")
+    return env["meta"]
 
 
 class CheckpointableState:
@@ -26,11 +80,17 @@ class CheckpointableState:
 
     def save(self, path: str) -> None:
         """Atomic write: a crash mid-save never corrupts the previous
-        checkpoint (tmp file + rename)."""
+        checkpoint (tmp file + rename). The ``__meta__`` entry carries a
+        schema version and a content checksum over meta + every array, so
+        :meth:`load` detects truncation/corruption instead of returning
+        garbage state."""
         host = {k: np.asarray(v) for k, v in self.arrays.items()}
+        envelope = {"schema": STATE_SCHEMA_VERSION,
+                    "checksum": _content_checksum(host, self.meta),
+                    "meta": self.meta}
         tmp = f"{path}.tmp"
         with open(tmp, "wb") as f:
-            np.savez(f, __meta__=json.dumps(self.meta), **host)
+            np.savez(f, __meta__=json.dumps(envelope), **host)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)
@@ -42,28 +102,56 @@ class CheckpointableState:
             os.close(dir_fd)
 
     @classmethod
-    def load(cls, path: str) -> "CheckpointableState":
+    def load(cls, path: str, verify: bool = True) -> "CheckpointableState":
+        """Load + verify. Any unreadable/truncated file and any checksum or
+        schema mismatch raises :class:`CheckpointCorrupt` (legacy files
+        without an envelope load unverified — they predate the checksum)."""
         out = cls()
-        with np.load(path, allow_pickle=False) as z:
-            for k in z.files:
-                if k == "__meta__":
-                    out.meta = json.loads(str(z[k]))
-                else:
-                    out.arrays[k] = z[k]
+        raw_meta: Optional[str] = None
+        try:
+            with np.load(path, allow_pickle=False) as z:
+                for k in z.files:
+                    if k == "__meta__":
+                        raw_meta = str(z[k])
+                    else:
+                        out.arrays[k] = z[k]
+        except CheckpointCorrupt:
+            raise
+        except Exception as e:  # zipfile.BadZipFile, OSError, ValueError, …
+            raise CheckpointCorrupt(
+                f"{path}: unreadable checkpoint ({type(e).__name__}: {e})"
+            ) from e
+        if raw_meta is not None:
+            out.meta = _parse_meta_envelope(raw_meta, path, out.arrays,
+                                            verify=verify)
         return out
 
 
 def checkpoint_consumed(path: str) -> int:
     """Resume offset recorded in a checkpoint (0 if none/absent) — the number
     of source records already reflected in the saved state. Reads only the
-    meta entry (np.load on an npz is lazy per-array), not the state arrays."""
-    if not os.path.exists(path):
-        return 0
-    with np.load(path, allow_pickle=False) as z:
-        if "__meta__" not in z.files:
-            return 0
-        meta = json.loads(str(z["__meta__"]))
+    meta entry (np.load on an npz is lazy per-array), not the state arrays;
+    the content checksum is therefore NOT verified here — the subsequent
+    full restore does that. A file that cannot even surface its meta raises
+    :class:`CheckpointCorrupt` instead of a raw traceback."""
+    meta = checkpoint_meta(path)
     return int(meta.get("consumed", 0))
+
+
+def checkpoint_meta(path: str) -> Dict[str, Any]:
+    """The (unverified) user meta of a checkpoint file; {} if absent."""
+    if not os.path.exists(path):
+        return {}
+    try:
+        with np.load(path, allow_pickle=False) as z:
+            if "__meta__" not in z.files:
+                return {}
+            raw = str(z["__meta__"])
+    except Exception as e:
+        raise CheckpointCorrupt(
+            f"{path}: unreadable checkpoint ({type(e).__name__}: {e})") from e
+    meta = _parse_meta_envelope(raw, path, host=None, verify=False)
+    return meta if isinstance(meta, dict) else {}
 
 
 class TrajStateStore:

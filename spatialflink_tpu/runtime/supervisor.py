@@ -322,6 +322,31 @@ class CircuitBreaker:
         self._opened_at = None
         self._half_open = False
 
+    def snapshot(self) -> dict:
+        """JSON-able breaker state for the checkpoint coordinator. The
+        open/half-open timing is stored as *remaining cool-down seconds* —
+        absolute monotonic clocks do not survive a process restart."""
+        return {
+            "consecutive": self._consecutive,
+            "trips": self.trips,
+            "open": self._opened_at is not None,
+            "half_open": self._half_open,
+            "remaining_cooldown_s": self.remaining_cooldown(),
+        }
+
+    def restore(self, state: dict) -> None:
+        """Inverse of :meth:`snapshot` against THIS process's clock: a
+        breaker checkpointed open resumes open with the remaining cool-down
+        re-anchored to now (conservative — the outage clock restarts)."""
+        self._consecutive = int(state.get("consecutive", 0))
+        self.trips = int(state.get("trips", 0))
+        self._half_open = bool(state.get("half_open", False))
+        if state.get("open"):
+            remaining = float(state.get("remaining_cooldown_s", 0.0))
+            self._opened_at = self._clock() - (self.cooldown_s - remaining)
+        else:
+            self._opened_at = None
+
     def record_failure(self) -> None:
         from spatialflink_tpu.utils.metrics import REGISTRY
 
@@ -554,6 +579,18 @@ class SupervisedBroker:
 
     def topic_values(self, topic: str):
         return self.inner.topic_values(topic)
+
+    def snapshot(self) -> dict:
+        """JSON-able supervision state for the checkpoint coordinator:
+        breaker state plus a dead-letter high-water mark (the DLQ records
+        themselves live durably in the dead-letter topic — the broker IS
+        their store; only the breaker's in-memory state needs carrying)."""
+        return {"breaker": self.breaker.snapshot()}
+
+    def restore(self, state: dict) -> None:
+        breaker = state.get("breaker")
+        if breaker:
+            self.breaker.restore(breaker)
 
     def close(self) -> None:
         if hasattr(self.inner, "close"):

@@ -235,6 +235,26 @@ class WindowAssembler:
             records = self._buffers.pop(start)
             yield (start, start + self.spec.size_ms, records)
 
+    def snapshot(self, encode) -> dict:
+        """JSON-able open-window state for the checkpoint coordinator:
+        watermark, late-drop count, and every open window's buffered records
+        (``encode(record) -> str``). Taken at a barrier where every SEALED
+        window has already been emitted downstream, this is exactly the
+        state a resumed run needs alongside the source position."""
+        return {
+            "watermark_max_ts": self.watermarker._max_ts,
+            "late_dropped": self.late_dropped,
+            "buffers": {str(s): [encode(r) for r in recs]
+                        for s, recs in self._buffers.items()},
+        }
+
+    def restore(self, state: dict, decode) -> None:
+        """Inverse of :meth:`snapshot` (``decode(str) -> record``)."""
+        self.watermarker._max_ts = int(state["watermark_max_ts"])
+        self.late_dropped = int(state.get("late_dropped", 0))
+        self._buffers = {int(s): [decode(r) for r in recs]
+                         for s, recs in state["buffers"].items()}
+
 
 class PaneBuffer:
     """Pane-sliced window assembly: each record is buffered ONCE into its
@@ -317,3 +337,26 @@ class PaneBuffer:
             lo = max(lo, self._next)
         yield from self._emit_range(lo, None)
         self._panes.clear()
+
+    def snapshot(self, encode) -> dict:
+        """JSON-able pane state for the checkpoint coordinator: watermark,
+        late-drop count, the emitted-frontier ``_next``, and every live
+        pane's records. A snapshot taken mid-seal-sweep (``_next`` not yet
+        advanced) may re-emit an already-delivered window on resume — the
+        idempotent window sink suppresses it; nothing is ever lost."""
+        return {
+            "watermark_max_ts": self.watermarker._max_ts,
+            "late_dropped": self.late_dropped,
+            "next": self._next,
+            "panes": {str(p): [encode(r) for r in recs]
+                      for p, recs in self._panes.items()},
+        }
+
+    def restore(self, state: dict, decode) -> None:
+        """Inverse of :meth:`snapshot`."""
+        self.watermarker._max_ts = int(state["watermark_max_ts"])
+        self.late_dropped = int(state.get("late_dropped", 0))
+        nxt = state.get("next")
+        self._next = None if nxt is None else int(nxt)
+        self._panes = {int(p): [decode(r) for r in recs]
+                       for p, recs in state["panes"].items()}

@@ -380,7 +380,7 @@ class WindowCommitTap:
                  parse: Optional[Callable[[Any], Any]] = None,
                  bulk_decode: Optional[Callable[[List[str]], List[Any]]]
                  = None, bulk_chunk: int = 2048,
-                 dlq=None):
+                 dlq=None, checkpointer=None):
         from collections import deque
 
         if bulk_decode is not None and parse is None:
@@ -395,6 +395,13 @@ class WindowCommitTap:
         self.parse = parse
         self.bulk_decode = bulk_decode
         self.bulk_chunk = max(1, bulk_chunk)
+        #: optional runtime.checkpoint.CheckpointCoordinator: the tap
+        #: reports per-record source positions AT HAND-OFF time (not pull
+        #: time — the chunked decode buffers raws past the source's read
+        #: head, and a checkpoint must never record a position covering
+        #: records still sitting in that buffer)
+        self.checkpointer = checkpointer
+        self._ckpt_key = f"kafka:{source.topic}"
         #: optional runtime.supervisor.DeadLetterQueue: parse failures are
         #: retried against FRESH fetches of the same offset (transport
         #: corruption heals on redelivery) and quarantined — with failure
@@ -461,6 +468,8 @@ class WindowCommitTap:
             return None
 
     def _track(self, obj, position: int):
+        if self.checkpointer is not None:
+            self.checkpointer.note_position(self._ckpt_key, position)
         ts = getattr(obj, "timestamp", None)
         if isinstance(ts, (int, float)):
             lwe = int(ts) - int(ts) % self.slide_ms + self.size_ms

@@ -1,0 +1,199 @@
+"""runtime/state.py unit coverage: TrajStateStore growth/rebase, the
+CheckpointableState save/load round trip (including the mid-save-crash
+leftover-.tmp path), and the checksum/schema hardening of the envelope."""
+
+import json
+import os
+import zipfile
+
+import numpy as np
+import pytest
+
+from spatialflink_tpu.runtime.state import (CheckpointableState,
+                                            CheckpointCorrupt,
+                                            STATE_SCHEMA_VERSION,
+                                            TrajStateStore,
+                                            checkpoint_consumed,
+                                            checkpoint_meta)
+
+
+# ------------------------------------------------------------ round trip
+
+
+def test_save_load_round_trip(tmp_path):
+    cp = CheckpointableState()
+    cp.arrays["a"] = np.arange(12, dtype=np.int32).reshape(3, 4)
+    cp.arrays["b"] = np.linspace(0.0, 1.0, 5, dtype=np.float32)
+    cp.meta = {"consumed": 7, "names": ["x", "y"], "nested": {"k": 1}}
+    path = str(tmp_path / "state.npz")
+    cp.save(path)
+
+    out = CheckpointableState.load(path)
+    assert out.meta == cp.meta
+    assert sorted(out.arrays) == ["a", "b"]
+    np.testing.assert_array_equal(out.arrays["a"], cp.arrays["a"])
+    np.testing.assert_array_equal(out.arrays["b"], cp.arrays["b"])
+    assert checkpoint_consumed(path) == 7
+    assert checkpoint_meta(path)["names"] == ["x", "y"]
+
+
+def test_mid_save_crash_leaves_previous_checkpoint_intact(tmp_path,
+                                                          monkeypatch):
+    """A crash between the tmp write and the rename (simulated by a failing
+    os.replace) must leave the PREVIOUS checkpoint loadable and the .tmp
+    behind — the atomicity contract the coordinator's retention builds on."""
+    path = str(tmp_path / "state.npz")
+    cp = CheckpointableState()
+    cp.arrays["v"] = np.array([1, 2, 3])
+    cp.meta = {"consumed": 3}
+    cp.save(path)
+
+    cp2 = CheckpointableState()
+    cp2.arrays["v"] = np.array([9, 9, 9, 9])
+    cp2.meta = {"consumed": 99}
+    real_replace = os.replace
+
+    def torn_replace(src, dst, *a, **kw):
+        if str(dst) == path:
+            raise OSError("simulated crash mid-rename")
+        return real_replace(src, dst, *a, **kw)
+
+    monkeypatch.setattr(os, "replace", torn_replace)
+    with pytest.raises(OSError, match="mid-rename"):
+        cp2.save(path)
+    monkeypatch.undo()
+
+    assert os.path.exists(path + ".tmp"), "tmp file should be left behind"
+    out = CheckpointableState.load(path)  # previous checkpoint still valid
+    assert out.meta["consumed"] == 3
+    np.testing.assert_array_equal(out.arrays["v"], [1, 2, 3])
+
+
+# ------------------------------------------------------------ corruption
+
+
+def test_truncated_file_raises_checkpoint_corrupt(tmp_path):
+    path = str(tmp_path / "state.npz")
+    cp = CheckpointableState()
+    cp.arrays["v"] = np.arange(1000)
+    cp.save(path)
+    data = open(path, "rb").read()
+    open(path, "wb").write(data[: len(data) // 2])
+    with pytest.raises(CheckpointCorrupt, match="unreadable|checksum"):
+        CheckpointableState.load(path)
+    with pytest.raises(CheckpointCorrupt):
+        checkpoint_consumed(path)
+
+
+def test_garbage_file_raises_checkpoint_corrupt(tmp_path):
+    path = str(tmp_path / "state.npz")
+    open(path, "wb").write(b"not a zip at all")
+    with pytest.raises(CheckpointCorrupt):
+        CheckpointableState.load(path)
+
+
+def test_checksum_mismatch_detected(tmp_path):
+    """Bit-flip an array payload inside the zip: the envelope checksum must
+    catch it (np.load alone would happily return the flipped values)."""
+    path = str(tmp_path / "state.npz")
+    cp = CheckpointableState()
+    cp.arrays["v"] = np.zeros(64, np.int64)
+    cp.meta = {"consumed": 5}
+    cp.save(path)
+
+    tampered = str(tmp_path / "tampered.npz")
+    with zipfile.ZipFile(path) as zin, \
+            zipfile.ZipFile(tampered, "w", zipfile.ZIP_STORED) as zout:
+        for item in zin.infolist():
+            data = zin.read(item.filename)
+            if item.filename == "v.npy":
+                data = data[:-8] + b"\x01" * 8
+            zout.writestr(item.filename, data)
+    with pytest.raises(CheckpointCorrupt, match="checksum"):
+        CheckpointableState.load(tampered)
+
+
+def test_newer_schema_version_refused(tmp_path):
+    path = str(tmp_path / "state.npz")
+    envelope = {"schema": STATE_SCHEMA_VERSION + 1, "checksum": "0" * 64,
+                "meta": {"consumed": 1}}
+    np.savez(path, __meta__=json.dumps(envelope))
+    with pytest.raises(CheckpointCorrupt, match="schema version"):
+        CheckpointableState.load(path)
+
+
+def test_legacy_unversioned_checkpoint_still_loads(tmp_path):
+    """Pre-envelope checkpoints (bare meta JSON, no checksum) must keep
+    loading — they predate the hardening."""
+    path = str(tmp_path / "legacy.npz")
+    np.savez(path, __meta__=json.dumps({"consumed": 4, "capacity": 8}),
+             v=np.arange(3))
+    out = CheckpointableState.load(path)
+    assert out.meta == {"consumed": 4, "capacity": 8}
+    assert checkpoint_consumed(path) == 4
+
+
+def test_checkpoint_consumed_missing_file_is_zero(tmp_path):
+    assert checkpoint_consumed(str(tmp_path / "nope.npz")) == 0
+
+
+# ------------------------------------------------------------ TrajStateStore
+
+
+def test_traj_state_store_ensure_growth_preserves_state():
+    import jax.numpy as jnp
+
+    store = TrajStateStore(capacity=4)
+    marked = store.state._replace(
+        last_ts=store.state.last_ts.at[:4].set(jnp.int32([1, 2, 3, 4])))
+    store.state = marked
+    store.ensure(3)  # no-op below capacity
+    assert store.capacity == 4
+    store.ensure(5)  # power-of-two growth
+    assert store.capacity >= 8 and store.capacity & (store.capacity - 1) == 0
+    np.testing.assert_array_equal(np.asarray(store.state.last_ts[:4]),
+                                  [1, 2, 3, 4])
+    store.ensure(100)
+    assert store.capacity >= 100
+    np.testing.assert_array_equal(np.asarray(store.state.last_ts[:4]),
+                                  [1, 2, 3, 4])
+
+
+def test_traj_state_store_rebase_ts():
+    import jax.numpy as jnp
+
+    from spatialflink_tpu.ops.trajectory import INT32_MIN
+
+    store = TrajStateStore(capacity=4)
+    store.state = store.state._replace(
+        last_ts=jnp.int32([INT32_MIN, 1000, -(2**30) + 5, 2**20]))
+    store.rebase_ts(0)  # no-op
+    np.testing.assert_array_equal(
+        np.asarray(store.state.last_ts),
+        [INT32_MIN, 1000, -(2**30) + 5, 2**20])
+    store.rebase_ts(500)
+    got = np.asarray(store.state.last_ts)
+    assert got[0] == INT32_MIN          # uninitialized sentinel kept
+    assert got[1] == 500                # shifted
+    assert got[2] == -(2**30) + 1       # clamped to the "very old" floor
+    assert got[3] == 2**20 - 500
+    # a huge forward jump clamps everything initialized to the floor
+    store.rebase_ts(2**31)
+    got = np.asarray(store.state.last_ts)
+    assert got[0] == INT32_MIN
+    assert (got[1:] == -(2**30) + 1).all()
+
+
+def test_traj_state_store_snapshot_restore_round_trip(tmp_path):
+    import jax.numpy as jnp
+
+    store = TrajStateStore(capacity=8)
+    store.state = store.state._replace(
+        last_ts=store.state.last_ts.at[0].set(jnp.int32(42)))
+    cp = store.snapshot()
+    path = str(tmp_path / "traj.npz")
+    cp.save(path)
+    restored = TrajStateStore.restore(CheckpointableState.load(path))
+    assert restored.capacity == 8
+    for a, b in zip(restored.state, store.state):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -251,6 +251,53 @@ def bench_checkpoint(option: int, path: str, n: int, every: int) -> list:
     ]
 
 
+def bench_live_plane(option: int, path: str, n: int) -> list:
+    """Overhead of the live operations plane on the record path, three
+    configurations over the same replay: plane OFF, a bound-but-UNQUERIED
+    status server with no telemetry session (the contract is a
+    byte-identical record loop — snapshots are built per HTTP request
+    only, so this must be ~0), and the full plane (telemetry session +
+    status server + live-stats digest thread at an interval longer than
+    the run — the session's per-record instrumentation is the cost)."""
+    from spatialflink_tpu import driver
+    from spatialflink_tpu.runtime.opserver import LiveStats, OpServer
+    from spatialflink_tpu.utils.telemetry import telemetry_session
+
+    def run():
+        p = _params(option)
+        with open(path) as f1:
+            t0 = time.perf_counter()
+            windows = _drain(driver.run_option(p, f1))
+            return windows, time.perf_counter() - t0
+
+    run()  # warm the jit caches all three configurations share
+    windows, dt_off = run()
+    srv = OpServer(port=0).start()
+    try:
+        _, dt_srv = run()
+    finally:
+        srv.close()
+    with telemetry_session():
+        srv = OpServer(port=0).start()
+        live = LiveStats(interval_s=3600.0).start()
+        try:
+            _, dt_full = run()
+        finally:
+            live.close()
+            srv.close()
+    base = dict(option=option, records=n, windows=windows)
+    return [
+        dict(base, path="live_plane_off", wall_s=round(dt_off, 3),
+             records_per_sec=round(n / dt_off)),
+        dict(base, path="status_server_idle", wall_s=round(dt_srv, 3),
+             records_per_sec=round(n / dt_srv),
+             overhead_vs_off=round(dt_srv / dt_off - 1.0, 4)),
+        dict(base, path="live_plane_full", wall_s=round(dt_full, 3),
+             records_per_sec=round(n / dt_full),
+             overhead_vs_off=round(dt_full / dt_off - 1.0, 4)),
+    ]
+
+
 def bench_multi_vs_jobs(option: int, path: str, n: int, q: int) -> list:
     """ONE multiQuery pipeline vs Q sequential single-query pipelines over
     the same replay — the end-to-end form of the 'Q standing queries cost Q
@@ -316,6 +363,11 @@ def main() -> int:
                     help="coordinated-checkpoint overhead rows (record "
                          "path, checkpointing off vs every N windows) over "
                          "the range option. 0 (default) disables them")
+    ap.add_argument("--live-plane", action="store_true",
+                    help="live-operations-plane overhead rows (record "
+                         "path: plane off vs an idle --status-port server "
+                         "vs the full server+session+--live-stats plane) "
+                         "over the range option")
     ap.add_argument("--pane-overlap", type=int, default=0,
                     help="sliding overlap (window = overlap * slide) for "
                          "the pane-incremental vs full-recompute rows over "
@@ -372,6 +424,14 @@ def main() -> int:
                     continue
                 for row in bench_checkpoint(opt, path, n,
                                             args.checkpoint_every):
+                    row["backend"] = backend
+                    print(json.dumps(row), flush=True)
+                    rows.append(row)
+        if args.live_plane:
+            for opt in (1,):
+                if opt not in [int(x) for x in args.options.split(",")]:
+                    continue
+                for row in bench_live_plane(opt, path, n):
                     row["backend"] = backend
                     print(json.dumps(row), flush=True)
                     rows.append(row)

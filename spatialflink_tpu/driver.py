@@ -1268,7 +1268,38 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--telemetry-interval", type=float, default=5.0,
                     metavar="SECONDS",
                     help="seconds between periodic telemetry snapshots "
-                         "(default 5.0)")
+                         "(default 5.0); also the --live-stats digest "
+                         "cadence")
+    ap.add_argument("--status-port", type=int, default=None, metavar="PORT",
+                    help="serve a live in-run status plane on "
+                         "127.0.0.1:PORT (0 = ephemeral, bound port "
+                         "printed): GET /healthz (SLO verdict, 200/503), "
+                         "/status (full JSON snapshot: throughput, latency "
+                         "percentiles, watermark lag, backlogs, pane-cache "
+                         "hit rate, checkpoint age/seq, breaker/DLQ state, "
+                         "hottest cells), /metrics (live Prometheus text), "
+                         "/events (lifecycle event ring). Snapshots are "
+                         "built per request only; without a telemetry "
+                         "session (--telemetry-dir/--live-stats) the "
+                         "record loop stays byte-identical and the plane "
+                         "serves the always-on registry counters")
+    ap.add_argument("--live-stats", action="store_true",
+                    help="print a one-line pipeline digest (throughput, "
+                         "windows, latency p99, watermark lag, backlog, "
+                         "checkpoint age, breaker/DLQ/degradation, health) "
+                         "to stderr every --telemetry-interval seconds; "
+                         "activates a telemetry session. Automatic in "
+                         "--kafka-follow runs that already have one")
+    ap.add_argument("--slo", metavar="SPEC", default=None,
+                    help="health/SLO thresholds as comma-joined key=value "
+                         "pairs, e.g. 'watermark_lag_ms=5000,"
+                         "p99_window_ms=250,commit_backlog=10000,"
+                         "checkpoint_age_s=60'. Drives /healthz (503 on "
+                         "breach), stamps a 'health' verdict into every "
+                         "telemetry snapshot and digest line, counts "
+                         "breach transitions in the slo-breaches counter, "
+                         "and emits slo-breach/slo-recovered (and "
+                         "watermark-stall) lifecycle events")
     ap.add_argument("--profile", metavar="DIR", default=None,
                     help="capture a jax.profiler trace of the run to DIR "
                          "(TensorBoard/XProf format) with per-operator "
@@ -1500,22 +1531,40 @@ def main(argv: Optional[List[str]] = None) -> int:
     if skip1 and limit1 is not None:
         limit1 = max(0, limit1 - skip1)
 
-    if args.telemetry_dir:
+    health = None
+    if args.slo is not None:
+        from spatialflink_tpu.runtime.health import HealthEvaluator
+
+        try:
+            health = HealthEvaluator.from_spec(args.slo)
+        except ValueError as e:
+            ap.error(str(e))
+        if (args.status_port is None and not args.telemetry_dir
+                and not args.live_stats):
+            print("warning: --slo has no consumer without --status-port, "
+                  "--telemetry-dir, or --live-stats (nothing evaluates "
+                  "the thresholds)", file=sys.stderr)
+
+    if args.telemetry_dir or args.live_stats:
         from spatialflink_tpu.utils.telemetry import telemetry_session
 
         # the session must wrap the KAFKA WIRING too (taps/sinks capture
-        # their gauges at construction), not just the result loop
-        with telemetry_session(args.telemetry_dir, args.telemetry_interval):
-            print(f"# telemetry: JSONL snapshots every "
-                  f"{args.telemetry_interval:g}s -> "
-                  f"{os.path.join(args.telemetry_dir, 'telemetry.jsonl')}",
-                  file=sys.stderr)
-            return _run_cli(ap, args, params, spec, skip1, limit1)
-    return _run_cli(ap, args, params, spec, skip1, limit1)
+        # their gauges at construction), not just the result loop.
+        # --live-stats without --telemetry-dir runs a reporterless session
+        # (instrumentation on, digest built from it per interval)
+        with telemetry_session(args.telemetry_dir or None,
+                               args.telemetry_interval, health=health):
+            if args.telemetry_dir:
+                print(f"# telemetry: JSONL snapshots every "
+                      f"{args.telemetry_interval:g}s -> "
+                      f"{os.path.join(args.telemetry_dir, 'telemetry.jsonl')}",
+                      file=sys.stderr)
+            return _run_cli(ap, args, params, spec, skip1, limit1, health)
+    return _run_cli(ap, args, params, spec, skip1, limit1, health)
 
 
 def _run_cli(ap, args, params: Params, spec: CaseSpec, skip1: int,
-             limit1: Optional[int]) -> int:
+             limit1: Optional[int], health=None) -> int:
     """The post-validation half of :func:`main`: wire transport, run the
     pipeline, drain results into the sinks, print summaries. Split out so
     the telemetry session can scope the whole run."""
@@ -1614,6 +1663,25 @@ def _run_cli(ap, args, params: Params, spec: CaseSpec, skip1: int,
     from spatialflink_tpu.utils import telemetry as _telemetry
 
     tel = _telemetry.active()
+    if args.status_port is not None:
+        from spatialflink_tpu.runtime.opserver import OpServer
+
+        # reads the active session (or the registry fallback) per request;
+        # closed by the stack on pipeline exit — including a control-tuple
+        # stop or a crash — so the port never outlives the run
+        opserver = OpServer(port=args.status_port, health=health).start()
+        stack.callback(opserver.close)
+        print(f"# status server: {opserver.url} "
+              "(/healthz /status /metrics /events)", file=sys.stderr)
+    if args.live_stats or (args.kafka_follow and tel is not None):
+        from spatialflink_tpu.runtime.opserver import LiveStats
+
+        # --kafka-follow runs with a telemetry session get the digest
+        # automatically: a live run is exactly where a terminal operator
+        # needs throughput/lag/health without the HTTP server
+        live = LiveStats(interval_s=args.telemetry_interval,
+                         health=health).start()
+        stack.callback(live.close)
     # per-window pipeline latency: wall clock from asking the pipeline for
     # the next result to receiving it (assembly + kernel + readback for
     # that window — the end-to-end number per emitted window)

@@ -352,6 +352,10 @@ class SpatialOperator:
               f"({type(err).__name__}: {str(err)[:200]}); degrading mesh "
               f"{self.conf.devices} -> {new}", file=sys.stderr)
         REGISTRY.counter("mesh-degradations").inc()
+        from spatialflink_tpu.utils.telemetry import emit_event
+
+        emit_event("mesh-degradation", error_type=type(err).__name__,
+                   from_devices=self.conf.devices, to_devices=new)
         self._degradations += 1
         self.conf.devices = new
         # a 2-D mesh drops to flat 1-D: after losing devices the hosts x

@@ -38,6 +38,8 @@ from spatialflink_tpu.runtime.state import (
     CheckpointCorrupt,
     TrajStateStore,
 )
+from spatialflink_tpu.runtime.health import HealthEvaluator
+from spatialflink_tpu.runtime.opserver import LiveStats, OpServer
 
 __all__ = [
     "CheckpointCoordinator",
@@ -59,4 +61,7 @@ __all__ = [
     "RetryError",
     "RetryPolicy",
     "SupervisedBroker",
+    "HealthEvaluator",
+    "LiveStats",
+    "OpServer",
 ]

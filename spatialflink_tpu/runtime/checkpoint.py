@@ -385,10 +385,12 @@ class CheckpointCoordinator:
         REGISTRY.counter("checkpoints-written").inc()
         tel = _telemetry.active()
         if tel is not None:
-            tel.histogram("checkpoint-write-ms").record(
-                (time.perf_counter() - t0) * 1e3)
-            tel.histogram("checkpoint-size-bytes").record(
-                os.path.getsize(path))
+            write_ms = (time.perf_counter() - t0) * 1e3
+            size = os.path.getsize(path)
+            tel.histogram("checkpoint-write-ms").record(write_ms)
+            tel.histogram("checkpoint-size-bytes").record(size)
+            tel.event("checkpoint-committed", seq=self.seq,
+                      write_ms=round(write_ms, 3), size_bytes=size)
             if not self._age_gauge_installed:
                 # callable gauge: snapshots always report the CURRENT age
                 tel.gauge("checkpoint.age-s",
@@ -448,7 +450,10 @@ class CheckpointCoordinator:
                         f"{path}: manifest schema {schema!r} != "
                         f"{MANIFEST_SCHEMA_VERSION}")
             except CheckpointCorrupt as e:
+                from spatialflink_tpu.utils.telemetry import emit_event
+
                 REGISTRY.counter("checkpoint-fallbacks").inc()
+                emit_event("checkpoint-fallback", path=path, error=str(e))
                 print(f"warning: {e}; falling back to the previous "
                       "retained checkpoint", file=sys.stderr)
                 continue
@@ -476,6 +481,10 @@ class CheckpointCoordinator:
             self.seq = int(meta.get("seq", seq))
             self.restored = True
             REGISTRY.counter("checkpoint-restores").inc()
+            from spatialflink_tpu.utils.telemetry import emit_event
+
+            emit_event("checkpoint-restored", seq=self.seq,
+                       positions=dict(self._positions))
             return True
         return False
 

@@ -1,0 +1,161 @@
+"""SLO / health evaluation for the live operations plane.
+
+The reference operator watches Flink's web UI for backpressure and lag and
+decides "healthy or not" by eye; here the judgment is a small configurable
+evaluator (``--slo key=value,...``) over the shared status digest
+(:func:`~spatialflink_tpu.utils.telemetry.status_digest`):
+
+- drives the status server's ``GET /healthz`` code (200 healthy / 503
+  breached) so orchestrators (k8s probes, load balancers) can act on it;
+- is stamped as ``health`` into every telemetry JSONL snapshot and
+  ``/status`` document, so post-hoc analysis sees WHEN the run went
+  unhealthy next to the counters that explain why;
+- counts breach TRANSITIONS (ok -> breached, per check) in the
+  ``slo-breaches`` registry counter and emits ``slo-breach`` /
+  ``slo-recovered`` lifecycle events (plus ``watermark-stall`` for the
+  watermark-lag check — the classic "source alive, event time frozen"
+  incident) into the event ring.
+
+Checks compare one digest field against one threshold. A field that has
+no value yet (gauge never set, histogram empty) is UNKNOWN and counts as
+healthy: a pipeline that has not produced a window yet is starting, not
+breaching, and a probe that 503s during warm-up would flap every
+deployment. All checks breach on ``value > threshold`` except
+``min_throughput_rps`` which breaches on ``value < threshold``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional
+
+
+def _hist_p99(field: str) -> Callable[[dict], Optional[float]]:
+    def get(status: dict) -> Optional[float]:
+        h = status.get(field) or {}
+        return h.get("p99") if h.get("count") else None
+    return get
+
+
+def _gauge(field: str) -> Callable[[dict], Optional[float]]:
+    return lambda status: status.get(field)
+
+
+def _checkpoint_age(status: dict) -> Optional[float]:
+    return (status.get("checkpoint") or {}).get("age_s")
+
+
+def _throughput(status: dict) -> Optional[float]:
+    # rate is 0.0 before the first record; treat a never-started stream as
+    # unknown (records_in == 0), a stalled one (records then silence) as a
+    # real, breachable 0 rps
+    if not status.get("records_in"):
+        return None
+    return status.get("throughput_rps")
+
+
+#: check name -> (extractor over the status digest, breach comparator).
+#: ``hi`` breaches when value > threshold, ``lo`` when value < threshold.
+KNOWN_CHECKS: Dict[str, tuple] = {
+    "watermark_lag_ms": (_gauge("watermark_lag_ms"), "hi"),
+    "p99_window_ms": (_hist_p99("window_latency_ms"), "hi"),
+    "p99_record_ms": (_hist_p99("record_latency_ms"), "hi"),
+    "commit_backlog": (_gauge("commit_backlog"), "hi"),
+    "window_backlog": (_gauge("window_backlog"), "hi"),
+    "checkpoint_age_s": (_checkpoint_age, "hi"),
+    "dlq_depth": (_gauge("dlq_depth"), "hi"),
+    "breaker_state": (_gauge("breaker_state"), "hi"),
+    "min_throughput_rps": (_throughput, "lo"),
+}
+
+
+class HealthEvaluator:
+    """Threshold checks over the status digest; stateful so breach
+    TRANSITIONS (not every unhealthy evaluation) bump the ``slo-breaches``
+    counter and the event ring — an hour-long outage is one breach event,
+    not one per scrape. One instance is shared by the reporter thread, the
+    status server, and the stderr digest (lock-guarded), so they agree on
+    the transition history."""
+
+    def __init__(self, thresholds: Dict[str, float]):
+        unknown = sorted(set(thresholds) - set(KNOWN_CHECKS))
+        if unknown:
+            raise ValueError(
+                f"unknown --slo check(s) {', '.join(unknown)}; known: "
+                + ", ".join(sorted(KNOWN_CHECKS)))
+        if not thresholds:
+            raise ValueError(
+                "--slo needs at least one key=value pair; known checks: "
+                + ", ".join(sorted(KNOWN_CHECKS)))
+        self.thresholds = {k: float(v) for k, v in thresholds.items()}
+        self._breached: Dict[str, bool] = {}
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_spec(cls, spec: str) -> "HealthEvaluator":
+        """Parse ``--slo watermark_lag_ms=5000,p99_window_ms=250,...``."""
+        thresholds: Dict[str, float] = {}
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            key, sep, val = part.partition("=")
+            if not sep:
+                raise ValueError(
+                    f"--slo entry {part!r} is not key=value")
+            try:
+                thresholds[key.strip()] = float(val)
+            except ValueError:
+                raise ValueError(
+                    f"--slo {key.strip()}={val!r} is not numeric")
+        return cls(thresholds)
+
+    def evaluate(self, snap: dict, registry=None) -> dict:
+        """Evaluate every configured check against one snapshot document
+        (its ``status`` digest; computed here if the caller passed a raw
+        snapshot). ``registry`` is where breach transitions count — pass
+        the registry the snapshot was built from (``status_snapshot``
+        does) so ``status.slo_breaches`` and the counter agree even under
+        a pinned/scoped registry; None falls back to the ambient one.
+        Returns the ``health`` stanza stamped into snapshots::
+
+            {"healthy": bool, "status": "ok"|"breach",
+             "checks": {name: {"value", "threshold", "ok"}}}
+        """
+        from spatialflink_tpu.utils import telemetry as _telemetry
+        from spatialflink_tpu.utils import metrics as _metrics
+
+        reg = registry if registry is not None else _metrics.REGISTRY
+        status = snap.get("status")
+        if status is None:
+            status = _telemetry.status_digest(snap)
+        checks: Dict[str, dict] = {}
+        healthy = True
+        with self._lock:
+            for name, threshold in sorted(self.thresholds.items()):
+                extract, direction = KNOWN_CHECKS[name]
+                value = extract(status)
+                ok = True
+                if value is not None:
+                    v = float(value)
+                    ok = (v <= threshold if direction == "hi"
+                          else v >= threshold)
+                checks[name] = {"value": value, "threshold": threshold,
+                                "ok": ok}
+                healthy = healthy and ok
+                was = self._breached.get(name, False)
+                if not ok and not was:
+                    reg.counter("slo-breaches").inc()
+                    _telemetry.emit_event("slo-breach", check=name,
+                                          value=value, threshold=threshold)
+                    if name == "watermark_lag_ms":
+                        _telemetry.emit_event("watermark-stall",
+                                              lag_ms=value,
+                                              threshold=threshold)
+                elif ok and was:
+                    _telemetry.emit_event("slo-recovered", check=name,
+                                          value=value)
+                self._breached[name] = not ok
+        return {"healthy": healthy,
+                "status": "ok" if healthy else "breach",
+                "checks": checks}

@@ -1,0 +1,291 @@
+"""In-run operations plane: the status server and the live stderr digest.
+
+The reference serves a live web UI while the job runs (Flink's dashboard,
+``StreamingJob.java:70-72`` named operators + Dropwizard meters); the
+rebuild's post-hoc telemetry (JSONL snapshots, final ``--metrics``) said
+what HAPPENED but nothing answered "what is it doing RIGHT NOW". This
+module adds that plane, stdlib-only:
+
+- :class:`OpServer` — a threaded HTTP server (``--status-port``; 0 binds
+  an ephemeral port, printed by the driver) serving
+
+  ========== =========================================================
+  endpoint    payload
+  ========== =========================================================
+  /healthz    SLO verdict, ``200`` healthy / ``503`` breached
+  /status     the full shared status snapshot (one JSON document)
+  /metrics    Prometheus text exposition, rendered LIVE per request
+  /events     the lifecycle event ring (checkpoints, breaker, DLQ, SLO)
+  ========== =========================================================
+
+- :class:`LiveStats` — a daemon thread printing a one-line stderr digest
+  per interval (``--live-stats``; automatic under ``--kafka-follow`` when
+  a telemetry session is active), for operators watching a terminal
+  instead of curl.
+
+Both consume :func:`~spatialflink_tpu.utils.telemetry.status_snapshot` —
+the SAME document the telemetry reporter writes as JSONL — and build it
+only on request / per interval, never per record. With no telemetry
+session active the server still serves the always-on registry counters
+(and ``/healthz`` evaluates whatever checks have data) while the record
+loop stays byte-identical to the uninstrumented path; spans, histograms,
+gauges, and events need a session (``--telemetry-dir`` / ``--live-stats``).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from spatialflink_tpu.utils import telemetry as _telemetry
+
+#: the one server the current process runs (the driver starts at most one);
+#: lets in-process tooling/tests discover the ephemeral port without
+#: scraping stderr
+_ACTIVE_SERVER: Optional["OpServer"] = None
+
+
+def active_server() -> Optional["OpServer"]:
+    """The process's running :class:`OpServer`, or None."""
+    return _ACTIVE_SERVER
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "spatialflink-opserver/1"
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # quiet: stderr belongs to the digest
+        pass
+
+    def _send(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        # one response per connection: a kept-alive handler loop would
+        # survive close() (shutdown() stops only the LISTENER) and keep
+        # answering probes after the pipeline exited — the plane must die
+        # with the run, so every response closes its connection
+        self.send_header("Connection", "close")
+        self.close_connection = True
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, code: int, payload: dict) -> None:
+        self._send(code, json.dumps(payload, sort_keys=True).encode(),
+                   "application/json")
+
+    def do_GET(self) -> None:  # noqa: N802 (http.server API)
+        srv: "OpServer" = self.server.opserver  # type: ignore[attr-defined]
+        srv.requests_served += 1
+        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        try:
+            if path == "/healthz":
+                code, payload = srv.healthz_payload()
+                self._send_json(code, payload)
+            elif path == "/status":
+                self._send_json(200, srv.status_payload())
+            elif path == "/metrics":
+                self._send(200, srv.metrics_text().encode(),
+                           "text/plain; version=0.0.4")
+            elif path == "/events":
+                self._send_json(200, srv.events_payload())
+            else:
+                self._send_json(404, {
+                    "error": f"unknown path {path!r}",
+                    "endpoints": ["/healthz", "/status", "/metrics",
+                                  "/events"]})
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # client went away mid-write (Ctrl-C'd curl sends RST)
+        except Exception as e:
+            # a payload bug must 500 the one request, not traceback onto
+            # the stderr the handler deliberately keeps quiet
+            try:
+                self._send_json(500, {"error": repr(e)})
+            except Exception:
+                pass
+
+
+class OpServer:
+    """Threaded in-run status server. ``port=0`` binds an ephemeral port
+    (read it back from :attr:`port`). Binds loopback by default — the
+    plane exposes operational detail, not a public API. Request handling
+    is read-only: every endpoint renders a fresh document from the active
+    telemetry session (or the registry fallback) at request time, so an
+    unqueried server costs the pipeline nothing."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 telemetry=None, health=None, registry=None):
+        self._requested_port = int(port)
+        self.host = host
+        #: pinned session; None = read the active session per request (the
+        #: driver's default — the server outlives no session but may start
+        #: before one's first snapshot)
+        self.telemetry = telemetry
+        #: SLO evaluator for /healthz when no session carries one
+        self.health = health
+        self.registry = registry
+        self.port: Optional[int] = None
+        self.requests_served = 0
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------- endpoint payloads ----------------------- #
+    # (public: tests and in-process tooling call these without HTTP)
+
+    def _tel(self):
+        return (self.telemetry if self.telemetry is not None
+                else _telemetry.active())
+
+    def status_payload(self) -> dict:
+        # pinned-vs-active and explicit-vs-session-health resolution lives
+        # in status_snapshot — ONE authority shared with the reporter and
+        # the digest, not re-implemented per consumer
+        return _telemetry.status_snapshot(self.telemetry, health=self.health,
+                                          registry=self.registry)
+
+    def healthz_payload(self):
+        """(http_code, payload): 200 when every configured check passes
+        (or no evaluator is configured — a bare liveness probe), 503
+        otherwise."""
+        snap = self.status_payload()
+        verdict = snap.get("health")
+        if verdict is None:
+            return 200, {"healthy": True, "status": "ok", "checks": {}}
+        return (200 if verdict["healthy"] else 503), verdict
+
+    def metrics_text(self) -> str:
+        return _telemetry.prometheus_text(self._tel(), registry=self.registry)
+
+    def events_payload(self) -> dict:
+        tel = self._tel()
+        if tel is None:
+            return {"events": [], "total": 0,
+                    "note": "lifecycle events need a telemetry session "
+                            "(--telemetry-dir / --live-stats)"}
+        return {"events": tel.events.list(), "total": tel.events.total}
+
+    # ------------------------------ lifecycle -------------------------- #
+
+    def start(self) -> "OpServer":
+        global _ACTIVE_SERVER
+        self._httpd = ThreadingHTTPServer((self.host, self._requested_port),
+                                          _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.opserver = self  # type: ignore[attr-defined]
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="opserver", daemon=True)
+        self._thread.start()
+        _ACTIVE_SERVER = self
+        return self
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def close(self) -> None:
+        global _ACTIVE_SERVER
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        if _ACTIVE_SERVER is self:
+            _ACTIVE_SERVER = None
+
+
+# --------------------------------------------------------------------- #
+# the stderr digest
+
+
+_BREAKER_NAMES = {0.0: "closed", 0.5: "half-open", 1.0: "open"}
+
+
+def format_digest(snap: dict) -> str:
+    """One stderr line from one status snapshot — the terminal operator's
+    view of the same document ``/status`` serves. Fields with no data yet
+    are omitted rather than printed as None/0 noise."""
+    st = snap.get("status") or {}
+    parts = []
+    up = snap.get("uptime_s")
+    if up is not None:
+        parts.append(f"up {up:.0f}s")
+    parts.append(f"in {st.get('records_in', 0)} rec "
+                 f"({st.get('throughput_rps', 0.0):.0f}/s)")
+    parts.append(f"win {st.get('windows_evaluated', 0)}")
+    wl = st.get("window_latency_ms") or {}
+    if wl.get("count"):
+        parts.append(f"win p99 {wl['p99']:.0f}ms")
+    if st.get("watermark_lag_ms") is not None:
+        parts.append(f"wm lag {st['watermark_lag_ms']:.0f}ms")
+    if st.get("commit_backlog") is not None:
+        parts.append(f"backlog {st['commit_backlog']:.0f}")
+    pc = st.get("pane_cache") or {}
+    if pc.get("hit_rate") is not None:
+        parts.append(f"pane hit {pc['hit_rate'] * 100:.0f}%")
+    ck = st.get("checkpoint") or {}
+    if ck.get("seq") is not None:
+        parts.append(f"ckpt #{int(ck['seq'])} age {ck.get('age_s', 0):.1f}s")
+    if st.get("breaker_state") is not None:
+        parts.append("breaker " + _BREAKER_NAMES.get(
+            st["breaker_state"], str(st["breaker_state"])))
+    if st.get("dlq_depth"):
+        parts.append(f"dlq {st['dlq_depth']}")
+    deg = snap.get("degradation") or {}
+    if deg:
+        parts.append(f"degraded x{sum(deg.values())}")
+    health = snap.get("health")
+    if health is not None:
+        bad = [n for n, c in health["checks"].items() if not c["ok"]]
+        parts.append("health " + health["status"]
+                     + (f" ({','.join(bad)})" if bad else ""))
+    return "# live: " + " | ".join(parts)
+
+
+class LiveStats:
+    """Daemon thread printing :func:`format_digest` to stderr — once
+    immediately at :meth:`start` (so even a short run shows a line), then
+    per ``interval_s``. Reads ``sys.stderr`` at print time so pytest's
+    capture and shell redirection both see the lines."""
+
+    def __init__(self, interval_s: float = 5.0, telemetry=None, health=None,
+                 registry=None):
+        self.interval_s = max(0.01, float(interval_s))
+        self.telemetry = telemetry
+        self.health = health
+        self.registry = registry
+        self.emitted = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _tick(self) -> None:
+        # pinned/active and explicit/session-health fallbacks are
+        # status_snapshot's job (same resolution as /status and the
+        # reporter)
+        snap = _telemetry.status_snapshot(self.telemetry, health=self.health,
+                                          registry=self.registry)
+        print(format_digest(snap), file=sys.stderr, flush=True)
+        self.emitted += 1
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self._tick()
+
+    def start(self) -> "LiveStats":
+        self._tick()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="live-stats")
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.interval_s + 5.0)
+            self._thread = None
+        self._tick()  # final line: the run's closing state

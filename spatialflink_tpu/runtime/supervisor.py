@@ -394,7 +394,10 @@ class DeadLetterQueue:
     def quarantine(self, *, source_topic: str, offset: int, raw,
                    error: BaseException, attempts: int) -> None:
         from spatialflink_tpu.utils.metrics import REGISTRY
+        from spatialflink_tpu.utils.telemetry import emit_event
 
+        emit_event("dlq-quarantine", topic=source_topic, offset=int(offset),
+                   error_type=type(error).__name__, attempts=int(attempts))
         self.broker.produce(
             self.topic,
             json.dumps({
@@ -444,6 +447,9 @@ class SupervisedBroker:
         self.retry = retry or RetryPolicy()
         self.breaker = breaker or CircuitBreaker()
         self._sleep = sleep
+        #: last breaker state reported to telemetry — transitions (and only
+        #: transitions) become lifecycle events in the ring
+        self._breaker_reported = self.breaker.state
 
     @classmethod
     def from_spec(cls, inner, spec: str) -> "SupervisedBroker":
@@ -478,8 +484,13 @@ class SupervisedBroker:
 
     def _note_breaker(self, tel) -> None:
         if tel is not None:
+            state = self.breaker.state
             tel.gauge("broker.breaker-state").set(
-                self._BREAKER_STATES[self.breaker.state])
+                self._BREAKER_STATES[state])
+            if state != self._breaker_reported:
+                # "breaker-open" / "breaker-half-open" / "breaker-closed"
+                tel.event(f"breaker-{state}", trips=self.breaker.trips)
+                self._breaker_reported = state
 
     def _call(self, fn: Callable, *args, label: str = "call", **kwargs):
         from spatialflink_tpu.utils import telemetry as _telemetry

@@ -461,6 +461,9 @@ class WindowCommitTap:
                     last = e2
                     continue
                 REGISTRY.counter("dlq-redelivery-healed").inc()
+                _telemetry.emit_event("dlq-redelivery-healed",
+                                      topic=self.source.topic, offset=offset,
+                                      attempts=attempts)
                 return obj
             self.dlq.quarantine(source_topic=self.source.topic,
                                 offset=offset, raw=raw, error=last,

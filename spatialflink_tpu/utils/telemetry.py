@@ -24,11 +24,27 @@ dimensions, all host-side and all O(1) per observation:
   hot-spot signal the reference reads off Flink's backpressure UI.
 - :class:`TelemetryReporter` — a daemon thread emitting one JSONL snapshot
   to ``--telemetry-dir`` immediately, every ``--telemetry-interval``
-  seconds, and at close (so even a short run yields >= 2 snapshots), plus a
-  final Prometheus text-format dump (``metrics.prom``). Snapshots embed the
-  ambient registry's counters AND :func:`~.metrics.degradation_snapshot`,
-  so PR 1's retry/breaker/DLQ events correlate with stage timings by
-  timestamp in one stream.
+  seconds, and at close (so even a short run yields >= 2 snapshots), and
+  REWRITING the Prometheus text dump (``metrics.prom``) on every snapshot
+  so a file-pointed scraper sees live values, not only the final state.
+  Snapshots embed the ambient registry's counters AND
+  :func:`~.metrics.degradation_snapshot`, so PR 1's retry/breaker/DLQ
+  events correlate with stage timings by timestamp in one stream.
+- :class:`EventRing` / :func:`emit_event` — a bounded ring of structured
+  lifecycle events (checkpoint committed/fallback, breaker transitions,
+  DLQ quarantine, mesh degradation, SLO breach/recovery) served by the
+  status server's ``/events`` endpoint and dropped for free when no
+  session is active.
+- :func:`status_snapshot` / :func:`status_digest` — THE definition of
+  "current pipeline state": the raw snapshot plus a derived operator
+  digest (throughput, latency percentiles, watermark lag, backlogs,
+  pane-cache hit rate, checkpoint age/seq, breaker/DLQ/mesh state, top
+  cells) shared verbatim by the reporter's JSONL lines, the status
+  server's ``/status``, and the ``--live-stats`` stderr digest — one
+  schema, three consumers. With no active session it degrades to a
+  registry-only view (the always-on counters/meters), so a bare
+  ``--status-port`` run serves real numbers while the record loop stays
+  byte-identical to the uninstrumented path.
 
 OFF BY DEFAULT: :func:`active` returns None until a
 :func:`telemetry_session` is entered, and every instrumented hot path
@@ -285,6 +301,33 @@ class CellOccupancy:
                 "top_cells": self.top_k(k)}
 
 
+class EventRing:
+    """Bounded ring buffer of structured lifecycle events. Appends are
+    O(1) and lock-guarded (emitters live on pipeline, reporter, and HTTP
+    threads); ``list()`` copies so readers never hold the lock while
+    serializing. ``total`` counts every event ever appended, including
+    those the ring has since evicted."""
+
+    def __init__(self, capacity: int = 256):
+        from collections import deque
+
+        self._ring = deque(maxlen=max(1, int(capacity)))
+        self._lock = threading.Lock()
+        self.total = 0
+
+    def append(self, kind: str, **fields) -> dict:
+        ev = {"ts_ms": int(time.time() * 1000), "kind": kind}
+        ev.update(fields)
+        with self._lock:
+            self._ring.append(ev)
+            self.total += 1
+        return ev
+
+    def list(self) -> List[dict]:
+        with self._lock:
+            return list(self._ring)
+
+
 class Telemetry:
     """One session's span/histogram/gauge/occupancy state.
 
@@ -303,9 +346,20 @@ class Telemetry:
         self.histograms: Dict[str, StreamingHistogram] = {}
         self.gauges: Dict[str, Gauge] = {}
         self.cells = CellOccupancy()
+        self.events = EventRing()
+        #: optional runtime.health.HealthEvaluator attached by the driver
+        #: (--slo): status_snapshot() stamps its verdict into every
+        #: snapshot this session emits
+        self.health = None
         self.started_at = time.time()
         self._lock = threading.Lock()
         self._tls = threading.local()
+
+    def event(self, kind: str, **fields) -> None:
+        """Record one structured lifecycle event (see :class:`EventRing`).
+        Emitters are stage boundaries (checkpoint commits, breaker
+        transitions, quarantines), never per-record paths."""
+        self.events.append(kind, **fields)
 
     # ------------------------------ spans ---------------------------- #
 
@@ -418,21 +472,143 @@ def span(stage: str, query: Optional[str] = None):
     return tel.span(stage, query) if tel is not None else _NULL_CM
 
 
+def emit_event(kind: str, **fields) -> None:
+    """Append a lifecycle event to the active session's ring; a no-op when
+    telemetry is off (one attribute read — safe at stage boundaries even
+    in uninstrumented runs)."""
+    tel = _ACTIVE
+    if tel is not None:
+        tel.event(kind, **fields)
+
+
+# --------------------------------------------------------------------- #
+# the shared "current pipeline state" snapshot (reporter JSONL lines, the
+# status server's /status, and the --live-stats stderr digest all render
+# exactly this — one schema definition)
+
+def _hist_digest(hists: dict, name: str) -> dict:
+    h = hists.get(name)
+    if not h or not h.get("count"):
+        return {"count": 0}
+    return {k: h.get(k) for k in ("count", "p50", "p95", "p99", "max")}
+
+
+def status_digest(snap: dict) -> dict:
+    """Derive the compact operator view from a raw snapshot dict: the
+    numbers an operator reads FIRST, by name, instead of fishing them out
+    of the spans/histograms/gauges/counters maps. Keys are stable schema
+    (ARCHITECTURE.md § Live operations); absent instruments render as
+    None / zero-count, never as missing keys."""
+    counters = snap.get("counters") or {}
+    gauges = snap.get("gauges") or {}
+    hists = snap.get("histograms") or {}
+    grid = snap.get("grid") or {}
+    hits = int(counters.get("pane-cache-hits", 0))
+    misses = int(counters.get("pane-cache-misses", 0))
+    return {
+        "records_in": int(counters.get("ingest-throughput.count", 0)),
+        "throughput_rps": round(
+            float(counters.get("ingest-throughput.rate", 0.0)), 3),
+        "windows_evaluated": int(counters.get("batches-evaluated", 0)),
+        "record_latency_ms": _hist_digest(hists, "record-latency-ms"),
+        "window_latency_ms": _hist_digest(hists, "window-latency-ms"),
+        "watermark_lag_ms": gauges.get("kafka.watermark-lag-ms"),
+        "commit_backlog": gauges.get("kafka.commit-backlog"),
+        "window_backlog": gauges.get("window-backlog"),
+        "pane_cache": {
+            "hits": hits, "misses": misses,
+            "hit_rate": (round(hits / (hits + misses), 4)
+                         if hits + misses else None),
+        },
+        "checkpoint": {
+            "seq": gauges.get("checkpoint.seq"),
+            "age_s": (round(gauges["checkpoint.age-s"], 3)
+                      if "checkpoint.age-s" in gauges else None),
+            "written": int(counters.get("checkpoints-written", 0)),
+            "replay_depth": gauges.get("recovery.replay-depth"),
+            "write_ms": _hist_digest(hists, "checkpoint-write-ms"),
+            "size_bytes": _hist_digest(hists, "checkpoint-size-bytes"),
+        },
+        "breaker_state": gauges.get("broker.breaker-state"),
+        "dlq_depth": int(counters.get("dlq-records", 0)),
+        "mesh_degradations": int(counters.get("mesh-degradations", 0)),
+        "slo_breaches": int(counters.get("slo-breaches", 0)),
+        "top_cells": grid.get("top_cells", []),
+    }
+
+
+def registry_snapshot(registry: Optional[_metrics.MetricsRegistry] = None
+                      ) -> dict:
+    """A snapshot with the raw-snapshot SHAPE built from the always-on
+    metrics registry alone — what a bare ``--status-port`` run (no
+    telemetry session) serves. Spans/histograms/gauges are empty by
+    construction: populating them needs the per-record instrumentation a
+    session activates, and the no-session contract is a byte-identical
+    record loop."""
+    reg = registry if registry is not None else _metrics.REGISTRY
+    return {
+        "ts_ms": int(time.time() * 1000),
+        "uptime_s": None,
+        "spans": {},
+        "histograms": {},
+        "gauges": {},
+        "counters": reg.snapshot(),
+        "degradation": _metrics.degradation_snapshot(reg),
+        "grid": {},
+    }
+
+
+def status_snapshot(tel: Optional[Telemetry] = None, health=None,
+                    registry: Optional[_metrics.MetricsRegistry] = None
+                    ) -> dict:
+    """One full "current pipeline state" document: the raw snapshot (or
+    the registry-only fallback), the derived ``status`` digest, and —
+    when an SLO evaluator is attached (explicitly or on the session) —
+    the ``health`` verdict. Built ON DEMAND only: per HTTP request, per
+    reporter interval, per digest line; never per record."""
+    tel = tel if tel is not None else _ACTIVE
+    snap = tel.snapshot() if tel is not None else registry_snapshot(registry)
+    snap["status"] = status_digest(snap)
+    if health is None and tel is not None:
+        health = tel.health
+    if health is not None:
+        # evaluated AFTER the digest so checks read the same numbers the
+        # operator sees; breach transitions count in the SAME registry the
+        # snapshot was built from (a pinned/scoped registry must see its
+        # own slo-breaches), landing in the NEXT snapshot's status
+        reg = (tel._registry() if tel is not None
+               else registry if registry is not None else _metrics.REGISTRY)
+        snap["health"] = health.evaluate(snap, registry=reg)
+    return snap
+
+
 # --------------------------------------------------------------------- #
 # reporter
 
-def prometheus_text(tel: Telemetry) -> str:
+def prometheus_text(tel: Optional[Telemetry] = None,
+                    registry: Optional[_metrics.MetricsRegistry] = None
+                    ) -> str:
     """Prometheus text exposition of a session: spans as count/total/max
     seconds, histograms as count/sum plus p50/p95/p99 quantile gauges,
     gauges and registry counters as-is. Metric names are fixed; the
     span/histogram/counter name rides a label (dots and dashes are legal
-    in label VALUES, so the query-scoped names survive unmangled)."""
+    in label VALUES, so the query-scoped names survive unmangled).
+    ``tel=None`` renders the registry-only view (counter families only) —
+    the no-session ``/metrics`` endpoint. Rendered live by both the
+    reporter (every snapshot rewrites ``metrics.prom``) and the status
+    server's ``/metrics`` — one renderer, two transports."""
     lines: List[str] = []
 
     def emit(metric: str, mtype: str, rows: List[Tuple[str, float]]):
         lines.append(f"# TYPE {metric} {mtype}")
         for labels, v in rows:
             lines.append(f"{metric}{{{labels}}} {v}")
+
+    if tel is None:
+        reg = registry if registry is not None else _metrics.REGISTRY
+        emit("spatialflink_counter", "counter",
+             [(f'name="{n}"', v) for n, v in sorted(reg.snapshot().items())])
+        return "\n".join(lines) + "\n"
 
     snap_reg = tel._registry()
     with tel._lock:
@@ -466,10 +642,14 @@ def prometheus_text(tel: Telemetry) -> str:
 
 
 class TelemetryReporter:
-    """Daemon thread writing JSONL snapshots to ``<out_dir>/telemetry.jsonl``:
-    one immediately at :meth:`start`, one per ``interval_s``, one final at
-    :meth:`close` (so every run yields >= 2), then a Prometheus text dump to
-    ``<out_dir>/metrics.prom``."""
+    """Daemon thread writing shared-schema :func:`status_snapshot` JSONL
+    lines to ``<out_dir>/telemetry.jsonl`` — one immediately at
+    :meth:`start`, one per ``interval_s``, one final at :meth:`close` (so
+    every run yields >= 2) — and REWRITING the Prometheus text dump
+    ``<out_dir>/metrics.prom`` on every snapshot (atomic tmp+rename, so a
+    scraper tailing the file never reads a torn exposition). Each line
+    embeds the derived ``status`` digest and, when the session carries an
+    SLO evaluator, the ``health`` verdict."""
 
     def __init__(self, telemetry: Telemetry, out_dir: str,
                  interval_s: float = 5.0):
@@ -483,10 +663,14 @@ class TelemetryReporter:
         self._thread: Optional[threading.Thread] = None
 
     def _emit(self) -> None:
-        snap = self.telemetry.snapshot()
+        snap = status_snapshot(self.telemetry)
         with open(self.jsonl_path, "a") as f:
             f.write(json.dumps(snap, sort_keys=True) + "\n")
         self.snapshots_written += 1
+        tmp = self.prom_path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(prometheus_text(self.telemetry))
+        os.replace(tmp, self.prom_path)
 
     def _loop(self) -> None:
         while not self._stop.wait(self.interval_s):
@@ -505,21 +689,23 @@ class TelemetryReporter:
             self._thread.join(timeout=self.interval_s + 5.0)
             self._thread = None
         self._emit()
-        with open(self.prom_path, "w") as f:
-            f.write(prometheus_text(self.telemetry))
 
 
 @contextlib.contextmanager
 def telemetry_session(out_dir: Optional[str] = None, interval_s: float = 5.0,
-                      registry: Optional[_metrics.MetricsRegistry] = None):
+                      registry: Optional[_metrics.MetricsRegistry] = None,
+                      health=None):
     """Activate telemetry for the enclosed block: installs the
     :class:`Telemetry` as the active session, hooks the grid's cell-
     assignment observer, and (when ``out_dir`` is given) runs a
-    :class:`TelemetryReporter`. Everything is restored on exit — including
-    after an exception — so a crashed run still gets its final snapshot."""
+    :class:`TelemetryReporter`. ``health`` attaches an SLO evaluator
+    (``runtime.health.HealthEvaluator``) so every snapshot carries its
+    verdict. Everything is restored on exit — including after an
+    exception — so a crashed run still gets its final snapshot."""
     from spatialflink_tpu.index import uniform_grid as _ug
 
     tel = Telemetry(registry)
+    tel.health = health
     old = set_active(tel)
     old_obs = _ug._CELL_OBSERVER
     _ug._CELL_OBSERVER = tel.record_cells

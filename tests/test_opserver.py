@@ -1,0 +1,556 @@
+"""Live operations plane tests: the status server's endpoint schemas and
+lifecycle, SLO/health evaluation (incl. the 200 -> 503 /healthz flip and
+the breach counter/events), the shared status-snapshot digest, the live
+Prometheus rewrite, and the driver acceptance runs — a live --kafka-follow
+run under --chaos with mid-run endpoint fetches, periodic stderr digests,
+and health-stamped JSONL snapshots."""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+import yaml
+
+from spatialflink_tpu.index import UniformGrid
+from spatialflink_tpu.models import Point
+from spatialflink_tpu.runtime.health import KNOWN_CHECKS, HealthEvaluator
+from spatialflink_tpu.runtime.opserver import (LiveStats, OpServer,
+                                               active_server, format_digest)
+from spatialflink_tpu.streams.formats import serialize_spatial
+from spatialflink_tpu.utils import telemetry as telemetry_mod
+from spatialflink_tpu.utils.metrics import scoped_registry
+from spatialflink_tpu.utils.telemetry import (EventRing, emit_event,
+                                              registry_snapshot,
+                                              status_snapshot,
+                                              telemetry_session)
+
+pytestmark = pytest.mark.liveops
+
+GRID = UniformGrid(115.5, 117.6, 39.6, 41.1, num_grid_partitions=100)
+
+RAW_KEYS = {"ts_ms", "uptime_s", "spans", "histograms", "gauges",
+            "counters", "degradation", "grid"}
+STATUS_KEYS = {"records_in", "throughput_rps", "windows_evaluated",
+               "record_latency_ms", "window_latency_ms", "watermark_lag_ms",
+               "commit_backlog", "window_backlog", "pane_cache",
+               "checkpoint", "breaker_state", "dlq_depth",
+               "mesh_degradations", "slo_breaches", "top_cells"}
+
+
+def _get(url, timeout=5):
+    """(status_code, parsed-or-text body) for one GET, 4xx/5xx included."""
+    try:
+        resp = urllib.request.urlopen(url, timeout=timeout)
+        code, body = resp.status, resp.read()
+        ctype = resp.headers.get("Content-Type", "")
+    except urllib.error.HTTPError as e:
+        code, body = e.code, e.read()
+        ctype = e.headers.get("Content-Type", "")
+    if "json" in ctype:
+        return code, json.loads(body)
+    return code, body.decode()
+
+
+class TestHealthEvaluator:
+    def test_spec_parsing(self):
+        h = HealthEvaluator.from_spec(
+            "watermark_lag_ms=5000, p99_window_ms=250,commit_backlog=1e4")
+        assert h.thresholds == {"watermark_lag_ms": 5000.0,
+                                "p99_window_ms": 250.0,
+                                "commit_backlog": 10000.0}
+
+    def test_unknown_check_names_the_known_set(self):
+        with pytest.raises(ValueError, match="watermark_lag_ms"):
+            HealthEvaluator.from_spec("wobble=3")
+        with pytest.raises(ValueError, match="key=value"):
+            HealthEvaluator.from_spec("watermark_lag_ms")
+        with pytest.raises(ValueError, match="not numeric"):
+            HealthEvaluator.from_spec("watermark_lag_ms=fast")
+        with pytest.raises(ValueError, match="at least one"):
+            HealthEvaluator.from_spec("")
+
+    def test_missing_data_is_healthy_not_breached(self):
+        # a pipeline that has not produced a window/gauge yet is starting
+        # up, not breaching — every check must tolerate absent instruments
+        # (scoped registry: earlier suites' global dlq/degradation counters
+        # must not masquerade as this fresh pipeline's state)
+        h = HealthEvaluator({k: 1.0 for k in KNOWN_CHECKS})
+        with scoped_registry():
+            verdict = h.evaluate(registry_snapshot())
+        assert verdict["healthy"] and verdict["status"] == "ok"
+        assert set(verdict["checks"]) == set(KNOWN_CHECKS)
+        # gauge/histogram-backed checks read None (never set); the
+        # counter-backed ones (dlq_depth, ...) legitimately read 0
+        assert all(c["ok"] and c["value"] in (None, 0)
+                   for c in verdict["checks"].values())
+
+    def test_breach_transition_counts_once_and_recovers(self):
+        with scoped_registry() as reg, telemetry_session() as tel:
+            h = HealthEvaluator.from_spec("watermark_lag_ms=10")
+            tel.gauge("kafka.watermark-lag-ms").set(50.0)
+            for _ in range(3):  # sustained breach = ONE transition
+                verdict = h.evaluate(tel.snapshot())
+                assert not verdict["healthy"]
+                assert verdict["status"] == "breach"
+                assert verdict["checks"]["watermark_lag_ms"] == {
+                    "value": 50.0, "threshold": 10.0, "ok": False}
+            assert reg.counter("slo-breaches").count == 1
+            kinds = [e["kind"] for e in tel.events.list()]
+            assert kinds == ["slo-breach", "watermark-stall"]
+            tel.gauge("kafka.watermark-lag-ms").set(2.0)
+            assert h.evaluate(tel.snapshot())["healthy"]
+            assert tel.events.list()[-1]["kind"] == "slo-recovered"
+            # re-breach is a NEW transition
+            tel.gauge("kafka.watermark-lag-ms").set(99.0)
+            h.evaluate(tel.snapshot())
+            assert reg.counter("slo-breaches").count == 2
+
+    def test_min_throughput_breaches_low_not_high(self):
+        with scoped_registry() as reg:
+            h = HealthEvaluator.from_spec("min_throughput_rps=100")
+            # no records yet -> unknown -> healthy (startup, not a stall)
+            assert h.evaluate(registry_snapshot())["healthy"]
+            reg.meter("ingest-throughput").mark(5)  # ~5 rec total, low rate
+            verdict = h.evaluate(registry_snapshot())
+            assert not verdict["healthy"]
+
+
+class TestStatusSnapshot:
+    def test_digest_surfaces_operator_fields(self):
+        with scoped_registry() as reg, telemetry_session() as tel:
+            reg.counter("pane-cache-hits").inc(30)
+            reg.counter("pane-cache-misses").inc(10)
+            reg.counter("checkpoints-written").inc(2)
+            reg.counter("dlq-records").inc(1)
+            reg.counter("batches-evaluated").inc(7)
+            reg.meter("ingest-throughput").mark(100)
+            tel.gauge("checkpoint.seq").set(2.0)
+            tel.gauge("checkpoint.age-s").set(1.25)
+            tel.gauge("broker.breaker-state").set(0.5)
+            tel.gauge("kafka.watermark-lag-ms").set(42.0)
+            tel.histogram("window-latency-ms").record(8.0)
+            tel.record_cells(__import__("numpy").array([3, 3, 9]))
+            snap = status_snapshot(tel)
+        assert RAW_KEYS <= set(snap)
+        st = snap["status"]
+        assert set(st) == STATUS_KEYS
+        assert st["pane_cache"] == {"hits": 30, "misses": 10,
+                                    "hit_rate": 0.75}
+        assert st["checkpoint"]["seq"] == 2.0
+        assert st["checkpoint"]["age_s"] == 1.25
+        assert st["checkpoint"]["written"] == 2
+        assert st["breaker_state"] == 0.5
+        assert st["dlq_depth"] == 1
+        assert st["records_in"] == 100
+        assert st["windows_evaluated"] == 7
+        assert st["watermark_lag_ms"] == 42.0
+        assert st["window_latency_ms"]["count"] == 1
+        assert st["top_cells"][0][0] == 3
+        # the whole document is JSON-serializable as-is
+        json.dumps(snap)
+
+    def test_registry_only_fallback_without_session(self):
+        assert telemetry_mod.active() is None
+        with scoped_registry() as reg:
+            reg.counter("batches-evaluated").inc(3)
+            snap = status_snapshot()
+        assert RAW_KEYS <= set(snap)
+        assert snap["uptime_s"] is None and snap["spans"] == {}
+        assert snap["counters"]["batches-evaluated"] == 3
+        assert snap["status"]["windows_evaluated"] == 3
+        assert snap["status"]["watermark_lag_ms"] is None
+
+    def test_health_stamped_from_session(self):
+        h = HealthEvaluator.from_spec("dlq_depth=0")
+        with scoped_registry() as reg, telemetry_session(health=h) as tel:
+            assert status_snapshot(tel)["health"]["healthy"]
+            reg.counter("dlq-records").inc()
+            assert status_snapshot(tel)["health"]["status"] == "breach"
+
+    def test_format_digest_one_line(self):
+        with scoped_registry(), telemetry_session() as tel:
+            tel.gauge("kafka.watermark-lag-ms").set(130.0)
+            line = format_digest(status_snapshot(tel))
+        assert line.startswith("# live: ") and "\n" not in line
+        assert "wm lag 130ms" in line
+
+
+class TestEventRing:
+    def test_capacity_eviction_and_total(self):
+        ring = EventRing(capacity=4)
+        for i in range(10):
+            ring.append("e", i=i)
+        evs = ring.list()
+        assert len(evs) == 4 and ring.total == 10
+        assert [e["i"] for e in evs] == [6, 7, 8, 9]
+        assert all("ts_ms" in e and e["kind"] == "e" for e in evs)
+
+    def test_emit_event_noop_without_session(self):
+        assert telemetry_mod.active() is None
+        emit_event("orphan", x=1)  # must not raise, must not record
+        with telemetry_session() as tel:
+            emit_event("kept", x=2)
+            assert [e["kind"] for e in tel.events.list()] == ["kept"]
+
+
+class TestOpServer:
+    def test_endpoints_schemas_ephemeral_port_and_shutdown(self):
+        with scoped_registry() as reg, telemetry_session() as tel:
+            reg.counter("batches-evaluated").inc(5)
+            tel.event("checkpoint-committed", seq=1)
+            srv = OpServer(port=0).start()
+            try:
+                assert srv.port and srv.port > 0  # ephemeral bind
+                assert active_server() is srv
+                code, health = _get(srv.url + "/healthz")
+                assert code == 200 and health == {
+                    "healthy": True, "status": "ok", "checks": {}}
+                code, status = _get(srv.url + "/status")
+                assert code == 200
+                assert RAW_KEYS | {"status"} <= set(status)
+                assert status["status"]["windows_evaluated"] == 5
+                code, prom = _get(srv.url + "/metrics")
+                assert code == 200
+                assert 'spatialflink_counter{name="batches-evaluated"} 5' \
+                    in prom
+                code, events = _get(srv.url + "/events")
+                assert code == 200 and events["total"] == 1
+                assert events["events"][0]["kind"] == "checkpoint-committed"
+                code, missing = _get(srv.url + "/nope")
+                assert code == 404 and "/status" in missing["endpoints"]
+                assert srv.requests_served == 5
+            finally:
+                port = srv.port
+                srv.close()
+        assert active_server() is None
+        with pytest.raises(urllib.error.URLError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/status", timeout=1)
+
+    def test_healthz_flips_200_to_503_on_injected_breach(self):
+        h = HealthEvaluator.from_spec("watermark_lag_ms=10")
+        with scoped_registry() as reg, telemetry_session(health=h) as tel:
+            srv = OpServer(port=0).start()
+            try:
+                tel.gauge("kafka.watermark-lag-ms").set(3.0)
+                code, verdict = _get(srv.url + "/healthz")
+                assert code == 200 and verdict["healthy"]
+                tel.gauge("kafka.watermark-lag-ms").set(5000.0)  # breach
+                code, verdict = _get(srv.url + "/healthz")
+                assert code == 503 and not verdict["healthy"]
+                assert verdict["checks"]["watermark_lag_ms"]["ok"] is False
+                assert reg.counter("slo-breaches").count == 1
+                # the /status document agrees (same evaluator instance)
+                _, status = _get(srv.url + "/status")
+                assert status["health"]["status"] == "breach"
+                assert status["status"]["slo_breaches"] == 1
+                tel.gauge("kafka.watermark-lag-ms").set(3.0)  # recover
+                code, _ = _get(srv.url + "/healthz")
+                assert code == 200
+            finally:
+                srv.close()
+
+    def test_no_session_serves_registry_counters(self):
+        assert telemetry_mod.active() is None
+        with scoped_registry() as reg:
+            reg.counter("records-evaluated").inc(11)
+            srv = OpServer(port=0,
+                           health=HealthEvaluator.from_spec(
+                               "commit_backlog=5")).start()
+            try:
+                code, status = _get(srv.url + "/status")
+                assert code == 200
+                assert status["counters"]["records-evaluated"] == 11
+                assert status["spans"] == {}  # no session, no spans
+                code, verdict = _get(srv.url + "/healthz")
+                assert code == 200  # backlog gauge absent -> unknown -> ok
+                code, events = _get(srv.url + "/events")
+                assert events["events"] == [] and "note" in events
+            finally:
+                srv.close()
+
+
+class TestLivePromRewrite:
+    def test_metrics_prom_rewritten_per_snapshot(self, tmp_path):
+        """Satellite: metrics.prom is live, not close-only — a scraper
+        pointed at the file mid-run sees values that keep moving."""
+        with scoped_registry() as reg, \
+                telemetry_session(str(tmp_path), interval_s=0.05):
+            reg.counter("batches-evaluated").inc(1)
+            deadline = time.monotonic() + 5.0
+            prom_path = os.path.join(str(tmp_path), "metrics.prom")
+            while time.monotonic() < deadline:
+                if os.path.exists(prom_path) and \
+                        'name="batches-evaluated"} 1' in open(prom_path).read():
+                    break
+                time.sleep(0.02)
+            else:
+                pytest.fail("live metrics.prom never showed the counter")
+            reg.counter("batches-evaluated").inc(41)
+            while time.monotonic() < deadline:
+                if 'name="batches-evaluated"} 42' in open(prom_path).read():
+                    break
+                time.sleep(0.02)
+            else:
+                pytest.fail("metrics.prom was not rewritten mid-run")
+        # the close-time dump still lands (and reflects the final state)
+        assert 'name="batches-evaluated"} 42' in open(prom_path).read()
+
+
+class TestLiveStats:
+    def test_periodic_digest_lines(self, capsys):
+        with scoped_registry() as reg, telemetry_session():
+            reg.meter("ingest-throughput").mark(10)
+            live = LiveStats(interval_s=0.05).start()
+            time.sleep(0.2)
+            live.close()
+        lines = [ln for ln in capsys.readouterr().err.splitlines()
+                 if ln.startswith("# live: ")]
+        assert len(lines) >= 2  # immediate + periodic(s) + final
+        assert live.emitted == len(lines)
+        assert any("in 10 rec" in ln for ln in lines)
+
+
+def _follow_conf(tmp_path, name):
+    with open("conf/spatialflink-conf.yml") as f:
+        d = yaml.safe_load(f)
+    d["kafkaBootStrapServers"] = f"memory://{name}"
+    d["window"].update(interval=1, step=1)
+    d["query"]["thresholds"]["outOfOrderTuples"] = 0
+    p = tmp_path / "conf.yml"
+    p.write_text(yaml.safe_dump(d))
+    return str(p), f"memory://{name}"
+
+
+CONTROL = json.dumps({"geometry": {"type": "control", "coordinates": []}})
+
+
+class _Poller(threading.Thread):
+    """Fetches the plane's endpoints MID-RUN: waits for the driver's
+    ephemeral server, then polls /status until live (non-initial) numbers
+    appear, then grabs every endpoint."""
+
+    def __init__(self, min_records=1):
+        super().__init__(daemon=True)
+        self.min_records = min_records
+        self.result: dict = {}
+
+    def run(self):
+        deadline = time.monotonic() + 25.0
+        srv = None
+        while time.monotonic() < deadline and srv is None:
+            srv = active_server()
+            if srv is None or srv.port is None:
+                srv = None
+                time.sleep(0.01)
+        if srv is None:
+            self.result["error"] = "status server never came up"
+            return
+        while time.monotonic() < deadline:
+            try:
+                code, status = _get(srv.url + "/status", timeout=2)
+            except Exception:
+                time.sleep(0.05)
+                continue
+            st = status.get("status", {})
+            if (code == 200 and st.get("records_in", 0) >= self.min_records
+                    and st.get("watermark_lag_ms") is not None
+                    and status.get("degradation")):
+                self.result["status"] = status
+                break
+            time.sleep(0.05)
+        else:
+            self.result["error"] = "live /status never matured"
+            return
+        try:
+            self.result["healthz"] = _get(srv.url + "/healthz", timeout=2)
+            self.result["metrics"] = _get(srv.url + "/metrics", timeout=2)
+            self.result["events"] = _get(srv.url + "/events", timeout=2)
+            # a later /status so breach counters had a chance to land
+            time.sleep(0.3)
+            self.result["status2"] = _get(srv.url + "/status", timeout=2)[1]
+            self.result["port"] = srv.port
+        except Exception as e:  # pragma: no cover - diagnostic only
+            self.result["error"] = repr(e)
+
+
+class TestLiveFollowAcceptance:
+    """The ISSUE acceptance run: a live --kafka-follow --status-port 0
+    --telemetry-dir run under --chaos, with a mid-run client asserting
+    well-formed live endpoint payloads, the SLO breach flipping /healthz
+    to 503, nonzero retry/breaker counters in /status correlated with the
+    degradation digest, >= 2 periodic stderr digests and JSONL snapshots
+    before the stream ends, and server shutdown on pipeline exit."""
+
+    def test_follow_chaos_live_plane(self, tmp_path, capsys):
+        from spatialflink_tpu.driver import main
+        from spatialflink_tpu.streams.kafka import (reset_memory_brokers,
+                                                    resolve_broker)
+
+        reset_memory_brokers()
+        try:
+            cfg, url = _follow_conf(tmp_path, "liveops-follow")
+            broker = resolve_broker(url)
+
+            def produce():
+                for i in range(250):
+                    p = Point.create(116.5 + 0.001 * (i % 40), 40.5, GRID,
+                                     obj_id=f"veh{i % 7}",
+                                     timestamp=int(time.time() * 1000))
+                    broker.produce("points.geojson",
+                                   serialize_spatial(p, "GeoJSON"))
+                    time.sleep(0.01)
+                broker.produce("points.geojson", CONTROL)
+
+            t = threading.Thread(target=produce, daemon=True)
+            poller = _Poller()
+            t.start()
+            poller.start()
+            tdir = tmp_path / "tel"
+            rc = main(["--config", cfg, "--kafka", "--kafka-follow",
+                       "--option", "1", "--status-port", "0",
+                       "--chaos", "seed=3,fail_next_fetches=2",
+                       "--retry", "attempts=8,base_ms=1",
+                       # any real lag breaches: the injected-SLO-breach shape
+                       "--slo", "watermark_lag_ms=0.0001",
+                       "--telemetry-dir", str(tdir),
+                       "--telemetry-interval", "0.1"])
+            t.join(timeout=30)
+            poller.join(timeout=30)
+            assert rc == 0
+            res = poller.result
+            assert "error" not in res, res
+            # --- live /status mid-run: non-initial values, full schema ---
+            status = res["status"]
+            assert RAW_KEYS | {"status", "health"} <= set(status)
+            st = status["status"]
+            assert set(st) == STATUS_KEYS
+            assert st["records_in"] >= 1
+            assert st["watermark_lag_ms"] is not None
+            # --- chaos counters in /status, correlated with the summary ---
+            assert status["degradation"].get("chaos-fetch-fail", 0) >= 1
+            assert status["degradation"].get("retry-attempts", 0) >= 1
+            # --- injected SLO breach: /healthz 503 + breach counter ---
+            code, verdict = res["healthz"]
+            assert code == 503 and not verdict["healthy"]
+            assert verdict["checks"]["watermark_lag_ms"]["ok"] is False
+            assert res["status2"]["status"]["slo_breaches"] >= 1
+            # --- live /metrics: prometheus families present mid-run ---
+            code, prom = res["metrics"]
+            assert code == 200
+            assert "spatialflink_counter" in prom
+            assert 'name="ingest-throughput.count"' in prom
+            # --- events ring reachable mid-run (chaos run may or may not
+            # trip the breaker; the SLO breach events are deterministic) ---
+            code, events = res["events"]
+            assert code == 200
+            kinds = {e["kind"] for e in events["events"]}
+            assert "slo-breach" in kinds and "watermark-stall" in kinds
+            # --- >= 2 periodic digests/snapshots BEFORE the run ended ---
+            err = capsys.readouterr().err
+            digests = [ln for ln in err.splitlines()
+                       if ln.startswith("# live: ")]
+            assert len(digests) >= 2, err
+            assert "degraded" in err  # kafka summary digest correlation
+            with open(os.path.join(str(tdir), "telemetry.jsonl")) as f:
+                snaps = [json.loads(line) for line in f]
+            assert len(snaps) >= 3  # start + >=1 periodic mid-run + final
+            for s in snaps:
+                assert "status" in s and "health" in s
+            assert snaps[-1]["health"]["status"] == "breach"
+            assert snaps[-1]["status"]["slo_breaches"] >= 1
+            # --- the plane died with the pipeline ---
+            assert active_server() is None
+            with pytest.raises(urllib.error.URLError):
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{res['port']}/status", timeout=1)
+        finally:
+            reset_memory_brokers()
+
+    @pytest.mark.slow
+    def test_follow_panes_checkpoint_soak(self, tmp_path):
+        """Longer follow soak: --panes + --checkpoint-dir under the plane;
+        /status surfaces the pane-cache hit rate and checkpoint seq/age
+        (the PR 3/PR 4 gauges an operator reads first) and /events carries
+        checkpoint-committed entries, all mid-run."""
+        from spatialflink_tpu.driver import main
+        from spatialflink_tpu.streams.kafka import (reset_memory_brokers,
+                                                    resolve_broker)
+
+        reset_memory_brokers()
+        try:
+            with open("conf/spatialflink-conf.yml") as f:
+                d = yaml.safe_load(f)
+            d["kafkaBootStrapServers"] = "memory://liveops-soak"
+            d["window"].update(interval=4, step=1)  # overlap 4 -> pane reuse
+            d["query"]["thresholds"]["outOfOrderTuples"] = 0
+            cfg = tmp_path / "conf.yml"
+            cfg.write_text(yaml.safe_dump(d))
+            broker = resolve_broker("memory://liveops-soak")
+
+            def produce():
+                # ~8s of wall-time event data: windows (4s, slide 1s) seal
+                # from ~4s on, so the mid-run poll has a multi-second span
+                # in which pane hits AND a checkpoint both already happened
+                for i in range(800):
+                    p = Point.create(116.5 + 0.001 * (i % 40), 40.5, GRID,
+                                     obj_id=f"veh{i % 7}",
+                                     timestamp=int(time.time() * 1000))
+                    broker.produce("points.geojson",
+                                   serialize_spatial(p, "GeoJSON"))
+                    time.sleep(0.01)
+                broker.produce("points.geojson", CONTROL)
+
+            got: dict = {}
+
+            def poll():
+                deadline = time.monotonic() + 30.0
+                while time.monotonic() < deadline:
+                    srv = active_server()
+                    if srv is None or srv.port is None:
+                        time.sleep(0.02)
+                        continue
+                    try:
+                        _, status = _get(srv.url + "/status", timeout=2)
+                    except Exception:
+                        time.sleep(0.05)
+                        continue
+                    st = status.get("status", {})
+                    if (st.get("pane_cache", {}).get("hits", 0) >= 1
+                            and (st.get("checkpoint", {}).get("seq") or 0)
+                            >= 1):
+                        got["status"] = status
+                        got["events"] = _get(srv.url + "/events",
+                                             timeout=2)[1]
+                        return
+                    time.sleep(0.05)
+
+            t = threading.Thread(target=produce, daemon=True)
+            pt = threading.Thread(target=poll, daemon=True)
+            t.start()
+            pt.start()
+            rc = main(["--config", str(cfg), "--kafka", "--kafka-follow",
+                       "--option", "1", "--panes",
+                       "--checkpoint-dir", str(tmp_path / "ckpt"),
+                       "--checkpoint-every", "2",
+                       "--status-port", "0",
+                       "--telemetry-dir", str(tmp_path / "tel"),
+                       "--telemetry-interval", "0.1"])
+            t.join(timeout=30)
+            pt.join(timeout=30)
+            assert rc == 0
+            assert "status" in got, "live /status never showed pane " \
+                                    "hits + checkpoint seq mid-run"
+            st = got["status"]["status"]
+            assert st["pane_cache"]["hit_rate"] > 0
+            assert st["checkpoint"]["seq"] >= 1
+            assert st["checkpoint"]["age_s"] is not None
+            assert st["checkpoint"]["write_ms"]["count"] >= 1
+            kinds = [e["kind"] for e in got["events"]["events"]]
+            assert "checkpoint-committed" in kinds
+        finally:
+            reset_memory_brokers()

@@ -187,6 +187,30 @@ class TestReporter:
             assert k in last["histograms"]["lat"]
         assert last["gauges"]["g"] == 1.0
 
+    def test_snapshot_carries_status_digest_and_health(self, tmp_path):
+        """Every JSONL line embeds the shared operator digest — incl. the
+        PR 3 pane-cache counters and PR 4 checkpoint gauges an operator
+        reads first — and, with an SLO evaluator attached, the health
+        verdict."""
+        from spatialflink_tpu.runtime.health import HealthEvaluator
+
+        with scoped_registry() as reg, telemetry_session(
+                str(tmp_path), interval_s=5.0,
+                health=HealthEvaluator.from_spec("dlq_depth=100")) as tel:
+            reg.counter("pane-cache-hits").inc(3)
+            reg.counter("pane-cache-misses").inc(1)
+            reg.counter("checkpoints-written").inc(1)
+            tel.gauge("checkpoint.seq").set(1.0)
+        snaps = _snapshots(tmp_path)
+        for s in snaps:
+            assert "status" in s and "health" in s
+        st = snaps[-1]["status"]
+        assert st["pane_cache"] == {"hits": 3, "misses": 1, "hit_rate": 0.75}
+        assert st["checkpoint"]["written"] == 1
+        assert st["checkpoint"]["seq"] == 1.0
+        assert "watermark_lag_ms" in st and "breaker_state" in st
+        assert snaps[-1]["health"]["status"] == "ok"
+
     def test_prometheus_dump(self, tmp_path):
         with telemetry_session(str(tmp_path), interval_s=5.0) as tel:
             with tel.span("s"):
@@ -299,6 +323,34 @@ class TestDriverTelemetry:
                      "--input1", inp, "--option", "1"]) == 0
         assert spy.calls == 0, \
             "telemetry disabled must leave the record loop uninstrumented"
+
+    def test_status_server_idle_keeps_record_loop_identical(
+            self, tmp_path, monkeypatch):
+        """The live-plane hot-path guarantee: --status-port with no
+        telemetry session leaves the record loop byte-identical to the
+        uninstrumented run — zero span/observe/histogram calls — and with
+        the server UNQUERIED, zero snapshot constructions (snapshots are
+        built on request/interval only, never per record)."""
+        from spatialflink_tpu.driver import main
+        from spatialflink_tpu.runtime import opserver as opserver_mod
+
+        spy = _CallCounter(monkeypatch)
+        snap_calls = []
+        orig_status = telemetry_mod.status_snapshot
+        monkeypatch.setattr(
+            telemetry_mod, "status_snapshot",
+            lambda *a, **k: (snap_calls.append(1), orig_status(*a, **k))[1])
+        inp = _write_points(tmp_path / "pts.geojson")
+        assert active() is None
+        assert main(["--config", "conf/spatialflink-conf.yml",
+                     "--input1", inp, "--option", "1",
+                     "--status-port", "0"]) == 0
+        assert spy.calls == 0, \
+            "an idle status server must not instrument the record loop"
+        assert snap_calls == [], \
+            "snapshot construction must happen on request only"
+        # the plane died with the pipeline
+        assert opserver_mod.active_server() is None
 
     def test_file_run_covers_ingest_to_sink(self, tmp_path, capsys):
         from spatialflink_tpu.driver import main

@@ -1,0 +1,194 @@
+"""Bench regression sentinel: diff two bench JSON files row by row.
+
+CI-shaped guard for the ledgers this repo already produces
+(``benchmarks/RESULTS_e2e_cpu.json``, ``RESULTS_panes_*.json``, ...): pair
+rows between a BASELINE file and a CURRENT file by their identity fields
+(option / path / overlap / queries / ...), compare one metric per row
+(default ``records_per_sec``, higher-is-better), and exit nonzero when any
+row regressed past its threshold — so a perf regression fails the pipeline
+instead of quietly rewriting the ledger.
+
+Usage:
+    python benchmarks/bench_diff.py BASELINE.json CURRENT.json \
+        [--metric records_per_sec] [--threshold 0.10] \
+        [--rule path=bulk:0.05] [--rule option=51,path=record:0.25] \
+        [--lower-is-better] [--require-all]
+
+- ``--threshold`` is the default allowed fractional regression (0.10 =
+  current may be up to 10% worse than baseline).
+- ``--rule k=v[,k=v...]:threshold`` overrides the threshold for rows whose
+  identity fields match every listed pair (first matching rule wins, in
+  argument order) — per-row thresholds for noisy rows (e.g. the scalar
+  record path) next to tight ones (the vectorized bulk path).
+- ``--lower-is-better`` flips the comparison (wall_s-style metrics).
+- Rows present in only one file are reported (``missing`` / ``new``) and
+  are non-fatal unless ``--require-all`` (a silently dropped bench row is
+  how coverage rots).
+
+Exit codes: 0 = no regression, 1 = regression(s), 2 = usage / missing
+rows under ``--require-all``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional, Tuple
+
+#: fields that IDENTIFY a row (never compared as metrics); a row's key is
+#: the subset of these it actually carries, in this order
+ID_FIELDS = ("option", "path", "overlap", "queries", "checkpoint_every",
+             "records", "backend")
+
+
+def load_rows(path: str) -> List[dict]:
+    """Rows from a bench JSON file: either ``{"rows": [...]}`` (the
+    RESULTS_* shape) or a bare JSON list / JSONL of row objects."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        doc = [json.loads(line) for line in text.splitlines() if line.strip()]
+    if isinstance(doc, dict):
+        doc = doc.get("rows", [])
+    if not isinstance(doc, list):
+        raise ValueError(f"{path}: expected a rows list or {{'rows': [...]}}")
+    return [r for r in doc if isinstance(r, dict)]
+
+
+def row_key(row: dict) -> Tuple:
+    return tuple((f, str(row[f])) for f in ID_FIELDS if f in row)
+
+
+def parse_rule(spec: str) -> Tuple[Dict[str, str], float]:
+    """``k=v[,k=v...]:threshold`` -> (match dict, threshold)."""
+    match_part, sep, thr_part = spec.rpartition(":")
+    if not sep:
+        raise ValueError(f"--rule {spec!r} is not 'k=v[,k=v]:threshold'")
+    try:
+        thr = float(thr_part)
+    except ValueError:
+        raise ValueError(f"--rule {spec!r}: threshold {thr_part!r} "
+                         "is not numeric")
+    match: Dict[str, str] = {}
+    for pair in match_part.split(","):
+        key, eq, val = pair.partition("=")
+        if not eq:
+            raise ValueError(f"--rule {spec!r}: {pair!r} is not key=value")
+        match[key.strip()] = val.strip()
+    return match, thr
+
+
+def rule_threshold(row: dict, rules: List[Tuple[Dict[str, str], float]],
+                   default: float) -> float:
+    for match, thr in rules:
+        if all(str(row.get(k)) == v for k, v in match.items()):
+            return thr
+    return default
+
+
+def diff_rows(base_rows: List[dict], cur_rows: List[dict], metric: str,
+              threshold: float,
+              rules: Optional[List[Tuple[Dict[str, str], float]]] = None,
+              lower_is_better: bool = False) -> List[dict]:
+    """Pairwise comparison; one result dict per row key, statuses:
+    ``ok`` / ``regression`` / ``missing`` (in baseline only) / ``new``
+    (in current only) / ``unmeasured`` (metric absent on either side)."""
+    rules = rules or []
+    base = {row_key(r): r for r in base_rows}
+    cur = {row_key(r): r for r in cur_rows}
+    out: List[dict] = []
+    for key, b in base.items():
+        label = ",".join(f"{k}={v}" for k, v in key)
+        c = cur.get(key)
+        if c is None:
+            out.append({"key": label, "status": "missing",
+                        "base": b.get(metric)})
+            continue
+        bv, cv = b.get(metric), c.get(metric)
+        if not isinstance(bv, (int, float)) or not isinstance(cv,
+                                                              (int, float)):
+            out.append({"key": label, "status": "unmeasured",
+                        "base": bv, "current": cv})
+            continue
+        thr = rule_threshold(b, rules, threshold)
+        # change > 0 is always an improvement, whichever way the metric
+        # points; regression when it exceeds the row's allowance
+        change = ((cv - bv) if not lower_is_better else (bv - cv)) / bv \
+            if bv else 0.0
+        out.append({
+            "key": label, "base": bv, "current": cv,
+            "change": round(change, 4), "threshold": thr,
+            "status": "regression" if change < -thr else "ok",
+        })
+    for key, c in cur.items():
+        if key not in base:
+            out.append({"key": ",".join(f"{k}={v}" for k, v in key),
+                        "status": "new", "current": c.get(metric)})
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="bench_diff",
+        description="diff two bench JSON files; nonzero exit on regression")
+    ap.add_argument("baseline", help="baseline bench JSON (the ledger)")
+    ap.add_argument("current", help="current bench JSON (the fresh run)")
+    ap.add_argument("--metric", default="records_per_sec",
+                    help="row field to compare (default records_per_sec)")
+    ap.add_argument("--threshold", type=float, default=0.10,
+                    help="default allowed fractional regression "
+                         "(default 0.10)")
+    ap.add_argument("--rule", action="append", default=[],
+                    metavar="K=V[,K=V]:THR",
+                    help="per-row threshold override for rows matching "
+                         "every K=V identity pair; first match wins")
+    ap.add_argument("--lower-is-better", action="store_true",
+                    help="the metric improves downward (wall_s)")
+    ap.add_argument("--require-all", action="store_true",
+                    help="baseline rows missing from current are fatal "
+                         "(exit 2)")
+    args = ap.parse_args(argv)
+
+    try:
+        rules = [parse_rule(s) for s in args.rule]
+        base_rows = load_rows(args.baseline)
+        cur_rows = load_rows(args.current)
+    except (ValueError, OSError, json.JSONDecodeError) as e:
+        print(f"bench_diff: {e}", file=sys.stderr)
+        return 2
+
+    results = diff_rows(base_rows, cur_rows, args.metric, args.threshold,
+                        rules, args.lower_is_better)
+    regressions = missing = 0
+    for r in results:
+        if r["status"] == "regression":
+            regressions += 1
+            print(f"REGRESSION {r['key']}: {args.metric} "
+                  f"{r['base']} -> {r['current']} "
+                  f"({r['change'] * 100:+.1f}%, allowed "
+                  f"-{r['threshold'] * 100:.0f}%)")
+        elif r["status"] == "ok":
+            print(f"ok         {r['key']}: {args.metric} "
+                  f"{r['base']} -> {r['current']} "
+                  f"({r['change'] * 100:+.1f}%)")
+        elif r["status"] == "missing":
+            missing += 1
+            print(f"MISSING    {r['key']}: in baseline only")
+        elif r["status"] == "new":
+            print(f"new        {r['key']}: in current only")
+        else:
+            print(f"unmeasured {r['key']}: {args.metric} absent "
+                  f"({r.get('base')!r} -> {r.get('current')!r})")
+    compared = sum(r["status"] in ("ok", "regression") for r in results)
+    print(f"# {compared} row(s) compared, {regressions} regression(s), "
+          f"{missing} missing", file=sys.stderr)
+    if missing and args.require_all:
+        return 2
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

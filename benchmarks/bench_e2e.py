@@ -252,13 +252,17 @@ def bench_checkpoint(option: int, path: str, n: int, every: int) -> list:
 
 
 def bench_live_plane(option: int, path: str, n: int) -> list:
-    """Overhead of the live operations plane on the record path, three
+    """Overhead of the live operations plane on the record path, four
     configurations over the same replay: plane OFF, a bound-but-UNQUERIED
     status server with no telemetry session (the contract is a
     byte-identical record loop — snapshots are built per HTTP request
-    only, so this must be ~0), and the full plane (telemetry session +
+    only, so this must be ~0), the full plane (telemetry session +
     status server + live-stats digest thread at an interval longer than
-    the run — the session's per-record instrumentation is the cost)."""
+    the run — the session's per-record instrumentation is the cost), and
+    the full plane WITH window trace lineage on (``--trace-dir``'s
+    recording cost: per-WINDOW trace notes + per-record cost-profile
+    pending accumulation — the trace-on overhead row BASELINE.md
+    tracks)."""
     from spatialflink_tpu import driver
     from spatialflink_tpu.runtime.opserver import LiveStats, OpServer
     from spatialflink_tpu.utils.telemetry import telemetry_session
@@ -270,21 +274,26 @@ def bench_live_plane(option: int, path: str, n: int) -> list:
             windows = _drain(driver.run_option(p, f1))
             return windows, time.perf_counter() - t0
 
-    run()  # warm the jit caches all three configurations share
+    run()  # warm the jit caches all four configurations share
     windows, dt_off = run()
     srv = OpServer(port=0).start()
     try:
         _, dt_srv = run()
     finally:
         srv.close()
-    with telemetry_session():
-        srv = OpServer(port=0).start()
-        live = LiveStats(interval_s=3600.0).start()
-        try:
-            _, dt_full = run()
-        finally:
-            live.close()
-            srv.close()
+
+    def run_plane(trace: bool):
+        with telemetry_session(trace=trace):
+            srv = OpServer(port=0).start()
+            live = LiveStats(interval_s=3600.0).start()
+            try:
+                return run()[1]
+            finally:
+                live.close()
+                srv.close()
+
+    dt_full = run_plane(trace=False)
+    dt_trace = run_plane(trace=True)
     base = dict(option=option, records=n, windows=windows)
     return [
         dict(base, path="live_plane_off", wall_s=round(dt_off, 3),
@@ -295,6 +304,10 @@ def bench_live_plane(option: int, path: str, n: int) -> list:
         dict(base, path="live_plane_full", wall_s=round(dt_full, 3),
              records_per_sec=round(n / dt_full),
              overhead_vs_off=round(dt_full / dt_off - 1.0, 4)),
+        dict(base, path="live_plane_trace", wall_s=round(dt_trace, 3),
+             records_per_sec=round(n / dt_trace),
+             overhead_vs_off=round(dt_trace / dt_off - 1.0, 4),
+             overhead_vs_full=round(dt_trace / dt_full - 1.0, 4)),
     ]
 
 

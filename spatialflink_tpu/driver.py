@@ -1270,6 +1270,18 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="seconds between periodic telemetry snapshots "
                          "(default 5.0); also the --live-stats digest "
                          "cadence")
+    ap.add_argument("--trace-dir", metavar="DIR", default=None,
+                    help="record per-window TRACE LINEAGE (first-record "
+                         "ingest, assembly, pane seals, kernel dispatch, "
+                         "merge/readback, emit, sink, Kafka sink commit — "
+                         "stable trace ids derived from (query, "
+                         "window_start), bounded ring of the last 256 "
+                         "windows) and export it at exit as Chrome "
+                         "trace-event JSON to DIR/trace.json — load it in "
+                         "Perfetto (ui.perfetto.dev) or chrome://tracing "
+                         "to scrub the run's timeline. Activates a "
+                         "telemetry session; live access via the status "
+                         "server's /trace/<id> and /trace/recent")
     ap.add_argument("--status-port", type=int, default=None, metavar="PORT",
                     help="serve a live in-run status plane on "
                          "127.0.0.1:PORT (0 = ephemeral, bound port "
@@ -1545,19 +1557,26 @@ def main(argv: Optional[List[str]] = None) -> int:
                   "--telemetry-dir, or --live-stats (nothing evaluates "
                   "the thresholds)", file=sys.stderr)
 
-    if args.telemetry_dir or args.live_stats:
+    if args.telemetry_dir or args.live_stats or args.trace_dir:
         from spatialflink_tpu.utils.telemetry import telemetry_session
 
         # the session must wrap the KAFKA WIRING too (taps/sinks capture
         # their gauges at construction), not just the result loop.
-        # --live-stats without --telemetry-dir runs a reporterless session
-        # (instrumentation on, digest built from it per interval)
+        # --live-stats/--trace-dir without --telemetry-dir run a
+        # reporterless session (instrumentation on; the digest / trace
+        # book are fed from it)
         with telemetry_session(args.telemetry_dir or None,
-                               args.telemetry_interval, health=health):
+                               args.telemetry_interval, health=health,
+                               trace_dir=args.trace_dir):
             if args.telemetry_dir:
                 print(f"# telemetry: JSONL snapshots every "
                       f"{args.telemetry_interval:g}s -> "
                       f"{os.path.join(args.telemetry_dir, 'telemetry.jsonl')}",
+                      file=sys.stderr)
+            if args.trace_dir:
+                print("# tracing: per-window lineage -> "
+                      f"{os.path.join(args.trace_dir, 'trace.json')} "
+                      "(Chrome trace-event JSON; open in Perfetto)",
                       file=sys.stderr)
             return _run_cli(ap, args, params, spec, skip1, limit1, health)
     return _run_cli(ap, args, params, spec, skip1, limit1, health)
@@ -1736,8 +1755,16 @@ def _run_cli(ap, args, params: Params, spec: CaseSpec, skip1: int,
                     and journal.seen(result)):
                 continue  # delivered by the pre-crash process
             if tel is not None:
+                s0 = time.time()
                 with tel.span("sink"):
                     emit_result(result)
+                if (tel.traces is not None
+                        and isinstance(result, WindowResult)):
+                    # the driver's emission stage in the window's trace
+                    # lineage — by window_start: the result no longer
+                    # carries its family label
+                    tel.traces.note_any(result.window_start, "sink",
+                                        s0, time.time())
             else:
                 emit_result(result)
             if journal is not None and isinstance(result, WindowResult):

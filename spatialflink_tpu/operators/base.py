@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import enum
 import sys
+import time
 from collections import deque
 import dataclasses
 from dataclasses import dataclass, field
@@ -521,14 +522,36 @@ class SpatialOperator:
         self._register_ckpt_pane_cache("pane-cache", cache)
         tel = _telemetry.active()
         label = self.telemetry_label or type(self).__name__
+        book = tel.traces if tel is not None else None
+        costs = tel.costs if tel is not None else None
 
         def eval_batch(panes, ts_base):
+            h0, m0 = ((cache.hits.count, cache.misses.count)
+                      if costs is not None else (0, 0))
+
+            def seal_pane(p_start, payload):
+                # a cache MISS is a pane sealing: the kernel runs once,
+                # here — trace it against the window that triggered it
+                if book is None:
+                    return PanePartial(pane_partial(payload, p_start))
+                t0 = time.time()
+                part = PanePartial(pane_partial(payload, p_start))
+                # payload is the pane's record list on the record path, an
+                # (idx, batch) pair on the bulk path — count accordingly
+                n = (len(payload[0]) if isinstance(payload, tuple)
+                     else len(payload))
+                book.note(label, ts_base, "pane-seal", t0, time.time(),
+                          pane=int(p_start), records=int(n))
+                return part
+
             parts = [
-                cache.get(p_start,
-                          lambda: PanePartial(pane_partial(payload, p_start)))
+                cache.get(p_start, lambda: seal_pane(p_start, payload))
                 for p_start, payload in panes
             ]
             cache.evict_before(ts_base)
+            if costs is not None:
+                costs.note_pane(label, cache.hits.count - h0,
+                                cache.misses.count - m0)
 
             def collect(_):
                 if tel is not None:
@@ -936,6 +959,8 @@ class SpatialOperator:
         op_name = type(self).__name__
         tel = _telemetry.active()
         label = self.telemetry_label or op_name
+        book = tel.traces if tel is not None else None
+        costs = tel.costs if tel is not None else None
         if tel is not None:
             backlog = tel.gauge("window-backlog")
             batched = self._spanned_batches(batched, tel, label)
@@ -945,25 +970,44 @@ class SpatialOperator:
             # reference's fire-per-element trigger never emits empties);
             # windowed mode reports every window, selected-or-not
             if sel or not realtime:
+                if book is not None:
+                    book.seal(label, start, end)
                 yield WindowResult(start, end, sel)
 
         def drain(n: int) -> Iterator[WindowResult]:
             while len(pending) > n:
                 start, end, dfd = pending.popleft()
-                with (tel.span("merge", query=label) if tel is not None
-                      else trace(f"{op_name}.readback")):
-                    sel = dfd.finish()
                 if tel is not None:
+                    w0 = time.time()
+                    with tel.span("merge", query=label):
+                        sel = dfd.finish()
+                    if book is not None:
+                        book.note(label, start, "merge", w0, time.time())
+                    if costs is not None:
+                        costs.attribute_merge(label, time.time() - w0)
                     backlog.set(len(pending))
+                else:
+                    with trace(f"{op_name}.readback"):
+                        sel = dfd.finish()
                 yield from emit(start, end, sel)
 
         coord = self.conf.checkpointer
         for start, end, payload in batched:
             batches.inc()
             records_c.inc(count(payload))
-            with (tel.span("kernel", query=label) if tel is not None
-                  else trace(f"{op_name}.dispatch")):
-                sel = eval_batch(payload, start)
+            if tel is not None:
+                w0 = time.time()
+                with tel.span("kernel", query=label):
+                    sel = eval_batch(payload, start)
+                if book is not None:
+                    book.note(label, start, "kernel", w0, time.time())
+                if costs is not None:
+                    costs.attribute_kernel(
+                        label, time.time() - w0, records=count(payload),
+                        nbytes=self._payload_nbytes(payload))
+            else:
+                with trace(f"{op_name}.dispatch"):
+                    sel = eval_batch(payload, start)
             if isinstance(sel, Deferred):
                 pending.append((start, end, sel))
                 if tel is not None:
@@ -985,20 +1029,84 @@ class SpatialOperator:
                     coord.commit()
         yield from drain(0)
 
-    @staticmethod
-    def _spanned_batches(batched: Iterable, tel, label: str) -> Iterator:
+    @classmethod
+    def _spanned_batches(cls, batched: Iterable, tel, label: str) -> Iterator:
         """Wrap a (start, end, payload) source so each pull is timed as the
         ``window`` stage (assembly/buffering time — the host-side half the
         kernel spans don't see). The span is class-based, so the final
-        StopIteration passes through it without being miscounted."""
+        StopIteration passes through it without being miscounted. With
+        tracing on, each pull also opens the window's trace record: the
+        assembly slice plus the first record's ingest wall clock."""
         it = iter(batched)
+        book = tel.traces
         while True:
             try:
+                t0 = time.time()
                 with tel.span("window", query=label):
                     item = next(it)
             except StopIteration:
                 return
+            if book is not None:
+                book.note(label, item[0], "window", t0, time.time())
+                ing = cls._first_ingest_ms(item[2])
+                if ing is not None:
+                    book.first_record(label, item[0], ing)
             yield item
+
+    @staticmethod
+    def _first_ingest_ms(payload):
+        """Best-effort first-record ingest wall clock for trace lineage:
+        record lists carry Points with an ``ingestion_time`` stamped at
+        parse; pane payloads hold ``(pane_start, records)`` pairs; bulk
+        (idx, batch) payloads have no per-record host objects — None."""
+        try:
+            recs = payload
+            if not isinstance(recs, list) or not recs:
+                return None
+            if (isinstance(recs[0], tuple) and len(recs[0]) == 2
+                    and isinstance(recs[0][1], list)):
+                recs = recs[0][1]  # pane payload: first pane's records
+                if not recs:
+                    return None
+            ing = getattr(recs[0], "ingestion_time", None)
+            if isinstance(ing, (int, float)) and ing > 0:
+                return int(ing)
+        except Exception:
+            pass
+        return None
+
+    @staticmethod
+    def _payload_nbytes(payload) -> int:
+        """Approximate host->device bytes for one window payload: summed
+        array ``nbytes`` where the payload carries arrays (bulk
+        (idx, batch) tuples), a flat 32-bytes-per-record estimate for host
+        record lists (x/y/ts/id as packed fields) — a cost-profile
+        ESTIMATE of data motion, not a transfer measurement."""
+        try:
+            if isinstance(payload, tuple) and len(payload) == 2:
+                idx, batch = payload
+                total = int(getattr(idx, "nbytes", 0))
+                parts = (batch if isinstance(batch, tuple)
+                         else [getattr(batch, f, None)
+                               for f in getattr(batch,
+                                                "__dataclass_fields__", ())])
+                for a in parts:
+                    total += int(getattr(a, "nbytes", 0) or 0)
+                return total
+            if isinstance(payload, list):
+                if (payload and isinstance(payload[0], tuple)
+                        and len(payload[0]) == 2):
+                    inner = payload[0][1]
+                    if isinstance(inner, list):  # record-path pane payload
+                        return 32 * sum(len(rs) for _, rs in payload)
+                    if isinstance(inner, tuple):  # bulk pane payload
+                        return sum(
+                            SpatialOperator._payload_nbytes(p)
+                            for _, p in payload)
+                return 32 * len(payload)
+        except Exception:
+            pass
+        return 0
 
 
 class GeomQueryMixin:

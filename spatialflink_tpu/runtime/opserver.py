@@ -9,14 +9,20 @@ module adds that plane, stdlib-only:
 - :class:`OpServer` — a threaded HTTP server (``--status-port``; 0 binds
   an ephemeral port, printed by the driver) serving
 
-  ========== =========================================================
-  endpoint    payload
-  ========== =========================================================
-  /healthz    SLO verdict, ``200`` healthy / ``503`` breached
-  /status     the full shared status snapshot (one JSON document)
-  /metrics    Prometheus text exposition, rendered LIVE per request
-  /events     the lifecycle event ring (checkpoints, breaker, DLQ, SLO)
-  ========== =========================================================
+  =============== ====================================================
+  endpoint         payload
+  =============== ====================================================
+  /healthz         SLO verdict, ``200`` healthy / ``503`` breached
+  /status          the full shared status snapshot (one JSON document)
+  /metrics         Prometheus text exposition, rendered LIVE per request
+  /events          the lifecycle event ring (checkpoints, breaker, DLQ,
+                   SLO); ``?since=<seq>`` returns only newer events —
+                   pollers resume from ``latest_seq`` instead of
+                   re-reading (and re-alerting on) the whole ring
+  /trace/recent    newest window-trace summaries (ids + bounds)
+  /trace/<id>      one window's full trace lineage (``--trace-dir``)
+  /profile/cells   per-cell / per-family cost profiles + time series
+  =============== ====================================================
 
 - :class:`LiveStats` — a daemon thread printing a one-line stderr digest
   per interval (``--live-stats``; automatic under ``--kafka-follow`` when
@@ -39,6 +45,7 @@ import sys
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+from urllib.parse import parse_qs, unquote
 
 from spatialflink_tpu.utils import telemetry as _telemetry
 
@@ -80,7 +87,8 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self) -> None:  # noqa: N802 (http.server API)
         srv: "OpServer" = self.server.opserver  # type: ignore[attr-defined]
         srv.requests_served += 1
-        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        path, _, query = self.path.partition("?")
+        path = path.rstrip("/") or "/"
         try:
             if path == "/healthz":
                 code, payload = srv.healthz_payload()
@@ -91,12 +99,29 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(200, srv.metrics_text().encode(),
                            "text/plain; version=0.0.4")
             elif path == "/events":
-                self._send_json(200, srv.events_payload())
+                since_raw = parse_qs(query).get("since", [None])[0]
+                try:
+                    since = None if since_raw is None else int(since_raw)
+                except ValueError:
+                    self._send_json(400, {
+                        "error": f"?since must be an integer event seq, "
+                                 f"got {since_raw!r}"})
+                    return
+                self._send_json(200, srv.events_payload(since))
+            elif path == "/trace/recent":
+                self._send_json(200, srv.traces_payload())
+            elif path.startswith("/trace/"):
+                code, payload = srv.trace_payload(
+                    unquote(path[len("/trace/"):]))
+                self._send_json(code, payload)
+            elif path == "/profile/cells":
+                self._send_json(200, srv.profile_cells_payload())
             else:
                 self._send_json(404, {
                     "error": f"unknown path {path!r}",
                     "endpoints": ["/healthz", "/status", "/metrics",
-                                  "/events"]})
+                                  "/events", "/trace/recent", "/trace/<id>",
+                                  "/profile/cells"]})
         except (BrokenPipeError, ConnectionResetError):
             pass  # client went away mid-write (Ctrl-C'd curl sends RST)
         except Exception as e:
@@ -159,13 +184,60 @@ class OpServer:
     def metrics_text(self) -> str:
         return _telemetry.prometheus_text(self._tel(), registry=self.registry)
 
-    def events_payload(self) -> dict:
+    def events_payload(self, since: Optional[int] = None) -> dict:
         tel = self._tel()
         if tel is None:
-            return {"events": [], "total": 0,
+            return {"events": [], "total": 0, "latest_seq": 0,
                     "note": "lifecycle events need a telemetry session "
                             "(--telemetry-dir / --live-stats)"}
-        return {"events": tel.events.list(), "total": tel.events.total}
+        # latest_seq must never run AHEAD of the delivered list — an event
+        # appended between reading the counter and copying the ring would
+        # then be skipped forever by a poller resuming from it. So: the
+        # last seq actually IN the response, else the counter read BEFORE
+        # the copy (resuming there can re-deliver, never lose)
+        latest = tel.events.total
+        evs = tel.events.list(since)
+        if evs:
+            latest = evs[-1]["seq"]
+        elif since is not None:
+            latest = max(latest, since)
+        return {"events": evs, "total": tel.events.total,
+                "latest_seq": latest}
+
+    # ------------------- cost-attribution plane payloads --------------- #
+
+    _TRACE_NOTE = ("window tracing needs a telemetry session with tracing "
+                   "enabled (--trace-dir)")
+
+    def _trace_book(self):
+        tel = self._tel()
+        return tel.traces if tel is not None else None
+
+    def traces_payload(self) -> dict:
+        book = self._trace_book()
+        if book is None:
+            return {"traces": [], "total": 0, "note": self._TRACE_NOTE}
+        return {"traces": book.recent(), "total": book.total}
+
+    def trace_payload(self, trace_id: str):
+        """(http_code, payload) for ``/trace/<id>``."""
+        book = self._trace_book()
+        if book is None:
+            return 404, {"error": self._TRACE_NOTE}
+        tr = book.get(trace_id)
+        if tr is None:
+            return 404, {"error": f"unknown or evicted trace {trace_id!r} "
+                                  "(ids are '<family>:<window_start>'; see "
+                                  "/trace/recent)"}
+        return 200, tr
+
+    def profile_cells_payload(self) -> dict:
+        tel = self._tel()
+        if tel is None:
+            return {"cells": [], "families": {}, "series": [],
+                    "note": "cost profiles need a telemetry session "
+                            "(--telemetry-dir / --live-stats / --trace-dir)"}
+        return tel.costs.cells_payload()
 
     # ------------------------------ lifecycle -------------------------- #
 
@@ -236,6 +308,14 @@ def format_digest(snap: dict) -> str:
             st["breaker_state"], str(st["breaker_state"])))
     if st.get("dlq_depth"):
         parts.append(f"dlq {st['dlq_depth']}")
+    tc = st.get("top_cost_cells") or []
+    if tc:
+        # the costliest grid cell and its attributed kernel share — the
+        # skew-cost headline (who pays, not just who's crowded)
+        cell, cost_ms, _recs = tc[0]
+        total = (snap.get("costs") or {}).get("total_kernel_ms") or 0.0
+        share = f" ({cost_ms / total * 100:.0f}%)" if total else ""
+        parts.append(f"hot cell {cell} {cost_ms:.0f}ms{share}")
     deg = snap.get("degradation") or {}
     if deg:
         parts.append(f"degraded x{sum(deg.values())}")

@@ -718,8 +718,16 @@ class KafkaWindowSink:
         if self._tel is not None:
             # per-window producing time under the sink stage (the span also
             # covers the dedup check — both are the sink's cost)
+            t0 = time.time()
             with self._tel.span("sink", query="kafka"):
                 self._emit(result)
+            if (self._tel.traces is not None
+                    and hasattr(result, "window_start")):
+                # close the window's trace lineage: records + marker are
+                # on the output topic (suppressed duplicates included —
+                # their dedup check IS the commit-path cost they paid)
+                self._tel.traces.note_any(result.window_start,
+                                          "sink-commit", t0, time.time())
         else:
             self._emit(result)
 

@@ -35,6 +35,26 @@ dimensions, all host-side and all O(1) per observation:
   DLQ quarantine, mesh degradation, SLO breach/recovery) served by the
   status server's ``/events`` endpoint and dropped for free when no
   session is active.
+- :class:`WindowTraceBook` — per-window TRACE LINEAGE: every emitted
+  window carries a trace record (stable id derived from
+  ``(query, window_start)``) whose events walk the window's life —
+  first-record ingest, assembly, pane seals, kernel dispatch, merge/
+  readback, emit, driver sink, Kafka sink commit — with wall-clock
+  timestamps and durations, buffered in a bounded ring and exportable as
+  Chrome trace-event JSON (Perfetto-loadable; the driver's
+  ``--trace-dir``). Opt-in per session (``trace=True`` /
+  ``trace_dir=``): a plain telemetry session records no traces, so the
+  PR 2/5 session cost is unchanged unless tracing is asked for.
+- :class:`CostProfiles` — WHO PAYS: per-grid-cell and per-query-family
+  cost accumulators (records in, attributed kernel/merge wall-clock,
+  pane-cache hits/misses, approximate bytes moved) fed from the existing
+  ``record_cells`` observer hook and the family-labeled spans in
+  ``operators/base.py``, plus a bounded windowed time series (one bucket
+  per snapshot interval, closed by the reporter or the
+  ``/profile/cells`` scrape) so skew COST — not just occupancy — is visible
+  and ratcheting. Kernel time is attributed to cells proportionally to
+  the records that arrived since the previous dispatch (the new slide of
+  data at steady state); documented as attribution, not measurement.
 - :func:`status_snapshot` / :func:`status_digest` — THE definition of
   "current pipeline state": the raw snapshot plus a derived operator
   digest (throughput, latency percentiles, watermark lag, backlogs,
@@ -257,27 +277,32 @@ class CellOccupancy:
             grown[: self._counts.size] = self._counts
             self._counts = grown
 
+    def record_scalar(self, ci: int) -> None:
+        """One pre-validated cell id (>= 0): a bounds check + increment."""
+        self._ensure(ci + 1)
+        self._counts[ci] += 1
+
+    def record_counts(self, hi: int, counts) -> None:
+        """A pre-normalized bincount (valid cells only, length ``hi``)."""
+        self._ensure(hi)
+        self._counts[:hi] += counts
+
     def record(self, cells) -> None:
-        np = self._np
         # scalar fast path: the per-record streaming ingest assigns one
         # cell at a time — a single bounds check + increment, O(1), no
         # array construction (the vectorized branch below would cost
-        # O(num_cells) per record and dwarf the parse it observes)
-        if isinstance(cells, (int, np.integer)) or (
-                isinstance(cells, np.ndarray) and cells.ndim == 0):
-            ci = int(cells)
-            if ci < 0:
-                return
-            self._ensure(ci + 1)
-            self._counts[ci] += 1
+        # O(num_cells) per record and dwarf the parse it observes).
+        # Telemetry.record_cells normalizes ONCE and calls the
+        # record_scalar/record_counts halves directly so the cost-profile
+        # twin shares the same pass; this entry serves direct callers.
+        norm = normalize_cells(cells, self._np)
+        if norm is None:
             return
-        c = np.asarray(cells).ravel()
-        c = c[c >= 0]
-        if c.size == 0:
-            return
-        hi = int(c.max()) + 1
-        self._ensure(hi)
-        self._counts[:hi] += np.bincount(c, minlength=hi).astype(np.int64)
+        kind, a, b = norm
+        if kind == "scalar":
+            self.record_scalar(a)
+        else:
+            self.record_counts(a, b)
 
     def top_k(self, k: int = 8) -> List[Tuple[int, int]]:
         np = self._np
@@ -301,12 +326,40 @@ class CellOccupancy:
                 "top_cells": self.top_k(k)}
 
 
+def normalize_cells(cells, np):
+    """ONE normalization pass shared by the occupancy and cost-profile
+    accumulators (both are fed by the same observer hook — doing the
+    scalar check / ravel / negative filter / bincount twice would double
+    the hot ingest path's observation cost): returns
+    ``("scalar", cell_id, None)`` for a single valid cell,
+    ``("counts", hi, bincount)`` for an array, or None when nothing valid
+    remains."""
+    if isinstance(cells, (int, np.integer)) or (
+            isinstance(cells, np.ndarray) and cells.ndim == 0):
+        ci = int(cells)
+        return None if ci < 0 else ("scalar", ci, None)
+    c = np.asarray(cells).ravel()
+    c = c[c >= 0]
+    if c.size == 0:
+        return None
+    hi = int(c.max()) + 1
+    return ("counts", hi, np.bincount(c, minlength=hi).astype(np.int64))
+
+
 class EventRing:
     """Bounded ring buffer of structured lifecycle events. Appends are
     O(1) and lock-guarded (emitters live on pipeline, reporter, and HTTP
     threads); ``list()`` copies so readers never hold the lock while
     serializing. ``total`` counts every event ever appended, including
-    those the ring has since evicted."""
+    those the ring has since evicted.
+
+    Every event carries a monotonic ``seq`` (1-based, assigned under the
+    lock — ``total`` IS the last assigned seq) plus BOTH a wall-clock
+    ``ts_ms`` and a steady ``mono_ms`` (``time.monotonic``) timestamp, so
+    a wall-clock step (NTP, DST) cannot reorder the stream a poller
+    reconstructs. ``list(since=seq)`` returns only events newer than
+    ``seq`` — the ``/events?since=`` cursor that lets pollers stop
+    re-reading (and re-alerting on) the whole ring every fetch."""
 
     def __init__(self, capacity: int = 256):
         from collections import deque
@@ -316,16 +369,374 @@ class EventRing:
         self.total = 0
 
     def append(self, kind: str, **fields) -> dict:
-        ev = {"ts_ms": int(time.time() * 1000), "kind": kind}
+        ev = {"ts_ms": int(time.time() * 1000),
+              "mono_ms": round(time.monotonic() * 1e3, 3), "kind": kind}
         ev.update(fields)
         with self._lock:
-            self._ring.append(ev)
             self.total += 1
+            ev["seq"] = self.total
+            self._ring.append(ev)
         return ev
 
-    def list(self) -> List[dict]:
+    def list(self, since: Optional[int] = None) -> List[dict]:
         with self._lock:
-            return list(self._ring)
+            evs = list(self._ring)
+        if since is not None:
+            evs = [e for e in evs if e.get("seq", 0) > since]
+        return evs
+
+
+class WindowTraceBook:
+    """Per-window trace lineage: one record per window, keyed by a STABLE
+    trace id derived from ``(query, window_start)`` (re-deliveries and
+    resumed runs land on the same id). Each record accumulates timestamped
+    events as the window moves through the pipeline — ``ingest`` (the
+    first record's ingestion wall clock), ``window`` (assembly pull),
+    ``pane-seal`` (one per fresh pane kernel, pane mode), ``kernel``
+    (dispatch), ``merge`` (readback), ``emit``, then the downstream
+    ``sink`` / ``sink-commit`` stages (appended by window_start — the
+    driver and Kafka sink don't know the family).
+
+    Bounded: at most ``capacity`` traces are retained (oldest-started
+    evicted first); ``total`` counts every trace ever started. All methods
+    are lock-guarded and called at WINDOW granularity, never per record.
+    :meth:`chrome_trace` renders the ring as Chrome trace-event JSON
+    (the ``{"traceEvents": [...]}`` form), loadable in Perfetto /
+    ``chrome://tracing`` — durations become ``"ph": "X"`` slices, instants
+    ``"ph": "i"`` marks, one named track (tid) per query family."""
+
+    def __init__(self, capacity: int = 256):
+        from collections import OrderedDict
+
+        self._traces: "OrderedDict[str, dict]" = OrderedDict()
+        self._lock = threading.Lock()
+        self.capacity = max(1, int(capacity))
+        self.total = 0
+
+    @staticmethod
+    def trace_id(query: str, window_start) -> str:
+        return f"{query}:{int(window_start)}"
+
+    def _trace(self, query: str, window_start) -> dict:
+        """Get-or-start (caller holds the lock)."""
+        tid = self.trace_id(query, window_start)
+        tr = self._traces.get(tid)
+        if tr is None:
+            tr = {"trace_id": tid, "query": query,
+                  "window_start": int(window_start), "window_end": None,
+                  "first_record_ms": None, "emitted_ms": None, "events": []}
+            self._traces[tid] = tr
+            self.total += 1
+            while len(self._traces) > self.capacity:
+                self._traces.popitem(last=False)
+        return tr
+
+    def note(self, query: str, window_start, stage: str, t0_s: float,
+             t1_s: Optional[float] = None, **fields) -> None:
+        """Append one event; ``t0_s``/``t1_s`` are ``time.time()`` seconds
+        (wall clock, so slices line up across threads and processes)."""
+        ev = {"stage": stage, "ts_ms": round(t0_s * 1e3, 3)}
+        if t1_s is not None:
+            ev["dur_ms"] = round((t1_s - t0_s) * 1e3, 3)
+        ev.update(fields)
+        with self._lock:
+            self._trace(query, window_start)["events"].append(ev)
+
+    def first_record(self, query: str, window_start, ingest_ms) -> None:
+        """Record the window's first-record ingest wall clock (once)."""
+        with self._lock:
+            tr = self._trace(query, window_start)
+            if tr["first_record_ms"] is None:
+                tr["first_record_ms"] = int(ingest_ms)
+                tr["events"].insert(
+                    0, {"stage": "ingest", "ts_ms": int(ingest_ms)})
+
+    def seal(self, query: str, window_start, window_end) -> None:
+        """The window was emitted by its operator: stamp bounds + an
+        ``emit`` instant (later sink stages still append — the trace stays
+        in the ring until evicted by capacity)."""
+        now_ms = round(time.time() * 1e3, 3)
+        with self._lock:
+            tr = self._trace(query, window_start)
+            tr["window_end"] = int(window_end)
+            tr["emitted_ms"] = now_ms
+            tr["events"].append({"stage": "emit", "ts_ms": now_ms})
+
+    def note_any(self, window_start, stage: str, t0_s: float,
+                 t1_s: Optional[float] = None, **fields) -> None:
+        """Append an event to EVERY trace with this ``window_start`` — the
+        downstream sink stages see a WindowResult, not a family label.
+        O(ring) per emitted window, never per record."""
+        ws = int(window_start)
+        ev = {"stage": stage, "ts_ms": round(t0_s * 1e3, 3)}
+        if t1_s is not None:
+            ev["dur_ms"] = round((t1_s - t0_s) * 1e3, 3)
+        ev.update(fields)
+        with self._lock:
+            for tr in self._traces.values():
+                if tr["window_start"] == ws:
+                    tr["events"].append(dict(ev))
+
+    # ------------------------------ readers --------------------------- #
+
+    def get(self, trace_id: str) -> Optional[dict]:
+        with self._lock:
+            tr = self._traces.get(trace_id)
+            if tr is None:
+                return None
+            return {**tr, "events": [dict(e) for e in tr["events"]]}
+
+    def recent(self, k: int = 32) -> List[dict]:
+        """Newest-started ``k`` trace summaries (id, window, event count,
+        emitted) — the ``/trace/recent`` index."""
+        with self._lock:
+            traces = list(self._traces.values())[-max(0, int(k)):]
+            return [{"trace_id": t["trace_id"], "query": t["query"],
+                     "window_start": t["window_start"],
+                     "window_end": t["window_end"],
+                     "emitted_ms": t["emitted_ms"],
+                     "events": len(t["events"])} for t in reversed(traces)]
+
+    def chrome_trace(self) -> dict:
+        """The ring as a Chrome trace-event document (Perfetto-loadable)."""
+        events: List[dict] = []
+        tids: Dict[str, int] = {}
+        with self._lock:
+            traces = [
+                {**t, "events": [dict(e) for e in t["events"]]}
+                for t in self._traces.values()
+            ]
+        for tr in traces:
+            tid = tids.setdefault(tr["query"], len(tids) + 1)
+            for ev in tr["events"]:
+                args = {k: v for k, v in ev.items()
+                        if k not in ("stage", "ts_ms", "dur_ms")}
+                args["trace_id"] = tr["trace_id"]
+                base = {"name": ev["stage"], "cat": tr["query"],
+                        "ts": round(ev["ts_ms"] * 1e3, 1), "pid": 1,
+                        "tid": tid, "args": args}
+                if "dur_ms" in ev:
+                    events.append({**base, "ph": "X",
+                                   "dur": max(1.0, round(ev["dur_ms"] * 1e3,
+                                                         1))})
+                else:
+                    events.append({**base, "ph": "i", "s": "t"})
+        for query, tid in tids.items():
+            events.append({"name": "thread_name", "ph": "M", "pid": 1,
+                           "tid": tid, "args": {"name": query}})
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def export_chrome(self, path: str) -> str:
+        """Write :meth:`chrome_trace` to ``path`` (atomic tmp+rename, like
+        the Prometheus dump — a viewer must never load a torn file)."""
+        doc = self.chrome_trace()
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+        return path
+
+
+class CostProfiles:
+    """Per-grid-cell and per-query-family COST accumulators — the
+    where-does-the-time-go / who-pays complement to :class:`CellOccupancy`
+    (which only counts). Fed at two grains:
+
+    - per record (via :meth:`Telemetry.record_cells`, i.e. the existing
+      ``UniformGrid.assign_cell`` observer hook): per-cell records-in,
+      plus a PENDING bucket of cells seen since the last kernel dispatch;
+    - per window (from the family-labeled spans in ``operators/base.py``):
+      kernel/merge wall-clock, records, approximate bytes moved, and
+      pane-cache hits/misses per family — and the pending cell bucket is
+      folded into per-cell ``cost_ms`` proportionally (at steady state the
+      records that arrived since the previous dispatch are the new slide
+      of data, so each cell's share of fresh records is its share of the
+      kernel it triggered). This is ATTRIBUTION, not measurement — the
+      kernel runs on the whole window — but it is exactly the signal
+      skew-aware balancing needs: a hot cell's records make every window
+      containing them expensive, and its attributed cost ratchets
+      accordingly.
+
+    :meth:`tick` (called by the reporter once per interval) appends a
+    delta bucket to a bounded ``series`` deque, so ``/profile/cells``
+    serves a windowed time series of skew cost, not just a cumulative
+    total."""
+
+    def __init__(self, series_capacity: int = 128,
+                 tick_interval_s: float = 5.0):
+        import numpy as np
+
+        self._np = np
+        self._records = np.zeros(0, dtype=np.int64)
+        self._cost_ms = np.zeros(0, dtype=np.float64)
+        self._pending = np.zeros(0, dtype=np.int64)
+        self._pending_total = 0
+        self._cost_at_tick = np.zeros(0, dtype=np.float64)
+        self.families: Dict[str, dict] = {}
+        from collections import deque
+
+        self.series = deque(maxlen=max(1, int(series_capacity)))
+        #: minimum spacing between :meth:`maybe_tick` buckets — the
+        #: session's snapshot interval (telemetry_session sets it)
+        self.tick_interval_s = max(0.01, float(tick_interval_s))
+        self._last_tick_s = time.time()
+        self._lock = threading.Lock()
+
+    def _ensure(self, hi: int) -> None:
+        if hi > self._records.size:
+            np = self._np
+            size = max(hi, 2 * self._records.size)
+            for name in ("_records", "_cost_ms", "_pending"):
+                old = getattr(self, name)
+                grown = np.zeros(size, dtype=old.dtype)
+                grown[: old.size] = old
+                setattr(self, name, grown)
+
+    def record_scalar(self, ci: int) -> None:
+        """One pre-validated cell id — the per-record ingest twin of
+        :meth:`CellOccupancy.record_scalar`."""
+        self._ensure(ci + 1)
+        self._records[ci] += 1
+        self._pending[ci] += 1
+        self._pending_total += 1
+
+    def record_counts(self, hi: int, counts, n: int) -> None:
+        """A pre-normalized bincount (``n`` = total valid records)."""
+        self._ensure(hi)
+        self._records[:hi] += counts
+        self._pending[:hi] += counts
+        self._pending_total += n
+
+    def record_cells(self, cells) -> None:
+        """Normalizing entry for direct callers; the session observer
+        (:meth:`Telemetry.record_cells`) normalizes ONCE and feeds the
+        scalar/counts halves of both accumulators instead."""
+        norm = normalize_cells(cells, self._np)
+        if norm is None:
+            return
+        kind, a, b = norm
+        if kind == "scalar":
+            self.record_scalar(a)
+        else:
+            self.record_counts(a, b, int(b.sum()))
+
+    def family(self, label: str) -> dict:
+        f = self.families.get(label)
+        if f is None:
+            with self._lock:
+                f = self.families.setdefault(label, {
+                    "records_in": 0, "windows": 0, "kernel_ms": 0.0,
+                    "merge_ms": 0.0, "pane_hits": 0, "pane_misses": 0,
+                    "bytes_moved": 0})
+        return f
+
+    def attribute_kernel(self, label: str, dt_s: float, records: int = 0,
+                         nbytes: int = 0) -> None:
+        """One window's kernel dispatch: bump the family profile and fold
+        the pending cell bucket into per-cell cost (proportional split of
+        ``dt_s`` over the cells of records that arrived since the last
+        dispatch; an all-cached window — no fresh records — attributes
+        nothing, which is honest: it cost no new kernel work per cell)."""
+        dt_ms = dt_s * 1e3
+        f = self.family(label)
+        with self._lock:
+            f["windows"] += 1
+            f["records_in"] += int(records)
+            f["kernel_ms"] += dt_ms
+            f["bytes_moved"] += int(nbytes)
+            if self._pending_total:
+                n = self._pending.size
+                self._cost_ms[:n] += self._pending * (
+                    dt_ms / self._pending_total)
+                self._pending[:] = 0
+                self._pending_total = 0
+
+    def attribute_merge(self, label: str, dt_s: float) -> None:
+        f = self.family(label)
+        with self._lock:
+            f["merge_ms"] += dt_s * 1e3
+
+    def note_pane(self, label: str, hits: int, misses: int) -> None:
+        f = self.family(label)
+        with self._lock:
+            f["pane_hits"] += int(hits)
+            f["pane_misses"] += int(misses)
+
+    def top_cost_cells(self, k: int = 8, cost=None) -> List[list]:
+        """``[cell, cost_ms, records]`` rows, costliest first."""
+        np = self._np
+        cost = cost if cost is not None else self._cost_ms
+        nz = np.nonzero(cost > 0)[0]
+        if nz.size == 0:
+            return []
+        order = nz[np.argsort(cost[nz])[::-1][:k]]
+        return [[int(c), round(float(cost[c]), 3),
+                 int(self._records[c]) if c < self._records.size else 0]
+                for c in order]
+
+    def maybe_tick(self) -> None:
+        """Close a bucket only when ``tick_interval_s`` elapsed since the
+        last one — safe to call from every periodic/read path (reporter
+        snapshot, ``/profile/cells`` scrape) without double-bucketing."""
+        if time.time() - self._last_tick_s >= self.tick_interval_s:
+            self.tick()
+
+    def tick(self) -> dict:
+        """Close one time-series bucket: per-cell cost DELTA since the
+        previous tick (top-k) plus the delta's total. Bounded by the
+        series deque."""
+        np = self._np
+        self._last_tick_s = time.time()
+        with self._lock:
+            cur = self._cost_ms
+            prev = self._cost_at_tick
+            if prev.size < cur.size:
+                grown = np.zeros(cur.size, dtype=np.float64)
+                grown[: prev.size] = prev
+                prev = grown
+            delta = cur - prev[: cur.size]
+            self._cost_at_tick = cur.copy()
+        bucket = {"ts_ms": int(time.time() * 1000),
+                  "kernel_ms": round(float(delta.sum()), 3),
+                  "top_cells": self.top_cost_cells(8, cost=delta)}
+        self.series.append(bucket)
+        return bucket
+
+    def _families_dict(self) -> dict:
+        with self._lock:
+            return {
+                label: {k: (round(v, 3) if isinstance(v, float) else v)
+                        for k, v in f.items()}
+                for label, f in self.families.items()
+            }
+
+    def to_dict(self, k: int = 8) -> dict:
+        """The compact form embedded in every snapshot."""
+        return {
+            "top_cost_cells": self.top_cost_cells(k),
+            "total_kernel_ms": round(
+                float(self._cost_ms.sum()), 3),
+            "families": self._families_dict(),
+            "series_len": len(self.series),
+        }
+
+    def cells_payload(self, k: int = 64) -> dict:
+        """The full ``/profile/cells`` document: top-k per-cell rows with
+        cost shares, the per-family table, and the windowed time series.
+        Scrape-driven ticking (Prometheus-style): in a reporterless
+        session (``--trace-dir``/``--status-port`` without
+        ``--telemetry-dir``) the series still advances, one bucket per
+        ``tick_interval_s`` of being read."""
+        self.maybe_tick()
+        total = float(self._cost_ms.sum())
+        cells = [{"cell": c, "records": n, "cost_ms": cost,
+                  "cost_share": round(cost / total, 4) if total else 0.0}
+                 for c, cost, n in self.top_cost_cells(k)]
+        return {"ts_ms": int(time.time() * 1000), "cells": cells,
+                "total_kernel_ms": round(total, 3),
+                "occupied_cells": int((self._records > 0).sum()),
+                "families": self._families_dict(),
+                "series": list(self.series)}
 
 
 class Telemetry:
@@ -340,12 +751,20 @@ class Telemetry:
     not accounting).
     """
 
-    def __init__(self, registry: Optional[_metrics.MetricsRegistry] = None):
+    def __init__(self, registry: Optional[_metrics.MetricsRegistry] = None,
+                 trace: bool = False):
         self.registry = registry
         self.spans: Dict[str, SpanStats] = {}
         self.histograms: Dict[str, StreamingHistogram] = {}
         self.gauges: Dict[str, Gauge] = {}
         self.cells = CellOccupancy()
+        self.costs = CostProfiles()
+        #: per-window trace lineage — OPT-IN (``trace=True`` /
+        #: ``--trace-dir``): None keeps the plain session's hot-path cost
+        #: exactly what PRs 2/5 measured; instrumented sites check this
+        #: once per stream/loop like everything else
+        self.traces: Optional[WindowTraceBook] = (
+            WindowTraceBook() if trace else None)
         self.events = EventRing()
         #: optional runtime.health.HealthEvaluator attached by the driver
         #: (--slo): status_snapshot() stamps its verdict into every
@@ -414,7 +833,19 @@ class Telemetry:
         return g
 
     def record_cells(self, cells) -> None:
-        self.cells.record(cells)
+        # ONE normalization (scalar check / filter / bincount) feeding
+        # both accumulators — this is the per-record observer hook, so the
+        # pass must not be paid twice
+        norm = normalize_cells(cells, self.cells._np)
+        if norm is None:
+            return
+        kind, a, b = norm
+        if kind == "scalar":
+            self.cells.record_scalar(a)
+            self.costs.record_scalar(a)
+        else:
+            self.cells.record_counts(a, b)
+            self.costs.record_counts(a, b, int(b.sum()))
 
     # ------------------------------ snapshot -------------------------- #
 
@@ -440,6 +871,11 @@ class Telemetry:
             "counters": reg.snapshot(),
             "degradation": _metrics.degradation_snapshot(reg),
             "grid": self.cells.to_dict(),
+            "costs": self.costs.to_dict(),
+            "traces": {
+                "enabled": self.traces is not None,
+                "total": self.traces.total if self.traces is not None else 0,
+            },
         }
 
 
@@ -534,6 +970,10 @@ def status_digest(snap: dict) -> dict:
         "mesh_degradations": int(counters.get("mesh-degradations", 0)),
         "slo_breaches": int(counters.get("slo-breaches", 0)),
         "top_cells": grid.get("top_cells", []),
+        # [[cell, attributed_kernel_ms, records], ...] — skew COST, the
+        # companion to top_cells' occupancy counts (CostProfiles)
+        "top_cost_cells": (snap.get("costs") or {}).get(
+            "top_cost_cells", []),
     }
 
 
@@ -555,6 +995,8 @@ def registry_snapshot(registry: Optional[_metrics.MetricsRegistry] = None
         "counters": reg.snapshot(),
         "degradation": _metrics.degradation_snapshot(reg),
         "grid": {},
+        "costs": {},
+        "traces": {"enabled": False, "total": 0},
     }
 
 
@@ -593,6 +1035,12 @@ def prometheus_text(tel: Optional[Telemetry] = None,
     gauges and registry counters as-is. Metric names are fixed; the
     span/histogram/counter name rides a label (dots and dashes are legal
     in label VALUES, so the query-scoped names survive unmangled).
+    Query-family-scoped spans and histograms (``knn.kernel``) split into
+    PROPER labels — ``stage="kernel",family="knn"`` — instead of a
+    flattened combined value, so live scrapes can aggregate a stage
+    across families (``sum by (stage)``) or a family across stages
+    without regex label surgery; unscoped names render as ``stage="..."``
+    / ``name="..."`` with no family label.
     ``tel=None`` renders the registry-only view (counter families only) —
     the no-session ``/metrics`` endpoint. Rendered live by both the
     reporter (every snapshot rewrites ``metrics.prom``) and the status
@@ -603,6 +1051,18 @@ def prometheus_text(tel: Optional[Telemetry] = None,
         lines.append(f"# TYPE {metric} {mtype}")
         for labels, v in rows:
             lines.append(f"{metric}{{{labels}}} {v}")
+
+    def span_labels(name: str) -> str:
+        family, sep, stage = name.rpartition(".")
+        if sep:
+            return f'stage="{stage}",family="{family}"'
+        return f'stage="{name}"'
+
+    def hist_labels(name: str, extra: str = "") -> str:
+        family, sep, base = name.rpartition(".")
+        lab = (f'name="{base}",family="{family}"' if sep
+               else f'name="{name}"')
+        return lab + extra
 
     if tel is None:
         reg = registry if registry is not None else _metrics.REGISTRY
@@ -616,22 +1076,22 @@ def prometheus_text(tel: Optional[Telemetry] = None,
         hists = dict(tel.histograms)
         gauges = dict(tel.gauges)
     emit("spatialflink_span_count", "counter",
-         [(f'stage="{n}"', s.count) for n, s in sorted(spans.items())])
+         [(span_labels(n), s.count) for n, s in sorted(spans.items())])
     emit("spatialflink_span_seconds_total", "counter",
-         [(f'stage="{n}"', round(s.total_s, 6))
+         [(span_labels(n), round(s.total_s, 6))
           for n, s in sorted(spans.items())])
     emit("spatialflink_span_seconds_max", "gauge",
-         [(f'stage="{n}"', round(s.max_s, 6))
+         [(span_labels(n), round(s.max_s, 6))
           for n, s in sorted(spans.items())])
     emit("spatialflink_histogram_count", "counter",
-         [(f'name="{n}"', h.count) for n, h in sorted(hists.items())])
+         [(hist_labels(n), h.count) for n, h in sorted(hists.items())])
     emit("spatialflink_histogram_sum", "counter",
-         [(f'name="{n}"', round(h.total, 6))
+         [(hist_labels(n), round(h.total, 6))
           for n, h in sorted(hists.items())])
     qrows = []
     for n, h in sorted(hists.items()):
         for q in (50, 95, 99):
-            qrows.append((f'name="{n}",quantile="0.{q}"',
+            qrows.append((hist_labels(n, f',quantile="0.{q}"'),
                           round(h.percentile(q), 6)))
     emit("spatialflink_histogram_quantile", "gauge", qrows)
     emit("spatialflink_gauge", "gauge",
@@ -663,6 +1123,10 @@ class TelemetryReporter:
         self._thread: Optional[threading.Thread] = None
 
     def _emit(self) -> None:
+        # close a cost-profile time-series bucket at most once per tick
+        # interval (maybe_tick: the /profile/cells scrape path ticks too,
+        # and the two must not double-bucket)
+        self.telemetry.costs.maybe_tick()
         snap = status_snapshot(self.telemetry)
         with open(self.jsonl_path, "a") as f:
             f.write(json.dumps(snap, sort_keys=True) + "\n")
@@ -694,18 +1158,26 @@ class TelemetryReporter:
 @contextlib.contextmanager
 def telemetry_session(out_dir: Optional[str] = None, interval_s: float = 5.0,
                       registry: Optional[_metrics.MetricsRegistry] = None,
-                      health=None):
+                      health=None, trace: bool = False,
+                      trace_dir: Optional[str] = None):
     """Activate telemetry for the enclosed block: installs the
     :class:`Telemetry` as the active session, hooks the grid's cell-
     assignment observer, and (when ``out_dir`` is given) runs a
     :class:`TelemetryReporter`. ``health`` attaches an SLO evaluator
     (``runtime.health.HealthEvaluator``) so every snapshot carries its
-    verdict. Everything is restored on exit — including after an
-    exception — so a crashed run still gets its final snapshot."""
+    verdict. ``trace=True`` (implied by ``trace_dir``) records per-window
+    trace lineage in a :class:`WindowTraceBook`; ``trace_dir`` exports it
+    as Chrome trace-event JSON (``trace.json``, Perfetto-loadable) at
+    close. Everything is restored on exit — including after an
+    exception — so a crashed run still gets its final snapshot (and its
+    trace: a crash is exactly when the timeline matters)."""
     from spatialflink_tpu.index import uniform_grid as _ug
 
-    tel = Telemetry(registry)
+    tel = Telemetry(registry, trace=trace or bool(trace_dir))
     tel.health = health
+    # the cost-profile series buckets at the session's snapshot cadence,
+    # whoever drives it (reporter snapshot or /profile/cells scrape)
+    tel.costs.tick_interval_s = max(0.01, float(interval_s))
     old = set_active(tel)
     old_obs = _ug._CELL_OBSERVER
     _ug._CELL_OBSERVER = tel.record_cells
@@ -719,8 +1191,17 @@ def telemetry_session(out_dir: Optional[str] = None, interval_s: float = 5.0,
             if reporter is not None:
                 reporter.close()
         finally:
-            # restore the globals even when the final snapshot/prom write
-            # fails (disk full, dir deleted mid-run): a dead session left
-            # active would instrument every later run in the process
-            _ug._CELL_OBSERVER = old_obs
-            set_active(old)
+            try:
+                if trace_dir and tel.traces is not None:
+                    os.makedirs(trace_dir, exist_ok=True)
+                    tel.traces.export_chrome(
+                        os.path.join(trace_dir, "trace.json"))
+            except Exception:
+                pass  # export is best-effort; never mask the run's error
+            finally:
+                # restore the globals even when the final snapshot/prom
+                # write fails (disk full, dir deleted mid-run): a dead
+                # session left active would instrument every later run in
+                # the process
+                _ug._CELL_OBSERVER = old_obs
+                set_active(old)

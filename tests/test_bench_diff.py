@@ -1,0 +1,128 @@
+"""bench_diff regression sentinel on synthetic rows: row pairing by
+identity fields, default + per-row thresholds, both metric directions,
+missing/new row handling, and the CLI exit codes CI keys on."""
+
+import json
+
+import pytest
+
+from benchmarks.bench_diff import (diff_rows, load_rows, main, parse_rule,
+                                   row_key)
+
+
+def _row(option=1, path="record", rps=1000, **kw):
+    return dict(option=option, path=path, records=100_000,
+                records_per_sec=rps, **kw)
+
+
+def _by_key(results):
+    return {r["key"]: r for r in results}
+
+
+class TestDiffRows:
+    def test_identity_pairing_and_ok(self):
+        base = [_row(1, "record", 1000), _row(1, "bulk", 9000),
+                _row(51, "record", 800)]
+        cur = [_row(51, "record", 820), _row(1, "bulk", 8950),
+               _row(1, "record", 990)]  # order must not matter
+        res = diff_rows(base, cur, "records_per_sec", 0.10)
+        assert all(r["status"] == "ok" for r in res)
+        assert len(res) == 3
+
+    def test_regression_past_threshold_flags(self):
+        base = [_row(1, "record", 1000), _row(1, "bulk", 9000)]
+        cur = [_row(1, "record", 850), _row(1, "bulk", 8500)]
+        res = _by_key(diff_rows(base, cur, "records_per_sec", 0.10))
+        assert res["option=1,path=record,records=100000"]["status"] == \
+            "regression"
+        assert res["option=1,path=bulk,records=100000"]["status"] == "ok"
+
+    def test_improvement_never_flags(self):
+        res = diff_rows([_row(rps=1000)], [_row(rps=5000)],
+                        "records_per_sec", 0.0)
+        assert res[0]["status"] == "ok" and res[0]["change"] == 4.0
+
+    def test_per_row_rule_overrides_default(self):
+        base = [_row(1, "record", 1000), _row(1, "bulk", 9000)]
+        cur = [_row(1, "record", 920), _row(1, "bulk", 8300)]
+        # default 10% passes both; a tight bulk-only rule fails bulk
+        rules = [parse_rule("path=bulk:0.05")]
+        res = _by_key(diff_rows(base, cur, "records_per_sec", 0.10, rules))
+        assert res["option=1,path=bulk,records=100000"]["status"] == \
+            "regression"
+        assert res["option=1,path=record,records=100000"]["status"] == "ok"
+
+    def test_lower_is_better_direction(self):
+        base = [_row(wall_s=10.0)]
+        worse = [_row(wall_s=12.0)]
+        better = [_row(wall_s=8.0)]
+        assert diff_rows(base, worse, "wall_s", 0.10,
+                         lower_is_better=True)[0]["status"] == "regression"
+        assert diff_rows(base, better, "wall_s", 0.10,
+                         lower_is_better=True)[0]["status"] == "ok"
+
+    def test_missing_new_and_unmeasured(self):
+        base = [_row(1, "record"), _row(1, "bulk"),
+                dict(option=9, path="x", records_per_sec=None)]
+        cur = [_row(1, "record"), _row(2, "record"),
+               dict(option=9, path="x", records_per_sec=None)]
+        statuses = {r["key"]: r["status"]
+                    for r in diff_rows(base, cur, "records_per_sec", 0.1)}
+        assert statuses["option=1,path=bulk,records=100000"] == "missing"
+        assert statuses["option=2,path=record,records=100000"] == "new"
+        assert statuses["option=9,path=x"] == "unmeasured"
+
+    def test_row_key_ignores_metrics(self):
+        assert row_key(_row(rps=1)) == row_key(_row(rps=99999))
+
+    def test_parse_rule_rejects_malformed(self):
+        with pytest.raises(ValueError, match="threshold"):
+            parse_rule("path=bulk")
+        with pytest.raises(ValueError, match="not numeric"):
+            parse_rule("path=bulk:fast")
+        with pytest.raises(ValueError, match="key=value"):
+            parse_rule("bulk:0.1")
+
+
+class TestCli:
+    def _write(self, tmp_path, name, rows, wrapped=True):
+        p = tmp_path / name
+        p.write_text(json.dumps({"rows": rows} if wrapped else rows))
+        return str(p)
+
+    def test_exit_codes(self, tmp_path, capsys):
+        base = self._write(tmp_path, "base.json",
+                           [_row(1, "record", 1000), _row(1, "bulk", 9000)])
+        ok = self._write(tmp_path, "ok.json",
+                         [_row(1, "record", 980), _row(1, "bulk", 9100)],
+                         wrapped=False)  # bare-list shape also loads
+        bad = self._write(tmp_path, "bad.json",
+                          [_row(1, "record", 400), _row(1, "bulk", 9100)])
+        assert main([base, ok]) == 0
+        assert main([base, bad]) == 1
+        out = capsys.readouterr().out
+        assert "REGRESSION" in out and "path=record" in out
+        # missing rows: visible but non-fatal ...
+        part = self._write(tmp_path, "part.json", [_row(1, "bulk", 9000)])
+        assert main([base, part]) == 0
+        assert "MISSING" in capsys.readouterr().out
+        # ... unless CI demands full coverage
+        assert main([base, part, "--require-all"]) == 2
+
+    def test_usage_errors_exit_2(self, tmp_path):
+        good = self._write(tmp_path, "g.json", [_row()])
+        assert main([str(tmp_path / "absent.json"), good]) == 2
+        assert main([good, good, "--rule", "nonsense"]) == 2
+
+    def test_cli_rules_and_metric_flags(self, tmp_path):
+        base = self._write(tmp_path, "b.json", [_row(1, "bulk", 9000)])
+        cur = self._write(tmp_path, "c.json", [_row(1, "bulk", 8400)])
+        assert main([base, cur]) == 0  # -6.7% inside the default 10%
+        assert main([base, cur, "--rule", "path=bulk:0.05"]) == 1
+
+    def test_load_rows_real_ledger_shape(self):
+        # the in-repo ledger parses and pairs with itself (zero diff)
+        rows = load_rows("benchmarks/RESULTS_e2e_cpu.json")
+        assert rows and all(isinstance(r, dict) for r in rows)
+        res = diff_rows(rows, rows, "records_per_sec", 0.0)
+        assert all(r["status"] in ("ok", "unmeasured") for r in res)

@@ -33,12 +33,13 @@ pytestmark = pytest.mark.liveops
 GRID = UniformGrid(115.5, 117.6, 39.6, 41.1, num_grid_partitions=100)
 
 RAW_KEYS = {"ts_ms", "uptime_s", "spans", "histograms", "gauges",
-            "counters", "degradation", "grid"}
+            "counters", "degradation", "grid", "costs", "traces"}
 STATUS_KEYS = {"records_in", "throughput_rps", "windows_evaluated",
                "record_latency_ms", "window_latency_ms", "watermark_lag_ms",
                "commit_backlog", "window_backlog", "pane_cache",
                "checkpoint", "breaker_state", "dlq_depth",
-               "mesh_degradations", "slo_breaches", "top_cells"}
+               "mesh_degradations", "slo_breaches", "top_cells",
+               "top_cost_cells"}
 
 
 def _get(url, timeout=5):

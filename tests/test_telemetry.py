@@ -285,30 +285,38 @@ class TestLatencySink:
 
 
 class _CallCounter:
-    """Counts every Telemetry.span/observe and StreamingHistogram.record
-    call process-wide — the telemetry-off hot-path assertion."""
+    """Counts every Telemetry.span/observe, StreamingHistogram.record,
+    CostProfiles feed, and WindowTraceBook note process-wide — the
+    telemetry-off hot-path assertion (the PR 6 cost/trace plane must obey
+    the same contract as the PR 2 spans: zero calls without a session)."""
 
     def __init__(self, monkeypatch):
+        from spatialflink_tpu.utils.telemetry import (CostProfiles,
+                                                      WindowTraceBook)
+
         self.calls = 0
-        orig_span, orig_obs = Telemetry.span, Telemetry.observe
-        orig_rec = StreamingHistogram.record
         counter = self
 
-        def span(self, *a, **k):
-            counter.calls += 1
-            return orig_span(self, *a, **k)
+        def wrap(cls, name):
+            orig = getattr(cls, name)
 
-        def observe(self, *a, **k):
-            counter.calls += 1
-            return orig_obs(self, *a, **k)
+            def spy(self, *a, **k):
+                counter.calls += 1
+                return orig(self, *a, **k)
 
-        def record(self, *a, **k):
-            counter.calls += 1
-            return orig_rec(self, *a, **k)
+            monkeypatch.setattr(cls, name, spy)
 
-        monkeypatch.setattr(Telemetry, "span", span)
-        monkeypatch.setattr(Telemetry, "observe", observe)
-        monkeypatch.setattr(StreamingHistogram, "record", record)
+        for cls, name in ((Telemetry, "span"), (Telemetry, "observe"),
+                          (StreamingHistogram, "record"),
+                          (CostProfiles, "record_cells"),
+                          (CostProfiles, "record_scalar"),
+                          (CostProfiles, "record_counts"),
+                          (CostProfiles, "attribute_kernel"),
+                          (CostProfiles, "attribute_merge"),
+                          (WindowTraceBook, "note"),
+                          (WindowTraceBook, "note_any"),
+                          (WindowTraceBook, "seal")):
+            wrap(cls, name)
 
 
 class TestDriverTelemetry:

@@ -192,6 +192,79 @@ def bench_panes(option: int, path: str, n: int, overlap: int) -> list:
     ]
 
 
+def bench_pane_state(option: int, path: str, n: int, overlap: int) -> list:
+    """Device-resident vs host-merged pane state (the --pane-merge A/B) at
+    sliding overlap ``overlap``: same replay, same backend, window-table
+    identity asserted in-run. Device mode keeps pane kernel partials in
+    device memory and merges each window ON device (one merged readback per
+    window); host mode resolves every partial to host and merges there.
+    Rows carry the measured per-slide readback bytes/transfers from the
+    always-on registry counters (the same numbers the bytes_moved cost
+    profile accumulates), so the data-motion contract is part of the
+    ledger. Runs unchanged on any backend — on the TPU the per-readback
+    saving is a tunnel RTT, not just bytes."""
+    from spatialflink_tpu import driver
+    from spatialflink_tpu.utils.metrics import REGISTRY, scoped_registry
+
+    p = _params(option)
+    p.window.interval_s = SLIDE_S * overlap
+    p.window.step_s = SLIDE_S
+    p.query.panes = True
+    spec = driver.CASES[option]
+    parsed = driver._bulk_parse_stream(p.input1, path,
+                                       p.query.allowed_lateness_s)
+    if parsed is None:
+        print(f"warning: option {option}: bulk ingest declined for the "
+              "pane-state rows; rows omitted", file=sys.stderr)
+        raise _BulkDeclined
+    u_grid, _ = p.grids()
+    q = driver._query_object(p, u_grid, spec.query)
+
+    def run(device: bool):
+        p.query.pane_device_merge = device
+        conf = driver._query_conf(p, spec)
+        op = driver._operator_class(spec)(conf, u_grid)
+        with scoped_registry() as reg:
+            t0 = time.perf_counter()
+            if spec.family == "range":
+                it = op.run_bulk(parsed, q, p.query.radius)
+            else:
+                it = op.run_bulk(parsed, q, p.query.radius, p.query.k)
+            table = _window_table(it, option)
+            dt = time.perf_counter() - t0
+            snap = reg.snapshot()
+        return table, dt, snap
+
+    run(True)   # warm both modes' jit shapes outside the timed rows
+    run(False)
+    t_dev, dt_dev, snap_dev = run(True)
+    t_host, dt_host, snap_host = run(False)
+    assert t_dev == t_host, (
+        f"option {option} overlap {overlap}: device pane merge diverged "
+        "from host merge")
+    slides = max(len(t_dev), 1)
+    base = dict(option=option, overlap=overlap, records=n,
+                windows=len(t_dev), identical=True)
+
+    def row(path_name, dt, snap):
+        rb_b = int(snap.get("pane-partial-readback-bytes", 0)
+                   + snap.get("pane-merged-readback-bytes", 0))
+        rb_n = int(snap.get("pane-partial-readbacks", 0)
+                   + snap.get("pane-merged-readbacks", 0))
+        return dict(base, path=path_name, wall_s=round(dt, 3),
+                    records_per_sec=round(n / dt),
+                    pane_readback_bytes=rb_b, pane_readbacks=rb_n,
+                    readback_bytes_per_slide=round(rb_b / slides, 1))
+
+    r_host = row("panes_host_merge", dt_host, snap_host)
+    r_dev = row("panes_device_merge", dt_dev, snap_dev)
+    r_dev["speedup_vs_host_merge"] = round(dt_host / dt_dev, 2)
+    r_dev["readback_bytes_vs_host"] = round(
+        r_dev["pane_readback_bytes"] / max(r_host["pane_readback_bytes"], 1),
+        3)
+    return [r_host, r_dev]
+
+
 def bench_checkpoint(option: int, path: str, n: int, every: int) -> list:
     """Coordinated-checkpoint overhead (the robustness cost BASELINE.md
     tracks): the record path with checkpointing OFF vs a coordinator
@@ -387,6 +460,12 @@ def main() -> int:
                          "the range/kNN options; window-table identity is "
                          "asserted in the same run. 0 (default) disables "
                          "the pane rows")
+    ap.add_argument("--pane-state-overlap", type=int, default=0,
+                    help="sliding overlap for the device-resident vs "
+                         "host-merged pane-state rows (--pane-merge A/B "
+                         "over the kNN option, identity asserted in-run, "
+                         "per-slide readback bytes attached). 0 (default) "
+                         "disables them")
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
 
@@ -445,6 +524,19 @@ def main() -> int:
                 if opt not in [int(x) for x in args.options.split(",")]:
                     continue
                 for row in bench_live_plane(opt, path, n):
+                    row["backend"] = backend
+                    print(json.dumps(row), flush=True)
+                    rows.append(row)
+        if args.pane_state_overlap > 1:
+            for opt in (51,):
+                if opt not in [int(x) for x in args.options.split(",")]:
+                    continue
+                try:
+                    ps_rows = bench_pane_state(opt, path, n,
+                                               args.pane_state_overlap)
+                except _BulkDeclined:
+                    continue
+                for row in ps_rows:
                     row["backend"] = backend
                     print(json.dumps(row), flush=True)
                     rows.append(row)

@@ -1,0 +1,244 @@
+"""Micro-bench regression GATE for the batched hot path.
+
+The batched-everywhere rebuild (chunk-vectorized decode + ``assign_bulk``
+window assignment + columnar window payloads) is a performance claim with
+no flag guarding it — a regression back to per-record cost would be
+silent. This harness measures the hot-path speedup RATIOS (batched vs the
+seed scalar loop, same machine, same run — ratios are robust to machine
+speed in a way absolute rec/s is not) and ``--check`` diffs them against
+the checked-in conservative floors in ``GUARD_baseline.json`` via
+``bench_diff`` (metric ``speedup``, >25% below a floor fails). Wired into
+tier-1 by ``tests/test_bench_guard.py``, so this PR's wins can't rot
+unnoticed.
+
+Rows (identity field ``path``):
+
+- ``window_assign``     chunked ``WindowAssembler.assemble`` vs per-record
+                        ``add`` (assignment + seal sweep only)
+- ``decode_columnar``   ``driver.decode_chunks`` native columnar CSV parse
+                        vs the seed per-record ``parse_spatial`` loop
+- ``windowed_pipeline`` windowed range end-to-end (decode -> windows ->
+                        kernel -> selection) on the batched path vs the
+                        same operator fed the scalar-decoded record stream
+
+Usage:
+    python benchmarks/bench_guard.py [--n N] [--out PATH]
+    python benchmarks/bench_guard.py --check          # exit 1 on regression
+    python benchmarks/bench_guard.py --write-baseline # refresh the floors
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "GUARD_baseline.json")
+#: floors are written at measured/MARGIN so box-to-box variance does not
+#: flap the gate; the 25% diff threshold sits on top
+MARGIN = 2.0
+
+
+def _lines(n: int):
+    rng = np.random.default_rng(0)
+    t0 = 1_700_000_000_000
+    ts = t0 + (np.arange(n) * 100_000 // max(n, 1))  # 100 s span
+    return [f"v{int(i) % 97},{int(t)},"
+            f"{115.5 + rng.random() * 2:.6f},{39.6 + rng.random() * 1.5:.6f}"
+            for i, t in enumerate(ts)]
+
+
+def _cfg():
+    from spatialflink_tpu.config import StreamConfig
+
+    return StreamConfig(format="CSV", date_format=None,
+                        csv_tsv_schema=[0, 1, 2, 3])
+
+
+def _grid():
+    from spatialflink_tpu.index import UniformGrid
+
+    return UniformGrid(115.5, 117.6, 39.6, 41.1, num_grid_partitions=100)
+
+
+def _scalar_decode(lines, cfg, grid):
+    """The SEED per-record decoder (parse_spatial per record) — kept here
+    and in tests/oracles.py as the reference the ratios divide by."""
+    from spatialflink_tpu.streams.formats import parse_spatial
+
+    return [parse_spatial(ln, cfg.format, grid, delimiter=cfg.delimiter,
+                          schema=cfg.csv_tsv_schema, geometry="Point")
+            for ln in lines]
+
+
+def bench_window_assign(n: int) -> dict:
+    import types
+
+    from spatialflink_tpu.runtime.windows import WindowAssembler, WindowSpec
+
+    rng = np.random.default_rng(0)
+    ts = (1_700_000_000_000 + np.sort(rng.integers(0, 100_000, n))).tolist()
+    recs = [types.SimpleNamespace(timestamp=t) for t in ts]
+    spec = WindowSpec.sliding(40_000, 5_000)  # overlap 8
+
+    def per_record():
+        wa = WindowAssembler(spec)
+        out = []
+        for r in recs:
+            out += [(s, e, len(rr)) for s, e, rr in wa.add(r.timestamp, r)]
+        out += [(s, e, len(rr)) for s, e, rr in wa.flush()]
+        return out
+
+    def chunked():
+        wa = WindowAssembler(spec)
+        return [(s, e, len(rr)) for s, e, rr in wa.assemble(iter(recs))]
+
+    per_record(), chunked()  # warm
+    t0 = time.perf_counter()
+    ref = per_record()
+    dt_rec = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    fast = chunked()
+    dt_chunk = time.perf_counter() - t0
+    assert fast == ref, "chunked assignment diverged from per-record add"
+    return dict(path="window_assign", records=n,
+                speedup=round(dt_rec / dt_chunk, 2))
+
+
+def bench_decode_columnar(n: int) -> dict:
+    from spatialflink_tpu import driver
+
+    lines = _lines(n)
+    cfg, grid = _cfg(), _grid()
+
+    def batched():
+        return sum(len(c) for c in driver.decode_chunks(iter(lines), cfg,
+                                                        grid))
+
+    batched()
+    _scalar_decode(lines[:2048], cfg, grid)  # warm both import paths
+    t0 = time.perf_counter()
+    total = batched()
+    dt_b = time.perf_counter() - t0
+    assert total == n
+    t0 = time.perf_counter()
+    objs = _scalar_decode(lines, cfg, grid)
+    dt_s = time.perf_counter() - t0
+    assert len(objs) == n
+    return dict(path="decode_columnar", records=n,
+                speedup=round(dt_s / dt_b, 2))
+
+
+def bench_windowed_pipeline(n: int) -> dict:
+    from spatialflink_tpu import driver
+    from spatialflink_tpu.models import Point
+    from spatialflink_tpu.operators import (PointPointRangeQuery,
+                                            QueryConfiguration, QueryType)
+
+    lines = _lines(n)
+    cfg, grid = _cfg(), _grid()
+    conf = QueryConfiguration(QueryType.WindowBased, 10_000, 5_000)
+    qp = Point.create(116.5, 40.3, grid, obj_id="q")
+    scalar_objs = _scalar_decode(lines, cfg, grid)
+
+    def run_batched():
+        op = PointPointRangeQuery(conf, grid)
+        stream = driver.decode_stream(iter(lines), cfg, grid)
+        return [(r.window_start, len(r.records))
+                for r in op.run(stream, qp, 0.5)]
+
+    def run_scalar():
+        op = PointPointRangeQuery(conf, grid)
+        return [(r.window_start, len(r.records))
+                for r in op.run(iter(scalar_objs), qp, 0.5)]
+
+    run_batched(), run_scalar()  # warm jit shapes both paths share
+    t0 = time.perf_counter()
+    tb = run_batched()
+    dt_b = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    ts_ = run_scalar()
+    dt_s = time.perf_counter() - t0
+    assert tb == ts_, "batched pipeline window table diverged"
+    # the scalar side here ALREADY skips the per-record parse (pre-decoded
+    # objects), so the ratio under-counts the full win — a conservative
+    # guard by construction
+    dt_s += 0.0
+    return dict(path="windowed_pipeline", records=n,
+                speedup=round((dt_s) / dt_b, 2))
+
+
+def measure(n: int) -> list:
+    return [bench_window_assign(n), bench_decode_columnar(n),
+            bench_windowed_pipeline(n)]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=120_000)
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--check", action="store_true",
+                    help="diff the fresh ratios against GUARD_baseline.json "
+                         "(bench_diff, metric=speedup, threshold 0.25); "
+                         "exit 1 on regression")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write measured/%.1f floors to GUARD_baseline.json"
+                         % MARGIN)
+    args = ap.parse_args()
+
+    from benchmarks._common import settle_backend
+
+    settle_backend()
+    import jax
+
+    backend = jax.default_backend()
+    rows = measure(args.n)
+    for r in rows:
+        r["backend"] = backend
+        print(json.dumps(r), flush=True)
+
+    if args.write_baseline:
+        floors = [dict(path=r["path"],
+                       speedup=round(max(r["speedup"] / MARGIN, 1.0), 2))
+                  for r in rows]
+        with open(BASELINE_PATH, "w") as f:
+            json.dump({"metric": "speedup",
+                       "note": "conservative floors = measured/%.1f; "
+                               "bench_guard --check trips >25%% below"
+                               % MARGIN,
+                       "rows": floors}, f, indent=1)
+        print(f"# wrote {BASELINE_PATH}", file=sys.stderr)
+        return 0
+
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump({"backend": backend, "rows": rows}, f, indent=1)
+
+    if args.check:
+        from benchmarks.bench_diff import main as diff_main
+
+        with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                         delete=False) as f:
+            # identity = path only (the floors are scale/backend-agnostic
+            # ratios; keeping records/backend in the key would unpair rows)
+            json.dump({"rows": [dict(path=r["path"], speedup=r["speedup"])
+                                for r in rows]}, f)
+            fresh = f.name
+        try:
+            return diff_main([BASELINE_PATH, fresh, "--metric", "speedup",
+                              "--threshold", "0.25", "--require-all"])
+        finally:
+            os.unlink(fresh)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

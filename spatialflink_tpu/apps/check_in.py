@@ -47,6 +47,10 @@ def _symbol(device_id: str) -> str:
 
 class CheckIn(SpatialOperator):
     """Occupancy pipeline. Grid-free: pass ``grid=None``."""
+    # interner-keyed cross-window state: windows must carry
+    # materialized records in the OPERATOR's id space (the
+    # chunked decode still batches the parse)
+    columnar_windows = False
 
     # CheckIn owns its fixed countWindow(2,1)/countWindow(1) pipeline
     # (apps/CheckIn.java); the generic count mode does not apply

@@ -95,6 +95,10 @@ def _segments_intersect_rects(x0, y0, x1, y1, rx0, ry0, rx1, ry1) -> np.ndarray:
 
 class StayTime(SpatialOperator):
     """Windowed stay-time pipeline over a :class:`UniformGrid`."""
+    # interner-keyed cross-window state: windows must carry
+    # materialized records in the OPERATOR's id space (the
+    # chunked decode still batches the parse)
+    columnar_windows = False
 
     # the normalized join pairs the point and sensor streams BY WINDOW
     # START; count windows' starts are data timestamps that would never

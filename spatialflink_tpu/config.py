@@ -191,6 +191,13 @@ class QueryConfig:
     # overlapping windows. Execution knob only — results are identical to
     # full-window evaluation (and tumbling/undecomposable specs bypass it).
     panes: bool = False
+    # device-resident pane state (the --pane-merge driver switch): pane
+    # partials stay in device memory across slides and windows merge them
+    # on device, reading back only the sealed window's merged result.
+    # Execution knob only — identical results; None = auto (device on
+    # accelerator backends, host on CPU), False = host merge (the A/B the
+    # pane-state bench row measures).
+    pane_device_merge: Optional[bool] = None
     radius: float = 0.0
     aggregate_function: str = "SUM"
     k: int = 10
@@ -228,6 +235,9 @@ class QueryConfig:
             parallelism=parallelism,
             hosts=hosts,
             panes=bool(_opt(d, "panes", False)),
+            pane_device_merge=(None if _opt(d, "paneDeviceMerge", None)
+                               is None
+                               else bool(_opt(d, "paneDeviceMerge", None))),
             radius=float(_opt(d, "radius", 0.0)),
             aggregate_function=agg,
             k=int(_opt(d, "k", 10)),
@@ -373,9 +383,11 @@ class Params:
         query = dataclasses.asdict(self.query)
         query.pop("parallelism", None)
         query.pop("hosts", None)
-        # pane mode is an execution strategy, not a semantic change: a
-        # panes-on re-run must dedup against a panes-off run's markers
+        # pane mode (and its merge placement) is an execution strategy, not
+        # a semantic change: a panes-on re-run must dedup against a
+        # panes-off run's markers
         query.pop("panes", None)
+        query.pop("pane_device_merge", None)
         payload = {
             "group": group,
             "query": query,

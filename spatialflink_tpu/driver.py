@@ -127,34 +127,111 @@ CASES = _build_cases()
 # stream decoding
 
 
-def decode_stream(records: Iterable, cfg: StreamConfig, grid: UniformGrid,
-                  geometry: str = "Point") -> Iterator[SpatialObject]:
-    """Raw lines/dicts → spatial objects; already-parsed objects pass through
-    (the reference's per-case ``Deserialization.*Stream`` stage). Marks the
-    ingest throughput meter and honors the control-tuple stop hook
-    (``HelperClass.checkExitControlTuple``).
+class ChunkedStream:
+    """The decoded stream as the operators consume it: iterating yields
+    spatial objects (the legacy record contract — joins, trajectory,
+    realtime and the apps flatten through here), while chunk-aware window
+    drivers (``WindowAssembler.assemble`` / ``PaneBuffer.assemble``) pull
+    :meth:`chunks` and never materialize per-record objects at all.
+    ``interner`` is the stream's one obj-id space (kNN resolution and
+    pane-merge tie order read through it)."""
 
-    Off-type records — e.g. a stray POINT row in a declared polygon stream,
-    which self-describing WKT/GeoJSON can produce — are DROPPED with a
-    counter (``off-type-dropped``) and a one-time warning rather than
-    crashing the pipeline in the operator's batcher: dead-lettering
-    malformed tuples is the streaming norm, and the typed operator pipelines
-    (like the reference's per-type streams) cannot batch them."""
+    __slots__ = ("_chunks", "interner")
+
+    def __init__(self, chunks: Iterator, interner):
+        self._chunks = chunks
+        self.interner = interner
+
+    def chunks(self) -> Iterator:
+        """Single-use chunk iterator (columnar PointChunk or record list)."""
+        return self._chunks
+
+    def __iter__(self):
+        for ch in self._chunks:
+            if hasattr(ch, "parsed"):
+                recs = ch.records()
+                if ch.note is not None and ch.positions is not None:
+                    # flatten consumers (joins, trajectory state machines)
+                    # pull one record at a time: re-note checkpoint
+                    # positions per record so a barrier can never cover
+                    # records still buffered in this loop
+                    for rec, p in zip(recs, ch.positions.tolist()):
+                        ch.note(int(p))
+                        yield rec
+                else:
+                    yield from recs
+            else:
+                yield from ch
+
+
+def _off_type_warner(geometry: str, dropped):
+    """Counter-keyed off-type warning: warns when the ``off-type-dropped``
+    counter first moves and again at each decade (1, 10, 100, ...), always
+    printing the running count — the batched decoder's replacement for the
+    old one-shot boolean (which went silent forever after one record)."""
+    state = {"next": 1}
+
+    def warn(typename: str) -> None:
+        c = dropped.count
+        if c >= state["next"]:
+            print(f"warning: dropping off-type {typename} record(s) from "
+                  f"declared {geometry} stream (off-type-dropped={c})",
+                  file=sys.stderr)
+            while state["next"] <= c:
+                state["next"] *= 10
+    return warn
+
+
+def decode_chunks(records: Iterable, cfg: StreamConfig, grid: UniformGrid,
+                  geometry: str = "Point", chunk: int = 4096,
+                  interner=None, max_buffer_s: float = 0.2) -> Iterator:
+    """Chunk-vectorized decode — THE ingest path for every mode (file
+    replay, kafka chunked drain, ``--kafka-follow`` live). Raw lines buffer
+    into chunks and parse through ``streams.bulk``'s columnar parsers (one
+    native call per chunk for CSV/TSV/GeoJSON point streams, yielding a
+    columnar :class:`~spatialflink_tpu.streams.bulk.PointChunk`); geometry
+    streams and pre-parsed objects batch per chunk with the same amortized
+    bookkeeping. Telemetry observes, the ingest meter, and the off-type
+    filter all run ONCE PER CHUNK instead of once per record.
+
+    Semantics preserved from the scalar decoder: the control-tuple stop
+    hook fires at the record that carries it (buffered records before it
+    still reach the pipeline), off-type rows — e.g. a stray polygon
+    feature in a declared point stream — are dropped per-chunk with the
+    same ``off-type-dropped`` counter (a chunk the columnar parser rejects
+    falls back to the exact per-record parse rather than crashing), and
+    live sources' starvation sentinel flushes the buffer so chunking adds
+    at most one poll cycle of latency."""
+    from spatialflink_tpu.streams import bulk as B
+    from spatialflink_tpu.streams.kafka import STARVED
+    from spatialflink_tpu.utils import IdInterner
     from spatialflink_tpu.utils import telemetry as _telemetry
-    from spatialflink_tpu.utils.metrics import REGISTRY, metered
+    from spatialflink_tpu.utils.metrics import (REGISTRY, ControlTupleExit,
+                                                check_exit_control_tuple)
 
     meter = REGISTRY.meter("ingest-throughput")
     dropped = REGISTRY.counter("off-type-dropped")
+    warn = _off_type_warner(geometry, dropped)
     needs_edges = geometry in ("Polygon", "LineString")
-    warned = False
-    # checked ONCE per stream: telemetry off = the uninstrumented loop
-    # (no span/histogram calls per record), on = per-record parse time
-    # accumulates under the "ingest" stage via observe() (no context-
-    # manager churn on the hot path)
+    is_point = geometry == "Point"
+    fmt = cfg.format.lower()
+    bulk_ok = is_point and fmt in ("csv", "tsv", "geojson")
+    interner = interner if interner is not None else IdInterner()
     tel = _telemetry.active()
-    for rec in metered(records, meter, control_check=True):
-        t0 = time.perf_counter() if tel is not None else 0.0
-        obj = rec if isinstance(rec, SpatialObject) else parse_spatial(
+
+    def off_type_filter(objs: List) -> List:
+        kept = []
+        for o in objs:
+            if ((needs_edges and not hasattr(o, "edge_array"))
+                    or (is_point and not hasattr(o, "x"))):
+                dropped.inc()
+                warn(type(o).__name__)
+            else:
+                kept.append(o)
+        return kept
+
+    def parse_one(rec):
+        return parse_spatial(
             rec, cfg.format, grid,
             delimiter=cfg.delimiter,
             schema=cfg.csv_tsv_schema,
@@ -163,19 +240,131 @@ def decode_stream(records: Iterable, cfg: StreamConfig, grid: UniformGrid,
             geometry=geometry,
             **cfg.geojson_kwargs(),
         )
+
+    def parse_raws(raws: List[str]):
+        # the columnar parse rides only when the chunk maps 1:1 onto parser
+        # lines (no INTERIOR newlines — a trailing newline from an
+        # unstripped file iterator is normalized away) and every row is a
+        # point the native/reject machinery accepts; anything else —
+        # including off-type rows, which the point parsers reject with
+        # ValueError — falls back to the exact per-record parse + the
+        # off-type drop counter
+        if bulk_ok:
+            raws = [r[:-1] if r.endswith("\n") else r for r in raws]
+        if bulk_ok and not any("\n" in r for r in raws):
+            data = "\n".join(raws).encode()
+            try:
+                if fmt == "geojson":
+                    parsed = B.bulk_parse_geojson(data, interner=interner,
+                                                  **cfg.geojson_kwargs())
+                else:
+                    parsed = B.bulk_parse_csv(
+                        data, delimiter="\t" if fmt == "tsv" else cfg.delimiter,
+                        schema=_schema4(cfg), date_format=cfg.date_format,
+                        interner=interner)
+            except ValueError:
+                parsed = None
+            if parsed is not None and len(parsed) == len(raws):
+                return B.PointChunk.build(parsed, grid)
+        return off_type_filter([parse_one(r) for r in raws])
+
+    src_chunks = getattr(records, "chunks", None)
+    if src_chunks is not None:
+        # an upstream chunked decoder (the Kafka commit tap) already parsed;
+        # apply only the meter + off-type bookkeeping per chunk
+        for ch in src_chunks():
+            if hasattr(ch, "parsed"):
+                meter.mark(len(ch))
+                if len(ch):
+                    yield ch
+            else:
+                meter.mark(len(ch))
+                kept = off_type_filter(list(ch))
+                if kept:
+                    yield kept
+        return
+
+    buf: List = []
+    kind = None  # "str" (columnar-parseable) | "obj" (parsed) | "raw"
+
+    def flush():
+        nonlocal buf, kind
+        if not buf:
+            return None
+        t0 = time.perf_counter() if tel is not None else 0.0
+        if kind == "str":
+            out = parse_raws(buf)
+        elif kind == "obj":
+            out = off_type_filter(buf)
+        else:
+            out = off_type_filter([parse_one(r) for r in buf])
         if tel is not None:
+            # ONE ingest observe per chunk — the parse cost amortized over
+            # the chunk (the scalar path observed per record)
             tel.observe("ingest", time.perf_counter() - t0)
-        off_type = ((needs_edges and not hasattr(obj, "edge_array"))
-                    or (geometry == "Point" and not hasattr(obj, "x")))
-        if off_type:
-            dropped.inc()
-            if not warned:
-                print(f"warning: dropping off-type {type(obj).__name__} "
-                      f"record(s) from declared {geometry} stream "
-                      "(counter: off-type-dropped)", file=sys.stderr)
-                warned = True
+        meter.mark(len(buf))
+        buf = []
+        kind = None
+        return out if len(out) else None
+
+    for rec in records:
+        if rec is STARVED:
+            # quiet live topic: hand everything buffered downstream so a
+            # chunk never waits out dead air (latency bound = one poll)
+            out = flush()
+            if out is not None:
+                yield out
             continue
-        yield obj
+        try:
+            check_exit_control_tuple(rec)
+        except ControlTupleExit:
+            out = flush()
+            if out is not None:
+                yield out
+            raise
+        k = ("str" if isinstance(rec, str)
+             else "obj" if isinstance(rec, SpatialObject) else "raw")
+        if buf and k != kind:
+            out = flush()
+            if out is not None:
+                yield out
+        if not buf:
+            t_first = time.perf_counter()
+        buf.append(rec)
+        kind = k
+        # size OR age flush: a slow live source without a starvation
+        # sentinel (direct KafkaSource feeds) must not hold records hostage
+        # to a chunk fill — `max_buffer_s` bounds the added decode latency
+        # (replay sources fill chunks in microseconds and never hit it)
+        if (len(buf) >= chunk
+                or time.perf_counter() - t_first >= max_buffer_s):
+            out = flush()
+            if out is not None:
+                yield out
+    out = flush()
+    if out is not None:
+        yield out
+
+
+def decode_stream(records: Iterable, cfg: StreamConfig, grid: UniformGrid,
+                  geometry: str = "Point",
+                  chunk: int = 4096) -> "ChunkedStream":
+    """Raw lines/dicts → spatial objects (the reference's per-case
+    ``Deserialization.*Stream`` stage), rebuilt on the batched
+    :func:`decode_chunks` seam: the scalar per-record parse loop is gone —
+    every mode decodes chunk-vectorized, and the returned
+    :class:`ChunkedStream` serves both per-record consumers (iteration)
+    and the chunk-aware window assemblers (``.chunks``). The seed scalar
+    decoder survives only as a test oracle (``tests/oracles.py``)."""
+    from spatialflink_tpu.utils import IdInterner
+
+    interner = getattr(records, "interner", None)
+    if interner is None and geometry == "Point" \
+            and cfg.format.lower() in ("csv", "tsv", "geojson"):
+        interner = IdInterner()
+    return ChunkedStream(
+        decode_chunks(records, cfg, grid, geometry, chunk, interner=interner),
+        interner)
 
 
 #: (family, mode) combinations the coordinated checkpointer covers: their
@@ -236,6 +425,8 @@ def _query_conf(params: Params, spec: CaseSpec) -> QueryConfiguration:
         # partials once per slide, merged across overlapping windows; only
         # engages for pane-decomposable event-time windows (operators gate)
         panes=params.query.panes,
+        # --pane-merge device|host: where pane partials live and merge
+        pane_device_merge=params.query.pane_device_merge,
         k=params.query.k,
         # query.parallelism ≙ env.setParallelism(30) (StreamingJob.java:221):
         # shard window batches across a device mesh; query.hosts > 1 makes
@@ -350,14 +541,28 @@ def run_option(params: Params, stream1: Iterable, stream2: Optional[Iterable]
     conf = _query_conf(params, spec)
     radius = params.query.radius
 
+    # decode chunk sizing: realtime chunks at the micro-batch size (chunk
+    # fill and batch fire coincide — no added latency vs the scalar path);
+    # count windows chunk at the slide COUNT (fires stay step-aligned);
+    # windowed modes use the default throughput chunk (live sources bound
+    # the buffering to one poll cycle via the starvation sentinel)
+    if spec.mode == "realtime":
+        dchunk = max(1, conf.realtime_batch_size)
+    elif params.window.type == "COUNT":
+        dchunk = max(1, min(4096, int(params.window.step_s)))
+    else:
+        dchunk = _decode_chunk_env(4096)
+
     if spec.family in ("range", "knn", "join"):
         cls = _operator_class(spec)
-        s1 = decode_stream(stream1, params.input1, u_grid, spec.stream)
+        s1 = decode_stream(stream1, params.input1, u_grid, spec.stream,
+                           chunk=dchunk)
         if spec.family == "join":
             op = cls(conf, u_grid, q_grid)
             if stream2 is None:
                 raise ValueError(f"queryOption {opt} (join) needs stream2")
-            s2 = decode_stream(stream2, params.input2, q_grid, spec.query)
+            s2 = decode_stream(stream2, params.input2, q_grid, spec.query,
+                               chunk=dchunk)
             out = op.run(s1, s2, radius)
         else:
             op = cls(conf, u_grid)
@@ -422,7 +627,9 @@ def run_option(params: Params, stream1: Iterable, stream2: Optional[Iterable]
 
 
 def _run_trajectory(params, spec, conf, u_grid, q_grid, stream1, stream2):
-    s1 = decode_stream(stream1, params.input1, u_grid)
+    dchunk = (max(1, conf.realtime_batch_size) if spec.mode == "realtime"
+              else 4096)
+    s1 = decode_stream(stream1, params.input1, u_grid, chunk=dchunk)
     q = params.query
     if spec.family == "tfilter":
         return ops.PointTFilterQuery(conf, u_grid).run(s1, set(q.traj_ids))
@@ -446,7 +653,7 @@ def _run_trajectory(params, spec, conf, u_grid, q_grid, stream1, stream2):
     if spec.family == "tjoin":
         if stream2 is None:
             raise ValueError("trajectory join needs stream2")
-        s2 = decode_stream(stream2, params.input2, q_grid)
+        s2 = decode_stream(stream2, params.input2, q_grid, chunk=dchunk)
         op = ops.PointPointTJoinQuery(conf, u_grid, q_grid)
         run = op.run_naive if spec.naive else op.run
         return run(s1, s2, params.query.radius)
@@ -790,6 +997,14 @@ def _parse_fn(cfg: StreamConfig, grid: UniformGrid, geometry: str):
     return parse
 
 
+def _decode_chunk_env(default: int) -> int:
+    """Decode chunk size with the ``SPATIALFLINK_DECODE_CHUNK`` override —
+    the knob tests/benches use to exercise chunk-boundary behavior (e.g.
+    record-granular checkpoint positions on tiny topics)."""
+    v = os.environ.get("SPATIALFLINK_DECODE_CHUNK")
+    return max(1, int(v)) if v else default
+
+
 def _schema4(cfg: StreamConfig) -> list:
     """csvTsvSchemaAttr padded to the 4 [oID, ts, x, y] slots (None =
     absent) — shared by the bulk file path and the kafka chunked decode."""
@@ -798,10 +1013,11 @@ def _schema4(cfg: StreamConfig) -> list:
 
 def _kafka_bulk_decode(cfg: StreamConfig, grid: UniformGrid):
     """Chunked native decode for broker-fed POINT streams (CSV/TSV/GeoJSON):
-    the bulk replay parser applied to poll batches, returning per-record
-    Point objects with vectorized cell assignment
-    (``ParsedPoints.to_points``). None when the format cannot ride it (the
-    tap then parses per record)."""
+    the bulk replay parser applied to poll batches, returning a COLUMNAR
+    :class:`~spatialflink_tpu.streams.bulk.PointChunk` (vectorized cell
+    assignment; per-record Point objects materialize only if a non-columnar
+    consumer flattens). None when the format cannot ride it (the tap then
+    parses per record)."""
     from spatialflink_tpu.streams import bulk as B
     from spatialflink_tpu.utils import IdInterner
 
@@ -811,7 +1027,7 @@ def _kafka_bulk_decode(cfg: StreamConfig, grid: UniformGrid):
     interner = IdInterner()
     schema = _schema4(cfg)
 
-    def decode(raws: List[str]) -> List:
+    def decode(raws: List[str]):
         data = "\n".join(raws).encode()
         if fmt == "geojson":
             parsed = B.bulk_parse_geojson(data, interner=interner,
@@ -821,8 +1037,9 @@ def _kafka_bulk_decode(cfg: StreamConfig, grid: UniformGrid):
                 data, delimiter="\t" if fmt == "tsv" else cfg.delimiter,
                 schema=schema, date_format=cfg.date_format,
                 interner=interner)
-        return parsed.to_points(grid)
+        return B.PointChunk.build(parsed, grid)
 
+    decode.interner = interner
     return decode
 
 
@@ -1106,19 +1323,22 @@ def _wire_kafka(params: Params, spec: CaseSpec, args, skip1: int
              if windowed and geom1 == "Point" else None)
     bulk2 = (_kafka_bulk_decode(params.input2, q_grid)
              if windowed and two_stream and geom2 == "Point" else None)
-    chunk = 512 if follow else 2048
+    chunk = _decode_chunk_env(512 if follow else 2048)
     # --limit bounds THIS run's consumption per stream (from the group's
-    # resume point), mirroring the file path's record bound
+    # resume point), mirroring the file path's record bound. Follow mode
+    # ALWAYS sets the starvation sentinel on windowed sources: the commit
+    # tap's chunk hand-off (native decode or record-mode batching) flushes
+    # on it, so chunking never adds more than one poll cycle of latency.
     src1 = KafkaSource(broker, t1, group, auto_commit=False,
                        stop_at_end=not follow, limit=args.limit,
-                       starvation_sentinel=follow and bulk1 is not None,
+                       starvation_sentinel=follow and windowed,
                        commit_lag=commit_lag)
     sources = [src1]
     src2 = None
     if two_stream:
         src2 = KafkaSource(broker, t2, group, auto_commit=False,
                            stop_at_end=not follow, limit=args.limit,
-                           starvation_sentinel=follow and bulk2 is not None,
+                           starvation_sentinel=follow and windowed,
                            commit_lag=commit_lag)
         sources.append(src2)
 
@@ -1319,10 +1539,25 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "Flink web UI observability as a trace "
                          "(StreamingJob.java:70-72)")
     ap.add_argument("--bulk", action="store_true",
-                    help="vectorized replay fast path (native ingest + bulk "
-                         "windows) for windowed Point/Point range, kNN and "
-                         "join cases; record-path lateness semantics, but no "
-                         "control-tuple stop hook")
+                    help="DEPRECATED alias: the chunk-vectorized decode + "
+                         "bulk window assignment is now the only execution "
+                         "path (every mode), so the flag no longer selects "
+                         "a faster engine — it keeps only its whole-replay "
+                         "semantics (no watermark-paced emission, no "
+                         "control-tuple stop hook) for bounded files/topics")
+    ap.add_argument("--pane-merge", choices=["auto", "device", "host"],
+                    default=None,
+                    help="where --panes partials live and merge: 'device' "
+                         "keeps pane kernel partials resident in device "
+                         "memory across slides and merges each sealed "
+                         "window ON DEVICE (one merged readback per window "
+                         "— kNN families; filter families keep their "
+                         "already-optimal host union), 'host' resolves "
+                         "each partial to host and merges there, 'auto' "
+                         "(default) picks device on accelerator backends "
+                         "(a per-pane host sync is a full dispatch RTT "
+                         "there) and host on CPU (measured faster — the "
+                         "pane-state bench rows are the A/B)")
     ap.add_argument("--panes", action="store_true",
                     help="pane-incremental sliding windows: buffer records "
                          "into non-overlapping slide-aligned panes, run the "
@@ -1400,6 +1635,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         params.query.multi_query = True
     if args.panes:
         params.query.panes = True
+    if args.pane_merge is not None and args.pane_merge != "auto":
+        params.query.pane_device_merge = args.pane_merge == "device"
+    if args.bulk:
+        print("note: --bulk is deprecated — the batched columnar path is "
+              "now the default for every mode; the flag keeps only its "
+              "whole-replay semantics (see README)", file=sys.stderr)
     if args.devices is not None:
         params.query.parallelism = args.devices
     if args.hosts is not None:

@@ -86,6 +86,21 @@ class QueryConfiguration:
     # readback) and with the device mesh (each pane batch shards like a
     # window batch would).
     panes: bool = False
+    # device-resident pane state (the --pane-merge driver switch): pane
+    # kernel partials stay in HBM across slides and each window's merge is
+    # a DEVICE op (kNN gather+re-top-k mirroring the shard merge), with only
+    # the sealed window's merged result read back — instead of resolving
+    # each partial to host (a blocking sync per pane, a full tunnel RTT on
+    # a remote TPU) and merging there. None = AUTO: device on accelerator
+    # backends, host on CPU (measured: the per-window merge dispatch costs
+    # more than the host dict-merge of k-sized partials there, and
+    # steady-state readback bytes are ~equal because PR 3's memoized
+    # partials already cross at most once). Families without a device merge
+    # (filter-shaped partials, whose host union is a plain concat of masks
+    # each read exactly once) and host-resident partials
+    # (checkpoint-restored) fall back to the host merge — results identical
+    # either way.
+    pane_device_merge: Optional[bool] = None
     # elastic-degradation bound: at most this many mesh halvings may absorb
     # dispatch failures before the operator raises instead of retrying
     # narrower. None = halvings down to TWO devices; the final halving to 1
@@ -207,19 +222,49 @@ class PaneCache:
             self.cache[key] = PanePartial(value) if wrapped else value
 
 
+def _device_nbytes(x) -> int:
+    """Summed ``nbytes`` over the array leaves of a deferred device payload
+    (tuples/NamedTuples/lists of jax or numpy arrays) — the readback-bytes
+    accounting the device-vs-host pane-state bench reads."""
+    total = 0
+    stack = [x]
+    while stack:
+        v = stack.pop()
+        if v is None:
+            continue
+        nb = getattr(v, "nbytes", None)
+        if nb is not None:
+            total += int(nb)
+        elif isinstance(v, (tuple, list)):
+            stack.extend(v)
+    return total
+
+
 class PanePartial:
     """One pane's cached kernel partial. Holds the raw evaluator output —
-    a :class:`Deferred` (device work in flight) or an already-final host
-    value — and memoizes the readback so every window sharing the pane pays
-    the device→host transfer once."""
+    a :class:`Deferred` (device work in flight / resident in device memory)
+    or an already-final host value — and memoizes the readback so every
+    window sharing the pane pays the device→host transfer at most once.
+    Under the device pane merge the Deferred is typically NEVER resolved:
+    the merge kernel consumes the resident arrays and only the merged
+    window result crosses to host (``resolve`` still works — the
+    checkpoint snapshot uses it, which is the readback-on-snapshot
+    contract). ``stats_done`` marks pruning-counter scalars already
+    consumed by a device merge, so they count once per pane."""
 
-    __slots__ = ("value",)
+    __slots__ = ("value", "stats_done")
 
     def __init__(self, value):
         self.value = value
+        self.stats_done = False
 
     def resolve(self):
         if isinstance(self.value, Deferred):
+            from spatialflink_tpu.utils.metrics import REGISTRY
+
+            REGISTRY.counter("pane-partial-readbacks").inc()
+            REGISTRY.counter("pane-partial-readback-bytes").inc(
+                _device_nbytes(self.value.device_result))
             self.value = self.value.finish()
         return self.value
 
@@ -265,6 +310,15 @@ class SpatialOperator:
     #: in one snapshot stream; subclasses set "range"/"knn"/"join"/"tknn"/…
     #: (None falls back to the class name)
     telemetry_label: Optional[str] = None
+
+    #: window payloads may be columnar LazyRecords views over the batched
+    #: decode's SoA chunks (device batches build straight from the slices;
+    #: obj ids live in the STREAM's decode-interner space). Operators whose
+    #: cross-window state or result resolution is keyed by the OPERATOR
+    #: interner (the trajectory families' TrajStateStore, the apps) opt out
+    #: — their windows materialize per-record objects as before (the decode
+    #: itself stays chunk-vectorized either way).
+    columnar_windows = True
 
     def __init__(self, conf: QueryConfiguration, grid: UniformGrid,
                  grid2: Optional[UniformGrid] = None):
@@ -465,7 +519,15 @@ class SpatialOperator:
 
     # ---------------------------------------------------------------- #
 
-    def _point_batch(self, records: List[Point], ts_base: int) -> PointBatch:
+    def _point_batch(self, records, ts_base: int) -> PointBatch:
+        from spatialflink_tpu.streams.bulk import LazyRecords
+
+        if isinstance(records, LazyRecords):
+            # batched record path: the window's device batch builds straight
+            # from the decoded SoA slices (cells assigned once per chunk, obj
+            # ids in the stream's decode-interner space — kNN resolution and
+            # pane tie-breaking read through `records.interner`)
+            return records.point_batch(self.grid, ts_base)
         return PointBatch.from_points(records, self.grid, self.interner, ts_base=ts_base)
 
     def _windows(self, stream: Iterable[Point]) -> Iterator[Tuple[int, int, List[Point]]]:
@@ -474,6 +536,8 @@ class SpatialOperator:
             return
         wa = WindowAssembler(self.conf.window_spec(), self.conf.allowed_lateness_ms)
         self._register_ckpt_windows("windows", wa)
+        if not self.columnar_windows:
+            stream = iter(stream)  # flatten any chunked decode stream
         # chunk-vectorized assignment (WindowSpec.assign_bulk under the
         # hood): identical window tables, late drops, and emission timing to
         # the per-record add loop, minus its per-record assign/seal cost
@@ -501,11 +565,14 @@ class SpatialOperator:
         pb = PaneBuffer(self.conf.window_spec(),
                         self.conf.allowed_lateness_ms)
         self._register_ckpt_windows("panes", pb)
-        for rec in stream:
-            yield from pb.add(rec.timestamp, rec)
-        yield from pb.flush()
+        if not self.columnar_windows:
+            stream = iter(stream)  # flatten any chunked decode stream
+        # chunk-aware: a batched decode stream (driver.decode_stream) hands
+        # columnar chunks straight into the pane buffer; plain record
+        # streams keep the per-record add loop
+        yield from pb.assemble(stream)
 
-    def _pane_eval(self, pane_partial, merge_partials):
+    def _pane_eval(self, pane_partial, merge_partials, device_merge=None):
         """The partial-cache evaluator for pane-window payloads: the window
         kernel (``pane_partial(payload, pane_start)`` — the same eval_batch
         the full-window path uses) runs ONCE per sealed pane; windows merge
@@ -515,8 +582,18 @@ class SpatialOperator:
         so snapshots show both the reuse rate and where the merge time
         goes. Eviction: windows arrive in ascending start order, so once
         window ``s`` dispatches, no later window can need a pane below
-        ``s + slide``."""
+        ``s + slide``.
+
+        ``device_merge(parts)`` (optional, gated by
+        ``conf.pane_device_merge``) is the family's DEVICE merge: it
+        consumes the parts' resident device arrays and returns a
+        :class:`Deferred` whose readback is the merged window result —
+        partials never individually cross to host. It returns None when
+        ineligible (e.g. a checkpoint-restored host-resident partial in the
+        window), which falls back to the host merge with identical
+        results."""
         from spatialflink_tpu.utils import telemetry as _telemetry
+        from spatialflink_tpu.utils.metrics import REGISTRY
 
         cache = PaneCache(self.conf.slide_ms)
         self._register_ckpt_pane_cache("pane-cache", cache)
@@ -524,6 +601,13 @@ class SpatialOperator:
         label = self.telemetry_label or type(self).__name__
         book = tel.traces if tel is not None else None
         costs = tel.costs if tel is not None else None
+        want_device = self.conf.pane_device_merge
+        if want_device is None:  # auto: device placement off the CPU backend
+            import jax
+
+            want_device = jax.default_backend() != "cpu"
+        use_device = device_merge is not None and want_device
+        rb_bytes = REGISTRY.counter("pane-partial-readback-bytes")
 
         def eval_batch(panes, ts_base):
             h0, m0 = ((cache.hits.count, cache.misses.count)
@@ -553,10 +637,33 @@ class SpatialOperator:
                 costs.note_pane(label, cache.hits.count - h0,
                                 cache.misses.count - m0)
 
+            merged = device_merge(parts) if use_device else None
+            if merged is not None:
+                # device-resident path: the partials stay in HBM; only the
+                # merged window result crosses, counted as the window's
+                # readback
+                def collect_dev(_):
+                    nb = _device_nbytes(merged.device_result)
+                    REGISTRY.counter("pane-merged-readbacks").inc()
+                    REGISTRY.counter("pane-merged-readback-bytes").inc(nb)
+                    if tel is not None:
+                        with tel.span("pane-merge", query=label):
+                            out = merged.finish()
+                        if costs is not None:
+                            costs.note_readback(label, nb)
+                        return out
+                    return merged.finish()
+
+                return Deferred(None, collect_dev)
+
             def collect(_):
+                b0 = rb_bytes.count
                 if tel is not None:
                     with tel.span("pane-merge", query=label):
-                        return merge_partials([h.resolve() for h in parts])
+                        out = merge_partials([h.resolve() for h in parts])
+                    if costs is not None:
+                        costs.note_readback(label, rb_bytes.count - b0)
+                    return out
                 return merge_partials([h.resolve() for h in parts])
 
             return Deferred(None, collect)
@@ -679,10 +786,17 @@ class SpatialOperator:
         return Deferred((dev, *stats) if stats is not None else dev, collect)
 
     def _defer_mask_select(self, mask, records: List, stats=None) -> Deferred:
-        """Deferred selection of ``records`` by a device boolean mask."""
+        """Deferred selection of ``records`` by a device boolean mask
+        (columnar windows gather their selection in one vectorized
+        ``LazyRecords.take``)."""
+        take = getattr(records, "take", None)
+
         def rows(m):
             idx = np.nonzero(np.asarray(m))[0]
-            return [records[i] for i in idx if i < len(records)]
+            idx = idx[idx < len(records)]
+            if take is not None:
+                return take(idx)
+            return [records[i] for i in idx]
         return self._defer_with_stats(mask, stats, rows)
 
     def _defer_knn(self, res, interner=None, dist_evals=None) -> Deferred:
@@ -790,14 +904,17 @@ class SpatialOperator:
             batch = batch_builder(records, ts_base)
             masks, gn_c, evals = self._multi_filter_stream(
                 batch, multi_mask_stats)
+            take = getattr(records, "take", None)
 
             def rows(m):
                 m = np.asarray(m)  # ONE (Q, N) device->host transfer
-                return [
-                    [records[i] for i in np.nonzero(m[q])[0]
-                     if i < len(records)]
-                    for q in range(n_queries)
-                ]
+                out = []
+                for q in range(n_queries):
+                    idx = np.nonzero(m[q])[0]
+                    idx = idx[idx < len(records)]
+                    out.append(take(idx) if take is not None
+                               else [records[i] for i in idx])
+                return out
 
             return self._defer_with_stats(
                 masks, (jnp.sum(gn_c), jnp.sum(evals)), rows)
@@ -853,15 +970,16 @@ class SpatialOperator:
             result.extras["queries"] = n_queries
             yield result
 
-    def _multi_results(self, stream: Iterable, eval_batch, *, pane_merge=None
-                       ) -> Iterator["WindowResult"]:
+    def _multi_results(self, stream: Iterable, eval_batch, *, pane_merge=None,
+                       pane_device_merge=None) -> Iterator["WindowResult"]:
         """_drive for multi-query evaluators, whose per-window result is a
         list of Q per-query lists — always truthy, so _drive_batched's
         realtime no-empty-emission gate cannot see an all-empty micro-batch;
         re-apply it on the per-query contents (the reference's
         fire-per-element trigger never emits empties)."""
         realtime = self.conf.query_type is QueryType.RealTime
-        for result in self._drive(stream, eval_batch, pane_merge=pane_merge):
+        for result in self._drive(stream, eval_batch, pane_merge=pane_merge,
+                                  pane_device_merge=pane_device_merge):
             if realtime and not any(result.records):
                 continue
             yield result
@@ -884,7 +1002,8 @@ class SpatialOperator:
         return "approx" if self.conf.approximate else "auto"
 
     def _drive_bulk(self, parsed, eval_batch, *, pad: Optional[int] = None,
-                    pane_merge=None) -> Iterator["WindowResult"]:
+                    pane_merge=None,
+                    pane_device_merge=None) -> Iterator["WindowResult"]:
         """Bulk-replay driver: vectorized window batches
         (``streams.bulk.bulk_window_batches``) through the pipelined
         evaluator. eval_batch((idx, PointBatch), ts_base) as in _drive.
@@ -898,7 +1017,9 @@ class SpatialOperator:
             pane_windows = bulk_pane_window_batches(
                 parsed, self.conf.window_spec(), self.grid, pad=pad)
             return self._drive_batched(
-                pane_windows, self._pane_eval(eval_batch, pane_merge),
+                pane_windows,
+                self._pane_eval(eval_batch, pane_merge,
+                                device_merge=pane_device_merge),
                 count=lambda panes: sum(len(p[1][0]) for p in panes))
         batched = (
             (start, end, (idx, batch))
@@ -908,8 +1029,8 @@ class SpatialOperator:
         return self._drive_batched(batched, eval_batch,
                                    count=lambda p: len(p[0]))
 
-    def _drive(self, stream: Iterable, eval_batch, *, pane_merge=None
-               ) -> Iterator["WindowResult"]:
+    def _drive(self, stream: Iterable, eval_batch, *, pane_merge=None,
+               pane_device_merge=None) -> Iterator["WindowResult"]:
         """Shared window/realtime driver.
 
         eval_batch(records, ts_base) returns either the final record list or
@@ -930,7 +1051,8 @@ class SpatialOperator:
         elif pane_merge is not None and self._panes_active():
             return self._drive_batched(
                 self._pane_windows(stream),
-                self._pane_eval(eval_batch, pane_merge),
+                self._pane_eval(eval_batch, pane_merge,
+                                device_merge=pane_device_merge),
                 count=self._pane_count)
         else:
             batched = self._windows(stream)
@@ -1059,14 +1181,20 @@ class SpatialOperator:
         record lists carry Points with an ``ingestion_time`` stamped at
         parse; pane payloads hold ``(pane_start, records)`` pairs; bulk
         (idx, batch) payloads have no per-record host objects — None."""
+        from spatialflink_tpu.streams.bulk import LazyRecords
+
         try:
             recs = payload
+            if isinstance(recs, LazyRecords):
+                # columnar window: materialize ONE record (its
+                # ingestion_time is the chunk's decode stamp)
+                return int(recs[0].ingestion_time) if len(recs) else None
             if not isinstance(recs, list) or not recs:
                 return None
             if (isinstance(recs[0], tuple) and len(recs[0]) == 2
-                    and isinstance(recs[0][1], list)):
+                    and isinstance(recs[0][1], (list, LazyRecords))):
                 recs = recs[0][1]  # pane payload: first pane's records
-                if not recs:
+                if not len(recs):
                     return None
             ing = getattr(recs[0], "ingestion_time", None)
             if isinstance(ing, (int, float)) and ing > 0:
@@ -1082,7 +1210,11 @@ class SpatialOperator:
         (idx, batch) tuples), a flat 32-bytes-per-record estimate for host
         record lists (x/y/ts/id as packed fields) — a cost-profile
         ESTIMATE of data motion, not a transfer measurement."""
+        from spatialflink_tpu.streams.bulk import LazyRecords
+
         try:
+            if isinstance(payload, LazyRecords):
+                return 32 * len(payload)
             if isinstance(payload, tuple) and len(payload) == 2:
                 idx, batch = payload
                 total = int(getattr(idx, "nbytes", 0))
@@ -1097,7 +1229,8 @@ class SpatialOperator:
                 if (payload and isinstance(payload[0], tuple)
                         and len(payload[0]) == 2):
                     inner = payload[0][1]
-                    if isinstance(inner, list):  # record-path pane payload
+                    if isinstance(inner, (list, LazyRecords)):
+                        # record-path pane payload
                         return 32 * sum(len(rs) for _, rs in payload)
                     if isinstance(inner, tuple):  # bulk pane payload
                         return sum(

@@ -328,14 +328,35 @@ class PointPointJoinQuery(SpatialOperator):
 
             return cache.get((pa, pb_s), evaluate)
 
-        def join_panes(start: int, panes_a: List, panes_b: List
-                       ) -> WindowResult:
-            blocks = [block(pa, ra, pb_s, rb)
-                      for pa, ra in panes_a for pb_s, rb in panes_b]
+        def evict(start: int) -> None:
             cache.evict_before(start)
             for bc in (bcache_a, bcache_b):
                 for dead in [p for p in bc if p < start + slide]:
                     del bc[dead]
+
+        def join_panes(start: int, panes_a: List, panes_b: List
+                       ) -> WindowResult:
+            if self._blocks_dispatch_bound(panes_a, panes_b):
+                # ADAPTIVE GRANULARITY: the window's pane-pair blocks are
+                # dispatch-bound (mean block lattice below the measured
+                # per-dispatch break-even — the 0.56–0.95× dense regime in
+                # BASELINE), so evaluate the window as ONE coalesced
+                # lattice dispatch instead of overlap² tiny ones. No
+                # cross-window reuse for such windows — matching the
+                # full-recompute path they now cost — while big-block
+                # (compute-bound) windows keep the cached-block path.
+                from spatialflink_tpu.utils.metrics import REGISTRY
+
+                REGISTRY.counter("join-blocks-coalesced").inc(
+                    len(panes_a) * len(panes_b))
+                evict(start)
+                return self._join_window(
+                    start, start + spec.size_ms,
+                    [r for _, rs in panes_a for r in rs],
+                    [r for _, rs in panes_b for r in rs], radius)
+            blocks = [block(pa, ra, pb_s, rb)
+                      for pa, ra in panes_a for pb_s, rb in panes_b]
+            evict(start)
 
             def collect(_):
                 return [pair for h in blocks for pair in h.resolve()]
@@ -365,6 +386,26 @@ class PointPointJoinQuery(SpatialOperator):
         for start in sorted(set(sealed_a) | set(sealed_b)):
             yield join_panes(start, sealed_a.pop(start, []),
                              sealed_b.pop(start, []))
+
+    @staticmethod
+    def _blocks_dispatch_bound(panes_a: List, panes_b: List) -> bool:
+        """True when this window's pane-pair blocks sit below the measured
+        per-dispatch break-even (``ops.join.adaptive_block_min_cells``):
+        mean block lattice cells at PADDED capacities — dispatch cost
+        scales with the padded shape, not the live record count."""
+        if not panes_a or not panes_b or len(panes_a) * len(panes_b) <= 1:
+            return False
+        from spatialflink_tpu.ops.join import adaptive_block_min_cells
+        from spatialflink_tpu.utils.padding import bucket_size
+
+        min_cells = adaptive_block_min_cells()
+        if min_cells <= 0:
+            return False
+        mean_a = sum(bucket_size(max(len(rs), 1))
+                     for _, rs in panes_a) / len(panes_a)
+        mean_b = sum(bucket_size(max(len(rs), 1))
+                     for _, rs in panes_b) / len(panes_b)
+        return mean_a * mean_b < min_cells
 
     def run_bulk(self, parsed_a, parsed_b, radius: float, *,
                  pad: int = None) -> Iterator[WindowResult]:

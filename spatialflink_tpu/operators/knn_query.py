@@ -19,6 +19,7 @@ import jax.numpy as jnp
 
 from spatialflink_tpu.models import Point
 from spatialflink_tpu.operators.base import (
+    Deferred,
     GeomQueryMixin,
     QueryConfiguration,
     QueryType,
@@ -26,6 +27,61 @@ from spatialflink_tpu.operators.base import (
     WindowResult,
 )
 from spatialflink_tpu.ops.knn import knn_point_stats
+
+
+def _knn_device_merge(op, k: int, interner, n_queries=None):
+    """Device-resident pane merge factory for the kNN families: each sealed
+    window's merge is ONE device gather+re-top-k over its panes' RESIDENT
+    partial arrays (``ops.knn.merge_knn_device``); only the merged result
+    crosses to host. Returns None — host-merge fallback, identical results
+    — when any part is host-resident (checkpoint-restored partials, empty
+    realtime evals). Pruning-counter scalars ride each pane's deferred
+    payload and count exactly once (``PanePartial.stats_done``)."""
+    def merge(parts):
+        devs = []
+        for p in parts:
+            v = p.value
+            d = getattr(v, "device_result", None)
+            if (not isinstance(v, Deferred) or not isinstance(d, tuple)
+                    or len(d) != 3
+                    # every partial must share ONE id space (a restored
+                    # host-layout pane or a plain-record pane resolves via
+                    # a different interner — merging raw device ids across
+                    # spaces would mint garbage; fall back to the host
+                    # merge, which resolves each part through its own)
+                    or getattr(v, "interner", None) is not interner):
+                return None
+            devs.append(d)
+        from spatialflink_tpu.ops.knn import (merge_knn_device,
+                                              merge_knn_device_multi)
+
+        if n_queries is None:
+            merged = merge_knn_device([d[0] for d in devs], k)
+        else:
+            merged = merge_knn_device_multi([d[0] for d in devs], k)
+
+        def collect(r):
+            import numpy as np
+
+            for p, d in zip(parts, devs):
+                if not p.stats_done:
+                    op._record_pruning_stats(d[1], d[2])
+                    p.stats_done = True
+            valid = np.asarray(r.valid)
+            oids = np.asarray(r.obj_id)
+            dists = np.asarray(r.dist)
+            if n_queries is None:
+                return [(interner.lookup(int(o)), float(dd))
+                        for o, dd in zip(oids[valid], dists[valid])]
+            return [
+                [(interner.lookup(int(o)), float(dd))
+                 for o, dd in zip(oids[q][valid[q]], dists[q][valid[q]])]
+                for q in range(n_queries)
+            ]
+
+        return Deferred(merged, collect)
+
+    return merge
 
 
 def merge_partials(parts, k: int, interner):
@@ -55,10 +111,18 @@ class PointPointKNNQuery(SpatialOperator):
     def run(self, stream: Iterable[Point], query_point: Point, radius: float,
             k: Optional[int] = None) -> Iterator[WindowResult]:
         k = k or self.conf.k
+        # a batched decode stream resolves ids through ITS interner (the
+        # stream's one obj-id space); plain record streams keep the
+        # operator's — the pane merges (host tie-break and device resolve)
+        # must read the same space the partials were built in
+        tie = getattr(stream, "interner", None)
+        if tie is None:  # NOT `or`: a still-empty interner is falsy
+            tie = self.interner
         for result in self._drive(
             stream, lambda records, ts_base: self._eval(records, query_point,
                                                         radius, k, ts_base),
-            pane_merge=lambda parts: merge_partials(parts, k, self.interner),
+            pane_merge=lambda parts: merge_partials(parts, k, tie),
+            pane_device_merge=_knn_device_merge(self, k, tie),
         ):
             result.extras["k"] = k
             yield result
@@ -69,7 +133,12 @@ class PointPointKNNQuery(SpatialOperator):
             return []
         batch = self._point_batch(records, ts_base)
         res, dist_evals = self._knn_result(batch, query_point, radius, k)
-        return self._defer_knn(res, dist_evals=dist_evals)
+        ri = getattr(records, "interner", None)
+        d = self._defer_knn(res, interner=ri, dist_evals=dist_evals)
+        # the id space this partial's device ids live in (device pane merge
+        # refuses to mix spaces)
+        d.interner = ri if ri is not None else self.interner
+        return d
 
     def _nb_layers(self, radius: float) -> int:
         """Candidate-cell layer count; radius 0 disables pruning (all cells
@@ -114,13 +183,17 @@ class PointPointKNNQuery(SpatialOperator):
         def eval_batch(payload, ts_base):
             _idx, batch = payload
             res, dist_evals = self._knn_result(batch, query_point, radius, k)
-            return self._defer_knn(res, interner=parsed.interner,
-                                   dist_evals=dist_evals)
+            d = self._defer_knn(res, interner=parsed.interner,
+                                dist_evals=dist_evals)
+            d.interner = parsed.interner
+            return d
 
         for result in self._drive_bulk(
                 parsed, eval_batch, pad=pad,
                 pane_merge=lambda parts: merge_partials(parts, k,
-                                                        parsed.interner)):
+                                                        parsed.interner),
+                pane_device_merge=_knn_device_merge(self, k,
+                                                    parsed.interner)):
             result.extras["k"] = k
             yield result
 
@@ -159,18 +232,25 @@ class PointPointKNNQuery(SpatialOperator):
         (parallel.ops.distributed_stream_knn_multi) — 8-dev ≡ 1-dev."""
         k = k or self.conf.k
         local = self._multi_local(query_points, radius, k)
+        tie = getattr(stream, "interner", None)
+        if tie is None:  # NOT `or`: a still-empty interner is falsy
+            tie = self.interner
 
         def eval_batch(records, ts_base):
             if not records:
                 return [[] for _ in query_points]
             batch = self._point_batch(records, ts_base)
             res, evals = self._knn_multi_result(batch, local, k)
-            return self._defer_knn_multi(res, jnp.sum(evals))
+            ri = getattr(records, "interner", None)
+            d = self._defer_knn_multi(res, jnp.sum(evals), interner=ri)
+            d.interner = ri if ri is not None else self.interner
+            return d
 
         for result in self._multi_results(
                 stream, eval_batch,
-                pane_merge=_merge_partials_multi(len(query_points), k,
-                                                self.interner)):
+                pane_merge=_merge_partials_multi(len(query_points), k, tie),
+                pane_device_merge=_knn_device_merge(
+                    self, k, tie, n_queries=len(query_points))):
             result.extras["k"] = k
             result.extras["queries"] = len(query_points)
             yield result
@@ -236,6 +316,9 @@ class _GenericKnn(SpatialOperator, GeomQueryMixin):
             ) -> Iterator[WindowResult]:
         k = k or self.conf.k
         setup = self._setup(query, radius)
+        tie = getattr(stream, "interner", None)
+        if tie is None:  # NOT `or`: a still-empty interner is falsy
+            tie = self.interner
 
         def elig_dists(batch):
             return self._elig_dists(batch, setup)
@@ -245,12 +328,15 @@ class _GenericKnn(SpatialOperator, GeomQueryMixin):
                 return []
             res, dist_evals = self._knn_eval(
                 self._batch(records, ts_base), elig_dists, k)
-            return self._defer_knn(res, dist_evals=dist_evals)
+            ri = getattr(records, "interner", None)
+            d = self._defer_knn(res, interner=ri, dist_evals=dist_evals)
+            d.interner = ri if ri is not None else self.interner
+            return d
 
         for result in self._drive(
                 stream, eval_batch,
-                pane_merge=lambda parts: merge_partials(parts, k,
-                                                        self.interner)):
+                pane_merge=lambda parts: merge_partials(parts, k, tie),
+                pane_device_merge=_knn_device_merge(self, k, tie)):
             result.extras["k"] = k
             yield result
 
@@ -291,17 +377,25 @@ class _GenericKnn(SpatialOperator, GeomQueryMixin):
         """Shared run_multi loop: ``local(batch)`` is the class's
         multi-kernel closure (:meth:`_multi_local`) over the class's stream
         batch form (:meth:`_batch`)."""
+        tie = getattr(stream, "interner", None)
+        if tie is None:  # NOT `or`: a still-empty interner is falsy
+            tie = self.interner
+
         def eval_batch(records, ts_base):
             if not records:
                 return [[] for _ in range(n_queries)]
             batch = self._batch(records, ts_base)
             res, evals = self._knn_multi_result(batch, local, k)
-            return self._defer_knn_multi(res, jnp.sum(evals))
+            ri = getattr(records, "interner", None)
+            d = self._defer_knn_multi(res, jnp.sum(evals), interner=ri)
+            d.interner = ri if ri is not None else self.interner
+            return d
 
         for result in self._multi_results(
                 stream, eval_batch,
-                pane_merge=_merge_partials_multi(n_queries, k,
-                                                self.interner)):
+                pane_merge=_merge_partials_multi(n_queries, k, tie),
+                pane_device_merge=_knn_device_merge(self, k, tie,
+                                                    n_queries=n_queries)):
             result.extras["k"] = k
             result.extras["queries"] = n_queries
             yield result

@@ -61,6 +61,10 @@ def assemble_subtrajectories(records: List[Point]) -> Dict[str, object]:
 
 
 class PointTFilterQuery(SpatialOperator):
+    # interner-keyed cross-window state: windows must carry
+    # materialized records in the OPERATOR's id space (the
+    # chunked decode still batches the parse)
+    columnar_windows = False
     telemetry_label = "tfilter"
 
     """Keep only trajectories whose objID is in ``traj_ids`` (empty => all)."""
@@ -88,6 +92,10 @@ class PointTFilterQuery(SpatialOperator):
 
 
 class PointPolygonTRangeQuery(SpatialOperator, GeomQueryMixin):
+    # interner-keyed cross-window state: windows must carry
+    # materialized records in the OPERATOR's id space (the
+    # chunked decode still batches the parse)
+    columnar_windows = False
     telemetry_label = "trange"
 
     """Trajectories passing through any of a set of query polygons."""
@@ -226,6 +234,10 @@ class PointPolygonTRangeQuery(SpatialOperator, GeomQueryMixin):
 
 
 class PointTStatsQuery(SpatialOperator):
+    # interner-keyed cross-window state: windows must carry
+    # materialized records in the OPERATOR's id space (the
+    # chunked decode still batches the parse)
+    columnar_windows = False
     telemetry_label = "tstats"
 
     """Per-trajectory spatial length / temporal length / average speed.
@@ -567,6 +579,10 @@ class PointTStatsQuery(SpatialOperator):
 
 
 class PointTAggregateQuery(SpatialOperator):
+    # interner-keyed cross-window state: windows must carry
+    # materialized records in the OPERATOR's id space (the
+    # chunked decode still batches the parse)
+    columnar_windows = False
     telemetry_label = "taggregate"
 
     """Per-cell heatmap of trajectory lengths.
@@ -1061,6 +1077,10 @@ class _ExtentStore:
 
 
 class PointPointTJoinQuery(SpatialOperator):
+    # interner-keyed cross-window state: windows must carry
+    # materialized records in the OPERATOR's id space (the
+    # chunked decode still batches the parse)
+    columnar_windows = False
     telemetry_label = "tjoin"
 
     """Trajectory-trajectory proximity join: one output per
@@ -1176,6 +1196,10 @@ class PointPointTJoinQuery(SpatialOperator):
 
 
 class PointPointTKNNQuery(SpatialOperator):
+    # interner-keyed cross-window state: windows must carry
+    # materialized records in the OPERATOR's id space (the
+    # chunked decode still batches the parse)
+    columnar_windows = False
     telemetry_label = "tknn"
 
     """k nearest trajectories to a query point within ``radius`` (exact

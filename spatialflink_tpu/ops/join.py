@@ -185,6 +185,63 @@ def join_counts(
 _LATTICE_BUDGET = 1 << 26
 
 
+_BLOCK_MIN_CELLS = None
+
+
+def adaptive_block_min_cells() -> int:
+    """MEASURED dispatch-cost threshold for the adaptive pane-block
+    coalescer: the lattice-cell count below which a standalone join block
+    is dispatch-bound (its fixed dispatch+readback cost exceeds its math).
+
+    Calibrated once per process on the live backend: time a minimal
+    ``join_mask`` dispatch→readback (the per-dispatch floor) and a larger
+    lattice (the marginal per-cell rate); ``min_cells = floor × rate`` is
+    the break-even block size. BASELINE's dense pane-join rows lose
+    (0.56–0.95×) exactly because their ``overlap²`` blocks sit below this
+    point — the operator coalesces such windows into one lattice dispatch
+    instead. ``SPATIALFLINK_JOIN_BLOCK_MIN_CELLS=<int>`` overrides (0
+    disables coalescing — the A/B knob benches and tests use)."""
+    global _BLOCK_MIN_CELLS
+    if _BLOCK_MIN_CELLS is not None:
+        return _BLOCK_MIN_CELLS
+    import os
+    import time
+
+    env = os.environ.get("SPATIALFLINK_JOIN_BLOCK_MIN_CELLS")
+    if env is not None:
+        _BLOCK_MIN_CELLS = max(0, int(env))
+        return _BLOCK_MIN_CELLS
+
+    def batch(n):
+        x = np.linspace(0.0, 1.0, n)
+        return PointBatch.from_arrays(
+            x, x, obj_id=np.arange(n, dtype=np.int32),
+            cell=np.zeros(n, np.int32), pad=n)
+
+    def run(a, b):
+        np.asarray(join_mask(a, b, 0.1, 4, 0.5, 0.5, n=4))
+
+    sa, sb = batch(256), batch(128)
+    ba, bb = batch(4096), batch(1024)
+    run(sa, sb)
+    run(ba, bb)  # compile both shapes outside the timed loops
+    reps = 5
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        run(sa, sb)
+    t_small = (time.perf_counter() - t0) / reps
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        run(ba, bb)
+    t_big = (time.perf_counter() - t0) / reps
+    cells_small, cells_big = 256 * 128, 4096 * 1024
+    rate = (cells_big - cells_small) / max(t_big - t_small, 1e-9)
+    # clamp: noise can make the floor look huge (or negative); a threshold
+    # past ~16M cells would coalesce genuinely compute-bound blocks
+    _BLOCK_MIN_CELLS = int(min(max(t_small * rate, 0.0), float(1 << 24)))
+    return _BLOCK_MIN_CELLS
+
+
 def _lattice_strategy() -> str:
     """'f32' (default) or 'bf16': which lattice _tiled_pairs runs. bf16 is
     the single-pass MXU superset + exact f32 re-check on survivors — the

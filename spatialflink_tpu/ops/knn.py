@@ -527,6 +527,49 @@ def merge_knn(results, k: int) -> KnnResult:
     return topk_by_distance(obj_id, dist, valid, k)
 
 
+@partial(jax.jit, static_argnames=("k",))
+def _merge_topk_stacked(obj_id, dist, valid, *, k: int) -> KnnResult:
+    """(P, k) stacked partials -> merged exact top-k. P*k is tiny (overlap
+    panes), so the full-sort dedup is the right strategy and matches the
+    per-window kernels' tie order (ascending interned id)."""
+    return topk_by_distance(obj_id.reshape(-1), dist.reshape(-1),
+                            valid.reshape(-1), k, "sort")
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _merge_topk_stacked_multi(obj_id, dist, valid, *, k: int) -> KnnResult:
+    """(P, Q, k) stacked multi-query partials -> (Q, k) merged top-k."""
+    q = obj_id.shape[1]
+    o = jnp.swapaxes(obj_id, 0, 1).reshape(q, -1)
+    d = jnp.swapaxes(dist, 0, 1).reshape(q, -1)
+    v = jnp.swapaxes(valid, 0, 1).reshape(q, -1)
+    return jax.vmap(
+        lambda oo, dd, vv: topk_by_distance(oo, dd, vv, k, "sort"))(o, d, v)
+
+
+def merge_knn_device(results, k: int) -> KnnResult:
+    """DEVICE-RESIDENT pane merge: per-pane top-k partials stay in device
+    memory across slides; each sealed window dispatches this gather +
+    re-top-k over its panes' resident arrays and reads back ONLY the merged
+    (k,) result — the device twin of :func:`merge_topk_host` (exact by the
+    same covering argument; ties break by interned id exactly like the
+    per-window kernel, so pane windows stay identical to full recompute).
+    Retraces per distinct pane count P, which is bounded by the window
+    overlap."""
+    return _merge_topk_stacked(jnp.stack([r.obj_id for r in results]),
+                               jnp.stack([r.dist for r in results]),
+                               jnp.stack([r.valid for r in results]), k=k)
+
+
+def merge_knn_device_multi(results, k: int) -> KnnResult:
+    """Multi-query :func:`merge_knn_device`: per-pane (Q, k) partials ->
+    one merged (Q, k) result per window, all on device."""
+    return _merge_topk_stacked_multi(
+        jnp.stack([r.obj_id for r in results]),
+        jnp.stack([r.dist for r in results]),
+        jnp.stack([r.valid for r in results]), k=k)
+
+
 @partial(jax.jit, static_argnames=("k", "strategy"))
 def knn_eligible(obj_id, dists, eligible, *, k: int,
                  strategy: str = "auto") -> KnnResult:

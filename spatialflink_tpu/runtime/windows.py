@@ -19,6 +19,67 @@ from typing import Dict, Iterator, List, Optional, Tuple
 from spatialflink_tpu.runtime.watermarks import BoundedOutOfOrderness
 
 
+class _ColumnarSeg(tuple):
+    """Marker for a ``(PointChunk, idx_array)`` columnar slice sitting in a
+    window/pane buffer alongside plain records — the batched decode path
+    buffers slices of the decoded SoA chunks instead of per-record Python
+    objects. A tuple subclass so LazyRecords consumes it as-is."""
+
+    __slots__ = ()
+
+
+def _finalize_buffer(buf: List):
+    """A sealed buffer's record list: plain lists pass through unchanged
+    (the scalar-record contract); buffers holding columnar segments wrap in
+    a LazyRecords view (records materialize on demand; the device batch
+    builds straight from the slices)."""
+    if not any(isinstance(x, _ColumnarSeg) for x in buf):
+        return buf
+    from spatialflink_tpu.streams.bulk import LazyRecords
+
+    segs: List = []
+    run: List = []
+    for x in buf:
+        if isinstance(x, _ColumnarSeg):
+            if run:
+                segs.append(run)
+                run = []
+            segs.append(tuple(x))
+        else:
+            run.append(x)
+    if run:
+        segs.append(run)
+    return LazyRecords(segs)
+
+
+def _materialize_buffer(buf: List) -> Iterator:
+    """Per-record view of a buffer for the checkpoint codec (columnar
+    segments materialize; the snapshot format stays record-shaped, so old
+    and new layouts round-trip through the same codec)."""
+    for x in buf:
+        if isinstance(x, _ColumnarSeg):
+            chunk, idx = x
+            for j in idx.tolist():
+                yield chunk.record(j)
+        else:
+            yield x
+
+
+def _keep_mask(watermarker, ts):
+    """Vectorized per-record lateness decisions for one chunk: the keep/drop
+    mask against the per-record PREFIX watermark — identical to feeding the
+    chunk one record at a time (shared by WindowAssembler.add_chunk /
+    add_parsed_chunk and PaneBuffer.add_parsed_chunk)."""
+    import numpy as np
+
+    prior = max(watermarker._max_ts, -(2 ** 62))
+    run_max = np.maximum.accumulate(ts)
+    wm_before = np.empty_like(ts)
+    wm_before[0] = prior
+    np.maximum(run_max[:-1], prior, out=wm_before[1:])
+    return ts >= wm_before - watermarker.allowed_lateness_ms
+
+
 @dataclass(frozen=True)
 class WindowSpec:
     size_ms: int
@@ -161,12 +222,7 @@ class WindowAssembler:
         # watermark BEFORE each record = max of prior state and the chunk
         # prefix (clamped: the uninitialized int64-min state would wrap
         # under the lateness subtraction)
-        prior = max(self.watermarker._max_ts, -(2 ** 62))
-        run_max = np.maximum.accumulate(ts)
-        wm_before = np.empty_like(ts)
-        wm_before[0] = prior
-        np.maximum(run_max[:-1], prior, out=wm_before[1:])
-        keep = ts >= wm_before - self.watermarker.allowed_lateness_ms
+        keep = _keep_mask(self.watermarker, ts)
         self.late_dropped += int((~keep).sum())
         kept_idx = np.nonzero(keep)[0]
         if kept_idx.size:
@@ -180,10 +236,59 @@ class WindowAssembler:
         wm = self.watermarker.on_event(int(ts.max()))
         yield from self._seal_until(wm)
 
+    def add_parsed_chunk(self, chunk) -> Iterator[Tuple[int, int, List]]:
+        """Columnar :meth:`add_chunk`: one decoded :class:`PointChunk`
+        buffers as SoA SLICES (``_ColumnarSeg``) instead of per-record
+        objects — same vectorized prefix-watermark late drops, same
+        ``assign_bulk`` window set, one seal sweep per chunk. Sealed
+        windows carry :class:`LazyRecords` views, so the operator layer
+        builds device batches straight from the slices and materializes
+        Point objects only for records a window actually emits."""
+        import numpy as np
+
+        ts = np.asarray(chunk.parsed.ts, np.int64)
+        if not ts.size:
+            return
+        keep = _keep_mask(self.watermarker, ts)
+        self.late_dropped += int((~keep).sum())
+        kept_idx = np.nonzero(keep)[0]
+        if kept_idx.size:
+            win, rec = self.spec.assign_bulk(ts[kept_idx])
+            bounds = np.flatnonzero(np.r_[True, win[1:] != win[:-1], True])
+            for i in range(len(bounds) - 1):
+                lo, hi = int(bounds[i]), int(bounds[i + 1])
+                self._buffers.setdefault(int(win[lo]), []).append(
+                    _ColumnarSeg((chunk, kept_idx[rec[lo:hi]])))
+        wm = self.watermarker.on_event(int(ts.max()))
+        yield from self._seal_until(wm)
+
+    def assemble_chunks(self, chunks) -> Iterator[Tuple[int, int, List]]:
+        """Drive a chunked decode stream (``driver.decode_chunks``): each
+        decoded chunk — columnar :class:`PointChunk` or a plain record list
+        (bulk-ineligible formats / mixed streams) — buffers whole and then
+        seals, so emission granularity is ONE DECODE CHUNK. In live mode a
+        chunk is at most one poll cycle (the source's starvation sentinel
+        flushes the decoder), bounding the added emission latency to the
+        same one-poll-cycle window the chunked Kafka decode always had; a
+        checkpoint barrier can therefore never observe records sitting in a
+        half-assembled chunk (every pulled record is in the buffers before
+        any seal yields)."""
+        for ch in chunks:
+            if hasattr(ch, "parsed"):
+                yield from self.add_parsed_chunk(ch)
+            elif ch:
+                yield from self.add_chunk([r.timestamp for r in ch], ch)
+        yield from self.flush()
+
     def assemble(self, stream, ts_of=None, chunk: int = 4096
                  ) -> Iterator[Tuple[int, int, List]]:
         """Drive a whole record stream through chunk-vectorized assignment
         (:meth:`add_chunk`) + the end-of-stream :meth:`flush`.
+
+        A chunked decode stream (one exposing ``.chunks`` — see
+        ``driver.decode_stream``) short-circuits to
+        :meth:`assemble_chunks`, consuming the decoder's columnar chunks
+        directly with no per-record materialization.
 
         Emission timing matches the per-record :meth:`add` loop exactly: a
         chunk flushes the moment its running watermark reaches the earliest
@@ -191,6 +296,10 @@ class WindowAssembler:
         so sealed windows are never held back behind a fill count — live
         sources emit mid-stream just like before. ``chunk`` only bounds
         memory between seal points."""
+        chunks_fn = getattr(stream, "chunks", None)
+        if chunks_fn is not None:
+            yield from self.assemble_chunks(chunks_fn())
+            return
         ts_of = ts_of if ts_of is not None else (lambda r: r.timestamp)
         lateness = self.watermarker.allowed_lateness_ms
         buf_r: List = []
@@ -226,25 +335,28 @@ class WindowAssembler:
             s for s in self._buffers if s + self.spec.size_ms <= watermark
         )
         for start in ready:
-            records = self._buffers.pop(start)
+            records = _finalize_buffer(self._buffers.pop(start))
             yield (start, start + self.spec.size_ms, records)
 
     def flush(self) -> Iterator[Tuple[int, int, List]]:
         """Seal every remaining window (end of bounded stream)."""
         for start in sorted(self._buffers):
-            records = self._buffers.pop(start)
+            records = _finalize_buffer(self._buffers.pop(start))
             yield (start, start + self.spec.size_ms, records)
 
     def snapshot(self, encode) -> dict:
         """JSON-able open-window state for the checkpoint coordinator:
         watermark, late-drop count, and every open window's buffered records
-        (``encode(record) -> str``). Taken at a barrier where every SEALED
-        window has already been emitted downstream, this is exactly the
-        state a resumed run needs alongside the source position."""
+        (``encode(record) -> str``; columnar segments materialize here, so
+        the snapshot format is identical to the record-path layout and old
+        checkpoints restore into either). Taken at a barrier where every
+        SEALED window has already been emitted downstream, this is exactly
+        the state a resumed run needs alongside the source position."""
         return {
             "watermark_max_ts": self.watermarker._max_ts,
             "late_dropped": self.late_dropped,
-            "buffers": {str(s): [encode(r) for r in recs]
+            "buffers": {str(s): [encode(r)
+                                 for r in _materialize_buffer(recs)]
                         for s, recs in self._buffers.items()},
         }
 
@@ -296,6 +408,52 @@ class PaneBuffer:
         wm = self.watermarker.on_event(ts_ms)
         yield from self._seal_until(wm)
 
+    def add_parsed_chunk(self, chunk) -> Iterator[Tuple[int, int, List]]:
+        """Columnar :meth:`add` over one decoded :class:`PointChunk`: the
+        same vectorized prefix-watermark late drops as
+        ``WindowAssembler.add_parsed_chunk``, pane assignment in one
+        ``ts - ts % slide`` pass, SoA slices buffered per pane, one seal
+        sweep per chunk."""
+        import numpy as np
+
+        ts = np.asarray(chunk.parsed.ts, np.int64)
+        if not ts.size:
+            return
+        keep = _keep_mask(self.watermarker, ts)
+        self.late_dropped += int((~keep).sum())
+        kept_idx = np.nonzero(keep)[0]
+        if kept_idx.size:
+            kts = ts[kept_idx]
+            pane = kts - kts % self.spec.slide_ms
+            order = np.argsort(pane, kind="stable")
+            pane_s = pane[order]
+            bounds = np.flatnonzero(
+                np.r_[True, pane_s[1:] != pane_s[:-1], True])
+            for i in range(len(bounds) - 1):
+                lo, hi = int(bounds[i]), int(bounds[i + 1])
+                self._panes.setdefault(int(pane_s[lo]), []).append(
+                    _ColumnarSeg((chunk, kept_idx[order[lo:hi]])))
+        wm = self.watermarker.on_event(int(ts.max()))
+        yield from self._seal_until(wm)
+
+    def assemble(self, stream) -> Iterator[Tuple[int, int, List]]:
+        """Drive a whole stream: a chunked decode stream (``.chunks``)
+        consumes columnar chunks directly (emission granularity = one
+        decode chunk, exactly like ``WindowAssembler.assemble_chunks``);
+        plain record streams keep the per-record :meth:`add` loop."""
+        chunks_fn = getattr(stream, "chunks", None)
+        if chunks_fn is not None:
+            for ch in chunks_fn():
+                if hasattr(ch, "parsed"):
+                    yield from self.add_parsed_chunk(ch)
+                else:
+                    for rec in ch:
+                        yield from self.add(rec.timestamp, rec)
+        else:
+            for rec in stream:
+                yield from self.add(rec.timestamp, rec)
+        yield from self.flush()
+
     def _seal_until(self, watermark: int) -> Iterator[Tuple[int, int, List]]:
         if not self._panes:
             return
@@ -324,7 +482,7 @@ class PaneBuffer:
                 starts.add(s)
                 s += slide
         for s in sorted(starts):
-            panes = [(p, self._panes[p])
+            panes = [(p, _finalize_buffer(self._panes[p]))
                      for p in range(s, s + size, slide) if p in self._panes]
             yield (s, s + size, panes)
 
@@ -348,7 +506,7 @@ class PaneBuffer:
             "watermark_max_ts": self.watermarker._max_ts,
             "late_dropped": self.late_dropped,
             "next": self._next,
-            "panes": {str(p): [encode(r) for r in recs]
+            "panes": {str(p): [encode(r) for r in _materialize_buffer(recs)]
                       for p, recs in self._panes.items()},
         }
 

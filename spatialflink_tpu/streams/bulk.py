@@ -16,7 +16,7 @@ Output is a :class:`ParsedPoints` SoA — exactly what
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import List, Optional, Sequence, Tuple
+from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -68,6 +68,298 @@ class ParsedPoints:
                                      cells)
         ]
 
+
+@dataclass
+class PointChunk:
+    """One decoded chunk riding the batched record path: the columnar parse
+    result plus the vectorized per-record cell assignment, so nothing
+    downstream re-derives either per record. ``positions`` (optional) carries
+    the per-record source offsets a Kafka commit tap snapshotted at pull
+    time; ``ingest_ms`` is the wall clock the chunk was decoded at — the
+    stamp lazily-materialized Points inherit as ``ingestion_time`` (the
+    scalar path stamped each record at parse; per-chunk is the batched
+    equivalent)."""
+
+    parsed: ParsedPoints
+    cells: np.ndarray                       # (N,) i32, -1 = outside grid
+    positions: Optional[np.ndarray] = None  # (N,) i64 source offsets
+    ingest_ms: int = 0
+    #: checkpoint-position callback (set by the Kafka commit tap): chunk
+    #: consumers that dribble records out one at a time (the flatten path
+    #: feeding joins/trajectory) re-note per record so a checkpoint barrier
+    #: never covers records still sitting in a half-consumed chunk; the
+    #: chunk-aware assemblers buffer whole chunks before any barrier can
+    #: run, so the tap's chunk-level note is already safe there
+    note: Optional[Callable[[int], None]] = None
+
+    def __len__(self) -> int:
+        return len(self.parsed)
+
+    @staticmethod
+    def build(parsed: ParsedPoints, grid: Optional[UniformGrid],
+              positions=None) -> "PointChunk":
+        import time as _time
+
+        if grid is not None and len(parsed):
+            cells, _ = grid.assign_cell(parsed.x, parsed.y)
+            cells = np.asarray(cells, np.int32)
+        else:
+            cells = np.full(len(parsed), -1, np.int32)
+        return PointChunk(parsed=parsed, cells=cells,
+                          positions=None if positions is None
+                          else np.asarray(positions, np.int64),
+                          ingest_ms=int(_time.time() * 1000))
+
+    def record(self, i: int) -> Point:
+        """Materialize record ``i`` (the lazy per-record view)."""
+        p = self.parsed
+        return Point(obj_id=p.interner.lookup(int(p.obj_id[i])),
+                     timestamp=int(p.ts[i]), x=float(p.x[i]),
+                     y=float(p.y[i]), cell=int(self.cells[i]),
+                     ingestion_time=self.ingest_ms)
+
+    def records(self) -> List[Point]:
+        """Materialize every record (the flatten path for consumers without
+        a columnar window driver — joins, trajectory, realtime)."""
+        lk = self.parsed.interner.lookup
+        ing = self.ingest_ms
+        return [
+            Point(obj_id=lk(int(o)), timestamp=int(t), x=float(x),
+                  y=float(y), cell=int(c), ingestion_time=ing)
+            for o, t, x, y, c in zip(self.parsed.obj_id, self.parsed.ts,
+                                     self.parsed.x, self.parsed.y,
+                                     self.cells)
+        ]
+
+
+class LazyRecords:
+    """A window's (or pane's) record list as columnar chunk slices,
+    materializing per-record :class:`Point` objects only on demand.
+
+    This is what the batched record path buffers instead of Python objects:
+    segments are either ``(PointChunk, idx_array)`` columnar slices or plain
+    record lists (mixed streams — a bulk-ineligible chunk falls back to
+    objects). ``point_batch`` builds the window's device batch straight from
+    the SoA slices (no per-record objects anywhere on the selected path);
+    ``__getitem__`` materializes single records so sparse selections (range
+    survivors, join pairs) only ever pay for what they emit. Object ids
+    across every segment live in ONE id space — the stream's decode
+    ``interner`` — which kNN result resolution and pane-merge tie-breaking
+    read through."""
+
+    __slots__ = ("_segs", "_offsets", "_len", "interner", "_cache")
+
+    def __init__(self, segs):
+        self._segs = segs
+        self._offsets = []
+        self._len = 0
+        self.interner = None
+        for seg in segs:
+            self._offsets.append(self._len)
+            if isinstance(seg, tuple):
+                chunk, idx = seg
+                self._len += int(idx.size)
+                if self.interner is None:
+                    self.interner = chunk.parsed.interner
+            else:
+                self._len += len(seg)
+        self._cache: dict = {}
+
+    def __len__(self) -> int:
+        return self._len
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [self[j] for j in range(*i.indices(self._len))]
+        if i < 0:
+            i += self._len
+        if not 0 <= i < self._len:
+            raise IndexError(i)
+        hit = self._cache.get(i)
+        if hit is not None:
+            return hit
+        # segment lookup (few segments per window; linear scan is fine)
+        for seg, off in zip(reversed(self._segs), reversed(self._offsets)):
+            if i >= off:
+                rec = (seg[0].record(int(seg[1][i - off]))
+                       if isinstance(seg, tuple) else seg[i - off])
+                self._cache[i] = rec
+                return rec
+        raise IndexError(i)
+
+    def __iter__(self):
+        for seg in self._segs:
+            if isinstance(seg, tuple):
+                chunk, idx = seg
+                for j in idx.tolist():
+                    yield chunk.record(j)
+            else:
+                yield from seg
+
+    def _flat(self):
+        """Memoized concatenated per-record arrays (x, y, ts, oid, cell,
+        ingest_ms) for vectorized selection; None when an object segment
+        makes the columnar gather inapplicable (mixed streams take the
+        per-item path)."""
+        flat = self._cache.get("_flat_", False)
+        if flat is not False:
+            return flat
+        xs, ys, tss, oids, cells, ings = [], [], [], [], [], []
+        for seg in self._segs:
+            if not isinstance(seg, tuple):
+                self._cache["_flat_"] = None
+                return None
+            chunk, idx = seg
+            p = chunk.parsed
+            xs.append(p.x[idx])
+            ys.append(p.y[idx])
+            tss.append(p.ts[idx])
+            oids.append(p.obj_id[idx])
+            cells.append(chunk.cells[idx])
+            ings.append(np.full(idx.size, chunk.ingest_ms, np.int64))
+        flat = tuple(np.concatenate(a) for a in (xs, ys, tss, oids, cells,
+                                                 ings))
+        self._cache["_flat_"] = flat
+        return flat
+
+    def point_batch(self, grid, ts_base: int,
+                    pad: Optional[int] = None) -> PointBatch:
+        """The window's device batch from the columnar slices — cells were
+        assigned once per chunk, obj ids stay in the decode interner's id
+        space. Object segments (mixed streams) intern into the same space."""
+        xs, ys, tss, oids, cells = [], [], [], [], []
+        interner = self.interner if self.interner is not None else IdInterner()
+        for seg in self._segs:
+            if isinstance(seg, tuple):
+                chunk, idx = seg
+                p = chunk.parsed
+                xs.append(p.x[idx])
+                ys.append(p.y[idx])
+                tss.append(p.ts[idx])
+                oids.append(p.obj_id[idx])
+                cells.append(chunk.cells[idx])
+            elif seg:
+                xs.append(np.array([r.x for r in seg], np.float64))
+                ys.append(np.array([r.y for r in seg], np.float64))
+                tss.append(np.array([r.timestamp for r in seg], np.int64))
+                oids.append(np.array([interner.intern(r.obj_id)
+                                      for r in seg], np.int32))
+                cells.append(np.array([r.cell for r in seg], np.int32))
+        if not xs:
+            return PointBatch.from_arrays(np.empty(0), np.empty(0),
+                                          grid=grid, ts_base=ts_base, pad=pad)
+        return PointBatch.from_arrays(
+            np.concatenate(xs), np.concatenate(ys), grid=grid,
+            obj_id=np.concatenate(oids), ts=np.concatenate(tss),
+            ts_base=ts_base, pad=pad, cell=np.concatenate(cells))
+
+    def take(self, idx):
+        """The records at ``idx`` as a :class:`PointRows` view — one
+        vectorized gather instead of N ``__getitem__`` segment lookups, and
+        Point objects materialize only if a consumer actually reads them
+        (result sinks serialize straight from the arrays)."""
+        flat = self._flat()
+        if flat is None:
+            return [self[int(i)] for i in idx]
+        idx = np.asarray(idx, np.int64)
+        return PointRows(tuple(a[idx] for a in flat), self.interner)
+
+
+class PointRows:
+    """A window's SELECTED records as columnar arrays — list-shaped (len /
+    index / iterate / slice materialize real :class:`Point` objects,
+    cached), but sinks that only need serialized output read
+    :meth:`serialize_batch` and never build a Python object per record.
+    This is what keeps the batched path's per-selected-record cost at
+    string-format level instead of dataclass-construction level."""
+
+    __slots__ = ("_cols", "interner", "_mat")
+
+    def __init__(self, cols, interner):
+        self._cols = cols  # (x, y, ts, oid, cell, ingest_ms) gathered
+        self.interner = interner
+        self._mat = None
+
+    def __len__(self) -> int:
+        return int(self._cols[0].shape[0])
+
+    def _materialize(self) -> List[Point]:
+        if self._mat is None:
+            fx, fy, ft, fo, fc, fi = self._cols
+            lk = self.interner.lookup
+            self._mat = [
+                Point(obj_id=lk(int(o)), timestamp=int(t), x=float(x),
+                      y=float(y), cell=int(c), ingestion_time=int(g))
+                for o, t, x, y, c, g in zip(fo, ft, fx, fy, fc, fi)
+            ]
+        return self._mat
+
+    def __getitem__(self, i):
+        return self._materialize()[i]
+
+    def __iter__(self):
+        return iter(self._materialize())
+
+    def __eq__(self, other):
+        if isinstance(other, PointRows):
+            other = other._materialize()
+        return self._materialize() == other
+
+    def __repr__(self):
+        return f"PointRows({len(self)} records)"
+
+    def __add__(self, other):
+        return self._materialize() + list(other)
+
+    def __radd__(self, other):
+        return list(other) + self._materialize()
+
+    def serialize_batch(self, fmt, *, delimiter: str = ",",
+                        date_format=None) -> Optional[List[str]]:
+        """Serialized output records straight from the columnar arrays —
+        GeoJSON rides the exact fast template ``formats.serialize_geojson``
+        uses (byte-identical, equivalence-tested); other formats return
+        None and the caller serializes materialized records."""
+        if not fmt or fmt.lower() != "geojson":
+            return None
+        import json as _json
+
+        from spatialflink_tpu.streams.formats import (_JSON_SAFE_RE,
+                                                      format_timestamp)
+
+        fx, fy, ft, fo, _fc, _fi = self._cols
+        lk = self.interner.lookup
+        tmpl = ('{"geometry": {"type": "Point", "coordinates": [%r, %r]}, '
+                '"properties": {"oID": %s, "timestamp": %s}, '
+                '"type": "Feature"}')
+        safe = _JSON_SAFE_RE.match
+        # ids: one quote/escape per DISTINCT object, gathered vectorized
+        uniq, inv = np.unique(fo, return_inverse=True)
+        qid = np.array(
+            [('"%s"' % s if safe(s) else _json.dumps(s))
+             for s in (lk(int(u)) for u in uniq)], dtype=object)[inv]
+        if date_format and "%f" not in date_format:
+            # timestamps quote-memoized per second (format_timestamp is
+            # already second-memoized; this also amortizes the escape —
+            # sound only without a sub-second token, like that memo)
+            memo: dict = {}
+
+            def jts(t):
+                k = int(t) // 1000
+                s = memo.get(k)
+                if s is None:
+                    raw = format_timestamp(int(t), date_format)
+                    s = '"%s"' % raw if safe(raw) else _json.dumps(raw)
+                    memo[k] = s
+                return s
+        elif date_format:
+            def jts(t):
+                raw = format_timestamp(int(t), date_format)
+                return '"%s"' % raw if safe(raw) else _json.dumps(raw)
+        else:
+            jts = int
+        return [tmpl % (float(x), float(y), o, jts(t))
+                for x, y, o, t in zip(fx, fy, qid, ft)]
 
 def _ptr(a: np.ndarray, ctype):
     return a.ctypes.data_as(ctypes.POINTER(ctype))

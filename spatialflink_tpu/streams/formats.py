@@ -64,9 +64,29 @@ def parse_timestamp(value, date_format: Optional[str] = DEFAULT_DATE_FORMAT) -> 
         return 0
 
 
+#: second-resolution strftime memo: result sinks format every selected
+#: record's timestamp and event times cluster heavily per second — for
+#: patterns without a sub-second token the rendered string is a pure
+#: function of (second, pattern), so one strftime per distinct second
+#: serves the whole stream (bounded: cleared past 64k entries)
+_TS_FMT_CACHE: dict = {}
+
+
 def format_timestamp(ms: int, date_format: Optional[str] = None) -> Union[int, str]:
     if not date_format:
         return int(ms)
+    ms = int(ms)
+    if "%f" not in date_format:
+        key = (ms // 1000, date_format)
+        hit = _TS_FMT_CACHE.get(key)
+        if hit is not None:
+            return hit
+        out = datetime.fromtimestamp(ms / 1000,
+                                     tz=timezone.utc).strftime(date_format)
+        if len(_TS_FMT_CACHE) > 65536:
+            _TS_FMT_CACHE.clear()
+        _TS_FMT_CACHE[key] = out
+        return out
     return datetime.fromtimestamp(ms / 1000, tz=timezone.utc).strftime(date_format)
 
 
@@ -117,6 +137,11 @@ def parse_geojson(
     return _geometry_from_geojson(geom, grid, oid, ts)
 
 
+#: printable ASCII minus the two characters json.dumps escapes (`"` and
+#: `\`): strings matching this render identically bare-quoted
+_JSON_SAFE_RE = re.compile(r'^[ !#-\[\]-~]*$')
+
+
 def _coords_json(obj: SpatialObject):
     if isinstance(obj, Point):
         return [obj.x, obj.y], "Point"
@@ -136,6 +161,25 @@ def _coords_json(obj: SpatialObject):
 def serialize_geojson(obj: SpatialObject, *, date_format: Optional[str] = None) -> str:
     """Feature JSON matching the reference's output schemas
     (``Serialization.java:17-51``)."""
+    if type(obj) is Point:
+        # hot-path Point serializer: byte-identical to the json.dumps of
+        # the dict below (same key order/separators; %r is float.__repr__,
+        # exactly json's float formatting; strings with characters json
+        # would escape still go through json.dumps) at a fraction of the
+        # cost — result sinks serialize every selected record, which
+        # dominated the batched pipeline's wall clock (equivalence pinned
+        # by tests/test_batched_path.py against the dict path)
+        ts = format_timestamp(obj.timestamp, date_format)
+        tsj = (ts if isinstance(ts, int)
+               else ('"%s"' % ts if _JSON_SAFE_RE.match(ts)
+                     else json.dumps(ts)))
+        oid = obj.obj_id
+        oj = ('"%s"' % oid if _JSON_SAFE_RE.match(oid)
+              else json.dumps(oid))
+        return ('{"geometry": {"type": "Point", "coordinates": [%r, %r]}, '
+                '"properties": {"oID": %s, "timestamp": %s}, '
+                '"type": "Feature"}'
+                % (obj.x, obj.y, oj, tsj))
     if isinstance(obj, GeometryCollection):
         geometry = {
             "type": "GeometryCollection",

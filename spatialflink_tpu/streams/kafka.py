@@ -31,11 +31,13 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional, Tuple
 
+import numpy as np
+
 from spatialflink_tpu.streams.formats import serialize_spatial
 from spatialflink_tpu.utils import telemetry as _telemetry
 
 
-@dataclass
+@dataclass(**({"slots": True} if __import__("sys").version_info >= (3, 10) else {}))
 class BrokerRecord:
     """One record in a topic log."""
 
@@ -70,6 +72,22 @@ class InMemoryBroker:
                 else int(time.time() * 1000))
             log.append(rec)
             return rec.offset
+
+    def produce_many(self, topic: str, values, key: Optional[str] = None
+                     ) -> int:
+        """Batched :meth:`produce` under ONE lock/timestamp — the window
+        sink's per-record production amortized (only the plain in-memory
+        broker offers this; fault-injecting/supervised wrappers keep the
+        per-record path so chaos semantics cover every record). Returns the
+        first offset."""
+        with self._lock:
+            log = self._topics.setdefault(topic, [])
+            base = len(log)
+            now = int(time.time() * 1000)
+            log.extend(BrokerRecord(offset=base + i, key=key, value=v,
+                                    timestamp_ms=now)
+                       for i, v in enumerate(values))
+            return base
 
     # ------------------------------ consumer ------------------------- #
 
@@ -108,6 +126,17 @@ def resequence_batch(batch: List[BrokerRecord], next_offset: int
     consumer's fetch-session dedup does; a no-op on clean transports.
     Shared by :class:`KafkaSource` and the driver's ``--bulk`` topic drain
     — both assume offset-ordered, exactly-once-per-position hand-off."""
+    # fast path: a clean transport delivers the batch already contiguous
+    # from next_offset — one scan, no sort, no copy (the common case on
+    # every poll of an undegraded broker)
+    if batch and batch[0].offset == next_offset:
+        expected = next_offset
+        for rec in batch:
+            if rec.offset != expected:
+                break
+            expected += 1
+        else:
+            return batch
     cleaned: List[BrokerRecord] = []
     last = next_offset - 1
     for rec in sorted(batch, key=lambda r: r.offset):
@@ -175,6 +204,51 @@ class KafkaSource:
     def commit_to(self, next_offset: int) -> None:
         """Commit the group's resume point (monotone in the broker)."""
         self.broker.commit(self.topic, self.group, next_offset)
+
+    def iter_batches(self) -> Iterator:
+        """Batched consumption for chunk-aware consumers (the commit tap's
+        native decode): yields ``(values, next_positions)`` lists per poll —
+        one Python-level iteration per POLL instead of per record, same
+        resequencing/limit/lagged-commit semantics as :meth:`__iter__` (and
+        :data:`STARVED` on empty live polls when the sentinel is on).
+        Requires ``auto_commit=False`` (the tap owns commit placement);
+        control tuples are NOT checked here — the consumer scans the batch
+        (the tap does)."""
+        if self.auto_commit:
+            raise ValueError("iter_batches requires auto_commit=False "
+                             "(the consumer owns commit placement)")
+        pos = self.position = self.broker.committed(self.topic, self.group)
+        yielded = 0
+        tel = _telemetry.active()
+        while True:
+            if self.limit is not None and yielded >= self.limit:
+                return
+            if tel is not None:
+                with tel.span("fetch", query="kafka"):
+                    batch = self.broker.fetch(self.topic, pos,
+                                              self.poll_batch)
+            else:
+                batch = self.broker.fetch(self.topic, pos, self.poll_batch)
+            if not batch:
+                if self.stop_at_end:
+                    return
+                if self.starvation_sentinel:
+                    yield STARVED
+                time.sleep(0.01)
+                continue
+            cleaned = resequence_batch(batch, pos)
+            if not cleaned:
+                continue  # all duplicates of already-delivered records
+            if self.limit is not None:
+                cleaned = cleaned[:self.limit - yielded]
+            vals = [r.value for r in cleaned]
+            poss = [r.offset + 1 for r in cleaned]
+            pos = self.position = poss[-1]
+            yielded += len(vals)
+            if self.commit_lag is not None:
+                self.broker.commit(self.topic, self.group,
+                                   max(0, pos - self.commit_lag))
+            yield vals, poss
 
     def __iter__(self) -> Iterator[Any]:
         # position starts at the group's committed offset (restart resume)
@@ -395,6 +469,9 @@ class WindowCommitTap:
         self.parse = parse
         self.bulk_decode = bulk_decode
         self.bulk_chunk = max(1, bulk_chunk)
+        #: the chunked decoder's obj-id space (set by the driver when the
+        #: decoder interns); downstream ChunkedStream consumers read it
+        self.interner = getattr(bulk_decode, "interner", None)
         #: optional runtime.checkpoint.CheckpointCoordinator: the tap
         #: reports per-record source positions AT HAND-OFF time (not pull
         #: time — the chunked decode buffers raws past the source's read
@@ -414,6 +491,7 @@ class WindowCommitTap:
         # per tracked record — cheap float stores, and only when a session
         # was active when the driver wired the tap
         tel = _telemetry.active()
+        self._tel = tel
         self._lag_gauge = (tel.gauge("kafka.watermark-lag-ms")
                            if tel is not None else None)
         self._backlog_gauge = (tel.gauge("kafka.commit-backlog")
@@ -487,11 +565,57 @@ class WindowCommitTap:
             self._backlog_gauge.set(len(self._pending))
         return obj
 
+    def _track_chunk(self, chunk):
+        """Vectorized :meth:`_track` for one columnar chunk: commit
+        bookkeeping per record (the prefix-commit sweep needs per-record
+        positions), checkpoint position + gauges once per chunk."""
+        if self.checkpointer is not None:
+            self.checkpointer.note_position(
+                self._ckpt_key, int(chunk.positions[-1]))
+            coord, key = self.checkpointer, self._ckpt_key
+            # per-record re-note hook for flatten consumers (see
+            # PointChunk.note); chunk-aware assemblers never need it
+            chunk.note = lambda p: coord.note_position(key, p)
+        ts = np.asarray(chunk.parsed.ts, np.int64)
+        lwe = ts - ts % self.slide_ms + self.size_ms
+        self._pending.extend(zip(chunk.positions.tolist(), lwe.tolist()))
+        if self._lag_gauge is not None:
+            self._lag_gauge.set(time.time() * 1000 - int(ts[-1]))
+        if self._backlog_gauge is not None:
+            self._backlog_gauge.set(len(self._pending))
+        return chunk
+
+    def chunks(self) -> Iterator[Any]:
+        """Chunked hand-off for the batched decode path
+        (``driver.decode_chunks``): yields columnar
+        :class:`~spatialflink_tpu.streams.bulk.PointChunk` chunks (native
+        decode, per-record positions snapshotted for the commit sweep) or
+        plain record lists (per-record fallback / record-mode parse), one
+        chunk per flush — at most one poll cycle of buffering in live mode
+        (the starvation sentinel flushes)."""
+        if self.bulk_decode is not None:
+            yield from self._bulk_chunks()
+            return
+        yield from self._record_chunks()
+
     def __iter__(self) -> Iterator[Any]:
         from spatialflink_tpu.utils.metrics import check_exit_control_tuple
 
         if self.bulk_decode is not None:
-            yield from self._iter_bulk()
+            # flatten the chunked decode (same buffering the chunked
+            # per-record hand-off always had); per-record position re-note
+            # keeps checkpoint barriers sound while records dribble out
+            for ch in self._bulk_chunks():
+                if hasattr(ch, "records"):
+                    recs = ch.records()
+                    if ch.note is not None and ch.positions is not None:
+                        for rec, p in zip(recs, ch.positions.tolist()):
+                            ch.note(int(p))
+                            yield rec
+                    else:
+                        yield from recs
+                else:
+                    yield from ch
             return
         for raw in self.source:
             if raw is STARVED:  # only batching consumers need the marker
@@ -502,7 +626,41 @@ class WindowCommitTap:
                 continue
             yield self._track(obj, self.source.position)
 
-    def _iter_bulk(self) -> Iterator[Any]:
+    def _record_chunks(self) -> Iterator[Any]:
+        """Record-mode chunk hand-off (no native decoder — e.g. geometry
+        streams): the per-record parse is unchanged, but records batch into
+        chunks so downstream bookkeeping amortizes; STARVED flushes."""
+        from spatialflink_tpu.utils.metrics import (ControlTupleExit,
+                                                    check_exit_control_tuple)
+
+        buf: List = []
+        tel = self._tel
+        for raw in self.source:
+            if raw is STARVED:
+                if buf:
+                    yield buf
+                    buf = []
+                continue
+            try:
+                check_exit_control_tuple(raw)
+            except ControlTupleExit:
+                if buf:
+                    yield buf
+                raise
+            t0 = time.perf_counter() if tel is not None else 0.0
+            obj = self._parse_or_dlq(raw, self.source.position)
+            if tel is not None:
+                tel.observe("ingest", time.perf_counter() - t0)
+            if obj is None:
+                continue
+            buf.append(self._track(obj, self.source.position))
+            if len(buf) >= self.bulk_chunk:
+                yield buf
+                buf = []
+        if buf:
+            yield buf
+
+    def _bulk_chunks(self) -> Iterator[Any]:
         from spatialflink_tpu.utils.metrics import (ControlTupleExit,
                                                     check_exit_control_tuple)
 
@@ -512,6 +670,7 @@ class WindowCommitTap:
         def flush():
             if not raws:
                 return
+            t0 = time.perf_counter() if self._tel is not None else 0.0
             # a record with an embedded newline would shift the native
             # parser's line<->record mapping; so would any count mismatch;
             # and a record the POINT bulk parser rejects outright (e.g. a
@@ -519,16 +678,16 @@ class WindowCommitTap:
             # three fall back to the exact per-record parse, which handles
             # them the way the streaming path always did (never silently
             # drop, mis-attribute, or crash on a record)
-            objs = None
+            chunk = None
             if not any("\n" in r for r in raws):
                 try:
-                    objs = self.bulk_decode(raws)
+                    chunk = self.bulk_decode(raws)
                 except ValueError:
-                    objs = None
-                if objs is not None and len(objs) != len(raws):
-                    objs = None
+                    chunk = None
+                if chunk is not None and len(chunk) != len(raws):
+                    chunk = None
             stop = None
-            if objs is None:
+            if chunk is None:
                 # a torn STOP tuple healing mid-fallback raises
                 # ControlTupleExit; records parsed BEFORE it in the chunk
                 # must still reach the pipeline (same contract as the
@@ -537,43 +696,83 @@ class WindowCommitTap:
                 objs = []
                 for r, p in zip(raws, poss):
                     try:
-                        objs.append(self._parse_or_dlq(r, p))
+                        obj = self._parse_or_dlq(r, p)
                     except ControlTupleExit as e:
                         stop = e
                         break
-            for obj, pos in zip(objs, poss):
-                if obj is None:  # quarantined poison record
-                    continue
-                yield self._track(obj, pos)
+                    if obj is not None:  # None = quarantined poison record
+                        objs.append(self._track(obj, p))
+                out = objs if objs else None
+            elif hasattr(chunk, "parsed"):
+                # columnar chunk: attach the per-record source positions the
+                # pull loop snapshotted and track in one vectorized pass
+                if chunk.positions is None:
+                    chunk.positions = np.asarray(poss, np.int64)
+                out = self._track_chunk(chunk)
+            else:
+                # legacy decoder contract: a plain list of parsed records
+                out = [self._track(obj, p)
+                       for obj, p in zip(chunk, poss) if obj is not None]
             raws.clear()
             poss.clear()
+            if self._tel is not None:
+                # ONE ingest observe per decoded chunk — the parse cost
+                # amortized per batch (the scalar tap observed per record)
+                self._tel.observe("ingest", time.perf_counter() - t0)
+            if out is not None and len(out):
+                yield out
             if stop is not None:
                 raise stop
 
-        for raw in self.source:
-            if raw is STARVED:
+        # one Python-level iteration per POLL: the source hands whole
+        # resequenced batches with per-record positions; only batches that
+        # carry a control marker or non-string records drop to the
+        # per-record slow path
+        for item in self.source.iter_batches():
+            if item is STARVED:
                 # quiet topic: hand everything buffered downstream so a
                 # chunk never waits out dead air (live-mode latency bound =
                 # one poll cycle, not one chunk fill)
                 yield from flush()
                 continue
-            try:
-                check_exit_control_tuple(raw)
-            except ControlTupleExit:
-                # records buffered BEFORE the control tuple must still reach
-                # the pipeline (the per-record path yielded every one of
-                # them before stopping)
-                yield from flush()
-                raise
-            if not isinstance(raw, str):
-                # pre-parsed objects pass through; flush first (order)
-                yield from flush()
-                obj = self._parse_or_dlq(raw, self.source.position)
-                if obj is not None:
-                    yield self._track(obj, self.source.position)
-                continue
-            raws.append(raw)
-            poss.append(self.source.position)
+            vals, positions = item
+            fast = True
+            for v in vals:
+                if not isinstance(v, str) or '"control"' in v:
+                    fast = False
+                    break
+            if fast:
+                # append in chunk-sized slices so the decode-chunk bound
+                # holds even when a poll batch exceeds it
+                i = 0
+                while i < len(vals):
+                    take = max(self.bulk_chunk - len(raws), 1)
+                    raws.extend(vals[i:i + take])
+                    poss.extend(positions[i:i + take])
+                    i += take
+                    if len(raws) >= self.bulk_chunk:
+                        yield from flush()
+            else:
+                for raw, position in zip(vals, positions):
+                    if isinstance(raw, str) and '"control"' not in raw:
+                        raws.append(raw)
+                        poss.append(position)
+                        continue
+                    # control candidate or pre-parsed object: flush the
+                    # buffered prefix FIRST (arrival order — the commit
+                    # sweep's pending deque must stay position-sorted;
+                    # records before a stop tuple must reach the pipeline)
+                    yield from flush()
+                    check_exit_control_tuple(raw)
+                    if isinstance(raw, str):
+                        # had the marker substring but is not an actual
+                        # control tuple — a normal record
+                        raws.append(raw)
+                        poss.append(position)
+                        continue
+                    obj = self._parse_or_dlq(raw, position)
+                    if obj is not None:
+                        yield [self._track(obj, position)]
             if len(raws) >= self.bulk_chunk:
                 yield from flush()
         yield from flush()
@@ -749,9 +948,28 @@ class KafkaWindowSink:
         recs = (result.flat_records() if hasattr(result, "flat_records")
                 else result.records)
         n = 0
-        for rec in recs:
-            self.broker.produce(self.topic, self._enc._encode(rec), key=wk)
-            n += 1
+        if recs and type(self.broker) is InMemoryBroker:
+            # batched production (one lock/timestamp for the window's
+            # records); wrapped brokers — chaos, supervised, real cluster —
+            # keep the per-record path so their per-produce semantics
+            # (fault injection, retries, acks) cover every record.
+            # Columnar selections (PointRows) serialize straight from their
+            # arrays — no per-record Python objects on the sink path.
+            vals = None
+            sb = getattr(recs, "serialize_batch", None)
+            if sb is not None and self._enc.fmt:
+                vals = sb(self._enc.fmt, delimiter=self._enc.delimiter,
+                          date_format=self._enc.date_format)
+            if vals is None:
+                enc = self._enc._encode
+                vals = [enc(r) for r in recs]
+            n = len(vals)
+            self.broker.produce_many(self.topic, vals, key=wk)
+        else:
+            for rec in recs:
+                self.broker.produce(self.topic, self._enc._encode(rec),
+                                    key=wk)
+                n += 1
         extras = {k: v for k, v in getattr(result, "extras", {}).items()
                   if k != "latency_ms"}
         if extras:

@@ -656,6 +656,15 @@ class CostProfiles:
         with self._lock:
             f["merge_ms"] += dt_s * 1e3
 
+    def note_readback(self, label: str, nbytes: int) -> None:
+        """Device→host bytes actually read back for one window's pane merge
+        (host-merged: the partials resolved this window; device-merged: the
+        merged result only) — folded into the family's ``bytes_moved`` so
+        the cost profile reflects real data motion on the pane path."""
+        f = self.family(label)
+        with self._lock:
+            f["bytes_moved"] += int(nbytes)
+
     def note_pane(self, label: str, hits: int, misses: int) -> None:
         f = self.family(label)
         with self._lock:

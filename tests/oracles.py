@@ -111,6 +111,42 @@ def polygon_polygon_dist(rings_a, rings_b) -> float:
     return d
 
 
+def scalar_decode_stream(records, cfg, grid, geometry="Point"):
+    """THE SEED SCALAR DECODER, kept verbatim as a test-only oracle: raw
+    lines/dicts -> spatial objects via one ``parse_spatial`` call per
+    record, off-type records dropped — the per-record loop
+    ``driver.decode_stream`` replaced with the chunk-vectorized
+    ``decode_chunks`` seam. The batched path must emit byte-identical
+    window contents when driven by either decoder."""
+    from spatialflink_tpu.models import SpatialObject
+    from spatialflink_tpu.streams.formats import parse_spatial
+
+    needs_edges = geometry in ("Polygon", "LineString")
+    for rec in records:
+        obj = rec if isinstance(rec, SpatialObject) else parse_spatial(
+            rec, cfg.format, grid, delimiter=cfg.delimiter,
+            schema=cfg.csv_tsv_schema, geometry=geometry,
+            **cfg.geojson_kwargs())
+        if ((needs_edges and not hasattr(obj, "edge_array"))
+                or (geometry == "Point" and not hasattr(obj, "x"))):
+            continue  # off-type (the scalar path's drop rule)
+        yield obj
+
+
+def scalar_window_tables(records, cfg, grid, size_ms, slide_ms,
+                         lateness_ms=0, geometry="Point"):
+    """Seed scalar pipeline head: per-record decode + per-record
+    ``WindowAssembler.add`` — yields ``(start, end, [records])`` with the
+    emission ORDER the scalar loop produced (the timing oracle live tests
+    compare consumption positions against)."""
+    from spatialflink_tpu.runtime.windows import WindowAssembler, WindowSpec
+
+    wa = WindowAssembler(WindowSpec.sliding(size_ms, slide_ms), lateness_ms)
+    for obj in scalar_decode_stream(records, cfg, grid, geometry):
+        yield from wa.add(obj.timestamp, obj)
+    yield from wa.flush()
+
+
 def sliding_window_table(ts_list, size, slide, lateness=0):
     """Independent re-derivation of the event-time sliding-window tables
     (Flink semantics + bounded out-of-orderness late drops): feeds the

@@ -1,0 +1,449 @@
+"""The batched-everywhere execution path (ISSUE 8): chunk-vectorized decode
++ assign_bulk window assignment as the ONLY path, checked against the seed
+scalar loop kept as a test oracle (tests/oracles.py) — contents byte-
+identical on file replay and under live --kafka-follow chaos (timing within
+one poll cycle), off-type rows dropped per-chunk with counter-keyed
+warnings, the fast Point serializer byte-identical to json.dumps, the
+adaptive join block coalescer engaged exactly in the dispatch-bound regime,
+and device-resident pane state restoring from host-layout checkpoints."""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from spatialflink_tpu import driver
+from spatialflink_tpu.config import StreamConfig
+from spatialflink_tpu.index import UniformGrid
+from spatialflink_tpu.models import Point
+from spatialflink_tpu.operators import (
+    PointPointJoinQuery,
+    PointPointKNNQuery,
+    PointPointRangeQuery,
+    QueryConfiguration,
+    QueryType,
+)
+from spatialflink_tpu.streams.kafka import (InMemoryBroker, KafkaSource,
+                                            WindowCommitTap)
+from spatialflink_tpu.utils.metrics import (ControlTupleExit, REGISTRY,
+                                            check_exit_control_tuple,
+                                            scoped_registry)
+
+from tests.oracles import (canon_knn_pair, canon_point, canon_windows,
+                           scalar_decode_stream)
+
+GRID = UniformGrid(115.5, 117.6, 39.6, 41.1, num_grid_partitions=100)
+T0 = 1_700_000_000_000
+
+
+def _csv_lines(n, seed=0, late_every=0):
+    """CSV point rows over 100 s of event time; every ``late_every``-th
+    record is pushed 30 s into the past (out-of-order + genuinely late
+    records, so the oracle's watermark drops are exercised)."""
+    rng = np.random.default_rng(seed)
+    ts = T0 + (np.arange(n) * 100_000 // max(n, 1))
+    out = []
+    for i in range(n):
+        t = int(ts[i])
+        if late_every and i and i % late_every == 0:
+            t -= 30_000
+        out.append(f"v{i % 53},{t},{115.6 + rng.random() * 1.8:.6f},"
+                   f"{39.7 + rng.random() * 1.3:.6f}")
+    return out
+
+
+def _geojson_lines(n, seed=1):
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        out.append(json.dumps({
+            "geometry": {"type": "Point",
+                         "coordinates": [115.6 + rng.random() * 1.8,
+                                         39.7 + rng.random() * 1.3]},
+            "properties": {"oID": f"v{i % 53}",
+                           "timestamp": T0 + i * 100_000 // max(n, 1)},
+            "type": "Feature"}))
+    return out
+
+
+def _cfg(fmt):
+    return StreamConfig(format=fmt, date_format=None,
+                        csv_tsv_schema=[0, 1, 2, 3])
+
+
+def _conf(fmt, **kw):
+    kw.setdefault("window_size_ms", 10_000)
+    kw.setdefault("slide_ms", 5_000)
+    return QueryConfiguration(QueryType.WindowBased, **kw)
+
+
+QP = Point.create(116.5, 40.3, GRID, obj_id="q")
+
+
+# --------------------------------------------------- file-path identity
+
+
+@pytest.mark.parametrize("fmt,lines_fn", [
+    ("CSV", _csv_lines), ("GeoJSON", lambda n: _geojson_lines(n))])
+def test_range_windows_identical_to_scalar_oracle(fmt, lines_fn):
+    """decode_stream (chunk-vectorized, columnar windows) vs the seed
+    scalar decoder: identical window tables, including with late records
+    dropped by the shared watermark rule."""
+    lines = (lines_fn(3000, late_every=17) if fmt == "CSV"
+             else lines_fn(3000))
+    cfg = _cfg(fmt)
+
+    op = PointPointRangeQuery(_conf(fmt), GRID)
+    batched = canon_windows(
+        op.run(driver.decode_stream(iter(lines), cfg, GRID), QP, 0.4),
+        canon_point)
+
+    op2 = PointPointRangeQuery(_conf(fmt), GRID)
+    scalar = canon_windows(
+        op2.run(scalar_decode_stream(iter(lines), cfg, GRID), QP, 0.4),
+        canon_point)
+    assert batched == scalar
+    assert len(batched) > 5
+
+
+@pytest.mark.parametrize("panes", [False, True])
+def test_knn_windows_identical_to_scalar_oracle(panes):
+    """kNN through the batched path (and its pane-incremental mode with
+    the device/host merge auto rule) vs the scalar oracle — the decode
+    interner's id space must resolve identically to the operator's."""
+    lines = _csv_lines(3000, late_every=29)
+    cfg = _cfg("CSV")
+    conf = _conf("CSV", panes=panes, k=7)
+
+    op = PointPointKNNQuery(conf, GRID)
+    batched = canon_windows(
+        op.run(driver.decode_stream(iter(lines), cfg, GRID), QP, 0.5, 7),
+        canon_knn_pair)
+    op2 = PointPointKNNQuery(conf, GRID)
+    scalar = canon_windows(
+        op2.run(scalar_decode_stream(iter(lines), cfg, GRID), QP, 0.5, 7),
+        canon_knn_pair)
+    assert batched == scalar and len(batched) > 5
+
+
+@pytest.mark.parametrize("device", [False, True])
+def test_pane_merge_placement_identical(device):
+    """--pane-merge device vs host: identical kNN pane windows; device mode
+    reads back ONE merged result per window (pane-merged-* counters),
+    host mode one partial per pane."""
+    lines = _csv_lines(4000)
+    cfg = _cfg("CSV")
+    conf = _conf("CSV", panes=True, k=5, window_size_ms=40_000,
+                 pane_device_merge=device)
+    with scoped_registry() as reg:
+        op = PointPointKNNQuery(conf, GRID)
+        table = canon_windows(
+            op.run(driver.decode_stream(iter(lines), cfg, GRID), QP, 0.5, 5),
+            canon_knn_pair)
+        snap = reg.snapshot()
+    assert len(table) > 5
+    if device:
+        assert snap.get("pane-merged-readbacks", 0) == len(table)
+        assert snap.get("pane-partial-readbacks", 0) == 0
+    else:
+        assert snap.get("pane-merged-readbacks", 0) == 0
+        assert snap.get("pane-partial-readbacks", 0) > 0
+
+    conf2 = _conf("CSV", panes=True, k=5, window_size_ms=40_000,
+                  pane_device_merge=not device)
+    op2 = PointPointKNNQuery(conf2, GRID)
+    other = canon_windows(
+        op2.run(driver.decode_stream(iter(lines), cfg, GRID), QP, 0.5, 5),
+        canon_knn_pair)
+    assert table == other
+
+
+# ----------------------------------------------------- off-type handling
+
+
+def test_off_type_rows_drop_per_chunk_with_counter(capsys):
+    """A polygon feature inside a declared point stream must not crash the
+    columnar parser: the chunk falls back to the exact per-record parse,
+    the rows drop with the off-type-dropped counter, and the warning is
+    COUNTER-KEYED (re-warns at each decade with the running count) instead
+    of one-shot."""
+    poly = json.dumps({
+        "geometry": {"type": "Polygon",
+                     "coordinates": [[[116, 40], [116.1, 40], [116.1, 40.1],
+                                      [116, 40]]]},
+        "properties": {"oID": "p", "timestamp": T0}, "type": "Feature"})
+    lines = _geojson_lines(300)
+    mixed = []
+    for i, ln in enumerate(lines):
+        mixed.append(ln)
+        if i % 20 == 0:
+            mixed.append(poly)
+    with scoped_registry() as reg:
+        objs = list(driver.decode_stream(iter(mixed), _cfg("GeoJSON"), GRID))
+        assert len(objs) == len(lines)  # every point kept, in order
+        assert reg.counter("off-type-dropped").count == 15
+    err = capsys.readouterr().err
+    assert "off-type-dropped=1" in err   # first drop warns
+    assert "off-type-dropped=1" in err and "Polygon" in err
+    # decade re-warn fired once the count passed 10
+    assert any("off-type-dropped=1" != w and "off-type-dropped=" in w
+               for w in err.splitlines() if "off-type" in w)
+
+
+def test_control_tuple_stops_after_buffered_prefix():
+    lines = _csv_lines(100)
+    stop = json.dumps({"geometry": {"type": "control", "coordinates": []}})
+    seen = []
+    with pytest.raises(ControlTupleExit):
+        for obj in driver.decode_stream(
+                iter(lines[:40] + [stop] + lines[40:]), _cfg("CSV"), GRID):
+            seen.append(obj)
+    assert len(seen) == 40  # records before the stop all arrived
+
+
+# ------------------------------------------------- serializer equivalence
+
+
+def test_fast_point_serializer_byte_identical():
+    from spatialflink_tpu.streams import formats as F
+
+    rng = np.random.default_rng(7)
+    ids = [f"veh-{i}" for i in range(20)] + ['q"uote', "back\\slash",
+                                            "unié", "tab\there", ""]
+    for i in range(500):
+        p = Point(obj_id=ids[i % len(ids)],
+                  timestamp=int(rng.integers(0, 2 ** 41)),
+                  x=float(rng.uniform(-180, 180)),
+                  y=float(rng.uniform(-90, 90)))
+        for df in (None, "%Y-%m-%d %H:%M:%S"):
+            ref = json.dumps({
+                "geometry": {"type": "Point", "coordinates": [p.x, p.y]},
+                "properties": {"oID": p.obj_id,
+                               "timestamp": F.format_timestamp(p.timestamp,
+                                                               df)},
+                "type": "Feature"})
+            assert F.serialize_geojson(p, date_format=df) == ref
+
+
+def test_pointrows_batch_serializer_matches_per_record():
+    """PointRows.serialize_batch (the sink's no-Python-objects fast path)
+    == serialize_spatial of each materialized record."""
+    from spatialflink_tpu.streams.formats import serialize_spatial
+
+    lines = _csv_lines(2000)
+    cfg = _cfg("CSV")
+    op = PointPointRangeQuery(_conf("CSV"), GRID)
+    results = list(op.run(driver.decode_stream(iter(lines), cfg, GRID),
+                          QP, 0.5))
+    checked = 0
+    for r in results:
+        sb = getattr(r.records, "serialize_batch", None)
+        if sb is None or not len(r.records):
+            continue
+        for df in (None, "%Y-%m-%d %H:%M:%S"):
+            vals = sb("GeoJSON", date_format=df)
+            assert vals == [serialize_spatial(rec, "GeoJSON",
+                                              date_format=df)
+                            for rec in r.records]
+        checked += 1
+    assert checked > 3, "no columnar selections reached the serializer"
+
+
+# --------------------------------------- live follow-mode chaos identity
+
+
+def test_follow_chaos_contents_and_timing_vs_scalar_oracle():
+    """Live --kafka-follow windowed run under --chaos (duplicates +
+    reordering): the batched path emits windows with IDENTICAL contents
+    and IDENTICAL emission timing within one poll cycle — each window
+    seals having consumed at most one poll batch more records than the
+    seed scalar path did (the decode chunk flushes on the starvation
+    sentinel, so chunking can never hold a window past a poll)."""
+    from spatialflink_tpu.runtime.faults import ChaosBroker, FaultPlan
+
+    inner = InMemoryBroker()
+    lines = _geojson_lines(4000)
+    for ln in lines:
+        inner.produce("t", ln)
+    stop = json.dumps({"geometry": {"type": "control", "coordinates": []}})
+    inner.produce("t", stop)
+    cfg = _cfg("GeoJSON")
+    poll = 250
+
+    def run_batched():
+        broker = ChaosBroker(inner, FaultPlan.from_spec(
+            "seed=11,duplicate=0.08,reorder=0.25"))
+        src = KafkaSource(broker, "t", "g-batched", poll_batch=poll,
+                          auto_commit=False, stop_at_end=False,
+                          starvation_sentinel=True)
+        tap = WindowCommitTap(src, 10_000, 5_000,
+                              parse=lambda r: None,  # decode is chunked
+                              bulk_decode=driver._kafka_bulk_decode(cfg,
+                                                                    GRID),
+                              bulk_chunk=poll)
+        # depth 1: a control-tuple stop drops in-flight deferred windows
+        # (they re-deliver on restart) on ANY path; the timing comparison
+        # wants the seal order, not the pipeline queue
+        op = PointPointRangeQuery(_conf("GeoJSON", pipeline_depth=1), GRID)
+        out = []
+        try:
+            for r in op.run(driver.decode_stream(tap, cfg, GRID), QP, 0.4):
+                out.append((r.window_start,
+                            sorted(canon_point(p) for p in r.records),
+                            src.position))
+        except ControlTupleExit:
+            pass
+        return out
+
+    def run_scalar():
+        from spatialflink_tpu.runtime.windows import (WindowAssembler,
+                                                      WindowSpec)
+        from spatialflink_tpu.streams.formats import parse_spatial
+
+        broker = ChaosBroker(inner, FaultPlan.from_spec(
+            "seed=11,duplicate=0.08,reorder=0.25"))
+        src = KafkaSource(broker, "t", "g-scalar", poll_batch=poll,
+                          auto_commit=False, stop_at_end=False)
+        wa = WindowAssembler(WindowSpec.sliding(10_000, 5_000))
+        op = PointPointRangeQuery(_conf("GeoJSON"), GRID)
+        out = []
+
+        def sealed():
+            try:
+                for raw in src:
+                    check_exit_control_tuple(raw)
+                    obj = parse_spatial(raw, "GeoJSON", GRID)
+                    for s, e, recs in wa.add(obj.timestamp, obj):
+                        yield s, e, recs, src.position
+            except ControlTupleExit:
+                # a control-tuple stop does NOT flush open windows (they
+                # re-deliver on restart) — exactly what the batched path
+                # does, so the oracle must match
+                pass
+
+        for s, e, recs, pos in sealed():
+            sel = op._eval(recs, QP, 0.4, s)
+            recs_out = sel.finish() if hasattr(sel, "finish") else sel
+            out.append((s, sorted(canon_point(p) for p in recs_out), pos))
+        return out
+
+    consumer = {}
+
+    def consume(name, fn):
+        consumer[name] = fn()
+
+    # live: both consumers run against the pre-produced topic in follow
+    # mode; the control tuple stops them
+    t1 = threading.Thread(target=consume, args=("b", run_batched))
+    t1.start()
+    t1.join(timeout=120)
+    assert not t1.is_alive(), "batched follow run hung"
+    t2 = threading.Thread(target=consume, args=("s", run_scalar))
+    t2.start()
+    t2.join(timeout=120)
+    assert not t2.is_alive(), "scalar follow run hung"
+
+    batched, scalar = consumer["b"], consumer["s"]
+    assert [(w, r) for w, r, _ in batched] == \
+        [(w, r) for w, r, _ in scalar], "window contents/order diverged"
+    assert len(batched) > 5
+    for (w, _, pb), (_, _, ps) in zip(batched, scalar):
+        assert abs(pb - ps) <= poll, (
+            f"window {w} emission drifted {pb - ps} records "
+            f"(> one poll cycle of {poll})")
+
+
+# ----------------------------------------------- adaptive join coalescer
+
+
+def _join_streams(n, seed):
+    rng = np.random.default_rng(seed)
+    span = 100_000
+
+    def pts(m, s2):
+        rng2 = np.random.default_rng(s2)
+        return [Point(obj_id=f"o{i}", timestamp=T0 + i * span // m,
+                      x=float(116.0 + rng2.random()),
+                      y=float(40.0 + rng2.random()),
+                      cell=int(GRID.assign_cell(
+                          np.array([116.5]), np.array([40.5]))[0][0]))
+                for i in range(m)]
+    a = pts(n, seed)
+    b = pts(max(n // 16, 8), seed + 1)
+    for p in a + b:
+        c, _ = GRID.assign_cell(np.array([p.x]), np.array([p.y]))
+        p.cell = int(c[0])
+    return a, b
+
+
+def _canon_pairs(results):
+    return [(r.window_start, sorted(((a.obj_id, a.timestamp),
+                                     (b.obj_id, b.timestamp))
+                                    for a, b in r.records))
+            for r in results]
+
+
+def test_join_coalescer_dense_blocks(monkeypatch):
+    """Dispatch-bound pane-pair blocks coalesce into one window dispatch:
+    identical pair sets to both the block path and full recompute, with
+    the join-blocks-coalesced counter proving the path switched."""
+    a, b = _join_streams(1200, 5)
+    conf = _conf("CSV", window_size_ms=40_000)  # overlap 8
+
+    def run(panes, min_cells):
+        import spatialflink_tpu.ops.join as J
+
+        monkeypatch.setattr(J, "_BLOCK_MIN_CELLS", None)
+        monkeypatch.setenv("SPATIALFLINK_JOIN_BLOCK_MIN_CELLS",
+                           str(min_cells))
+        c = QueryConfiguration(QueryType.WindowBased, 40_000, 5_000,
+                               panes=panes)
+        with scoped_registry() as reg:
+            op = PointPointJoinQuery(c, GRID, GRID)
+            table = _canon_pairs(op.run(iter(a), iter(b), 0.3))
+            coalesced = reg.counter("join-blocks-coalesced").count
+        return table, coalesced
+
+    full, c0 = run(False, 0)
+    blocks, c1 = run(True, 0)           # coalescer disabled: block path
+    coal, c2 = run(True, 10 ** 9)       # forced: every window coalesces
+    auto, c3 = run(True, -1)            # measured threshold decides
+    assert c0 == 0 and c1 == 0 and c2 > 0
+    assert blocks == full == coal == auto
+
+
+# ------------------------------------- checkpoint compat (device panes)
+
+
+@pytest.mark.recovery
+def test_host_layout_checkpoint_restores_into_device_mode(tmp_path,
+                                                          monkeypatch):
+    """A checkpoint written by the HOST-resident pane layout (partials
+    resolved to host at snapshot — the pre-device on-disk format, unchanged)
+    must restore into a --pane-merge device run: restored host partials
+    make the device merge fall back per window, results identical to the
+    uninterrupted oracle, no duplicate markers."""
+    from tests.test_recovery import (_crash_at_fresh_window, _lines, _oracle,
+                                _produce, _window_table)
+
+    monkeypatch.setenv("SPATIALFLINK_DECODE_CHUNK", "32")
+    lines = _lines()
+    expected = _oracle(tmp_path, 51, lines, "pm-oracle", None, ["--panes"])
+    cfg, broker = _produce(tmp_path, "pm-crash", lines)
+    cpd = str(tmp_path / "cp-pm")
+    base = ["--config", cfg, "--kafka", "--option", "51", "--panes",
+            "--checkpoint-dir", cpd, "--checkpoint-every", "2"]
+    with monkeypatch.context() as m:
+        _crash_at_fresh_window(m, 4)
+        with pytest.raises(RuntimeError, match="injected crash"):
+            driver.main(base + ["--pane-merge", "host"])
+    import os
+
+    assert [f for f in os.listdir(cpd) if f.endswith(".npz")], \
+        "crash run wrote no checkpoint"
+    # resume in DEVICE mode against the host-layout snapshot
+    assert driver.main(base + ["--pane-merge", "device", "--resume"]) == 0
+    table = _window_table(broker)
+    assert all(len(v) == 1 for v in table.values())
+    assert {k: v[0] for k, v in table.items()} == expected

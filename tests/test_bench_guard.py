@@ -1,0 +1,41 @@
+"""The batched-hot-path regression gate is itself tier-1: a regression back
+to per-record decode/assignment cost must fail the suite, not wait for the
+next manual bench run (ISSUE 8's wins rot silently otherwise)."""
+
+import json
+import os
+import subprocess
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_guard_passes_thresholds():
+    """bench_guard --check against the checked-in GUARD_baseline.json
+    floors: the measured batched-vs-scalar speedup ratios must stay within
+    25% of the conservative floors (ratios, not absolute rec/s, so the
+    gate is machine-robust). Also pins the row contract bench_diff pairs
+    on."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "benchmarks", "bench_guard.py"),
+         "--check", "--n", "60000"],
+        capture_output=True, text=True, timeout=420, env=env, cwd=_ROOT)
+    rows = [json.loads(ln) for ln in r.stdout.splitlines()
+            if ln.startswith("{")]
+    assert [x["path"] for x in rows] == [
+        "window_assign", "decode_columnar", "windowed_pipeline"], r.stdout
+    assert all(x["speedup"] > 0 for x in rows)
+    assert r.returncode == 0, (
+        f"bench_guard regression:\n{r.stdout}\n{r.stderr[-1000:]}")
+
+
+def test_guard_baseline_rows_exist():
+    base = json.load(open(os.path.join(_ROOT, "benchmarks",
+                                       "GUARD_baseline.json")))
+    assert base["metric"] == "speedup"
+    assert {r["path"] for r in base["rows"]} == {
+        "window_assign", "decode_columnar", "windowed_pipeline"}
+    # the floors assert the batched path is actually FASTER than scalar
+    assert all(r["speedup"] >= 1.0 for r in base["rows"])

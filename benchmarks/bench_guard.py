@@ -20,6 +20,13 @@ Rows (identity field ``path``):
 - ``windowed_pipeline`` windowed range end-to-end (decode -> windows ->
                         kernel -> selection) on the batched path vs the
                         same operator fed the scalar-decoded record stream
+- ``skew_adaptive``     multi-query windowed range over a high-skew
+                        clustered stream: skew-adaptive grid (hot-cell
+                        split prefilter, repartition controller live) vs
+                        the uniform grid — the ISSUE 9 win, gated so
+                        skew-adaptivity regressions fail tier-1 like the
+                        batched-path ratios (window-table identity
+                        asserted in-run)
 
 Usage:
     python benchmarks/bench_guard.py [--n N] [--out PATH]
@@ -45,6 +52,11 @@ BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 #: floors are written at measured/MARGIN so box-to-box variance does not
 #: flap the gate; the 25% diff threshold sits on top
 MARGIN = 2.0
+#: per-row margin overrides: the skew-adaptive ratio sits closer to 1 than
+#: the batched-vs-scalar ratios, so the default /2 floor would degenerate
+#: to 1.0 and the gate could never catch a silently-broken prefilter
+#: (ratio ~1.0); a tighter margin keeps the floor meaningfully above it
+MARGIN_BY_PATH = {"skew_adaptive": 1.3}
 
 
 def _lines(n: int):
@@ -176,9 +188,75 @@ def bench_windowed_pipeline(n: int) -> dict:
                 speedup=round((dt_s) / dt_b, 2))
 
 
+def bench_skew_adaptive(n: int) -> dict:
+    """Adaptive-vs-uniform grid ratio on the skewed clustered stream — the
+    compact tier-1 form of ``bench_skew.py``'s high-skew row: a
+    standing-query fleet (Q=128, eight hotspot monitors) over a 95%-hot
+    clustered stream, repartition controller live, identity asserted."""
+    import dataclasses
+
+    import numpy as np
+
+    from spatialflink_tpu import driver
+    from spatialflink_tpu.index import AdaptiveGrid
+    from spatialflink_tpu.models import Point
+    from spatialflink_tpu.operators import (PointPointRangeQuery,
+                                            QueryConfiguration, QueryType)
+    from spatialflink_tpu.runtime.repartition import RepartitionController
+    from spatialflink_tpu.streams.synthetic import clustered_lines
+
+    # the ratio needs enough windows for the kernel share to dominate the
+    # jit-warm/decode fraction: pin the row's own record count so a small
+    # --n (the tier-1 run) cannot wash the gate out
+    n = max(n, 120_000)
+    cfg, grid = _cfg(), _grid()
+    lines = clustered_lines(grid, n, 0.95, seed=7, fmt="csv", dt_ms=1)
+    rng = np.random.default_rng(101)
+    q = 128  # the Q-axis serving fleet bench_skew.py sweeps; at small Q the
+    # kernel no longer dominates and the ratio loses its gating power
+    xs = rng.uniform(grid.min_x, grid.max_x, q)
+    ys = rng.uniform(grid.min_y, grid.max_y, q)
+    hx = (grid.min_x + grid.max_x) / 2 + grid.cell_length / 3
+    hy = (grid.min_y + grid.max_y) / 2 + grid.cell_length / 3
+    span = 2.0 * grid.cell_length
+    xs[:8] = hx + rng.uniform(-span / 2, span / 2, 8)
+    ys[:8] = hy + rng.uniform(-span / 2, span / 2, 8)
+    qpts = [Point.create(float(x), float(y), grid)
+            for x, y in zip(xs, ys)]
+    conf = QueryConfiguration(QueryType.WindowBased, 40_000, 5_000)
+
+    def run(adaptive: bool):
+        c, ctl = conf, None
+        if adaptive:
+            ag = AdaptiveGrid(grid, refine=8)
+            c = dataclasses.replace(conf, adaptive_grid=ag)
+            ctl = RepartitionController(ag,
+                                        interval_records=max(1000, n // 8))
+        op = PointPointRangeQuery(c, grid)
+        stream = driver.decode_stream(iter(lines), cfg, grid)
+        if ctl is not None:
+            ctl.install()
+        try:
+            t0 = time.perf_counter()
+            out = [(w.window_start, tuple(len(r) for r in w.records))
+                   for w in op.run_multi(stream, qpts, 0.002)]
+            dt = time.perf_counter() - t0
+        finally:
+            if ctl is not None:
+                ctl.uninstall()
+        return out, dt
+
+    run(False), run(True)  # warm jit shapes + the adapted layouts
+    ref, dt_u = run(False)
+    got, dt_a = run(True)
+    assert got == ref, "adaptive window table diverged from uniform"
+    return dict(path="skew_adaptive", records=n,
+                speedup=round(dt_u / dt_a, 2))
+
+
 def measure(n: int) -> list:
     return [bench_window_assign(n), bench_decode_columnar(n),
-            bench_windowed_pipeline(n)]
+            bench_windowed_pipeline(n), bench_skew_adaptive(n)]
 
 
 def main() -> int:
@@ -207,13 +285,17 @@ def main() -> int:
 
     if args.write_baseline:
         floors = [dict(path=r["path"],
-                       speedup=round(max(r["speedup"] / MARGIN, 1.0), 2))
+                       speedup=round(max(
+                           r["speedup"] / MARGIN_BY_PATH.get(r["path"],
+                                                             MARGIN),
+                           1.0), 2))
                   for r in rows]
         with open(BASELINE_PATH, "w") as f:
             json.dump({"metric": "speedup",
-                       "note": "conservative floors = measured/%.1f; "
-                               "bench_guard --check trips >25%% below"
-                               % MARGIN,
+                       "note": "conservative floors = measured/%.1f "
+                               "(skew_adaptive: /%.1f); bench_guard "
+                               "--check trips >25%% below"
+                               % (MARGIN, MARGIN_BY_PATH["skew_adaptive"]),
                        "rows": floors}, f, indent=1)
         print(f"# wrote {BASELINE_PATH}", file=sys.stderr)
         return 0

@@ -436,6 +436,11 @@ def _query_conf(params: Params, spec: CaseSpec) -> QueryConfiguration:
         # coordinated checkpointing (--checkpoint-dir): operators register
         # their window/pane/trajectory state and barrier through this
         checkpointer=getattr(params, "checkpointer", None),
+        # skew-adaptive refinement layer (--adaptive-grid): the shared
+        # AdaptiveGrid whose leaf masks drive the pre-kernel prefilter
+        adaptive_grid=getattr(params, "adaptive_grid", None),
+        # mesh shard placement (--shard-order)
+        shard_order=getattr(params, "shard_order", "arrival"),
     )
 
 
@@ -1574,6 +1579,38 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "one dispatch per window (run_multi; default keeps "
                          "reference parity: first query object only). "
                          "All nine range and kNN pairs, plus trajectory kNN")
+    ap.add_argument("--adaptive-grid", nargs="?", const=4, type=int,
+                    default=None, metavar="K", dest="adaptive_grid",
+                    help="skew-adaptive grid: refine hot cells KxK (default "
+                         "K=4) and coarsen cold neighborhoods, with "
+                         "epoch-based split/merge decisions driven by the "
+                         "live occupancy gauges (and per-cell attributed "
+                         "cost when telemetry is on). Records keep their "
+                         "base cells and device kernels are untouched; the "
+                         "refined GN∪CN leaf masks gate window-batch "
+                         "membership host-side before the kernel, so "
+                         "exact-mode results are identical to the uniform "
+                         "grid and the win is the smaller batch on skewed "
+                         "streams (single-query range family; layout "
+                         "served at /partition, carried in coordinated "
+                         "checkpoints)")
+    ap.add_argument("--repartition-interval", type=int, default=50_000,
+                    metavar="N",
+                    help="records per repartition epoch for "
+                         "--adaptive-grid (default 50000): each epoch "
+                         "re-evaluates split/merge thresholds with "
+                         "hysteresis (split at 5%% epoch share, merge "
+                         "back below 1.25%% for 2 consecutive epochs)")
+    ap.add_argument("--shard-order", choices=["arrival", "cell"],
+                    default="arrival",
+                    help="mesh shard placement for distributed window "
+                         "batches: 'arrival' (default) shards contiguously; "
+                         "'cell' pre-permutes each batch so whole grid "
+                         "cells co-locate per shard (keyBy(gridID) parity, "
+                         "parallel.mesh.cell_hash_order) — results are "
+                         "identical; BASELINE.md records the measured "
+                         "verdict (the host permute usually costs more "
+                         "than the kernel saving)")
     ap.add_argument("--kafka", action="store_true",
                     help="consume inputStream{1,2}.topicName and produce "
                          "results to outputStream.topicName through the "
@@ -1755,6 +1792,41 @@ def main(argv: Optional[List[str]] = None) -> int:
             # dynamic attribute (not a dataclass field): the coordinator
             # must not leak into Params.to_dict()/fingerprints
             params.checkpointer = coord
+    if args.shard_order != "arrival":
+        params.shard_order = args.shard_order
+    if args.adaptive_grid is not None:
+        if args.bulk:
+            # the whole-replay alias builds its batches straight from the
+            # parsed file before any window-time refinement could gate them
+            print("--adaptive-grid ignored with --bulk (whole-replay "
+                  "batches bypass the window-time prefilter); the default "
+                  "batched path supports it", file=sys.stderr)
+        else:
+            from spatialflink_tpu.index import AdaptiveGrid
+            from spatialflink_tpu.runtime.repartition import (
+                RepartitionController)
+
+            try:
+                agrid = AdaptiveGrid(params.grids()[0],
+                                     refine=args.adaptive_grid)
+            except ValueError as e:
+                ap.error(f"--adaptive-grid: {e}")
+            ctl = RepartitionController(
+                agrid, interval_records=args.repartition_interval)
+            coord = getattr(params, "checkpointer", None)
+            if coord is not None:
+                # grid layout rides the coordinated manifest: --resume
+                # restores the adapted partitioning (auto-applied here if
+                # the coordinator already loaded one)
+                ctl.register_checkpoint(coord)
+            # dynamic attributes (not dataclass fields), like checkpointer:
+            # must not leak into Params.to_dict()/fingerprints
+            params.adaptive_grid = agrid
+            params.repartitioner = ctl
+            print(f"# adaptive grid: hot cells split "
+                  f"{args.adaptive_grid}x{args.adaptive_grid}, repartition "
+                  f"epoch every {args.repartition_interval} records "
+                  "(layout at /partition)", file=sys.stderr)
     if not args.kafka and (args.chaos is not None or args.retry is not None
                            or args.dlq or args.seed_scan_limit is not None):
         ap.error("--chaos/--retry/--dlq/--seed-scan-limit wrap the broker "
@@ -1914,6 +1986,14 @@ def _run_cli(ap, args, params: Params, spec: CaseSpec, skip1: int,
     import contextlib
 
     stack = contextlib.ExitStack()
+    repartitioner = getattr(params, "repartitioner", None)
+    if repartitioner is not None:
+        # chain onto the grid-cell observer hook (decode-time base-cell
+        # assignments feed the epoch counters) and become the /partition
+        # endpoint's controller; restored on exit so repeated in-process
+        # runs (tests) never leak the chain
+        repartitioner.install()
+        stack.callback(repartitioner.uninstall)
     if args.profile:
         from spatialflink_tpu.utils.metrics import profile_to
 

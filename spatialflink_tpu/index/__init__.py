@@ -1,5 +1,6 @@
 """Spatial index layer (reference: GeoFlink/spatialIndices/)."""
 
 from spatialflink_tpu.index.uniform_grid import UniformGrid, GridParams
+from spatialflink_tpu.index.adaptive_grid import AdaptiveGrid
 
-__all__ = ["UniformGrid", "GridParams"]
+__all__ = ["UniformGrid", "GridParams", "AdaptiveGrid"]

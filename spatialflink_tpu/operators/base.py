@@ -115,6 +115,22 @@ class QueryConfiguration:
     # None (default) = no checkpointing — every hot path checks once.
     checkpointer: Optional[Any] = field(default=None, repr=False,
                                         compare=False)
+    # skew-adaptive refinement layer (the --adaptive-grid driver switch):
+    # an index.AdaptiveGrid whose leaf-space GN∪CN masks gate window-batch
+    # membership HOST-SIDE before the kernel dispatch (the pre-kernel
+    # candidate prefilter). Records keep their base cells, device kernels
+    # and masks are untouched, and the leaf masks are a sound
+    # over-approximation for every layout — exact-mode results are
+    # identical to the uniform grid; the win is the smaller padded batch
+    # on skewed streams. None (default) = uniform grid only.
+    adaptive_grid: Optional[Any] = field(default=None, repr=False,
+                                         compare=False)
+    # mesh shard placement (--shard-order): "arrival" keeps the default
+    # contiguous sharding; "cell" applies parallel.mesh.cell_hash_order so
+    # whole grid cells co-locate per shard (keyBy(gridID) parity), with the
+    # inverse permutation restoring mask alignment at readback. Results
+    # are identical either way; see BASELINE.md for the measured verdict.
+    shard_order: str = "arrival"
 
     def window_spec(self) -> WindowSpec:
         if self.query_type is QueryType.CountBased:
@@ -292,6 +308,32 @@ class WindowResult:
         if "queries" in self.extras:
             return [r for per_query in self.records for r in per_query]
         return self.records
+
+
+class _LeafMaskCache:
+    """One query's leaf-space mask under the adaptive grid, invalidated by
+    the grid's monotonic version stamp: a repartition bumps ``version`` and
+    the next window rebuilds the mask (counted on
+    ``prefilter-mask-recomputes``). The cache is per run()-closure, so
+    every standing query owns exactly one."""
+
+    __slots__ = ("grid", "build", "version", "mask")
+
+    def __init__(self, grid, build):
+        self.grid = grid
+        self.build = build
+        self.version = -1
+        self.mask = None
+
+    def get(self):
+        if self.mask is None or self.version != self.grid.version:
+            from spatialflink_tpu.utils.metrics import REGISTRY
+
+            if self.mask is not None:
+                REGISTRY.counter("prefilter-mask-recomputes").inc()
+            self.mask = self.build()
+            self.version = self.grid.version
+        return self.mask
 
 
 class SpatialOperator:
@@ -518,6 +560,148 @@ class SpatialOperator:
             coord.barrier()
 
     # ---------------------------------------------------------------- #
+
+    # --------------------- adaptive-grid prefilter -------------------- #
+
+    def _leaf_mask_cache(self, build) -> Optional[_LeafMaskCache]:
+        """A version-stamped cache of one query's GN∪CN leaf mask, or None
+        when the adaptive refinement layer is off (``conf.adaptive_grid``
+        unset) — the single gate every prefiltering operator checks."""
+        ag = self.conf.adaptive_grid
+        return _LeafMaskCache(ag, build) if ag is not None else None
+
+    @staticmethod
+    def _record_arrays(records):
+        """(x, y, ts, obj_id, cell) numpy arrays for a window's records —
+        zero-copy from a columnar LazyRecords window, one materializing
+        pass for plain record lists (obj_id is None there: the prefiltered
+        range batches never read it)."""
+        from spatialflink_tpu.streams.bulk import LazyRecords
+
+        if isinstance(records, LazyRecords):
+            flat = records._flat()
+            if flat is not None:
+                return flat[0], flat[1], flat[2], flat[3], flat[4]
+        xs = np.array([r.x for r in records], np.float64)
+        ys = np.array([r.y for r in records], np.float64)
+        ts = np.array([r.timestamp for r in records], np.int64)
+        cells = np.array([r.cell for r in records], np.int32)
+        return xs, ys, ts, None, cells
+
+    @staticmethod
+    def _chunk_leaves(chunk, ag) -> np.ndarray:
+        """Per-CHUNK leaf assignment, cached on the chunk and stamped with
+        the grid version: sliding windows revisit each chunk size/slide
+        times, so the two-stage assignment runs once per chunk per layout
+        (exactly how base cells are assigned once per chunk in
+        ``PointChunk.build``), not once per window membership."""
+        cache = getattr(chunk, "_leaf_cache", None)
+        if cache is not None and cache[0] == ag.version:
+            return cache[1]
+        leaf = ag.assign_leaf(chunk.parsed.x, chunk.parsed.y)
+        chunk._leaf_cache = (ag.version, leaf)
+        return leaf
+
+    def _prefilter(self, records, mask_cache: Optional[_LeafMaskCache],
+                   ts_base: int):
+        """Pre-kernel candidate prefilter over the refined leaf space: keep
+        exactly the records whose leaf is in the query's GN∪CN leaf set and
+        build the (smaller) device batch from the kept rows. Returns
+        ``(keep_idx, PointBatch)`` — ``batch`` None when NO leaf survives
+        (the window skips its kernel dispatch entirely) — or None when the
+        layer is off.
+
+        Identity: the leaf masks over-approximate the kernel's own
+        GN/CN-and-distance selection for EVERY layout (every selected
+        record lies within ``radius``, hence in a leaf the mask keeps), so
+        the filtered dispatch emits the same records — the counters
+        ``prefilter-records``/``prefilter-kept`` are the candidate-set
+        selectivity the skew bench reports, and the only behavior change.
+        Approximate mode is the one documented exception: the prefilter
+        removes candidates that are provably outside ``radius``, making
+        the approximate result set TIGHTER than the uniform grid's (never
+        looser).
+
+        Cost shape: leaf ids come from the per-chunk cache (amortized over
+        the window overlap), the mask test is one boolean gather per
+        window, and the kept batch builds from O(kept) per-segment
+        gathers — the overhead stays far under the kernel/batch work it
+        eliminates."""
+        if mask_cache is None:
+            return None
+        from spatialflink_tpu.streams.bulk import LazyRecords
+        from spatialflink_tpu.utils.metrics import REGISTRY
+
+        ag = self.conf.adaptive_grid
+        mask = mask_cache.get()
+        segs = (records._segs if isinstance(records, LazyRecords)
+                else None)
+        if segs is not None and all(isinstance(s, tuple) for s in segs):
+            # columnar window: per-seg leaf gathers + O(kept) batch build
+            total = 0
+            keep_pos: List[np.ndarray] = []
+            xs, ys, tss, oids, cells = [], [], [], [], []
+            for (chunk, idx), off in zip(segs, records._offsets):
+                leaf = self._chunk_leaves(chunk, ag)[idx]
+                # one gather + one AND (invalid leaves read slot 0, gated)
+                k = mask[np.where(leaf >= 0, leaf, 0)] & (leaf >= 0)
+                total += int(idx.size)
+                kp = np.nonzero(k)[0]
+                if kp.size:
+                    keep_pos.append(off + kp)
+                    sel = idx[kp]
+                    p = chunk.parsed
+                    xs.append(p.x[sel])
+                    ys.append(p.y[sel])
+                    tss.append(p.ts[sel])
+                    oids.append(p.obj_id[sel])
+                    cells.append(chunk.cells[sel])
+            REGISTRY.counter("prefilter-records").inc(total)
+            if not keep_pos:
+                REGISTRY.counter("prefilter-windows-skipped").inc()
+                return np.empty(0, np.int64), None
+            idx = np.concatenate(keep_pos)
+            REGISTRY.counter("prefilter-kept").inc(int(idx.size))
+            batch = PointBatch.from_arrays(
+                np.concatenate(xs), np.concatenate(ys),
+                obj_id=np.concatenate(oids), ts=np.concatenate(tss),
+                ts_base=ts_base, cell=np.concatenate(cells))
+            return idx, batch
+        # generic fallback (plain record lists / mixed streams)
+        x, y, ts, oid, cell = self._record_arrays(records)
+        leaf = ag.assign_leaf(x, y)
+        keep = np.zeros(leaf.shape, bool)
+        v = leaf >= 0
+        keep[v] = mask[leaf[v]]
+        idx = np.nonzero(keep)[0]
+        REGISTRY.counter("prefilter-records").inc(int(leaf.size))
+        REGISTRY.counter("prefilter-kept").inc(int(idx.size))
+        if idx.size == 0:
+            REGISTRY.counter("prefilter-windows-skipped").inc()
+            return idx, None
+        batch = PointBatch.from_arrays(
+            x[idx], y[idx],
+            obj_id=None if oid is None else oid[idx],
+            ts=ts[idx], ts_base=ts_base, cell=cell[idx])
+        return idx, batch
+
+    def _defer_mask_select_at(self, mask, records: List, keep_idx,
+                              stats=None) -> Deferred:
+        """:meth:`_defer_mask_select` for a PREFILTERED batch: kernel mask
+        positions map back to original records through ``keep_idx``."""
+        take = getattr(records, "take", None)
+
+        def rows(m):
+            sel = np.nonzero(np.asarray(m))[0]
+            sel = sel[sel < keep_idx.size]
+            orig = keep_idx[sel]
+            if take is not None:
+                return take(orig)
+            return [records[int(i)] for i in orig]
+
+        return self._defer_with_stats(mask, stats, rows)
+
+    # ------------------------------------------------------------------ #
 
     def _point_batch(self, records, ts_base: int) -> PointBatch:
         from spatialflink_tpu.streams.bulk import LazyRecords
@@ -746,19 +930,47 @@ class SpatialOperator:
 
         return eval_batch
 
+    def _maybe_cell_order(self, batch):
+        """``--shard-order cell``: pre-permute the batch so whole grid
+        cells co-locate per shard (``parallel.mesh.cell_hash_order`` —
+        keyBy(gridID) placement parity) and return the inverse permutation
+        that restores per-record mask alignment at readback. Returns
+        ``(batch, None)`` untouched in arrival order (the default), on
+        single-device runs, and for batches without a 1-D cell column."""
+        cell = getattr(batch, "cell", None)
+        if (not self.distributed or self.conf.shard_order != "cell"
+                or cell is None or getattr(cell, "ndim", 0) != 1):
+            return batch, None
+        from spatialflink_tpu.parallel.mesh import cell_hash_order
+
+        perm = cell_hash_order(np.asarray(cell), self.conf.devices)
+        inv = np.empty_like(perm)
+        inv[perm] = np.arange(perm.size)
+        batch = type(batch)(*(np.asarray(a)[perm] for a in batch))
+        return batch, inv
+
     def _filter_stream(self, batch, mask_stats_fn):
         """(mask, gn_bypassed, dist_evals) for a stream batch: the
         single-device path calls ``mask_stats_fn(batch)`` directly; with
         ``conf.devices`` the batch is sharded and the SAME closure runs per
         shard with psum-merged stats (parallel.ops.distributed_stream_filter)
         — the mesh dispatch every reference pipeline gets from
-        ``env.setParallelism(30)`` (``StreamingJob.java:221``)."""
+        ``env.setParallelism(30)`` (``StreamingJob.java:221``). Under
+        ``--shard-order cell`` the batch is cell-bucketed before sharding
+        and the mask is un-permuted on device at the end."""
         from spatialflink_tpu.parallel.ops import distributed_stream_filter
 
-        return self._stream_dispatch(
+        batch, inv = self._maybe_cell_order(batch)
+        out = self._stream_dispatch(
             batch, mask_stats_fn,
             lambda mesh, sb: distributed_stream_filter(
                 mesh, sb, mask_stats_fn))
+        if inv is None:
+            return out
+        import jax.numpy as jnp
+
+        mask, gn_c, evals = out
+        return jnp.asarray(mask)[inv], gn_c, evals
 
     @staticmethod
     def _record_pruning_stats(gn_bypassed, dist_evals) -> None:
@@ -858,15 +1070,24 @@ class SpatialOperator:
     def _multi_filter_stream(self, batch, multi_mask_stats):
         """(masks (Q, N), gn (Q,), evals (Q,)) for one batch — the same
         closure whole-batch or per shard with psum-merged per-query counters
-        (parallel.ops.distributed_stream_filter_multi)."""
+        (parallel.ops.distributed_stream_filter_multi). ``--shard-order
+        cell`` permutes/un-permutes around the dispatch like
+        :meth:`_filter_stream` (the mask's record axis is the last)."""
         from spatialflink_tpu.parallel.ops import (
             distributed_stream_filter_multi,
         )
 
-        return self._stream_dispatch(
+        batch, inv = self._maybe_cell_order(batch)
+        out = self._stream_dispatch(
             batch, multi_mask_stats,
             lambda mesh, sb: distributed_stream_filter_multi(
                 mesh, sb, multi_mask_stats))
+        if inv is None:
+            return out
+        import jax.numpy as jnp
+
+        masks, gn_c, evals = out
+        return jnp.asarray(masks)[:, inv], gn_c, evals
 
     def _knn_multi_result(self, batch, local_fn, k: int):
         """(KnnResult (Q, k), evals (Q,)) for one batch — whole-batch, or
@@ -889,31 +1110,52 @@ class SpatialOperator:
         return merge
 
     def _run_multi_filter(self, stream: Iterable, n_queries: int,
-                          multi_mask_stats, batch_builder
+                          multi_mask_stats, batch_builder,
+                          leaf_mask_builder=None
                           ) -> Iterator["WindowResult"]:
         """Shared run_multi driver for FILTER-shaped operators (range):
         ``multi_mask_stats(batch) -> (masks (Q, N), gn_c (Q,), evals (Q,))``;
         records become Q per-query record lists, pruning counters aggregate
         across the query batch. With ``conf.devices`` the batch is sharded
-        and the same closure runs per shard."""
+        and the same closure runs per shard.
+
+        ``leaf_mask_builder`` (adaptive grid only) builds the UNION of the
+        Q queries' GN∪CN leaf masks: a record outside every query's
+        candidate set cannot appear in any per-query result, so the
+        prefilter shrinks the Q×N kernel to Q×kept — on a skewed stream
+        this is where the adaptive win is largest, because the whole
+        standing-query fleet shares one batch residency."""
         import jax.numpy as jnp
+
+        mask_cache = (self._leaf_mask_cache(leaf_mask_builder)
+                      if leaf_mask_builder is not None else None)
+        empty = [[] for _ in range(n_queries)]
 
         def eval_batch(records, ts_base):
             if not records:
-                return [[] for _ in range(n_queries)]
-            batch = batch_builder(records, ts_base)
+                return [list(e) for e in empty]
+            pre = self._prefilter(records, mask_cache, ts_base)
+            if pre is not None:
+                keep, batch = pre
+                if batch is None:
+                    return [list(e) for e in empty]
+            else:
+                keep, batch = None, batch_builder(records, ts_base)
             masks, gn_c, evals = self._multi_filter_stream(
                 batch, multi_mask_stats)
             take = getattr(records, "take", None)
+            limit = keep.size if keep is not None else len(records)
 
             def rows(m):
                 m = np.asarray(m)  # ONE (Q, N) device->host transfer
                 out = []
                 for q in range(n_queries):
                     idx = np.nonzero(m[q])[0]
-                    idx = idx[idx < len(records)]
+                    idx = idx[idx < limit]
+                    if keep is not None:
+                        idx = keep[idx]
                     out.append(take(idx) if take is not None
-                               else [records[i] for i in idx])
+                               else [records[int(i)] for i in idx])
                 return out
 
             return self._defer_with_stats(

@@ -69,18 +69,33 @@ class PointPointRangeQuery(_PointStreamBulkSource, _RangeMultiBulkMixin,
 
     def run(self, stream: Iterable[Point], query_point: Point, radius: float
             ) -> Iterator[WindowResult]:
+        # --adaptive-grid: the query's GN∪CN leaf mask, version-cached so a
+        # mid-run repartition invalidates it on the next window (the point
+        # query tightens to its exact fine cell inside a split hot cell)
+        mask_cache = self._leaf_mask_cache(
+            lambda: self.conf.adaptive_grid.neighboring_leaf_mask(
+                radius, query_point.cell,
+                point=(query_point.x, query_point.y)))
         return self._drive(
             stream, lambda records, ts_base: self._eval(records, query_point,
-                                                        radius, ts_base),
+                                                        radius, ts_base,
+                                                        mask_cache),
             pane_merge=self.merge_partials,
         )
 
     # ---------------------------------------------------------------- #
 
     def _eval(self, records: List[Point], query_point: Point, radius: float,
-              ts_base: int) -> List[Point]:
+              ts_base: int, mask_cache=None) -> List[Point]:
         if not records:
             return []
+        pre = self._prefilter(records, mask_cache, ts_base)
+        if pre is not None:
+            idx, batch = pre
+            if batch is None:  # no candidate leaves in this window
+                return []
+            mask, stats = self._range_mask(batch, query_point, radius)
+            return self._defer_mask_select_at(mask, records, idx, stats)
         batch = self._point_batch(records, ts_base)
         mask, stats = self._range_mask(batch, query_point, radius)
         return self._defer_mask_select(mask, records, stats)
@@ -151,10 +166,17 @@ class PointPointRangeQuery(_PointStreamBulkSource, _RangeMultiBulkMixin,
         semantics; ``extras["queries"] = Q``. Pruning counters aggregate
         across the Q queries of each dispatch; with ``conf.devices`` the
         stream batch shards over the mesh like every other operator."""
+        def union_leaf_mask():
+            # --adaptive-grid: a record outside EVERY query's GN∪CN leaf
+            # set cannot appear in any per-query result — the Q×N kernel
+            # shrinks to Q×kept (one leaf-space sweep for the whole fleet)
+            return self.conf.adaptive_grid.union_neighboring_leaf_mask(
+                radius, [(q.cell, (q.x, q.y)) for q in query_points])
+
         return self._run_multi_filter(
             stream, len(query_points),
             self._multi_mask_stats(query_points, radius),
-            self._point_batch)
+            self._point_batch, leaf_mask_builder=union_leaf_mask)
 
     def run_incremental(self, stream: Iterable[Point], query_point: Point,
                         radius: float) -> Iterator[WindowResult]:
@@ -223,10 +245,23 @@ class PointGeomRangeQuery(_PointStreamBulkSource, _RangeMultiBulkMixin,
     def run(self, stream: Iterable[Point], query_geom, radius: float
             ) -> Iterator[WindowResult]:
         mask_stats = self._mask_stats_fn(query_geom, radius)
+        # --adaptive-grid: leaf mask unioned over the geometry's base cells
+        # (UniformGrid.java:193-222 union semantics, refined per level)
+        mask_cache = self._leaf_mask_cache(
+            lambda: self.conf.adaptive_grid.neighboring_leaf_mask(
+                radius, self._query_cells(query_geom)))
 
         def eval_batch(records, ts_base):
             if not records:
                 return []
+            pre = self._prefilter(records, mask_cache, ts_base)
+            if pre is not None:
+                idx, batch = pre
+                if batch is None:
+                    return []
+                mask, gn_c, evals = self._filter_stream(batch, mask_stats)
+                return self._defer_mask_select_at(mask, records, idx,
+                                                 (gn_c, evals))
             batch = self._point_batch(records, ts_base)
             mask, gn_c, evals = self._filter_stream(batch, mask_stats)
             return self._defer_mask_select(mask, records, (gn_c, evals))
@@ -255,10 +290,14 @@ class PointGeomRangeQuery(_PointStreamBulkSource, _RangeMultiBulkMixin,
         """Q polygon/linestring QUERIES over one point stream in ONE
         dispatch per window (``ops.geom.range_points_to_geom_queries``);
         same contract as ``PointPointRangeQuery.run_multi``."""
+        def union_leaf_mask():
+            return self.conf.adaptive_grid.union_neighboring_leaf_mask(
+                radius, [(self._query_cells(q), None) for q in query_geoms])
+
         return self._run_multi_filter(
             stream, len(query_geoms),
             self._multi_mask_stats(query_geoms, radius),
-            self._point_batch)
+            self._point_batch, leaf_mask_builder=union_leaf_mask)
 
 
 class _GeomStreamBulkMixin:

@@ -116,12 +116,14 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(code, payload)
             elif path == "/profile/cells":
                 self._send_json(200, srv.profile_cells_payload())
+            elif path == "/partition":
+                self._send_json(200, srv.partition_payload())
             else:
                 self._send_json(404, {
                     "error": f"unknown path {path!r}",
                     "endpoints": ["/healthz", "/status", "/metrics",
                                   "/events", "/trace/recent", "/trace/<id>",
-                                  "/profile/cells"]})
+                                  "/profile/cells", "/partition"]})
         except (BrokenPipeError, ConnectionResetError):
             pass  # client went away mid-write (Ctrl-C'd curl sends RST)
         except Exception as e:
@@ -239,6 +241,22 @@ class OpServer:
                             "(--telemetry-dir / --live-stats / --trace-dir)"}
         return tel.costs.cells_payload()
 
+    def partition_payload(self) -> dict:
+        """``/partition``: the skew-adaptive grid's live layout, policy
+        thresholds, epoch progress, and recent split/merge decisions
+        (``--adaptive-grid``); an explanatory note when the run is on the
+        plain uniform grid."""
+        from spatialflink_tpu.runtime.repartition import active_controller
+
+        ctl = active_controller()
+        if ctl is None:
+            return {"adaptive": False,
+                    "note": "no adaptive grid in this run "
+                            "(enable with --adaptive-grid)"}
+        payload = ctl.status()
+        payload["adaptive"] = True
+        return payload
+
     # ------------------------------ lifecycle -------------------------- #
 
     def start(self) -> "OpServer":
@@ -308,6 +326,13 @@ def format_digest(snap: dict) -> str:
             st["breaker_state"], str(st["breaker_state"])))
     if st.get("dlq_depth"):
         parts.append(f"dlq {st['dlq_depth']}")
+    sk = st.get("skew") or {}
+    if sk.get("top_share"):
+        # skew concentration: the hottest cell's record share + Gini — the
+        # numbers the --adaptive-grid split threshold compares against
+        gini = sk.get("gini")
+        parts.append(f"skew top {sk['top_share'] * 100:.0f}%"
+                     + (f" gini {gini:.2f}" if gini is not None else ""))
     tc = st.get("top_cost_cells") or []
     if tc:
         # the costliest grid cell and its attributed kernel share — the

@@ -1,0 +1,373 @@
+"""Runtime repartition controller: epoch-based split/merge decisions for the
+skew-adaptive grid, driven by the live occupancy signal (and, when a
+telemetry session is active, by PR 6's per-cell ATTRIBUTED kernel cost — a
+hot cell's records make every window containing them expensive, so cost is
+the sharper trigger than raw counts).
+
+Design points:
+
+- The controller observes base-cell assignments through the SAME module
+  hook telemetry uses (``index.uniform_grid._CELL_OBSERVER``), CHAINED so
+  both consumers see one pass — it costs one extra bincount per decoded
+  chunk, nothing per record.
+- Decisions are EPOCH-based (every ``interval_records`` observed records)
+  with HYSTERESIS: a cell splits when its epoch share crosses
+  ``split_share``; a split cell merges back only after its share has
+  stayed below ``merge_share`` (< split_share) for ``cooldown_epochs``
+  consecutive epochs — the split/merge thresholds are deliberately far
+  apart so a cell oscillating around one threshold cannot thrash the
+  layout. Cold ``coarsen x coarsen`` neighborhoods coarsen under the same
+  cooldown discipline.
+- Every applied change bumps the grid's monotonic ``version`` (operators
+  key their cached per-query leaf masks on it), emits a ``repartition``
+  lifecycle event into the :class:`~spatialflink_tpu.utils.telemetry
+  .EventRing`, and bumps the ``repartitions``/``grid-splits``/
+  ``grid-merges`` registry counters.
+- Correctness does not depend on WHEN (or whether) an epoch fires: the
+  adaptive masks are a sound over-approximation for every layout, so a
+  repartition can never change a window's result set — only how much work
+  the pre-kernel prefilter saves. The mid-run identity tests
+  (``tests/test_repartition.py``) pin this, including under ``--chaos``
+  and across a checkpoint/resume straddling a repartition.
+- The layout is a coordinated-checkpoint participant (component ``grid``):
+  ``--resume`` restores the adapted partitioning and version; epoch
+  counters deliberately restart (they re-warm within one interval).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from spatialflink_tpu.index import AdaptiveGrid
+from spatialflink_tpu.index import uniform_grid as _ug
+
+#: the one controller the current process runs (driver-installed); lets the
+#: opserver's /partition endpoint and in-process tooling find it without
+#: plumbing (same pattern as opserver.active_server)
+_ACTIVE: Optional["RepartitionController"] = None
+
+
+def active_controller() -> Optional["RepartitionController"]:
+    """The process's installed :class:`RepartitionController`, or None."""
+    return _ACTIVE
+
+
+@dataclass
+class RepartitionPolicy:
+    """Split/merge thresholds. Shares are fractions of the records observed
+    in ONE epoch; hysteresis = the split and merge thresholds are far apart
+    AND merges/coarsens wait out ``cooldown_epochs`` below threshold."""
+
+    #: split a base cell when its epoch record share reaches this
+    split_share: float = 0.05
+    #: merge a split cell back once its share stays below this (must be
+    #: well under split_share — the hysteresis band)
+    merge_share: float = 0.0125
+    #: consecutive cold epochs before a merge / un-coarsen applies
+    cooldown_epochs: int = 2
+    #: cap on concurrently split cells (each costs refine^2 leaves)
+    max_splits: int = 64
+    #: coarsen an aligned block when the whole block's epoch share is below
+    #: this (default: a nearly-empty block — ~5 records per 50k-record
+    #: epoch); 0 disables coarsening
+    coarsen_share: float = 0.0001
+    #: un-coarsen when the block's share reaches this (hysteresis twin)
+    uncoarsen_share: float = 0.002
+    #: ignore epochs with fewer observed records than this (no signal)
+    min_epoch_records: int = 256
+    #: blend weight of ATTRIBUTED COST share vs record share in the split
+    #: score when a telemetry session provides per-cell cost (0 = counts
+    #: only, 1 = cost only); cost is the sharper skew signal (PR 6)
+    cost_weight: float = 0.5
+
+    def validate(self) -> "RepartitionPolicy":
+        if not 0 < self.merge_share < self.split_share <= 1:
+            raise ValueError(
+                f"need 0 < merge_share ({self.merge_share}) < split_share "
+                f"({self.split_share}) <= 1 (the hysteresis band)")
+        if self.coarsen_share and not (0 <= self.coarsen_share
+                                       < self.uncoarsen_share):
+            raise ValueError(
+                f"need coarsen_share ({self.coarsen_share}) < "
+                f"uncoarsen_share ({self.uncoarsen_share})")
+        return self
+
+
+class RepartitionController:
+    """Feeds base-cell observations into epoch split/merge decisions on an
+    :class:`AdaptiveGrid`. Thread-safe enough for its consumers: the
+    observe path runs on the pipeline thread; ``status()`` (the
+    ``/partition`` endpoint) reads under the same lock the epoch mutates
+    under."""
+
+    def __init__(self, grid: AdaptiveGrid,
+                 interval_records: int = 50_000,
+                 policy: Optional[RepartitionPolicy] = None,
+                 coarsen: bool = True):
+        self.grid = grid
+        self.interval_records = max(1, int(interval_records))
+        self.policy = (policy or RepartitionPolicy()).validate()
+        self.coarsen_enabled = bool(coarsen) and self.policy.coarsen_share > 0
+        n2 = grid.n * grid.n
+        self._counts = np.zeros(n2, np.int64)
+        self._since = 0
+        self.epochs = 0
+        self.repartitions = 0
+        #: consecutive epochs each split cell spent below merge_share
+        self._cold_epochs: dict = {}
+        #: consecutive epochs each block spent below coarsen_share
+        self._block_cold: dict = {}
+        #: recent decisions, newest last (the /partition event tail)
+        self.decisions: List[dict] = []
+        self._lock = threading.Lock()
+        self._restore_observer: Optional[Callable[[], None]] = None
+
+    # ------------------------------------------------------------------ #
+    # observation
+
+    def install(self) -> "RepartitionController":
+        """Chain onto the grid-cell observer hook (shared with telemetry's
+        occupancy/cost recorders) and become the process's active
+        controller. :meth:`uninstall` restores both."""
+        global _ACTIVE
+        prev = _ug._CELL_OBSERVER
+        note = self.note_cells
+
+        def observe(cells) -> None:
+            if prev is not None:
+                prev(cells)
+            note(cells)
+
+        _ug._CELL_OBSERVER = observe
+
+        def restore() -> None:
+            global _ACTIVE
+            _ug._CELL_OBSERVER = prev
+            if _ACTIVE is self:
+                _ACTIVE = None
+
+        self._restore_observer = restore
+        _ACTIVE = self
+        return self
+
+    def uninstall(self) -> None:
+        if self._restore_observer is not None:
+            self._restore_observer()
+            self._restore_observer = None
+
+    def note_cells(self, cells) -> None:
+        """One decoded chunk's base-cell ids (any shape; -1 = outside the
+        grid). Accumulates the epoch bincount and fires :meth:`epoch` when
+        the interval fills — on the pipeline thread, between chunks, so a
+        layout change can never interleave with a window evaluation."""
+        c = np.asarray(cells).ravel()
+        c = c[(c >= 0) & (c < self._counts.size)]
+        if c.size == 0:
+            return
+        with self._lock:
+            self._counts += np.bincount(c, minlength=self._counts.size)
+            self._since += int(c.size)
+            due = self._since >= self.interval_records
+        if due:
+            self.epoch()
+
+    # ------------------------------------------------------------------ #
+    # decisions
+
+    def _cost_shares(self) -> Optional[np.ndarray]:
+        """Per-base-cell attributed-cost shares from the active telemetry
+        session's :class:`~spatialflink_tpu.utils.telemetry.CostProfiles`,
+        or None without a session / without attributed cost yet. Cumulative
+        (not per-epoch) — cost ratchets toward persistently hot cells,
+        which is the right bias for a split decision."""
+        from spatialflink_tpu.utils import telemetry as _telemetry
+
+        tel = _telemetry.active()
+        if tel is None:
+            return None
+        cost = tel.costs.cell_costs(self._counts.size)
+        total = float(cost.sum())
+        if total <= 0:
+            return None
+        return cost / total
+
+    def epoch(self) -> bool:
+        """Close one epoch: evaluate split/merge/coarsen with hysteresis and
+        apply the new layout. Returns True when the layout changed."""
+        p = self.policy
+        with self._lock:
+            counts = self._counts
+            total = int(counts.sum())
+            self._counts = np.zeros_like(counts)
+            self._since = 0
+            self.epochs += 1
+            epoch_no = self.epochs
+        # the no-signal floor clamps to the epoch interval: a deliberately
+        # small --repartition-interval must still make decisions
+        if total < min(p.min_epoch_records, self.interval_records):
+            return False
+        shares = counts / total
+        cost = self._cost_shares()
+        if cost is not None and p.cost_weight > 0:
+            score = (1 - p.cost_weight) * shares + p.cost_weight * cost
+        else:
+            score = shares
+
+        splits = set(self.grid.split_cells())
+        # merges first (cooldown): a split cell cold for cooldown epochs
+        # merges back to base granularity
+        merged = []
+        for cell in sorted(splits):
+            if shares[cell] < p.merge_share:
+                self._cold_epochs[cell] = self._cold_epochs.get(cell, 0) + 1
+                if self._cold_epochs[cell] >= p.cooldown_epochs:
+                    splits.discard(cell)
+                    merged.append(int(cell))
+                    self._cold_epochs.pop(cell, None)
+            else:
+                self._cold_epochs.pop(cell, None)
+        # splits: hottest first, capped
+        new_splits = []
+        for cell in np.argsort(score)[::-1]:
+            if len(splits) >= p.max_splits:
+                break
+            if score[cell] < p.split_share:
+                break
+            if int(cell) not in splits:
+                splits.add(int(cell))
+                new_splits.append(int(cell))
+                self._cold_epochs.pop(int(cell), None)
+
+        # coarsen/un-coarsen cold neighborhoods (block lattice sums)
+        blocks = set(self.grid.coarse_blocks())
+        coarsened, uncoarsened = [], []
+        if self.coarsen_enabled:
+            n, c = self.grid.n, self.grid.coarsen
+            nb = -(-n // c)
+            grid2d = shares.reshape(n, n)
+            pad = nb * c
+            padded = np.zeros((pad, pad))
+            padded[:n, :n] = grid2d
+            block_share = padded.reshape(nb, c, nb, c).sum(axis=(1, 3))
+            for bx in range(nb):
+                for by in range(nb):
+                    key = (bx, by)
+                    members = self.grid._block_members(bx, by)
+                    if any(m in splits for m in members):
+                        blocks.discard(key)
+                        self._block_cold.pop(key, None)
+                        continue
+                    s = float(block_share[bx, by])
+                    if key in blocks:
+                        if s >= p.uncoarsen_share:
+                            blocks.discard(key)
+                            uncoarsened.append(list(key))
+                            self._block_cold.pop(key, None)
+                    elif s < p.coarsen_share:
+                        self._block_cold[key] = \
+                            self._block_cold.get(key, 0) + 1
+                        if self._block_cold[key] >= p.cooldown_epochs:
+                            blocks.add(key)
+                            coarsened.append(list(key))
+                            self._block_cold.pop(key, None)
+                    else:
+                        self._block_cold.pop(key, None)
+
+        changed = self.grid.apply_layout(splits, blocks)
+        if changed:
+            self._note_change(epoch_no, total, new_splits, merged,
+                              coarsened, uncoarsened)
+        return changed
+
+    def _note_change(self, epoch_no: int, total: int, new_splits, merged,
+                     coarsened, uncoarsened) -> None:
+        from spatialflink_tpu.utils import telemetry as _telemetry
+        from spatialflink_tpu.utils.metrics import REGISTRY
+
+        self.repartitions += 1
+        REGISTRY.counter("repartitions").inc()
+        REGISTRY.counter("grid-splits").inc(len(new_splits))
+        REGISTRY.counter("grid-merges").inc(len(merged))
+        decision = {
+            "ts_ms": int(time.time() * 1000),
+            "epoch": epoch_no,
+            "epoch_records": total,
+            "version": self.grid.version,
+            "split": new_splits,
+            "merged": merged,
+            "coarsened": coarsened,
+            "uncoarsened": uncoarsened,
+            "num_leaves": self.grid.num_leaves,
+        }
+        with self._lock:
+            self.decisions.append(decision)
+            del self.decisions[:-32]
+        _telemetry.emit_event(
+            "repartition", version=self.grid.version, epoch=epoch_no,
+            split=new_splits, merged=merged, coarsened=len(coarsened),
+            uncoarsened=len(uncoarsened), num_leaves=self.grid.num_leaves)
+        tel = _telemetry.active()
+        if tel is not None:
+            tel.gauge("grid.version").set(float(self.grid.version))
+            tel.gauge("grid.leaves").set(float(self.grid.num_leaves))
+
+    # ------------------------------------------------------------------ #
+    # serving / checkpointing
+
+    def status(self) -> dict:
+        """The ``/partition`` endpoint payload: the live layout, the policy
+        thresholds (so the trigger is observable BEFORE it fires, next to
+        the skew gauges it reads), epoch progress, and recent decisions."""
+        with self._lock:
+            since = self._since
+            decisions = list(self.decisions)
+            counts = self._counts
+            total = int(counts.sum())
+            top = []
+            if total:
+                nz = np.nonzero(counts)[0]
+                order = nz[np.argsort(counts[nz])[::-1][:8]]
+                top = [[int(c), round(float(counts[c]) / total, 4)]
+                       for c in order]
+        return {
+            "grid": self.grid.layout(),
+            "policy": {
+                "split_share": self.policy.split_share,
+                "merge_share": self.policy.merge_share,
+                "cooldown_epochs": self.policy.cooldown_epochs,
+                "max_splits": self.policy.max_splits,
+                "coarsen_share": (self.policy.coarsen_share
+                                  if self.coarsen_enabled else 0.0),
+                "uncoarsen_share": self.policy.uncoarsen_share,
+                "cost_weight": self.policy.cost_weight,
+            },
+            "interval_records": self.interval_records,
+            "epoch": {"number": self.epochs, "records": since,
+                      "top_shares": top},
+            "repartitions": self.repartitions,
+            "decisions": decisions,
+        }
+
+    def register_checkpoint(self, coordinator) -> None:
+        """Carry the grid layout in the coordinated-checkpoint manifest
+        (component ``grid``) so ``--resume`` restores the adapted
+        partitioning. Registration auto-restores pending loaded state."""
+
+        def snapshot():
+            return {}, self.grid.layout()
+
+        def restore(_arrays, meta) -> None:
+            self.grid.apply_layout(
+                meta.get("split_cells", ()),
+                [tuple(b) for b in meta.get("coarse_blocks", ())])
+            # the version is monotonic ACROSS the resume: never rewind
+            # below the saved stamp (operators' mask caches key on it)
+            self.grid.version = max(self.grid.version,
+                                    int(meta.get("version", 0)))
+
+        coordinator.register("grid", snapshot, restore)

@@ -320,9 +320,40 @@ class CellOccupancy:
             return 0.0
         return float(nz.max() / nz.mean())
 
+    def top_share(self) -> float:
+        """The hottest cell's share of ALL recorded assignments — the
+        skew-concentration number the repartition split threshold is
+        compared against (``--adaptive-grid`` splits when an epoch share
+        crosses ``split_share``), surfaced so the trigger is observable
+        before it fires."""
+        total = int(self._counts.sum())
+        if total == 0:
+            return 0.0
+        return float(self._counts.max()) / total
+
+    def gini(self) -> float:
+        """Gini coefficient of the per-cell record distribution over
+        OCCUPIED cells: 0 = perfectly uniform, ->1 = everything in one
+        cell. Companion concentration gauge to :meth:`top_share` (top
+        share sees only the single hottest cell; Gini sees the whole
+        tail)."""
+        np = self._np
+        nz = np.sort(self._counts[self._counts > 0].astype(np.float64))
+        m = nz.size
+        if m == 0:
+            return 0.0
+        total = float(nz.sum())
+        if total <= 0 or m == 1:
+            return 0.0
+        # standard mean-difference form over the sorted counts
+        idx = np.arange(1, m + 1)
+        return float((2.0 * (idx * nz).sum() / (m * total)) - (m + 1) / m)
+
     def to_dict(self, k: int = 8) -> dict:
         occ = int((self._counts > 0).sum())
         return {"occupied_cells": occ, "skew": round(self.skew(), 3),
+                "top_share": round(self.top_share(), 4),
+                "gini": round(self.gini(), 4),
                 "top_cells": self.top_k(k)}
 
 
@@ -671,6 +702,16 @@ class CostProfiles:
             f["pane_hits"] += int(hits)
             f["pane_misses"] += int(misses)
 
+    def cell_costs(self, size: int):
+        """Per-cell cumulative attributed kernel cost (ms), zero-padded /
+        truncated to ``size`` — the repartition controller's cost signal
+        (``runtime.repartition``). A copy; callers may normalize freely."""
+        np = self._np
+        out = np.zeros(size, np.float64)
+        n = min(size, self._cost_ms.size)
+        out[:n] = self._cost_ms[:n]
+        return out
+
     def top_cost_cells(self, k: int = 8, cost=None) -> List[list]:
         """``[cell, cost_ms, records]`` rows, costliest first."""
         np = self._np
@@ -979,6 +1020,15 @@ def status_digest(snap: dict) -> dict:
         "mesh_degradations": int(counters.get("mesh-degradations", 0)),
         "slo_breaches": int(counters.get("slo-breaches", 0)),
         "top_cells": grid.get("top_cells", []),
+        # skew-concentration gauges (CellOccupancy): top-cell record share
+        # and Gini over occupied cells — what the --adaptive-grid
+        # repartition trigger compares its split threshold against, so the
+        # threshold is observable BEFORE it fires
+        "skew": {
+            "factor": grid.get("skew"),
+            "top_share": grid.get("top_share"),
+            "gini": grid.get("gini"),
+        },
         # [[cell, attributed_kernel_ms, records], ...] — skew COST, the
         # companion to top_cells' occupancy counts (CostProfiles)
         "top_cost_cells": (snap.get("costs") or {}).get(

@@ -25,7 +25,8 @@ def test_bench_guard_passes_thresholds():
     rows = [json.loads(ln) for ln in r.stdout.splitlines()
             if ln.startswith("{")]
     assert [x["path"] for x in rows] == [
-        "window_assign", "decode_columnar", "windowed_pipeline"], r.stdout
+        "window_assign", "decode_columnar", "windowed_pipeline",
+        "skew_adaptive"], r.stdout
     assert all(x["speedup"] > 0 for x in rows)
     assert r.returncode == 0, (
         f"bench_guard regression:\n{r.stdout}\n{r.stderr[-1000:]}")
@@ -36,6 +37,8 @@ def test_guard_baseline_rows_exist():
                                        "GUARD_baseline.json")))
     assert base["metric"] == "speedup"
     assert {r["path"] for r in base["rows"]} == {
-        "window_assign", "decode_columnar", "windowed_pipeline"}
-    # the floors assert the batched path is actually FASTER than scalar
+        "window_assign", "decode_columnar", "windowed_pipeline",
+        "skew_adaptive"}
+    # the floors assert the batched path (and the skew-adaptive grid on
+    # the clustered stream) is actually FASTER than its baseline
     assert all(r["speedup"] >= 1.0 for r in base["rows"])

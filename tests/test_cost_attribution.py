@@ -130,17 +130,11 @@ class TestWindowTraceBook:
         assert json.load(open(path))["traceEvents"]
 
 
-ZIPF_HOT = 17  # the hot cell of the clustered streams below
+# the Zipf/clustered generator is SHARED with the adaptive-grid suites and
+# benchmarks/bench_skew.py — one definition in streams.synthetic
+from spatialflink_tpu.streams.synthetic import ZIPF_HOT, zipf_cells
 
-
-def _zipf_cells(n=4000, seed=7):
-    """A clustered cell-id stream: ~60% of records land in ZIPF_HOT, the
-    rest spread Zipf-ish over higher cells — the skew shape a uniform
-    grid sees under real (vehicle/checkin) traffic."""
-    rng = np.random.default_rng(seed)
-    tail = 20 + (rng.zipf(1.5, n) % 60)
-    cells = np.where(rng.uniform(size=n) < 0.6, ZIPF_HOT, tail)
-    return cells.astype(np.int64)
+_zipf_cells = zipf_cells
 
 
 class TestZipfOccupancy:
@@ -157,6 +151,20 @@ class TestZipfOccupancy:
         # hottest cell dwarfs the runner-up and the skew factor says so
         assert top[0][1] > 3 * top[1][1]
         assert occ.skew() > 5.0
+        # the skew-CONCENTRATION gauges (the --adaptive-grid trigger's
+        # observable form): the hot cell holds ~60% of the records, and the
+        # distribution is far from uniform on the Gini scale
+        assert occ.top_share() == pytest.approx(
+            top[0][1] / len(cells), abs=1e-9)
+        assert occ.top_share() > 0.55
+        assert occ.gini() > 0.5
+        d = occ.to_dict()
+        assert {"top_share", "gini"} <= set(d)
+        # a perfectly uniform stream reads as unconcentrated
+        flat = CellOccupancy()
+        flat.record(np.arange(100, dtype=np.int64))
+        assert flat.gini() == pytest.approx(0.0, abs=1e-9)
+        assert flat.top_share() == pytest.approx(0.01, abs=1e-9)
 
 
 class TestCostProfiles:
@@ -247,33 +255,25 @@ class TestCostProfiles:
         session: the hot cell tops the cost profile AND the status digest
         surfaces it (top_cost_cells), with the family profile fed from
         the real kernel spans."""
-        rng = np.random.default_rng(11)
-        hot_x, hot_y = 116.5, 40.5
-        t0 = 1_700_000_000_000
+        from spatialflink_tpu.streams.synthetic import clustered_points
 
-        def stream():
-            for i in range(600):
-                if rng.uniform() < 0.7:
-                    # 0.007° spread keeps the cluster inside ONE 0.021°
-                    # cell (116.5 sits at 47.6 cell-widths from min_x, so
-                    # [116.5, 116.507] never crosses the 116.508 boundary)
-                    x, y = hot_x + rng.uniform(0, 0.007), hot_y
-                else:
-                    x = 115.6 + rng.uniform(0, 1.9)
-                    y = 39.7 + rng.uniform(0, 1.3)
-                yield Point.create(x, y, GRID, obj_id=f"o{i}",
-                                   timestamp=t0 + i * 100)
-
+        # shared generator (streams.synthetic): 70% of records in a tight
+        # cluster spanning a third of one cell, anchored mid-cell so the
+        # whole cluster shares ONE grid cell
+        hot_x, hot_y = 116.4975, 40.5135
+        stream = clustered_points(GRID, 600, 0.7, seed=11,
+                                  hot_center=(hot_x, hot_y),
+                                  cluster_span_cells=0.33)
         conf = QueryConfiguration(QueryType.WindowBased,
                                   window_size_ms=10_000, slide_ms=5_000)
         q = Point.create(hot_x, hot_y, GRID)
         with scoped_registry(), telemetry_session() as tel:
             n = sum(1 for _ in PointPointRangeQuery(conf, GRID).run(
-                stream(), q, 0.5))
+                iter(stream), q, 0.5))
             assert n >= 2
             payload = tel.costs.cells_payload()
             snap = status_snapshot(tel)
-        hot_cell = int(GRID.assign_cell(hot_x + 0.003, hot_y)[0])
+        hot_cell = int(GRID.assign_cell(hot_x, hot_y)[0])
         assert payload["cells"], "pipeline produced no cost profile"
         assert payload["cells"][0]["cell"] == hot_cell
         # dominance, not an exact share: per-dispatch wall-clock weights

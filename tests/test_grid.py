@@ -1,11 +1,15 @@
-"""UniformGrid parity tests against a direct reading of UniformGrid.java."""
+"""UniformGrid parity tests against a direct reading of UniformGrid.java,
+plus the adaptive two-level grid's refined-cell-space correctness: the
+split/coarse leaf masks proven against a brute-force distance oracle, the
+vectorized two-stage assignment, and ``cell_key`` wire parity on split
+cells."""
 
 import math
 
 import numpy as np
 import pytest
 
-from spatialflink_tpu.index import UniformGrid
+from spatialflink_tpu.index import AdaptiveGrid, UniformGrid
 from spatialflink_tpu.index.uniform_grid import cells_within_layers
 
 # Canonical Beijing / T-Drive config (conf/geoflink-conf.yml:20-21)
@@ -177,3 +181,212 @@ class TestDevicePredicate:
         g = make_grid(100)
         got = cells_within_layers(np.array([-1], np.int32), np.int32(0), 100, g.n)
         assert not np.asarray(got).any()
+
+
+# --------------------------------------------------------------------- #
+# Adaptive two-level grid (index/adaptive_grid.py)
+
+
+def _rect_dists(px, py, rect):
+    """(min, max) Euclidean distance from a point to a closed rect."""
+    x0, y0, x1, y1 = rect
+    dx_min = max(x0 - px, px - x1, 0.0)
+    dy_min = max(y0 - py, py - y1, 0.0)
+    dx_max = max(abs(px - x0), abs(px - x1))
+    dy_max = max(abs(py - y0), abs(py - y1))
+    return math.hypot(dx_min, dy_min), math.hypot(dx_max, dy_max)
+
+
+def _random_layout(ag, rng, n_splits=6, n_coarse=4):
+    n, c = ag.n, ag.coarsen
+    splits = rng.choice(n * n, size=n_splits, replace=False).tolist()
+    nb = -(-n // c)
+    blocks = [(int(rng.integers(0, nb)), int(rng.integers(0, nb)))
+              for _ in range(n_coarse)]
+    ag.apply_layout(splits, blocks)
+    return ag
+
+
+class TestAdaptiveLayout:
+    def test_default_layout_is_the_base_grid(self):
+        g = make_grid(40)
+        ag = AdaptiveGrid(g, refine=4)
+        assert ag.num_leaves == g.num_cells
+        # every base mask is reproduced EXACTLY on the leaf space
+        perm = np.array([ag.leaf_of_cell(c) for c in range(g.num_cells)])
+        q = g.cell_id(20, 20)
+        for r in (0.07, 0.2, 0.5, 1.1):
+            assert (ag.guaranteed_leaf_mask(r, q)[perm]
+                    == g.guaranteed_cells_mask(r, q)).all()
+            assert (ag.neighboring_leaf_mask(r, q)[perm]
+                    == g.neighboring_cells_mask(r, q)).all()
+
+    def test_apply_layout_versions_only_real_changes(self):
+        ag = AdaptiveGrid(make_grid(20), refine=3)
+        assert ag.apply_layout([5, 9], [(4, 4)])
+        assert ag.version == 1
+        assert not ag.apply_layout([9, 5], [(4, 4)])  # same layout
+        assert ag.version == 1
+        assert ag.apply_layout([5], [(4, 4)])
+        assert ag.version == 2
+        assert ag.split_cells() == [5]
+
+    def test_split_wins_over_coarsen(self):
+        ag = AdaptiveGrid(make_grid(20), refine=2, coarsen=2)
+        # cell 0 is inside block (0, 0): the block must be dropped
+        ag.apply_layout([0], [(0, 0), (5, 5)])
+        assert ag.coarse_blocks() == [(5, 5)]
+
+    def test_leaves_partition_the_bbox(self):
+        """Property: every in-bbox point maps to exactly one leaf whose
+        bounds contain it — across splits AND coarse blocks."""
+        g = make_grid(25)
+        ag = _random_layout(AdaptiveGrid(g, refine=4), np.random.default_rng(3))
+        rng = np.random.default_rng(4)
+        xs = rng.uniform(g.min_x, g.max_x, 4000)
+        ys = rng.uniform(g.min_y, g.max_y, 4000)
+        leaves = ag.assign_leaf(xs, ys)
+        assert (leaves >= 0).all() and (leaves < ag.num_leaves).all()
+        for i in range(0, 4000, 131):
+            x0, y0, x1, y1 = ag.leaf_bounds(int(leaves[i]))
+            assert x0 - 1e-9 <= xs[i] <= x1 + 1e-9
+            assert y0 - 1e-9 <= ys[i] <= y1 + 1e-9
+
+    def test_assign_leaf_out_of_bbox_invalid(self):
+        ag = AdaptiveGrid(make_grid(10), refine=2)
+        assert (ag.assign_leaf(np.array([110.0, 118.0]),
+                               np.array([40.0, 40.0])) == -1).all()
+
+    def test_two_stage_assignment_matches_base_plus_sub(self):
+        """The vectorized path == per-point base cell + fine sub-index."""
+        g = make_grid(30)
+        ag = AdaptiveGrid(g, refine=4)
+        ag.apply_layout([g.cell_id(7, 9), g.cell_id(20, 3)])
+        rng = np.random.default_rng(5)
+        xs = rng.uniform(g.min_x, g.max_x, 2000)
+        ys = rng.uniform(g.min_y, g.max_y, 2000)
+        leaves = ag.assign_leaf(xs, ys)
+        cells, _ = g.assign_cell(xs, ys)
+        for i in range(0, 2000, 61):
+            cell = int(cells[i])
+            first = ag.leaf_of_cell(cell)
+            if cell in (g.cell_id(7, 9), g.cell_id(20, 3)):
+                rx = (xs[i] - g.min_x) / g.cell_length - cell // g.n
+                ry = (ys[i] - g.min_y) / g.cell_length - cell % g.n
+                sub = (min(3, int(rx * 4)) * 4 + min(3, int(ry * 4)))
+                assert leaves[i] == first + sub
+            else:
+                assert leaves[i] == first
+
+
+class TestAdaptiveMaskOracle:
+    """The refined GN/CN masks against a brute-force distance oracle:
+    guaranteed leaves must be FULLY inside the radius, and every leaf whose
+    closest point is within the radius must be in GN ∪ CN — across random
+    layouts, query positions (inside split cells, unsplit cells, coarse
+    blocks), and radii spanning sub-fine-cell to multi-cell."""
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_point_query_masks_vs_bruteforce(self, seed):
+        g = make_grid(20)
+        ag = _random_layout(AdaptiveGrid(g, refine=4),
+                            np.random.default_rng(seed))
+        rng = np.random.default_rng(100 + seed)
+        for _ in range(6):
+            px = rng.uniform(g.min_x, g.max_x)
+            py = rng.uniform(g.min_y, g.max_y)
+            qc, _ = g.assign_cell(px, py)
+            r = float(rng.uniform(0.2, 8.0)) * ag.fine_length
+            gn = ag.guaranteed_leaf_mask(r, int(qc), point=(px, py))
+            cn = ag.candidate_leaf_mask(r, int(qc), point=(px, py))
+            nb = ag.neighboring_leaf_mask(r, int(qc), point=(px, py))
+            assert not (gn & cn).any()
+            assert ((gn | cn) == nb).all()
+            for leaf in range(ag.num_leaves):
+                dmin, dmax = _rect_dists(px, py, ag.leaf_bounds(leaf))
+                if gn[leaf]:
+                    assert dmax <= r + 1e-9, \
+                        f"GN leaf {leaf} not fully inside r"
+                if dmin < r * (1 - 1e-9):
+                    assert nb[leaf], \
+                        f"leaf {leaf} intersects the ball but not in NB"
+
+    def test_split_cell_masks_are_tighter_than_base(self):
+        """The refinement's point: inside a split hot cell, a small-radius
+        query keeps strictly fewer fine leaves than the whole base cell —
+        while still covering the true candidate set."""
+        g = make_grid(20)
+        ag = AdaptiveGrid(g, refine=4)
+        q = g.cell_id(10, 10)
+        ag.apply_layout([q])
+        x0, y0, x1, y1 = g.cell_bounds(q)
+        px, py = x0 + 0.1 * (x1 - x0), y0 + 0.1 * (y1 - y0)  # corner
+        r = 0.3 * ag.fine_length
+        nb = ag.neighboring_leaf_mask(r, q, point=(px, py))
+        # fine leaves of the split cell actually selected
+        first = ag.leaf_of_cell(q)
+        in_cell = nb[first: first + 16]
+        assert 0 < int(in_cell.sum()) < 16
+
+    def test_geom_query_cells_union_semantics(self):
+        """Multi-cell queries union per cell (UniformGrid.java:193-222):
+        the mask equals the OR of single-cell masks."""
+        g = make_grid(20)
+        ag = _random_layout(AdaptiveGrid(g, refine=3),
+                            np.random.default_rng(9))
+        cells = [g.cell_id(4, 4), g.cell_id(6, 5)]
+        r = 0.25
+        union_nb = ag.neighboring_leaf_mask(r, cells)
+        per = [ag.neighboring_leaf_mask(r, c) for c in cells]
+        assert (union_nb == (per[0] | per[1])).all()
+        union_gn = ag.guaranteed_leaf_mask(r, cells)
+        per_gn = [ag.guaranteed_leaf_mask(r, c) for c in cells]
+        assert (union_gn == (per_gn[0] | per_gn[1])).all()
+
+    def test_radius_zero_selects_all_leaves(self):
+        ag = AdaptiveGrid(make_grid(10), refine=2)
+        ag.apply_layout([3])
+        nb = ag.neighboring_leaf_mask(0.0, 3)
+        assert nb.all()  # UniformGrid.java:264-266 parity
+        assert not ag.guaranteed_leaf_mask(0.0, 3).any()
+
+
+class TestAdaptiveCellKeys:
+    def test_wire_parity_and_roundtrip_on_split_cells(self):
+        """cell_key parity: the first 10 chars of every leaf key are
+        EXACTLY the uniform grid's zero-padded key of the base cell the
+        leaf lies in (verified geometrically via the brute-force bounds,
+        not via the adaptive grid's own tables), and keys round-trip."""
+        g = make_grid(20)
+        ag = _random_layout(AdaptiveGrid(g, refine=4),
+                            np.random.default_rng(11))
+        for leaf in range(0, ag.num_leaves, 7):
+            key = ag.cell_key(leaf)
+            assert ag.cell_from_key(key) == leaf
+            # geometric wire parity: the anchor prefix names a base cell
+            # whose bounds contain the leaf's center
+            x0, y0, x1, y1 = ag.leaf_bounds(leaf)
+            cx, cy = (x0 + x1) / 2, (y0 + y1) / 2
+            base_cell = g.cell_from_key(key[:10])
+            bx0, by0, bx1, by1 = g.cell_bounds(base_cell)
+            assert bx0 - 1e-9 <= cx and by0 - 1e-9 <= cy
+            if ":" in key:  # split leaves sit INSIDE one base cell
+                assert cx <= bx1 + 1e-9 and cy <= by1 + 1e-9
+                # and the prefix matches the uniform key of the point
+                ucell, _ = g.assign_cell(cx, cy)
+                assert key[:10] == g.cell_key(int(ucell))
+
+    def test_split_key_shape(self):
+        g = make_grid(100)
+        ag = AdaptiveGrid(g, refine=4)
+        cell = g.cell_id(7, 42)
+        ag.apply_layout([cell])
+        first = ag.leaf_of_cell(cell)
+        assert ag.cell_key(first) == "0000700042:0"
+        assert ag.cell_key(first + 15) == "0000700042:15"
+        assert ag.cell_from_key("0000700042:15") == first + 15
+        # unsplit leaves keep the bare 10-char reference format
+        other = ag.leaf_of_cell(g.cell_id(3, 5))
+        assert ag.cell_key(other) == g.cell_key(g.cell_id(3, 5))
+        with pytest.raises(ValueError):
+            ag.cell_from_key("0000300005:2")  # sub-key of an unsplit cell

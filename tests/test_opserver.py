@@ -39,7 +39,7 @@ STATUS_KEYS = {"records_in", "throughput_rps", "windows_evaluated",
                "commit_backlog", "window_backlog", "pane_cache",
                "checkpoint", "breaker_state", "dlq_depth",
                "mesh_degradations", "slo_breaches", "top_cells",
-               "top_cost_cells"}
+               "skew", "top_cost_cells"}
 
 
 def _get(url, timeout=5):
@@ -151,6 +151,11 @@ class TestStatusSnapshot:
         assert st["watermark_lag_ms"] == 42.0
         assert st["window_latency_ms"]["count"] == 1
         assert st["top_cells"][0][0] == 3
+        # skew-concentration gauges (top-cell share / Gini) ride the same
+        # digest — the observable form of the --adaptive-grid trigger
+        assert st["skew"]["top_share"] == pytest.approx(2 / 3, abs=1e-3)
+        assert 0.0 <= st["skew"]["gini"] <= 1.0
+        assert st["skew"]["factor"] == pytest.approx(4 / 3, abs=1e-3)
         # the whole document is JSON-serializable as-is
         json.dumps(snap)
 

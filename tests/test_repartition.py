@@ -1,0 +1,427 @@
+"""Skew-adaptive grid suite: the repartition controller's epoch split/merge
+decisions (hysteresis, cost-weighted scoring, observer chain), the
+``/partition`` endpoint, and the tentpole invariant — WINDOW-TABLE IDENTITY
+across grid-version changes: a repartition mid-run must never change a
+result, including under ``--chaos`` transport faults and across a
+checkpoint/resume that straddles a repartition (the manifest carries the
+grid layout; ``--resume`` restores the adapted partitioning)."""
+
+import dataclasses
+import json
+import os
+import urllib.request
+
+import numpy as np
+import pytest
+import yaml
+
+from spatialflink_tpu.driver import main
+from spatialflink_tpu.index import AdaptiveGrid, UniformGrid
+from spatialflink_tpu.index import uniform_grid as _ug
+from spatialflink_tpu.models import Point
+from spatialflink_tpu.operators import (PointPointRangeQuery,
+                                        QueryConfiguration, QueryType)
+from spatialflink_tpu.runtime.checkpoint import CheckpointCoordinator
+from spatialflink_tpu.runtime.opserver import OpServer
+from spatialflink_tpu.runtime.repartition import (RepartitionController,
+                                                  RepartitionPolicy,
+                                                  active_controller)
+from spatialflink_tpu.streams import (reset_memory_brokers, resolve_broker,
+                                      serialize_spatial)
+from spatialflink_tpu.streams.kafka import KafkaWindowSink
+from spatialflink_tpu.streams.synthetic import clustered_lines, clustered_points
+from spatialflink_tpu.utils.metrics import scoped_registry
+from spatialflink_tpu.utils.telemetry import telemetry_session
+
+pytestmark = pytest.mark.adaptive
+
+CONF = "conf/spatialflink-conf.yml"
+IN1, OUT = "points.geojson", "output"
+GRID = UniformGrid(115.5, 117.6, 39.6, 41.1, num_grid_partitions=100)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_brokers():
+    reset_memory_brokers()
+    yield
+    reset_memory_brokers()
+
+
+def _policy(**kw):
+    kw.setdefault("split_share", 0.2)
+    kw.setdefault("merge_share", 0.05)
+    kw.setdefault("min_epoch_records", 64)
+    # coarsening off unless the test is about it: the decision units pin
+    # split/merge behavior in isolation
+    kw.setdefault("coarsen_share", 0.0)
+    return RepartitionPolicy(**kw)
+
+
+class TestPolicy:
+    def test_hysteresis_band_validated(self):
+        with pytest.raises(ValueError, match="hysteresis"):
+            RepartitionPolicy(split_share=0.1, merge_share=0.1).validate()
+        with pytest.raises(ValueError, match="coarsen_share"):
+            RepartitionPolicy(coarsen_share=0.5,
+                              uncoarsen_share=0.1).validate()
+        RepartitionPolicy().validate()  # defaults are coherent
+
+
+class TestControllerDecisions:
+    def _hot_epoch(self, ctl, hot_cell, n=1000, hot_share=0.6, seed=0):
+        rng = np.random.default_rng(seed)
+        tail = rng.integers(0, GRID.num_cells, n)
+        cells = np.where(rng.uniform(size=n) < hot_share, hot_cell, tail)
+        ctl.note_cells(cells)
+
+    def test_hot_cell_splits_and_cold_merge_waits_out_cooldown(self):
+        ag = AdaptiveGrid(GRID, refine=4)
+        ctl = RepartitionController(ag, interval_records=1000,
+                                    policy=_policy(cooldown_epochs=2))
+        self._hot_epoch(ctl, 4242)
+        assert ag.split_cells() == [4242] and ag.version == 1
+        # cell cools: epoch 1 below merge_share -> still split (cooldown)
+        self._hot_epoch(ctl, 4242, hot_share=0.0, seed=1)
+        assert ag.split_cells() == [4242]
+        # epoch 2 below merge_share -> merges back
+        self._hot_epoch(ctl, 4242, hot_share=0.0, seed=2)
+        assert ag.split_cells() == [] and ag.version == 2
+        # oscillation around the SPLIT threshold alone never merges: the
+        # band between merge_share and split_share is sticky
+        self._hot_epoch(ctl, 7, hot_share=0.6, seed=3)
+        assert ag.split_cells() == [7]
+        for s in range(4, 10):
+            self._hot_epoch(ctl, 7, hot_share=0.1, seed=s)  # > merge_share
+            assert ag.split_cells() == [7], "hysteresis band must hold"
+
+    def test_max_splits_caps_and_prefers_hottest(self):
+        ag = AdaptiveGrid(GRID, refine=4)
+        ctl = RepartitionController(
+            ag, interval_records=1000,
+            policy=_policy(split_share=0.1, max_splits=2))
+        # three hot cells at 30/25/20% — only the two hottest split
+        cells = np.concatenate([np.full(300, 11), np.full(250, 22),
+                                np.full(200, 33),
+                                np.arange(250) % GRID.num_cells])
+        ctl.note_cells(cells)
+        assert ag.split_cells() == [11, 22]
+
+    def test_cold_blocks_coarsen_and_uncoarsen(self):
+        ag = AdaptiveGrid(GRID, refine=4, coarsen=2)
+        pol = _policy(coarsen_share=0.0005, uncoarsen_share=0.01,
+                      cooldown_epochs=1)
+        ctl = RepartitionController(ag, interval_records=1000, policy=pol)
+        # traffic concentrated far from block (0,0): the cold corner
+        # coarsens after the cooldown
+        rng = np.random.default_rng(0)
+        hot = 5000 + rng.integers(0, 50, 1000)
+        ctl.note_cells(hot)
+        assert (0, 0) in ag.coarse_blocks()
+        # traffic arrives in the corner -> un-coarsens
+        corner = np.concatenate([np.full(100, 0),
+                                 5000 + rng.integers(0, 50, 900)])
+        ctl.note_cells(corner)
+        assert (0, 0) not in ag.coarse_blocks()
+
+    def test_small_epochs_are_ignored(self):
+        # an epoch closed with too little signal (under BOTH the policy
+        # floor and the interval) makes no decision; a deliberately tiny
+        # --repartition-interval still does (the floor clamps to it)
+        ag = AdaptiveGrid(GRID, refine=4)
+        ctl = RepartitionController(ag, interval_records=10_000,
+                                    policy=_policy(min_epoch_records=1000))
+        ctl.note_cells(np.full(50, 9))
+        assert not ctl.epoch() and ag.version == 0  # 50 < min(1000, 10000)
+        small = RepartitionController(ag, interval_records=10,
+                                      policy=_policy(min_epoch_records=1000))
+        small.note_cells(np.full(50, 9))  # 50 >= min(1000, 10) -> decides
+        assert ag.version == 1 and ag.split_cells() == [9]
+
+    def test_attributed_cost_boosts_split_score(self):
+        """Cost-weighted trigger: a cell at a record share BELOW the split
+        threshold still splits when the attributed kernel cost (PR 6's
+        CostProfiles) concentrates there."""
+        ag = AdaptiveGrid(GRID, refine=4)
+        ctl = RepartitionController(
+            ag, interval_records=1000,
+            policy=_policy(split_share=0.5, cost_weight=0.5))
+        with scoped_registry(), telemetry_session() as tel:
+            # cost share ~1.0 in cell 1234; record share only ~0.3
+            tel.costs.record_cells(np.full(10, 1234))
+            tel.costs.attribute_kernel("range", 1.0, records=10)
+            rng = np.random.default_rng(1)
+            cells = np.concatenate([np.full(300, 1234),
+                                    rng.integers(0, GRID.num_cells, 700)])
+            ctl.note_cells(cells)
+            # score = 0.5*0.3 + 0.5*1.0 = 0.65 >= 0.5 -> splits; without
+            # the cost term (0.3 < 0.5) it would not
+            assert ag.split_cells() == [1234]
+            # the event + gauges landed in the session
+            kinds = [e["kind"] for e in tel.events.list()]
+            assert "repartition" in kinds
+            assert tel.gauges["grid.version"].get() == 1.0
+
+    def test_observer_chain_feeds_both_consumers_and_restores(self):
+        ag = AdaptiveGrid(GRID, refine=4)
+        ctl = RepartitionController(ag, interval_records=100,
+                                    policy=_policy())
+        with scoped_registry(), telemetry_session() as tel:
+            ctl.install()
+            try:
+                assert active_controller() is ctl
+                GRID.assign_cell(np.full(200, 116.5), np.full(200, 40.5))
+                # telemetry occupancy still sees the assignments
+                assert tel.cells.top_k(1)[0][1] == 200
+                # and the controller closed an epoch over them
+                assert ctl.epochs >= 1
+            finally:
+                ctl.uninstall()
+            assert active_controller() is None
+            before = tel.cells.top_k(1)[0][1]
+            GRID.assign_cell(116.5, 40.5)
+            assert tel.cells.top_k(1)[0][1] == before + 1  # chain restored
+
+
+class TestPartitionEndpoint:
+    def test_partition_payload_with_and_without_controller(self):
+        srv = OpServer(port=0).start()
+        try:
+            code, body = _get(srv.url + "/partition")
+            assert code == 200 and body["adaptive"] is False
+            assert "note" in body
+
+            ag = AdaptiveGrid(GRID, refine=4)
+            ctl = RepartitionController(ag, interval_records=1000,
+                                        policy=_policy()).install()
+            try:
+                ctl.note_cells(np.full(1000, 777))
+                code, body = _get(srv.url + "/partition")
+                assert code == 200 and body["adaptive"] is True
+                assert body["grid"]["split_cells"] == [777]
+                assert body["grid"]["version"] == 1
+                assert body["policy"]["split_share"] == 0.2
+                assert body["repartitions"] == 1
+                assert body["decisions"][-1]["split"] == [777]
+                json.dumps(body)
+            finally:
+                ctl.uninstall()
+        finally:
+            srv.close()
+
+
+def _get(url, timeout=5):
+    resp = urllib.request.urlopen(url, timeout=timeout)
+    return resp.status, json.loads(resp.read())
+
+
+# ----------------------------------------------------------------- identity
+
+
+def _canon(results):
+    return [(r.window_start, r.window_end,
+             sorted((p.obj_id, p.timestamp) for p in r.records))
+            for r in results]
+
+
+class TestMidRunIdentity:
+    def test_operator_identity_across_grid_version_changes(self):
+        """Uniform vs adaptive over the same clustered stream, with the
+        layout FORCED to change repeatedly between windows (splits applied
+        and reverted mid-run): every window table identical, and the
+        per-query mask caches provably recomputed on version bumps."""
+        recs = clustered_points(GRID, 4000, 0.8, seed=5,
+                                cluster_span_cells=2.0, dt_ms=20)
+        hot = max(((c, sum(1 for r in recs if r.cell == c))
+                   for c in {r.cell for r in recs}), key=lambda t: t[1])[0]
+        q = Point.create(*_cell_center(hot), GRID)
+        conf = QueryConfiguration(QueryType.WindowBased,
+                                  window_size_ms=10_000, slide_ms=5_000)
+        expected = _canon(PointPointRangeQuery(conf, GRID).run(
+            iter(recs), q, 0.006))
+
+        ag = AdaptiveGrid(GRID, refine=4)
+        conf_a = dataclasses.replace(conf, adaptive_grid=ag)
+        layouts = [([hot], []), ([], []), ([hot, hot + 1], [(0, 0)])]
+
+        def churn(stream):
+            for i, r in enumerate(stream):
+                if i % 900 == 0:  # several version bumps across the run
+                    ag.apply_layout(*layouts[(i // 900) % len(layouts)])
+                yield r
+
+        with scoped_registry() as reg:
+            got = _canon(PointPointRangeQuery(conf_a, GRID).run(
+                churn(iter(recs)), q, 0.006))
+            assert got == expected
+            assert ag.version >= 3
+            assert reg.counter("prefilter-mask-recomputes").count >= 2
+            assert reg.counter("prefilter-kept").count < \
+                reg.counter("prefilter-records").count
+
+    def test_multi_query_identity_and_union_mask_pruning(self):
+        """run_multi under the adaptive grid: per-query result lists are
+        identical to the uniform grid while the UNION leaf mask actually
+        prunes (the Q×N kernel shrinks to Q×kept)."""
+        recs = clustered_points(GRID, 3000, 0.9, seed=6,
+                                cluster_span_cells=2.0, dt_ms=30)
+        rng = np.random.default_rng(2)
+        qpts = [Point.create(float(x), float(y), GRID) for x, y in zip(
+            rng.uniform(GRID.min_x, GRID.max_x, 12),
+            rng.uniform(GRID.min_y, GRID.max_y, 12))]
+        # one hotspot monitor inside the cluster (the refinement case)
+        hx = (GRID.min_x + GRID.max_x) / 2 + GRID.cell_length / 3
+        hy = (GRID.min_y + GRID.max_y) / 2 + GRID.cell_length / 3
+        qpts[0] = Point.create(hx, hy, GRID)
+        conf = QueryConfiguration(QueryType.WindowBased,
+                                  window_size_ms=10_000, slide_ms=5_000)
+
+        def canon(results):
+            return [(r.window_start,
+                     tuple(sorted((p.obj_id, p.timestamp) for p in per_q)
+                           for per_q in r.records))
+                    for r in results]
+
+        expected = canon(PointPointRangeQuery(conf, GRID).run_multi(
+            iter(recs), qpts, 0.003))
+        ag = AdaptiveGrid(GRID, refine=8)
+        hot_cell = int(GRID.assign_cell(hx, hy)[0])
+        ag.apply_layout([hot_cell, hot_cell + 1, hot_cell - 1])
+        conf_a = dataclasses.replace(conf, adaptive_grid=ag)
+        with scoped_registry() as reg:
+            got = canon(PointPointRangeQuery(conf_a, GRID).run_multi(
+                iter(recs), qpts, 0.003))
+            kept = reg.counter("prefilter-kept").count
+            total = reg.counter("prefilter-records").count
+        assert got == expected
+        assert 0 < kept < 0.8 * total, \
+            f"union leaf mask did not prune (kept {kept}/{total})"
+
+    def test_driver_chaos_identity_uniform_vs_adaptive(self, tmp_path):
+        """--kafka --chaos window-table identity: the adaptive run under
+        transport faults produces the byte-identical marker table of a
+        fault-free uniform run, with repartitions actually firing."""
+        lines = clustered_lines(GRID, 900, 0.85, seed=7, fmt="geojson",
+                                dt_ms=120)
+        with open(CONF) as f:
+            d = yaml.safe_load(f)
+
+        def run(name, extra):
+            d["kafkaBootStrapServers"] = f"memory://{name}"
+            cfg = tmp_path / f"{name}.yml"
+            cfg.write_text(yaml.safe_dump(d))
+            broker = resolve_broker(f"memory://{name}")
+            for ln in lines:
+                broker.produce(IN1, ln)
+            assert main(["--config", str(cfg), "--kafka", "--option", "1"]
+                        + extra) == 0
+            table = {}
+            for r in broker.fetch(OUT, 0, 1_000_000):
+                if isinstance(r.key, str) and r.key.startswith(
+                        KafkaWindowSink.MARKER):
+                    table[r.key[len(KafkaWindowSink.MARKER):]] = int(r.value)
+            assert table
+            return table
+
+        expected = run("uni", [])
+        got = run("ada", ["--adaptive-grid", "--repartition-interval", "300",
+                          "--chaos", "seed=11,fetch_fail=0.3,duplicate=0.3,"
+                                     "reorder=0.5",
+                          "--retry", "attempts=12,base_ms=1,max_ms=20"])
+        assert got == expected
+
+    def test_checkpoint_resume_straddles_a_repartition(self, tmp_path,
+                                                       monkeypatch):
+        """Crash AFTER a repartition has fired and been checkpointed;
+        resume must restore the adapted layout from the manifest (grid
+        component: version + splits) and converge to the uninterrupted
+        run's window table with no duplicate markers."""
+        monkeypatch.setenv("SPATIALFLINK_DECODE_CHUNK", "64")
+        lines = clustered_lines(GRID, 900, 0.85, seed=9, fmt="geojson",
+                                dt_ms=120)
+        with open(CONF) as f:
+            d = yaml.safe_load(f)
+
+        def setup(name):
+            d["kafkaBootStrapServers"] = f"memory://{name}"
+            cfg = tmp_path / f"{name}.yml"
+            cfg.write_text(yaml.safe_dump(d))
+            broker = resolve_broker(f"memory://{name}")
+            for ln in lines:
+                broker.produce(IN1, ln)
+            return str(cfg), broker
+
+        def table(broker):
+            out = {}
+            for r in broker.fetch(OUT, 0, 1_000_000):
+                if isinstance(r.key, str) and r.key.startswith(
+                        KafkaWindowSink.MARKER):
+                    out.setdefault(r.key[len(KafkaWindowSink.MARKER):],
+                                   []).append(int(r.value))
+            return out
+
+        cfg_o, broker_o = setup("straddle-oracle")
+        assert main(["--config", cfg_o, "--kafka", "--option", "1"]) == 0
+        expected = {k: v[0] for k, v in table(broker_o).items()}
+
+        cfg, broker = setup("straddle")
+        cpd = str(tmp_path / "cp-straddle")
+        argv = ["--config", cfg, "--kafka", "--option", "1",
+                "--adaptive-grid", "--repartition-interval", "150",
+                "--checkpoint-dir", cpd, "--checkpoint-every", "2"]
+        # crash on the 12th fresh window — well past the first repartition
+        # epochs (~150/300/450 records), so a pre-crash checkpoint has
+        # committed the adapted layout
+        orig = KafkaWindowSink.emit
+        state = {"fresh": 0}
+
+        def boom(self, result):
+            if self.window_key(result) not in self.delivered:
+                state["fresh"] += 1
+                if state["fresh"] == 12:
+                    raise RuntimeError("injected crash")
+            orig(self, result)
+
+        with monkeypatch.context() as m:
+            m.setattr(KafkaWindowSink, "emit", boom)
+            with pytest.raises(RuntimeError, match="injected crash"):
+                main(argv)
+        # the manifest carries the ADAPTED layout (the straddle premise)
+        coord = CheckpointCoordinator(cpd, job=None)
+        assert coord.load()
+        grid_meta = coord._pending.get("grid")
+        assert grid_meta is not None, "manifest lacks the grid component"
+        saved = grid_meta[1]
+        assert saved["version"] >= 1 and saved["split_cells"], \
+            "no repartition before the crash — the straddle premise failed"
+
+        assert main(argv + ["--resume"]) == 0
+        got = table(broker)
+        dups = {k: v for k, v in got.items() if len(v) > 1}
+        assert not dups, f"duplicate sink emissions after resume: {dups}"
+        assert {k: v[0] for k, v in got.items()} == expected
+
+    def test_grid_component_roundtrip_via_coordinator(self, tmp_path):
+        """Unit form of the layout restore: commit a layout through one
+        coordinator, register a fresh controller against a new coordinator
+        over the same dir — the layout (and version floor) comes back."""
+        ag = AdaptiveGrid(GRID, refine=4)
+        ag.apply_layout([7, 9], [(10, 10)])
+        ctl = RepartitionController(ag, policy=_policy())
+        coord = CheckpointCoordinator(str(tmp_path / "cp"), job="j")
+        ctl.register_checkpoint(coord)
+        coord.barrier()  # not due yet
+        coord.commit()
+
+        ag2 = AdaptiveGrid(GRID, refine=4)
+        ctl2 = RepartitionController(ag2, policy=_policy())
+        coord2 = CheckpointCoordinator(str(tmp_path / "cp"), job="j")
+        assert coord2.load()
+        ctl2.register_checkpoint(coord2)
+        assert ag2.split_cells() == [7, 9]
+        assert ag2.coarse_blocks() == [(10, 10)]
+        assert ag2.version >= ag.version
+
+
+def _cell_center(cell):
+    x0, y0, x1, y1 = GRID.cell_bounds(int(cell))
+    return (x0 + x1) / 2, (y0 + y1) / 2

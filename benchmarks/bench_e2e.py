@@ -435,6 +435,118 @@ def bench_multi_vs_jobs(option: int, path: str, n: int, q: int) -> list:
                  record_x_queries_per_sec=round(n * q / dt_jobs))]
 
 
+def bench_query_plane(path: str, n: int, q: int = 32) -> list:
+    """Standing-query control plane rows (ISSUE 10):
+
+    - ``query_plane_static``  a Q-query fleet served through the DYNAMIC
+                              registry path with no churn — the control
+                              plane's baseline cost over run_multi
+    - ``query_plane_churn``   the same fleet with one admit + one retire
+                              per window interval (fleet size constant, so
+                              every change repads within the same size
+                              bucket) — admission churn must not collapse
+                              throughput
+    - ``query_plane_q<Q>``    Q-sweep amortization THROUGH the registry:
+                              registry fleet vs Q dedicated single-query
+                              pipelines re-reading the stream
+    """
+    from spatialflink_tpu import driver
+    from spatialflink_tpu.config import StreamConfig
+    from spatialflink_tpu.models import Point
+    from spatialflink_tpu.operators import (PointPointRangeQuery,
+                                            QueryConfiguration, QueryType)
+    from spatialflink_tpu.runtime.queryplane import QueryRegistry
+
+    import numpy as np
+
+    with open(path) as f:
+        lines = f.read().splitlines()
+    cfg = StreamConfig(format="CSV", date_format=None,
+                       csv_tsv_schema=[0, 1, 2, 3])
+    grid = _params(1).grids()[0]
+    conf = QueryConfiguration(QueryType.WindowBased,
+                              int(WINDOW_S * 1000), int(SLIDE_S * 1000))
+    rng = np.random.default_rng(5)
+    radius = 0.5
+
+    def mkpts(m):
+        return [(float(grid.min_x + rng.random() * (grid.max_x - grid.min_x)),
+                 float(grid.min_y + rng.random() * (grid.max_y - grid.min_y)))
+                for _ in range(m)]
+
+    def mkreg(pts):
+        reg = QueryRegistry("range", radius=radius)
+        for i, (x, y) in enumerate(pts):
+            reg.admit({"id": f"q{i}", "x": x, "y": y})
+        reg.apply()
+        return reg
+
+    def run_registry(pts, churn=False):
+        reg = mkreg(pts)
+        op = PointPointRangeQuery(conf, grid)
+        stream = driver.decode_stream(iter(lines), cfg, grid)
+        seq = {"i": 0}
+        results = op.run_dynamic(stream, reg, radius)
+        windows = 0
+        t0 = time.perf_counter()
+        for _w in results:
+            windows += 1
+            if churn:
+                # one admit + one retire per emitted window: constant
+                # fleet size — every change repads within the same bucket
+                i = seq["i"]
+                reg.admit({"id": f"churn{i}",
+                           "x": float(grid.min_x + (i % 10) * 0.1),
+                           "y": float(grid.min_y + (i % 10) * 0.1)})
+                live = [e.id for e in reg.active_entries()]
+                reg.retire(live[0])
+                seq["i"] += 1
+        dt = time.perf_counter() - t0
+        return windows, dt, reg
+
+    def run_jobs(pts):
+        t0 = time.perf_counter()
+        for x, y in pts:
+            op = PointPointRangeQuery(conf, grid)
+            stream = driver.decode_stream(iter(lines), cfg, grid)
+            for _ in op.run(stream, Point.create(x, y, grid), radius):
+                pass
+        return time.perf_counter() - t0
+
+    rows = []
+    pts = mkpts(q)
+    run_registry(pts)  # warm the bucket's jit shapes
+    windows, dt_static, _ = run_registry(pts)
+    w2, dt_churn, reg = run_registry(pts, churn=True)
+    from spatialflink_tpu.ops.range import range_filter_point_multi_masks
+    compiles_before = range_filter_point_multi_masks._cache_size()
+    _w3, _dt3, _ = run_registry(pts, churn=True)
+    recompiles = (range_filter_point_multi_masks._cache_size()
+                  - compiles_before)
+    rows.append(dict(path="query_plane_static", queries=q, records=n,
+                     windows=windows, wall_s=round(dt_static, 3),
+                     records_per_sec=round(n / dt_static)))
+    rows.append(dict(path="query_plane_churn", queries=q, records=n,
+                     windows=w2, wall_s=round(dt_churn, 3),
+                     records_per_sec=round(n / dt_churn),
+                     churn_per_interval="1 admit + 1 retire per window",
+                     fleet_repads=reg.repads.count,
+                     xla_recompiles_in_bucket=recompiles,
+                     churn_vs_static=round(dt_static / dt_churn, 2)))
+    # Q-sweep amortization through the registry path
+    for m in (1, 8, q):
+        spts = mkpts(m)
+        run_registry(spts)
+        _wn, dt_reg, _ = run_registry(spts)
+        dt_jobs = run_jobs(spts)
+        rows.append(dict(
+            path=f"query_plane_q{m}", queries=m, records=n,
+            wall_s=round(dt_reg, 3),
+            record_x_queries_per_sec=round(n * m / dt_reg),
+            speedup_vs_sequential_jobs=round(dt_jobs / dt_reg, 2)))
+    return rows
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=None,
@@ -466,6 +578,14 @@ def main() -> int:
                          "over the kNN option, identity asserted in-run, "
                          "per-slide readback bytes attached). 0 (default) "
                          "disables them")
+    ap.add_argument("--query-plane", type=int, default=0, metavar="Q",
+                    help="standing-query control plane rows: a Q-query "
+                         "dynamic registry fleet static vs under "
+                         "1-admit+1-retire-per-window churn (rec/s, fleet "
+                         "repads, in-bucket XLA recompiles — must be 0), "
+                         "plus a Q-sweep amortization row through the "
+                         "registry path vs dedicated per-query pipelines. "
+                         "0 (default) disables them")
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
 
@@ -540,6 +660,11 @@ def main() -> int:
                     row["backend"] = backend
                     print(json.dumps(row), flush=True)
                     rows.append(row)
+        if args.query_plane > 1:
+            for row in bench_query_plane(path, n, args.query_plane):
+                row["backend"] = backend
+                print(json.dumps(row), flush=True)
+                rows.append(row)
         if args.pane_overlap > 1:
             for opt in (1, 51):
                 if opt not in [int(x) for x in args.options.split(",")]:

@@ -27,6 +27,12 @@ Rows (identity field ``path``):
                         skew-adaptivity regressions fail tier-1 like the
                         batched-path ratios (window-table identity
                         asserted in-run)
+- ``query_plane``       a Q=8 standing-query fleet served through the
+                        DYNAMIC registry path (one padded Q-axis dispatch
+                        per window) vs Q dedicated single-query pipelines
+                        re-reading the stream — the ISSUE 10 contract:
+                        the control plane must preserve run_multi's
+                        amortization (per-query identity asserted)
 
 Usage:
     python benchmarks/bench_guard.py [--n N] [--out PATH]
@@ -254,9 +260,69 @@ def bench_skew_adaptive(n: int) -> dict:
                 speedup=round(dt_u / dt_a, 2))
 
 
+def bench_query_plane(n: int) -> dict:
+    """Standing-query control plane ratio: a Q=8 DYNAMIC fleet served
+    through the registry path (one padded Q-axis dispatch per window,
+    admissions applied at window boundaries) vs Q dedicated single-query
+    pipelines re-reading the stream — the reference's one-Flink-job-per-
+    query shape. The registry path must preserve run_multi's amortization
+    ON TOP of its lifecycle machinery; per-query window-table identity is
+    asserted so a silently-wrong demux can never pass the gate."""
+    from spatialflink_tpu import driver
+    from spatialflink_tpu.models import Point
+    from spatialflink_tpu.operators import (PointPointRangeQuery,
+                                            QueryConfiguration, QueryType)
+    from spatialflink_tpu.runtime.queryplane import QueryRegistry
+
+    lines = _lines(n)
+    cfg, grid = _cfg(), _grid()
+    conf = QueryConfiguration(QueryType.WindowBased, 10_000, 5_000)
+    rng = np.random.default_rng(3)
+    q = 8
+    pts = [(115.5 + rng.random() * 2, 39.6 + rng.random() * 1.5)
+           for _ in range(q)]
+
+    def registry():
+        reg = QueryRegistry("range", radius=0.5)
+        for i, (x, y) in enumerate(pts):
+            reg.admit({"id": f"q{i}", "x": x, "y": y})
+        reg.apply()
+        return reg
+
+    def run_dynamic():
+        op = PointPointRangeQuery(conf, grid)
+        stream = driver.decode_stream(iter(lines), cfg, grid)
+        return [(w.window_start, tuple(len(r) for r in w.records))
+                for w in op.run_dynamic(stream, registry(), 0.5)]
+
+    def run_dedicated():
+        out = []
+        for x, y in pts:
+            op = PointPointRangeQuery(conf, grid)
+            stream = driver.decode_stream(iter(lines), cfg, grid)
+            out.append([(w.window_start, len(w.records))
+                        for w in op.run(stream,
+                                        Point.create(x, y, grid), 0.5)])
+        return out
+
+    run_dynamic(), run_dedicated()  # warm jit shapes on both sides
+    t0 = time.perf_counter()
+    dyn = run_dynamic()
+    dt_d = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    ded = run_dedicated()
+    dt_s = time.perf_counter() - t0
+    for i in range(q):
+        assert [(ws, c[i]) for ws, c in dyn] == ded[i], \
+            f"dynamic fleet query {i} diverged from its dedicated run"
+    return dict(path="query_plane", records=n, queries=q,
+                speedup=round(dt_s / dt_d, 2))
+
+
 def measure(n: int) -> list:
     return [bench_window_assign(n), bench_decode_columnar(n),
-            bench_windowed_pipeline(n), bench_skew_adaptive(n)]
+            bench_windowed_pipeline(n), bench_skew_adaptive(n),
+            bench_query_plane(n)]
 
 
 def main() -> int:

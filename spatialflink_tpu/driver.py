@@ -571,7 +571,17 @@ def run_option(params: Params, stream1: Iterable, stream2: Optional[Iterable]
             out = op.run(s1, s2, radius)
         else:
             op = cls(conf, u_grid)
-            if params.query.multi_query:
+            registry = getattr(params, "query_registry", None)
+            if registry is not None:
+                # dynamic standing-query plane: the live registry — not the
+                # static config — says what runs (admissions/retirements
+                # land at window boundaries, padded to Q-axis size buckets)
+                if spec.family == "knn":
+                    out = op.run_dynamic(s1, registry, radius,
+                                         params.query.k)
+                else:
+                    out = op.run_dynamic(s1, registry, radius)
+            elif params.query.multi_query:
                 out = _run_multi_case(params, spec, op, s1, u_grid, radius)
             else:
                 q = _query_object(params, u_grid, spec.query)
@@ -1574,6 +1584,30 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "specs whose slide does not divide the size bypass "
                          "the cache (pane-cache-hits/-misses counters show "
                          "the reuse rate)")
+    ap.add_argument("--queries-file", metavar="PATH", default=None,
+                    help="activate the DYNAMIC standing-query plane seeded "
+                         "from a JSON file of query specs ([{'id', 'x', "
+                         "'y', optional 'radius'/'k'/'route'/'slo'}, ...] "
+                         "or {'queries': [...]}): the fleet batches onto "
+                         "the device Q-axis (padded to power-of-two size "
+                         "buckets so admissions repad instead of "
+                         "recompiling) and queries are admitted/updated/"
+                         "retired MID-RUN via POST/DELETE /queries on "
+                         "--status-port and/or a --control-topic, with "
+                         "per-query counters, routes (stdout/file:/"
+                         "kafka:), SLO verdicts, GET /queries, and a "
+                         "'queries' slot in coordinated checkpoints so "
+                         "--resume restores the live fleet. Windowed "
+                         "point-query range (all stream types) and "
+                         "Point/Point kNN")
+    ap.add_argument("--control-topic", metavar="TOPIC", default=None,
+                    help="with --kafka: also consume JSON admit/update/"
+                         "retire control records for the standing-query "
+                         "plane from TOPIC ({'action': 'admit', 'query': "
+                         "{...}} / {'action': 'retire', 'id': ...}), "
+                         "applied at window boundaries (activates the "
+                         "dynamic plane like --queries-file; both may be "
+                         "used together)")
     ap.add_argument("--multi-query", action="store_true",
                     help="answer ALL configured query points/geometries in "
                          "one dispatch per window (run_multi; default keeps "
@@ -1733,6 +1767,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.kafka and args.bulk and args.kafka_follow:
         ap.error("--kafka-follow and --bulk are mutually exclusive "
                  "(bulk is a bounded vectorized drain, not a live stream)")
+    # the dynamic standing-query plane (validated/constructed below, after
+    # the checkpointer exists); the flag participates in the checkpoint
+    # LAYOUT tag — a dynamic run's manifest carries a 'queries' component a
+    # static run could never restore
+    dynamic_queries = bool(args.queries_file or args.control_topic)
     if args.resume and not args.checkpoint_dir:
         ap.error("--resume requires --checkpoint-dir")
     if args.checkpoint_dir:
@@ -1775,6 +1814,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 layout=(f"{spec.family}:{spec.mode}"
                         f":panes={int(bool(params.query.panes))}"
                         f":multi={int(bool(params.query.multi_query))}"
+                        f":dyn={int(dynamic_queries)}"
                         f":{src_id}"))
             if args.resume:
                 try:
@@ -1827,6 +1867,82 @@ def main(argv: Optional[List[str]] = None) -> int:
                   f"{args.adaptive_grid}x{args.adaptive_grid}, repartition "
                   f"epoch every {args.repartition_interval} records "
                   "(layout at /partition)", file=sys.stderr)
+    if dynamic_queries:
+        from spatialflink_tpu.runtime.queryplane import (QueryRegistry,
+                                                         QuerySpec,
+                                                         QuerySpecError,
+                                                         load_queries_file)
+
+        if args.control_topic and not args.kafka:
+            ap.error("--control-topic consumes admissions from the broker "
+                     "and needs --kafka")
+        if (spec.family not in ("range", "knn") or spec.query != "Point"
+                or (spec.family == "knn" and spec.stream != "Point")):
+            ap.error("--queries-file/--control-topic (the dynamic "
+                     "standing-query plane) serve point-query fleets: "
+                     "windowed range over any stream type, and Point/Point "
+                     f"kNN — not queryOption {params.query.option} "
+                     f"({spec.family}, {spec.stream}x{spec.query})")
+        if spec.mode != "window" or params.window.type == "COUNT":
+            ap.error("the dynamic standing-query plane runs event-time "
+                     "windowed cases only (the fleet changes at window "
+                     "boundaries)")
+        if spec.latency:
+            ap.error("the dynamic standing-query plane does not combine "
+                     "with the latency variants (per-record latency "
+                     "assumes single-query record lists)")
+        if args.bulk:
+            ap.error("the dynamic standing-query plane does not compose "
+                     "with --bulk (a whole-replay has no admission "
+                     "boundaries)")
+        if params.query.multi_query:
+            ap.error("--multi-query is subsumed by the query registry "
+                     "(the live fleet IS the multi-query set); drop the "
+                     "flag")
+        if params.query.panes:
+            print("note: --panes is bypassed on the dynamic standing-query "
+                  "path (pane partials are fleet-shaped; a fleet change "
+                  "would serve stale partials) — full-window evaluation",
+                  file=sys.stderr)
+        registry = QueryRegistry(spec.family, radius=params.query.radius,
+                                 k=params.query.k)
+        coord = getattr(params, "checkpointer", None)
+        restored = bool(coord is not None
+                        and registry.register_checkpoint(coord))
+        if restored:
+            print(f"# resume: restored standing-query fleet "
+                  f"(version {registry.fleet_version}, "
+                  f"{len(registry.active_entries())} live)", file=sys.stderr)
+        else:
+            seeds = []
+            try:
+                if args.queries_file:
+                    seeds = load_queries_file(args.queries_file, spec.family)
+            except (OSError, ValueError) as e:
+                ap.error(f"--queries-file: {e}")
+            if not seeds and params.query.query_points:
+                # the config's queryPoints seed the fleet (the registry is
+                # the source of truth for what runs; the static config is
+                # just its time-zero admission batch)
+                seeds = [QuerySpec(id=f"q{i}", family=spec.family, x=x, y=y)
+                         for i, (x, y) in
+                         enumerate(params.query.query_points)]
+            try:
+                for s in seeds:
+                    registry.admit(s)
+            except QuerySpecError as e:
+                ap.error(f"--queries-file: {e}")
+            # seeds serve from window one — dedicated-static-run parity
+            registry.apply()
+        # dynamic attribute, like checkpointer: must not leak into
+        # Params.to_dict()/fingerprints
+        params.query_registry = registry
+        surfaces = ["POST/DELETE /queries (--status-port)"]
+        if args.control_topic:
+            surfaces.append(f"control topic '{args.control_topic}'")
+        print(f"# query plane: dynamic {spec.family} fleet, "
+              f"{len(registry.active_entries())} live "
+              f"(admission via {' + '.join(surfaces)})", file=sys.stderr)
     if not args.kafka and (args.chaos is not None or args.retry is not None
                            or args.dlq or args.seed_scan_limit is not None):
         ap.error("--chaos/--retry/--dlq/--seed-scan-limit wrap the broker "
@@ -1994,6 +2110,22 @@ def _run_cli(ap, args, params: Params, spec: CaseSpec, skip1: int,
         # runs (tests) never leak the chain
         repartitioner.install()
         stack.callback(repartitioner.uninstall)
+    registry = getattr(params, "query_registry", None)
+    router = None
+    if registry is not None:
+        from spatialflink_tpu.runtime.queryplane import (ControlTopicConsumer,
+                                                         QueryRouter)
+
+        # install BEFORE the opserver starts: POST/DELETE/GET /queries
+        # discover the registry through queryplane.active_registry()
+        registry.install()
+        stack.callback(registry.uninstall)
+        if getattr(args, "control_topic", None) and kafka is not None:
+            registry.attach_control(ControlTopicConsumer(
+                kafka.broker, args.control_topic, args.kafka_group))
+        router = QueryRouter(registry, broker=kafka.broker
+                             if kafka is not None else None)
+        stack.callback(router.close)
     if args.profile:
         from spatialflink_tpu.utils.metrics import profile_to
 
@@ -2032,6 +2164,11 @@ def _run_cli(ap, args, params: Params, spec: CaseSpec, skip1: int,
         _emit(result, sink)
         if kafka is not None:
             kafka.emit(result)
+        if (router is not None and isinstance(result, WindowResult)
+                and "query_ids" in result.extras):
+            # per-query demux: counters/SLO verdicts always; non-stdout
+            # routes (file:/kafka:) get one JSON doc per (window, query)
+            router.route(result)
         if out_sink is not None:
             if isinstance(result, WindowResult):
                 for rec in result.flat_records():

@@ -1212,6 +1212,107 @@ class SpatialOperator:
             result.extras["queries"] = n_queries
             yield result
 
+    def _run_dynamic_filter(self, stream: Iterable, registry, radius: float,
+                            multi_mask_builder, batch_builder,
+                            leaf_union_builder=None
+                            ) -> Iterator["WindowResult"]:
+        """Dynamic standing-query driver for FILTER-shaped operators
+        (range): the Q-axis fleet comes from a live
+        :class:`~spatialflink_tpu.runtime.queryplane.QueryRegistry`
+        instead of a frozen query list. Per window:
+
+        1. ``registry.apply()`` lands any staged admissions/updates/
+           retirements (and drains the control topic) — windows are the
+           fleet-change granularity, so a window is never evaluated
+           against a half-applied fleet and checkpoint barriers (also
+           between windows) always snapshot a consistent one;
+        2. on a ``fleet_version`` bump the padded query arrays, the gated
+           multi-mask closure, and the union leaf-mask cache are rebuilt
+           (the same invalidation contract grid-version bumps drive);
+           within a size bucket the rebuild REPADS to identical shapes,
+           so the jitted kernels are cache hits — zero XLA recompiles;
+        3. the (B, N) kernel masks and per-query pruning counters are
+           ANDed/scaled with the (B,) valid-slot gate, forcing padded
+           slots empty, and only the LIVE slots demultiplex into the
+           result — each window carries ``extras['query_ids']`` naming
+           its fleet at dispatch time.
+
+        Pane mode deliberately does not engage here: pane partials are
+        fleet-shaped, and reusing a partial across a fleet change would
+        serve stale queries — full-window evaluation keeps admissions
+        exact."""
+        import jax.numpy as jnp
+
+        state: dict = {"v": -1, "entries": [], "live": 0, "fn": None,
+                       "mask_cache": None}
+
+        def ensure() -> None:
+            if state["v"] == registry.fleet_version:
+                return
+            entries, qpts, valid = registry.padded_fleet(self.grid)
+            fn = mask_cache = None
+            if entries:
+                base_fn = multi_mask_builder(qpts, radius)
+                jvalid = jnp.asarray(valid)
+
+                def fn(b, _base=base_fn, _v=jvalid):
+                    masks, gn_c, evals = _base(b)
+                    # padded slots forced empty: masks AND the valid gate,
+                    # pruning counters scaled by it (a pad slot must not
+                    # inflate gn-bypassed/distance-computations)
+                    return masks & _v[:, None], gn_c * _v, evals * _v
+
+                if leaf_union_builder is not None:
+                    live_pts = qpts[:len(entries)]
+                    mask_cache = self._leaf_mask_cache(
+                        lambda: leaf_union_builder(live_pts))
+            state.update(v=registry.fleet_version, entries=entries,
+                         live=len(entries), fn=fn, mask_cache=mask_cache)
+
+        window_ids: dict = {}
+
+        def eval_batch(records, ts_base):
+            registry.apply()
+            ensure()
+            live = state["live"]
+            window_ids[ts_base] = [e.id for e in state["entries"]]
+            if not live:
+                return []
+            if not records:
+                return [[] for _ in range(live)]
+            keep = None
+            pre = self._prefilter(records, state["mask_cache"], ts_base)
+            if pre is not None:
+                keep, batch = pre
+                if batch is None:
+                    return [[] for _ in range(live)]
+            else:
+                batch = batch_builder(records, ts_base)
+            masks, gn_c, evals = self._multi_filter_stream(batch, state["fn"])
+            take = getattr(records, "take", None)
+            limit = keep.size if keep is not None else len(records)
+
+            def rows(m):
+                m = np.asarray(m)  # ONE (B, N) device->host transfer
+                out = []
+                for q in range(live):
+                    idx = np.nonzero(m[q])[0]
+                    idx = idx[idx < limit]
+                    if keep is not None:
+                        idx = keep[idx]
+                    out.append(take(idx) if take is not None
+                               else [records[int(i)] for i in idx])
+                return out
+
+            return self._defer_with_stats(
+                masks, (jnp.sum(gn_c), jnp.sum(evals)), rows)
+
+        for result in self._drive(stream, eval_batch):
+            ids = window_ids.pop(result.window_start, [])
+            result.extras["query_ids"] = ids
+            result.extras["queries"] = len(ids)
+            yield result
+
     def _multi_results(self, stream: Iterable, eval_batch, *, pane_merge=None,
                        pane_device_merge=None) -> Iterator["WindowResult"]:
         """_drive for multi-query evaluators, whose per-window result is a

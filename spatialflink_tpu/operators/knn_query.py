@@ -255,6 +255,69 @@ class PointPointKNNQuery(SpatialOperator):
             result.extras["queries"] = len(query_points)
             yield result
 
+    def run_dynamic(self, stream: Iterable[Point], registry, radius: float,
+                    k: Optional[int] = None) -> Iterator[WindowResult]:
+        """Standing kNN serving from a live ``QueryRegistry``: the fleet's
+        query points pad to size buckets on the vmapped (B, k) kernel —
+        admissions within a bucket repad instead of recompiling — and
+        only the LIVE slots demultiplex (``extras['query_ids']``), with
+        the per-query distance-evaluation counters gated by the valid
+        mask so padded slots count nothing. Full-window evaluation (no
+        pane partials: they are fleet-shaped — see
+        ``_run_dynamic_filter``'s rationale)."""
+        import numpy as np
+
+        k = k or self.conf.k
+        state: dict = {"v": -1, "entries": [], "live": 0, "local": None,
+                       "jvalid": None}
+
+        def ensure() -> None:
+            if state["v"] == registry.fleet_version:
+                return
+            entries, qpts, valid = registry.padded_fleet(self.grid)
+            local = jvalid = None
+            if entries:
+                local = self._multi_local(qpts, radius, k)
+                jvalid = jnp.asarray(valid)
+            state.update(v=registry.fleet_version, entries=entries,
+                         live=len(entries), local=local, jvalid=jvalid)
+
+        window_ids: dict = {}
+
+        def eval_batch(records, ts_base):
+            registry.apply()
+            ensure()
+            live = state["live"]
+            window_ids[ts_base] = [e.id for e in state["entries"]]
+            if not live:
+                return []
+            if not records:
+                return [[] for _ in range(live)]
+            batch = self._point_batch(records, ts_base)
+            res, evals = self._knn_multi_result(batch, state["local"], k)
+            ri = getattr(records, "interner", None)
+            interner = ri if ri is not None else self.interner
+
+            def rows(r):
+                valid = np.asarray(r.valid)
+                oids = np.asarray(r.obj_id)
+                dists = np.asarray(r.dist)
+                return [
+                    [(interner.lookup(int(o)), float(d))
+                     for o, d in zip(oids[q][valid[q]], dists[q][valid[q]])]
+                    for q in range(live)
+                ]
+
+            return self._defer_with_stats(
+                res, (0, jnp.sum(evals * state["jvalid"])), rows)
+
+        for result in self._drive(stream, eval_batch):
+            ids = window_ids.pop(result.window_start, [])
+            result.extras["query_ids"] = ids
+            result.extras["queries"] = len(ids)
+            result.extras["k"] = k
+            yield result
+
     def _bulk_batches(self, parsed, pad):
         from spatialflink_tpu.streams.bulk import bulk_window_batches
 

@@ -178,6 +178,25 @@ class PointPointRangeQuery(_PointStreamBulkSource, _RangeMultiBulkMixin,
             self._multi_mask_stats(query_points, radius),
             self._point_batch, leaf_mask_builder=union_leaf_mask)
 
+    def run_dynamic(self, stream: Iterable[Point], registry, radius: float
+                    ) -> Iterator[WindowResult]:
+        """Standing-query serving: the Q-axis fleet comes from a live
+        ``runtime.queryplane.QueryRegistry`` — queries admitted/updated/
+        retired MID-RUN take effect at the next window, padded to size
+        buckets so fleet changes within a bucket never recompile, with
+        the adaptive-grid union leaf mask rebuilt on every fleet-version
+        bump (exactly as it is on grid-version bumps)."""
+        ag = self.conf.adaptive_grid
+        leaf_union = None
+        if ag is not None:
+            def leaf_union(pts):
+                return ag.union_neighboring_leaf_mask(
+                    radius, [(p.cell, (p.x, p.y)) for p in pts])
+
+        return self._run_dynamic_filter(
+            stream, registry, radius, self._multi_mask_stats,
+            self._point_batch, leaf_union_builder=leaf_union)
+
     def run_incremental(self, stream: Iterable[Point], query_point: Point,
                         radius: float) -> Iterator[WindowResult]:
         """Incremental sliding windows: carry the previous window's survivors
@@ -394,6 +413,15 @@ class GeomPointRangeQuery(SpatialOperator, GeomQueryMixin,
         return self._run_multi_filter(
             stream, len(query_points),
             self._multi_mask_stats(query_points, radius),
+            self._geom_batch)
+
+    def run_dynamic(self, stream: Iterable, registry, radius: float
+                    ) -> Iterator[WindowResult]:
+        """Standing point-query serving over a geometry STREAM — the same
+        live-registry contract as ``PointPointRangeQuery.run_dynamic``
+        (no leaf prefilter: geometry streams keep their full batch)."""
+        return self._run_dynamic_filter(
+            stream, registry, radius, self._multi_mask_stats,
             self._geom_batch)
 
 

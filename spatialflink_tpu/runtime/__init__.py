@@ -40,6 +40,14 @@ from spatialflink_tpu.runtime.state import (
 )
 from spatialflink_tpu.runtime.health import HealthEvaluator
 from spatialflink_tpu.runtime.opserver import LiveStats, OpServer
+from spatialflink_tpu.runtime.queryplane import (
+    ControlTopicConsumer,
+    QueryRegistry,
+    QueryRouter,
+    QuerySpec,
+    QuerySpecError,
+    QueryState,
+)
 
 __all__ = [
     "CheckpointCoordinator",
@@ -64,4 +72,10 @@ __all__ = [
     "HealthEvaluator",
     "LiveStats",
     "OpServer",
+    "ControlTopicConsumer",
+    "QueryRegistry",
+    "QueryRouter",
+    "QuerySpec",
+    "QuerySpecError",
+    "QueryState",
 ]

@@ -22,7 +22,16 @@ module adds that plane, stdlib-only:
   /trace/recent    newest window-trace summaries (ids + bounds)
   /trace/<id>      one window's full trace lineage (``--trace-dir``)
   /profile/cells   per-cell / per-family cost profiles + time series
+  /queries         GET: the standing-query ledger; POST: admit/update a
+                   query (schema-validated JSON body, lands at the next
+                   window boundary) — the dynamic query plane
+  /queries/<id>    GET: one query's lifecycle record; DELETE: drain it
   =============== ====================================================
+
+Method handling is uniform: a known route hit with a verb outside its
+set answers a JSON ``405`` with an ``Allow:`` header; unknown paths are
+``404`` whatever the verb (http.server's default bare 501 never reaches
+a client for the verbs named here).
 
 - :class:`LiveStats` — a daemon thread printing a one-line stderr digest
   per interval (``--live-stats``; automatic under ``--kafka-follow`` when
@@ -60,6 +69,35 @@ def active_server() -> Optional["OpServer"]:
     return _ACTIVE_SERVER
 
 
+#: known routes -> methods they answer. Exact paths first; prefix routes
+#: (one level of <id>) below. Anything else is 404; a known route hit with
+#: a method outside its set is a JSON 405 carrying an ``Allow:`` header —
+#: BaseHTTPRequestHandler's bare 501 for undefined ``do_<METHOD>``s never
+#: reaches a client for the methods the plane names here.
+_ROUTES = {
+    "/healthz": ("GET",), "/status": ("GET",), "/metrics": ("GET",),
+    "/events": ("GET",), "/trace/recent": ("GET",),
+    "/profile/cells": ("GET",), "/partition": ("GET",),
+    "/queries": ("GET", "POST"),
+}
+_PREFIX_ROUTES = {"/trace/": ("GET",), "/queries/": ("GET", "DELETE")}
+
+_ENDPOINTS = ["/healthz", "/status", "/metrics", "/events", "/trace/recent",
+              "/trace/<id>", "/profile/cells", "/partition", "/queries",
+              "/queries/<id>"]
+
+
+def _allowed_methods(path: str):
+    """The method set a path answers, or None when the path is unknown."""
+    m = _ROUTES.get(path)
+    if m is not None:
+        return m
+    for prefix, pm in _PREFIX_ROUTES.items():
+        if path.startswith(prefix) and len(path) > len(prefix):
+            return pm
+    return None
+
+
 class _Handler(BaseHTTPRequestHandler):
     server_version = "spatialflink-opserver/1"
     protocol_version = "HTTP/1.1"
@@ -67,10 +105,13 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # quiet: stderr belongs to the digest
         pass
 
-    def _send(self, code: int, body: bytes, ctype: str) -> None:
+    def _send(self, code: int, body: bytes, ctype: str,
+              headers: Optional[dict] = None) -> None:
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         # one response per connection: a kept-alive handler loop would
         # survive close() (shutdown() stops only the LISTENER) and keep
         # answering probes after the pipeline exited — the plane must die
@@ -78,52 +119,50 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Connection", "close")
         self.close_connection = True
         self.end_headers()
-        self.wfile.write(body)
+        if self.command != "HEAD":  # HEAD: headers only, per HTTP
+            self.wfile.write(body)
 
-    def _send_json(self, code: int, payload: dict) -> None:
+    def _send_json(self, code: int, payload: dict,
+                   headers: Optional[dict] = None) -> None:
         self._send(code, json.dumps(payload, sort_keys=True).encode(),
-                   "application/json")
+                   "application/json", headers)
 
-    def do_GET(self) -> None:  # noqa: N802 (http.server API)
+    def _read_body(self):
+        """The request body parsed as JSON, or (None, error-payload)."""
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+        except ValueError:
+            length = 0
+        if length <= 0:
+            return None, {"error": "a JSON body is required "
+                                   "(send Content-Length)"}
+        if length > 1 << 20:
+            return None, {"error": "body too large (1 MiB max)"}
+        raw = self.rfile.read(length)
+        try:
+            return json.loads(raw), None
+        except (json.JSONDecodeError, UnicodeDecodeError) as e:
+            return None, {"error": f"invalid JSON body: {e}"}
+
+    def _dispatch(self, method: str) -> None:
         srv: "OpServer" = self.server.opserver  # type: ignore[attr-defined]
         srv.requests_served += 1
         path, _, query = self.path.partition("?")
         path = path.rstrip("/") or "/"
         try:
-            if path == "/healthz":
-                code, payload = srv.healthz_payload()
-                self._send_json(code, payload)
-            elif path == "/status":
-                self._send_json(200, srv.status_payload())
-            elif path == "/metrics":
-                self._send(200, srv.metrics_text().encode(),
-                           "text/plain; version=0.0.4")
-            elif path == "/events":
-                since_raw = parse_qs(query).get("since", [None])[0]
-                try:
-                    since = None if since_raw is None else int(since_raw)
-                except ValueError:
-                    self._send_json(400, {
-                        "error": f"?since must be an integer event seq, "
-                                 f"got {since_raw!r}"})
-                    return
-                self._send_json(200, srv.events_payload(since))
-            elif path == "/trace/recent":
-                self._send_json(200, srv.traces_payload())
-            elif path.startswith("/trace/"):
-                code, payload = srv.trace_payload(
-                    unquote(path[len("/trace/"):]))
-                self._send_json(code, payload)
-            elif path == "/profile/cells":
-                self._send_json(200, srv.profile_cells_payload())
-            elif path == "/partition":
-                self._send_json(200, srv.partition_payload())
-            else:
-                self._send_json(404, {
-                    "error": f"unknown path {path!r}",
-                    "endpoints": ["/healthz", "/status", "/metrics",
-                                  "/events", "/trace/recent", "/trace/<id>",
-                                  "/profile/cells", "/partition"]})
+            allowed = _allowed_methods(path)
+            if allowed is None:
+                self._send_json(404, {"error": f"unknown path {path!r}",
+                                      "endpoints": _ENDPOINTS})
+                return
+            if method not in allowed:
+                # proper JSON 405 with Allow: — not http.server's bare 501
+                self._send_json(
+                    405, {"error": f"method {method} not allowed for "
+                                   f"{path!r}", "allow": list(allowed)},
+                    headers={"Allow": ", ".join(allowed)})
+                return
+            self._route(srv, method, path, query)
         except (BrokenPipeError, ConnectionResetError):
             pass  # client went away mid-write (Ctrl-C'd curl sends RST)
         except Exception as e:
@@ -133,6 +172,76 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(500, {"error": repr(e)})
             except Exception:
                 pass
+
+    def _route(self, srv: "OpServer", method: str, path: str,
+               query: str) -> None:
+        if path == "/healthz":
+            code, payload = srv.healthz_payload()
+            self._send_json(code, payload)
+        elif path == "/status":
+            self._send_json(200, srv.status_payload())
+        elif path == "/metrics":
+            self._send(200, srv.metrics_text().encode(),
+                       "text/plain; version=0.0.4")
+        elif path == "/events":
+            since_raw = parse_qs(query).get("since", [None])[0]
+            try:
+                since = None if since_raw is None else int(since_raw)
+            except ValueError:
+                self._send_json(400, {
+                    "error": f"?since must be an integer event seq, "
+                             f"got {since_raw!r}"})
+                return
+            self._send_json(200, srv.events_payload(since))
+        elif path == "/trace/recent":
+            self._send_json(200, srv.traces_payload())
+        elif path.startswith("/trace/"):
+            code, payload = srv.trace_payload(
+                unquote(path[len("/trace/"):]))
+            self._send_json(code, payload)
+        elif path == "/profile/cells":
+            self._send_json(200, srv.profile_cells_payload())
+        elif path == "/partition":
+            self._send_json(200, srv.partition_payload())
+        elif path == "/queries" and method == "GET":
+            self._send_json(200, srv.queries_payload())
+        elif path == "/queries" and method == "POST":
+            body, err = self._read_body()
+            if err is not None:
+                self._send_json(400, err)
+                return
+            code, payload = srv.admit_query_payload(body)
+            self._send_json(code, payload)
+        elif path.startswith("/queries/"):
+            qid = unquote(path[len("/queries/"):])
+            if method == "DELETE":
+                code, payload = srv.retire_query_payload(qid)
+            else:
+                code, payload = srv.query_payload(qid)
+            self._send_json(code, payload)
+        else:  # unreachable while _ROUTES and this dispatch agree
+            self._send_json(404, {"error": f"unknown path {path!r}",
+                                  "endpoints": _ENDPOINTS})
+
+    # http.server calls do_<METHOD>; everything funnels through _dispatch
+    # so route/method resolution cannot fork per verb
+    def do_GET(self) -> None:  # noqa: N802 (http.server API)
+        self._dispatch("GET")
+
+    def do_POST(self) -> None:  # noqa: N802
+        self._dispatch("POST")
+
+    def do_DELETE(self) -> None:  # noqa: N802
+        self._dispatch("DELETE")
+
+    def do_PUT(self) -> None:  # noqa: N802
+        self._dispatch("PUT")
+
+    def do_PATCH(self) -> None:  # noqa: N802
+        self._dispatch("PATCH")
+
+    def do_HEAD(self) -> None:  # noqa: N802
+        self._dispatch("HEAD")
 
 
 class OpServer:
@@ -240,6 +349,68 @@ class OpServer:
                     "note": "cost profiles need a telemetry session "
                             "(--telemetry-dir / --live-stats / --trace-dir)"}
         return tel.costs.cells_payload()
+
+    # ---------------------- standing-query plane ----------------------- #
+
+    _QUERIES_NOTE = ("no dynamic query registry in this run (enable with "
+                     "--queries-file / --control-topic)")
+
+    @staticmethod
+    def _registry():
+        from spatialflink_tpu.runtime.queryplane import active_registry
+
+        return active_registry()
+
+    def queries_payload(self) -> dict:
+        """``GET /queries``: the live standing-query ledger (fleet slots,
+        lifecycle states, per-query counters/SLO verdicts, fleet version
+        and padding bucket)."""
+        reg = self._registry()
+        if reg is None:
+            return {"queries": [], "live": 0, "note": self._QUERIES_NOTE}
+        return reg.status()
+
+    def query_payload(self, qid: str):
+        """(http_code, payload) for ``GET /queries/<id>``."""
+        reg = self._registry()
+        if reg is None:
+            return 404, {"error": self._QUERIES_NOTE}
+        for row in reg.status()["queries"]:
+            if row["id"] == qid:
+                return 200, row
+        return 404, {"error": f"unknown query {qid!r} (see /queries)"}
+
+    def admit_query_payload(self, body):
+        """(http_code, payload) for ``POST /queries``: admit a new
+        standing query — or stage an update when the id already names a
+        live one. Takes effect at the next window boundary."""
+        from spatialflink_tpu.runtime.queryplane import QuerySpecError
+
+        reg = self._registry()
+        if reg is None:
+            return 409, {"error": self._QUERIES_NOTE}
+        try:
+            entry = reg.admit(body)
+        except QuerySpecError as e:
+            return 400, {"error": str(e)}
+        return 200, {"query": entry.to_dict(),
+                     "fleet_version": reg.fleet_version,
+                     "applies": "at the next window boundary"}
+
+    def retire_query_payload(self, qid: str):
+        """(http_code, payload) for ``DELETE /queries/<id>``: an active
+        query drains (in-flight windows complete), a pending one retires
+        immediately."""
+        reg = self._registry()
+        if reg is None:
+            return 409, {"error": self._QUERIES_NOTE}
+        try:
+            entry = reg.retire(qid)
+        except KeyError:
+            return 404, {"error": f"unknown or already-retired query "
+                                  f"{qid!r} (see /queries)"}
+        return 200, {"query": entry.to_dict(),
+                     "fleet_version": reg.fleet_version}
 
     def partition_payload(self) -> dict:
         """``/partition``: the skew-adaptive grid's live layout, policy

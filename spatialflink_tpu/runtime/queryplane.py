@@ -1,0 +1,737 @@
+"""Standing-query control plane: dynamic multi-tenant query serving.
+
+The reference answers "many standing queries" with many Flink JOBS — one
+pipeline per query object, each re-reading the stream
+(``StreamingJob.java:470``). The rebuild's ``run_multi`` already batches a
+FIXED fleet onto the device Q-axis (7.2-7.4x end-to-end amortization at
+Q=8), but the fleet was frozen at driver launch: adding a monitor meant
+restarting the pipeline. This module makes the fleet DYNAMIC:
+
+- :class:`QuerySpec` / :class:`QueryEntry` — one standing query's
+  validated spec (id, family, point, route, optional per-query SLO) and
+  its lifecycle record (``pending -> active -> draining -> retired``).
+- :class:`QueryRegistry` — the single source of truth for what is
+  running. Admissions/updates/retirements arrive from ANY thread (the
+  opserver's ``POST /queries`` / ``DELETE /queries/<id>``, the Kafka
+  control topic, in-process calls); they take effect only at
+  :meth:`QueryRegistry.apply`, which the dynamic drive loop calls at
+  window boundaries (= decode-chunk boundaries) — so the fleet never
+  changes mid-window, emission granularity is preserved, and checkpoint
+  barriers (which also sit between windows) always see a consistent
+  fleet. Each applied change bumps the monotonic ``fleet_version``; the
+  operators rebuild their padded query arrays and invalidate per-query
+  mask caches on the bump, exactly as grid-version bumps invalidate the
+  adaptive-grid leaf masks.
+- Size-bucket padding — :func:`bucket_size` pads the active fleet to the
+  next power of two, so admissions/retirements within a bucket REPAD
+  (same array shapes, XLA jit-cache hit) instead of recompiling; padded
+  slots are forced empty by the (Q,)-valid gate the dynamic evaluators
+  apply to masks and pruning counters.
+- :class:`ControlTopicConsumer` — the Kafka admission surface: JSON
+  admit/update/retire records on ``--control-topic``, drained inside
+  :meth:`QueryRegistry.apply` (so control consumption shares the
+  window-boundary cadence), position carried in the checkpoint so a
+  resume does not replay control history it already applied.
+- :class:`QueryRouter` — per-query result demultiplexing: each window's
+  per-query record lists fan out to the query's declared route
+  (``stdout`` | ``file:<path>`` | ``kafka:<topic>``) with per-query
+  ``windows-emitted@<id>`` / ``records-out@<id>`` counters (rendered as
+  ``query="<id>"`` Prometheus labels) and the per-query SLO verdict.
+- Checkpoint component ``queries`` — the registry registers with the
+  coordinated checkpointer, so ``--resume`` restores the LIVE fleet,
+  including mid-drain queries and the control-topic position.
+
+The registry is deliberately transport-agnostic: it never touches the
+broker or HTTP itself — surfaces push into it, the drive loop pulls from
+it.
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from spatialflink_tpu.utils import metrics as _metrics
+
+#: the one registry the current process runs (the driver installs at most
+#: one) — how the opserver's POST/DELETE/GET /queries surface finds it
+_ACTIVE: Optional["QueryRegistry"] = None
+
+
+def active_registry() -> Optional["QueryRegistry"]:
+    """The process's installed :class:`QueryRegistry`, or None."""
+    return _ACTIVE
+
+
+def bucket_size(n: int) -> int:
+    """Fleet padding bucket: the next power of two >= ``n`` (min 1).
+    Kernel shapes depend on the PADDED Q axis, so any fleet change within
+    a bucket reuses the jitted kernels — zero XLA recompiles."""
+    if n <= 1:
+        return 1
+    return 1 << (n - 1).bit_length()
+
+
+class QuerySpecError(ValueError):
+    """A query spec failed schema validation (bad/missing field, family
+    mismatch, unservable k/radius). Carried verbatim to the admission
+    surface (HTTP 400 / control-record reject)."""
+
+
+class QueryState(enum.Enum):
+    PENDING = "pending"      # admitted, joins the fleet at the next apply()
+    ACTIVE = "active"        # serving: owns a slot on the device Q-axis
+    DRAINING = "draining"    # retirement requested; serves until apply()
+    RETIRED = "retired"      # left the fleet
+
+
+_FAMILIES = ("range", "knn")
+_ROUTE_PREFIXES = ("stdout", "file:", "kafka:")
+_SLO_KEYS = ("min_window_records", "max_window_records")
+
+
+@dataclass
+class QuerySpec:
+    """One standing query, as admitted over the wire. ``x``/``y`` are the
+    query point (the dynamic plane serves point-query range/kNN — the
+    Q-axis batched families); ``radius``/``k`` default to the run's values
+    and, because the fleet shares ONE kernel dispatch, must match them
+    when given. ``route`` names where this query's windows go; ``slo`` is
+    an optional per-query verdict spec over per-window record counts."""
+
+    id: str
+    family: str
+    x: float
+    y: float
+    radius: Optional[float] = None
+    k: Optional[int] = None
+    route: str = "stdout"
+    slo: Optional[Dict[str, float]] = None
+
+    def to_dict(self) -> dict:
+        d = {"id": self.id, "family": self.family, "x": self.x, "y": self.y,
+             "route": self.route}
+        if self.radius is not None:
+            d["radius"] = self.radius
+        if self.k is not None:
+            d["k"] = self.k
+        if self.slo:
+            d["slo"] = dict(self.slo)
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Any, *, default_family: Optional[str] = None
+                  ) -> "QuerySpec":
+        """Schema-validated build — every admission surface (POST body,
+        control record, ``--queries-file`` entry) funnels through here so
+        a malformed query is rejected with the SAME named-field error
+        everywhere."""
+        if not isinstance(d, dict):
+            raise QuerySpecError(f"query spec must be an object, got "
+                                 f"{type(d).__name__}")
+        unknown = set(d) - {"id", "family", "x", "y", "radius", "k",
+                            "route", "slo"}
+        if unknown:
+            raise QuerySpecError(f"unknown query field(s) "
+                                 f"{sorted(unknown)}")
+        qid = d.get("id")
+        if not isinstance(qid, str) or not qid or len(qid) > 128:
+            raise QuerySpecError("'id' must be a non-empty string "
+                                 "(<= 128 chars)")
+        family = d.get("family", default_family)
+        if family not in _FAMILIES:
+            raise QuerySpecError(f"'family' must be one of {_FAMILIES}, "
+                                 f"got {family!r}")
+        try:
+            x, y = float(d["x"]), float(d["y"])
+        except (KeyError, TypeError, ValueError):
+            raise QuerySpecError("'x' and 'y' must be numeric coordinates")
+        radius = d.get("radius")
+        if radius is not None:
+            try:
+                radius = float(radius)
+            except (TypeError, ValueError):
+                raise QuerySpecError("'radius' must be numeric")
+        k = d.get("k")
+        if k is not None:
+            try:
+                k = int(k)
+            except (TypeError, ValueError):
+                raise QuerySpecError("'k' must be an integer")
+        route = d.get("route", "stdout")
+        if (not isinstance(route, str)
+                or not route.startswith(_ROUTE_PREFIXES)
+                or route.startswith(("file:", "kafka:")) and
+                len(route.split(":", 1)[1]) == 0):
+            raise QuerySpecError(
+                "'route' must be 'stdout', 'file:<path>', or "
+                f"'kafka:<topic>', got {route!r}")
+        slo = d.get("slo")
+        if slo is not None:
+            if (not isinstance(slo, dict)
+                    or not slo
+                    or set(slo) - set(_SLO_KEYS)):
+                raise QuerySpecError(
+                    f"'slo' must be a non-empty object over {_SLO_KEYS}")
+            try:
+                slo = {sk: float(sv) for sk, sv in slo.items()}
+            except (TypeError, ValueError):
+                raise QuerySpecError("'slo' thresholds must be numeric")
+        return cls(id=qid, family=family, x=x, y=y, radius=radius, k=k,
+                   route=route, slo=slo)
+
+
+@dataclass
+class QueryEntry:
+    """One query's lifecycle record inside the registry."""
+
+    spec: QuerySpec
+    state: QueryState = QueryState.PENDING
+    #: spec staged by update(); swapped in at the next apply()
+    pending_spec: Optional[QuerySpec] = field(default=None, repr=False)
+    admitted_ms: int = 0
+    retired_ms: Optional[int] = None
+    #: fleet_version at which the entry last joined/changed in the fleet
+    since_version: int = 0
+    #: per-query SLO bookkeeping (verdict over per-window record counts)
+    slo_ok: Optional[bool] = None
+    slo_breaches: int = 0
+
+    @property
+    def id(self) -> str:
+        return self.spec.id
+
+    @property
+    def serving(self) -> bool:
+        """In the fleet right now (draining queries still serve — they
+        leave only at the next apply)."""
+        return self.state in (QueryState.ACTIVE, QueryState.DRAINING)
+
+    def to_dict(self) -> dict:
+        d = {"id": self.id, "state": self.state.value,
+             "spec": self.spec.to_dict(),
+             "admitted_ms": self.admitted_ms,
+             "since_version": self.since_version,
+             "windows_emitted":
+                 _metrics.REGISTRY.counter(f"windows-emitted@{self.id}").count,
+             "records_out":
+                 _metrics.REGISTRY.counter(f"records-out@{self.id}").count}
+        if self.retired_ms is not None:
+            d["retired_ms"] = self.retired_ms
+        if self.spec.slo:
+            d["slo"] = {"ok": self.slo_ok, "breaches": self.slo_breaches}
+        return d
+
+
+class QueryRegistry:
+    """The run's standing-query fleet: lifecycle, Q-axis padding contract,
+    checkpoint component, and the apply-at-window-boundary admission
+    discipline (see the module docstring).
+
+    ``family``/``radius``/``k`` are the RUN's values: the whole fleet
+    shares one kernel dispatch per window, hence one family, one radius
+    and one k (specs may omit them, or restate them exactly — anything
+    else is rejected at admission, loudly, instead of silently serving a
+    different query than asked)."""
+
+    def __init__(self, family: str, *, radius: float = 0.0,
+                 k: Optional[int] = None, retain_retired: int = 64):
+        if family not in _FAMILIES:
+            raise ValueError(f"family must be one of {_FAMILIES}")
+        self.family = family
+        self.radius = float(radius)
+        self.k = k
+        self._lock = threading.RLock()
+        self._entries: Dict[str, QueryEntry] = {}
+        #: ACTIVE/DRAINING ids in slot (admission) order — the Q-axis
+        self._fleet: List[str] = []
+        self._version = 0
+        self._dirty = False
+        self._retired: List[str] = []
+        self._retain_retired = retain_retired
+        self._control: Optional["ControlTopicConsumer"] = None
+        #: control position restored from a checkpoint before the consumer
+        #: existed; applied at attach_control
+        self._restored_control_pos: Optional[int] = None
+        self.repads = _metrics.REGISTRY.counter("fleet-repads")
+
+    # ------------------------------ admission ------------------------- #
+
+    def _validate(self, spec: QuerySpec) -> QuerySpec:
+        if spec.family != self.family:
+            raise QuerySpecError(
+                f"query {spec.id!r}: family {spec.family!r} does not match "
+                f"this run's pipeline family {self.family!r} (one pipeline "
+                "serves one family; run a second driver for the other)")
+        if spec.radius is not None and spec.radius != self.radius:
+            raise QuerySpecError(
+                f"query {spec.id!r}: radius {spec.radius} != the fleet "
+                f"radius {self.radius} (the Q-axis shares one candidate-"
+                "layer geometry; omit 'radius' to inherit it)")
+        if self.family == "knn" and spec.k is not None and spec.k != self.k:
+            raise QuerySpecError(
+                f"query {spec.id!r}: k={spec.k} != the fleet k={self.k} "
+                "(the Q-axis shares one top-k width; omit 'k' to inherit)")
+        return spec
+
+    def admit(self, spec) -> QueryEntry:
+        """Admit a new standing query (PENDING until the next apply), or —
+        when the id already names a live query — stage an UPDATE of it.
+        Thread-safe; callable from any surface."""
+        if not isinstance(spec, QuerySpec):
+            spec = QuerySpec.from_dict(spec, default_family=self.family)
+        self._validate(spec)
+        with self._lock:
+            cur = self._entries.get(spec.id)
+            if cur is not None and cur.state is not QueryState.RETIRED:
+                return self._stage_update(cur, spec)
+            entry = QueryEntry(spec=spec, admitted_ms=int(time.time() * 1000))
+            self._entries[spec.id] = entry
+            self._dirty = True
+        _metrics.REGISTRY.counter("queries-admitted").inc()
+        _emit("query-admitted", id=spec.id, route=spec.route)
+        return entry
+
+    def update(self, qid: str, changes: dict) -> QueryEntry:
+        """Stage an update of a live query (new spec takes effect at the
+        next apply — the same window-boundary discipline as admission)."""
+        with self._lock:
+            entry = self._entries.get(qid)
+            if entry is None or entry.state is QueryState.RETIRED:
+                raise KeyError(qid)
+            merged = entry.spec.to_dict()
+            merged.update(changes or {})
+            merged["id"] = qid
+            spec = self._validate(
+                QuerySpec.from_dict(merged, default_family=self.family))
+            return self._stage_update(entry, spec)
+
+    def _stage_update(self, entry: QueryEntry, spec: QuerySpec
+                      ) -> QueryEntry:
+        with self._lock:
+            entry.pending_spec = spec
+            self._dirty = True
+        _metrics.REGISTRY.counter("queries-updated").inc()
+        _emit("query-updated", id=entry.id)
+        return entry
+
+    def retire(self, qid: str) -> QueryEntry:
+        """Request retirement: an ACTIVE query turns DRAINING (it keeps
+        serving until the next apply — in-flight windows complete under
+        the old fleet); a still-PENDING query retires immediately."""
+        with self._lock:
+            entry = self._entries.get(qid)
+            if entry is None or entry.state is QueryState.RETIRED:
+                raise KeyError(qid)
+            if entry.state is QueryState.PENDING:
+                self._retire_now(entry)
+            elif entry.state is QueryState.ACTIVE:
+                entry.state = QueryState.DRAINING
+                self._dirty = True
+                _emit("query-draining", id=qid)
+        _metrics.REGISTRY.counter("queries-retired").inc()
+        return entry
+
+    def _retire_now(self, entry: QueryEntry) -> None:
+        entry.state = QueryState.RETIRED
+        entry.retired_ms = int(time.time() * 1000)
+        entry.pending_spec = None
+        self._retired.append(entry.id)
+        _emit("query-retired", id=entry.id)
+        # bound the retired ledger (ids stay queryable for a while so a
+        # DELETE/GET race reads "retired", not 404)
+        while len(self._retired) > self._retain_retired:
+            dead = self._retired.pop(0)
+            self._entries.pop(dead, None)
+
+    # ------------------------------ the fleet ------------------------- #
+
+    @property
+    def fleet_version(self) -> int:
+        """Monotonic stamp of the ACTIVE fleet composition. Operators cache
+        their padded query arrays under it and rebuild on a bump — the
+        same invalidation contract the adaptive grid's ``version`` gives
+        the leaf-mask caches."""
+        return self._version
+
+    def apply(self) -> bool:
+        """The ONE place fleet changes land, called by the dynamic drive
+        loop between windows (= at decode-chunk boundaries): drain the
+        control topic, then transition pending->active, draining->retired,
+        and swap staged updates. Returns True when the fleet changed
+        (fleet_version bumped)."""
+        if self._control is not None:
+            self._control.poll(self)
+        with self._lock:
+            if not self._dirty:
+                return False
+            changed = False
+            for entry in list(self._entries.values()):
+                if entry.state is QueryState.PENDING:
+                    entry.state = QueryState.ACTIVE
+                    entry.since_version = self._version + 1
+                    self._fleet.append(entry.id)
+                    changed = True
+                    _emit("query-active", id=entry.id)
+                elif entry.state is QueryState.DRAINING:
+                    self._fleet.remove(entry.id)
+                    self._retire_now(entry)
+                    changed = True
+                elif (entry.state is QueryState.ACTIVE
+                        and entry.pending_spec is not None):
+                    entry.spec = entry.pending_spec
+                    entry.pending_spec = None
+                    entry.since_version = self._version + 1
+                    changed = True
+            self._dirty = False
+            if changed:
+                self._version += 1
+                self.repads.inc()
+            return changed
+
+    def active_entries(self) -> List[QueryEntry]:
+        """The serving fleet in slot order (ACTIVE + DRAINING — a draining
+        query keeps its slot until the next apply)."""
+        with self._lock:
+            return [self._entries[q] for q in self._fleet]
+
+    def padded_fleet(self, grid) -> Tuple[List[QueryEntry], list, Any]:
+        """``(entries, padded_query_points, valid)`` for the device Q-axis:
+        the live fleet's query Points padded to :func:`bucket_size` with
+        copies of the last live point (shape filler only — ``valid`` is
+        the (B,) bool mask the evaluators AND into the kernel masks and
+        pruning counters, forcing padded slots empty)."""
+        import numpy as np
+
+        from spatialflink_tpu.models import Point
+
+        entries = self.active_entries()
+        pts = [Point.create(e.spec.x, e.spec.y, grid) for e in entries]
+        b = bucket_size(len(pts))
+        valid = np.zeros(b, bool)
+        valid[:len(pts)] = True
+        while pts and len(pts) < b:
+            pts.append(pts[-1])
+        return entries, pts, valid
+
+    # ------------------------------ surfaces -------------------------- #
+
+    def attach_control(self, consumer: "ControlTopicConsumer") -> None:
+        """Wire the Kafka control-topic consumer (drained inside apply).
+        A checkpoint-restored control position seeks the consumer first,
+        so resumed runs do not replay control records the restored fleet
+        already reflects."""
+        if self._restored_control_pos is not None:
+            consumer.seek(self._restored_control_pos)
+            self._restored_control_pos = None
+        self._control = consumer
+
+    def note_window(self, entry: QueryEntry, n_records: int) -> None:
+        """Per-query accounting for one demuxed window: the always-on
+        counters (rendered as ``query="<id>"`` Prometheus labels), the
+        per-query record-count histogram when a session is active, and
+        the per-query SLO verdict."""
+        from spatialflink_tpu.utils import telemetry as _telemetry
+
+        qid = entry.id
+        _metrics.REGISTRY.counter(f"windows-emitted@{qid}").inc()
+        _metrics.REGISTRY.counter(f"records-out@{qid}").inc(n_records)
+        tel = _telemetry.active()
+        if tel is not None:
+            tel.histogram(f"window-records@{qid}").record(n_records)
+        slo = entry.spec.slo
+        if slo:
+            ok = True
+            if "min_window_records" in slo and \
+                    n_records < slo["min_window_records"]:
+                ok = False
+            if "max_window_records" in slo and \
+                    n_records > slo["max_window_records"]:
+                ok = False
+            if ok is not entry.slo_ok:
+                if not ok:
+                    entry.slo_breaches += 1
+                    _metrics.REGISTRY.counter("query-slo-breaches").inc()
+                    _emit("query-slo-breach", id=qid, records=n_records)
+                elif entry.slo_ok is False:
+                    _emit("query-slo-recovered", id=qid)
+                entry.slo_ok = ok
+
+    def status(self) -> dict:
+        """The ``GET /queries`` payload: the full ledger (live + recently
+        retired), fleet composition, version, and the padding contract."""
+        with self._lock:
+            entries = [e.to_dict() for e in self._entries.values()]
+            fleet = list(self._fleet)
+        live = len(fleet)
+        return {"family": self.family, "radius": self.radius, "k": self.k,
+                "fleet_version": self._version,
+                "fleet": fleet, "live": live,
+                "bucket": bucket_size(live),
+                "queries": entries,
+                "control_position":
+                    None if self._control is None else self._control.position}
+
+    # ------------------------------ checkpoint ------------------------ #
+
+    def register_checkpoint(self, coordinator) -> bool:
+        """Register as coordinated-checkpoint component ``queries``;
+        returns True when a loaded manifest restored a fleet (the caller
+        then skips seeding — the restored fleet IS the source of truth)."""
+        return coordinator.register(
+            "queries", lambda: ({}, self.snapshot()),
+            lambda _arrays, meta: self.restore(meta))
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "fleet_version": self._version,
+                "fleet": list(self._fleet),
+                "entries": [
+                    {"spec": e.spec.to_dict(), "state": e.state.value,
+                     "pending_spec": (e.pending_spec.to_dict()
+                                      if e.pending_spec else None),
+                     "admitted_ms": e.admitted_ms,
+                     "since_version": e.since_version}
+                    for e in self._entries.values()
+                    if e.state is not QueryState.RETIRED],
+                "control_pos":
+                    None if self._control is None else self._control.position,
+            }
+
+    def restore(self, meta: dict) -> None:
+        """Rebuild the live fleet — including mid-drain entries and staged
+        updates — from a checkpoint component."""
+        with self._lock:
+            self._entries = {}
+            for row in meta.get("entries", []):
+                spec = QuerySpec.from_dict(row["spec"],
+                                           default_family=self.family)
+                entry = QueryEntry(
+                    spec=spec, state=QueryState(row["state"]),
+                    admitted_ms=int(row.get("admitted_ms", 0)),
+                    since_version=int(row.get("since_version", 0)))
+                if row.get("pending_spec"):
+                    entry.pending_spec = QuerySpec.from_dict(
+                        row["pending_spec"], default_family=self.family)
+                self._entries[entry.id] = entry
+            self._fleet = [q for q in meta.get("fleet", [])
+                           if q in self._entries]
+            self._version = int(meta.get("fleet_version", 0))
+            # pending admissions / drains staged before the checkpoint
+            # still need an apply on resume
+            self._dirty = any(
+                e.state in (QueryState.PENDING, QueryState.DRAINING)
+                or e.pending_spec is not None
+                for e in self._entries.values())
+            pos = meta.get("control_pos")
+            if pos is not None:
+                if self._control is not None:
+                    self._control.seek(int(pos))
+                else:
+                    self._restored_control_pos = int(pos)
+        _metrics.REGISTRY.counter("queries-restored").inc(len(self._entries))
+
+    # ------------------------------ lifecycle ------------------------- #
+
+    def install(self) -> "QueryRegistry":
+        global _ACTIVE
+        _ACTIVE = self
+        return self
+
+    def uninstall(self) -> None:
+        global _ACTIVE
+        if _ACTIVE is self:
+            _ACTIVE = None
+
+
+def _emit(kind: str, **fields) -> None:
+    """Lifecycle events onto the existing /events ring (no-op without a
+    telemetry session — same contract as every other event producer)."""
+    from spatialflink_tpu.utils.telemetry import emit_event
+
+    emit_event(kind, **fields)
+
+
+# --------------------------------------------------------------------- #
+# the Kafka control topic
+
+
+class ControlTopicConsumer:
+    """Admission surface #2: a control TOPIC interleaved with the data
+    plane. Records are JSON objects::
+
+        {"action": "admit",  "query": {"id": "q9", "x": ..., "y": ...}}
+        {"action": "update", "id": "q9", "query": {"route": "kafka:out9"}}
+        {"action": "retire", "id": "q9"}
+
+    ``poll`` (called inside ``QueryRegistry.apply`` — i.e. at window/chunk
+    boundaries) drains new records and applies them; malformed or
+    rejected records count on ``control-records-rejected`` and emit a
+    ``control-record-rejected`` event instead of crashing the pipeline (a
+    bad admission must not take down the queries already serving). The
+    position commits to the consumer group after each poll and rides the
+    ``queries`` checkpoint component, so a resume continues where the
+    restored fleet left off."""
+
+    def __init__(self, broker, topic: str, group: str = "spatialflink"):
+        self.broker = broker
+        self.topic = topic
+        self.group = group + "-control"
+        self.position = int(broker.committed(topic, self.group))
+        self.applied = 0
+
+    def seek(self, position: int) -> None:
+        self.position = int(position)
+
+    def poll(self, registry: "QueryRegistry") -> int:
+        """Drain and apply every control record past ``position``; returns
+        the number applied."""
+        n = 0
+        while True:
+            try:
+                batch = self.broker.fetch(self.topic, self.position, 256)
+            except Exception as e:
+                # transport trouble on the CONTROL plane must not stall the
+                # data plane; the next poll retries from the same position
+                _metrics.REGISTRY.counter("control-fetch-errors").inc()
+                _emit("control-fetch-error", error=str(e)[:200])
+                return n
+            if not batch:
+                break
+            for rec in batch:
+                self.position = rec.offset + 1
+                n += self._apply_one(registry, rec.value)
+        if n:
+            self.broker.commit(self.topic, self.group, self.position)
+            self.applied += n
+        return n
+
+    def _apply_one(self, registry: "QueryRegistry", value) -> int:
+        try:
+            d = json.loads(value) if isinstance(value, (str, bytes)) else value
+            if not isinstance(d, dict):
+                raise QuerySpecError("control record must be a JSON object")
+            action = d.get("action")
+            if action == "admit":
+                registry.admit(d.get("query"))
+            elif action == "update":
+                qid = d.get("id") or (d.get("query") or {}).get("id")
+                if not qid:
+                    raise QuerySpecError("'update' needs an 'id'")
+                registry.update(qid, d.get("query") or {})
+            elif action == "retire":
+                if not d.get("id"):
+                    raise QuerySpecError("'retire' needs an 'id'")
+                registry.retire(d["id"])
+            else:
+                raise QuerySpecError(
+                    f"'action' must be admit/update/retire, got {action!r}")
+            return 1
+        except KeyError as e:
+            self._reject(f"unknown query id {e}", value)
+        except (QuerySpecError, json.JSONDecodeError,
+                UnicodeDecodeError) as e:
+            self._reject(str(e), value)
+        return 0
+
+    def _reject(self, reason: str, value) -> None:
+        _metrics.REGISTRY.counter("control-records-rejected").inc()
+        _emit("control-record-rejected", reason=reason[:200])
+        print(f"warning: control topic {self.topic!r}: rejected record "
+              f"({reason}): {str(value)[:120]}", file=sys.stderr)
+
+
+# --------------------------------------------------------------------- #
+# per-query result routing
+
+
+class QueryRouter:
+    """Demultiplex one dynamic window's per-query record lists to each
+    query's declared route. ``stdout`` queries ride the driver's normal
+    result emission (the router only does the accounting); ``file:<path>``
+    appends one JSON line per (window, query); ``kafka:<topic>`` produces
+    the same document to the topic. Routes resolve lazily and are shared
+    across queries naming the same target."""
+
+    def __init__(self, registry: "QueryRegistry", broker=None):
+        self.registry = registry
+        self.broker = broker
+        self._files: Dict[str, Any] = {}
+        self.routed = _metrics.REGISTRY.counter("query-windows-routed")
+
+    @staticmethod
+    def _doc(qid: str, result, recs: list) -> str:
+        from spatialflink_tpu.models import SpatialObject
+        from spatialflink_tpu.streams.formats import serialize_spatial
+
+        out = []
+        for r in recs:
+            if isinstance(r, SpatialObject):
+                out.append(serialize_spatial(r, "GeoJSON"))
+            elif isinstance(r, tuple):  # kNN (objID, distance)
+                out.append([r[0], float(r[1])])
+            else:
+                out.append(str(r))
+        return json.dumps({
+            "query": qid,
+            "window": [result.window_start, result.window_end],
+            "count": len(recs), "records": out}, sort_keys=True)
+
+    def route(self, result) -> None:
+        """Account + fan out one WindowResult carrying
+        ``extras['query_ids']`` (the dynamic drive loop's contract)."""
+        ids = result.extras.get("query_ids") or []
+        entries = {e.id: e for e in self.registry.active_entries()}
+        for qid, recs in zip(ids, result.records):
+            entry = entries.get(qid)
+            if entry is None:
+                continue  # retired between dispatch and readback
+            self.registry.note_window(entry, len(recs))
+            route = entry.spec.route
+            if route == "stdout":
+                continue  # the driver's normal sinks already carry it
+            self.routed.inc()
+            doc = self._doc(qid, result, recs)
+            if route.startswith("file:"):
+                path = route[5:]
+                f = self._files.get(path)
+                if f is None:
+                    f = self._files[path] = open(path, "a")
+                f.write(doc + "\n")
+                f.flush()
+            elif route.startswith("kafka:") and self.broker is not None:
+                self.broker.produce(route[6:], doc)
+
+    def close(self) -> None:
+        for f in self._files.values():
+            try:
+                f.close()
+            except OSError:
+                pass
+        self._files.clear()
+
+
+def load_queries_file(path: str, family: str) -> List[QuerySpec]:
+    """Parse a ``--queries-file``: a JSON array of query specs, or an
+    object ``{"queries": [...]}``. Validation errors name the offending
+    entry."""
+    with open(path) as f:
+        data = json.load(f)
+    if isinstance(data, dict):
+        data = data.get("queries", [])
+    if not isinstance(data, list):
+        raise QuerySpecError(f"{path}: expected a JSON array of query "
+                             "specs (or {'queries': [...]})")
+    out = []
+    for i, d in enumerate(data):
+        try:
+            out.append(QuerySpec.from_dict(d, default_family=family))
+        except QuerySpecError as e:
+            raise QuerySpecError(f"{path}: query[{i}]: {e}")
+    return out

@@ -1118,15 +1118,30 @@ def prometheus_text(tel: Optional[Telemetry] = None,
         return f'stage="{name}"'
 
     def hist_labels(name: str, extra: str = "") -> str:
-        family, sep, base = name.rpartition(".")
-        lab = (f'name="{base}",family="{family}"' if sep
-               else f'name="{name}"')
+        # per-query instruments ride a '<base>@<query-id>' naming
+        # convention (the standing-query plane's counters/histograms):
+        # split into a PROPER query="<id>" label — the same treatment the
+        # family-scoped '<family>.<base>' names get — so scrapes can
+        # aggregate across the fleet (sum by (name)) or follow one query
+        base, qsep, qid = name.partition("@")
+        family, sep, leaf = base.rpartition(".")
+        lab = (f'name="{leaf}",family="{family}"' if sep
+               else f'name="{base}"')
+        if qsep:
+            lab += f',query="{qid}"'
         return lab + extra
+
+    def counter_labels(name: str) -> str:
+        base, qsep, qid = name.partition("@")
+        if qsep:
+            return f'name="{base}",query="{qid}"'
+        return f'name="{name}"'
 
     if tel is None:
         reg = registry if registry is not None else _metrics.REGISTRY
         emit("spatialflink_counter", "counter",
-             [(f'name="{n}"', v) for n, v in sorted(reg.snapshot().items())])
+             [(counter_labels(n), v)
+              for n, v in sorted(reg.snapshot().items())])
         return "\n".join(lines) + "\n"
 
     snap_reg = tel._registry()
@@ -1154,9 +1169,10 @@ def prometheus_text(tel: Optional[Telemetry] = None,
                           round(h.percentile(q), 6)))
     emit("spatialflink_histogram_quantile", "gauge", qrows)
     emit("spatialflink_gauge", "gauge",
-         [(f'name="{n}"', g.get()) for n, g in sorted(gauges.items())])
+         [(counter_labels(n), g.get()) for n, g in sorted(gauges.items())])
     emit("spatialflink_counter", "counter",
-         [(f'name="{n}"', v) for n, v in sorted(snap_reg.snapshot().items())])
+         [(counter_labels(n), v)
+          for n, v in sorted(snap_reg.snapshot().items())])
     return "\n".join(lines) + "\n"
 
 

@@ -26,7 +26,7 @@ def test_bench_guard_passes_thresholds():
             if ln.startswith("{")]
     assert [x["path"] for x in rows] == [
         "window_assign", "decode_columnar", "windowed_pipeline",
-        "skew_adaptive"], r.stdout
+        "skew_adaptive", "query_plane"], r.stdout
     assert all(x["speedup"] > 0 for x in rows)
     assert r.returncode == 0, (
         f"bench_guard regression:\n{r.stdout}\n{r.stderr[-1000:]}")
@@ -38,7 +38,7 @@ def test_guard_baseline_rows_exist():
     assert base["metric"] == "speedup"
     assert {r["path"] for r in base["rows"]} == {
         "window_assign", "decode_columnar", "windowed_pipeline",
-        "skew_adaptive"}
+        "skew_adaptive", "query_plane"}
     # the floors assert the batched path (and the skew-adaptive grid on
     # the clustered stream) is actually FASTER than its baseline
     assert all(r["speedup"] >= 1.0 for r in base["rows"])

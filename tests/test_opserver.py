@@ -237,6 +237,101 @@ class TestOpServer:
             urllib.request.urlopen(
                 f"http://127.0.0.1:{port}/status", timeout=1)
 
+    def test_method_handling_405_with_allow_and_404_elsewhere(self):
+        """Satellite fix: non-GET on a known route must be a proper JSON
+        405 carrying ``Allow:`` (NOT http.server's default bare 501), an
+        unknown path is 404 whatever the method, and the mutating
+        /queries verbs answer for real."""
+
+        def req(url, method, body=None):
+            r = urllib.request.Request(
+                url, method=method,
+                data=None if body is None else json.dumps(body).encode())
+            try:
+                resp = urllib.request.urlopen(r, timeout=3)
+                code, raw, hdrs = resp.status, resp.read(), resp.headers
+            except urllib.error.HTTPError as e:
+                code, raw, hdrs = e.code, e.read(), e.headers
+            payload = (json.loads(raw)
+                       if raw and "json" in hdrs.get("Content-Type", "")
+                       else None)  # HEAD responses carry headers only
+            return code, payload, hdrs
+
+        srv = OpServer(port=0).start()
+        try:
+            u = srv.url
+            # known GET-only routes: JSON 405 + Allow for every other verb
+            for path in ("/status", "/healthz", "/metrics", "/events",
+                         "/partition", "/trace/recent", "/trace/some-id",
+                         "/profile/cells"):
+                for method in ("POST", "DELETE", "PUT", "PATCH", "HEAD"):
+                    code, payload, hdrs = req(u + path, method, body={})
+                    assert code == 405, (path, method, code)
+                    assert hdrs.get("Allow") == "GET", (path, method)
+                    if method != "HEAD":  # HEAD: headers only
+                        assert payload["allow"] == ["GET"]
+                        assert path in payload["error"]
+            # unknown paths: 404 for ANY method, with the endpoint list
+            for method in ("GET", "POST", "DELETE", "PUT", "PATCH"):
+                code, payload, _ = req(u + "/wat", method, body={})
+                assert code == 404 and "/queries" in payload["endpoints"]
+            # /queries knows GET+POST; DELETE lives on /queries/<id>
+            code, _, hdrs = req(u + "/queries", "DELETE")
+            assert code == 405 and hdrs.get("Allow") == "GET, POST"
+            code, _, hdrs = req(u + "/queries/some-id", "POST", body={})
+            assert code == 405 and hdrs.get("Allow") == "GET, DELETE"
+            # without a registry the query surface answers, not crashes
+            assert req(u + "/queries", "GET")[0] == 200
+            assert req(u + "/queries", "POST", body={"id": "x"})[0] == 409
+            assert req(u + "/queries/x", "DELETE")[0] == 409
+            # a POST body that is not JSON is a 400, not a traceback
+            r = urllib.request.Request(u + "/queries", method="POST",
+                                       data=b"{nope")
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(r, timeout=3)
+            assert ei.value.code == 400
+        finally:
+            srv.close()
+
+    def test_queries_surface_with_live_registry(self):
+        """POST /queries admits (then updates), GET lists, DELETE drains —
+        the HTTP admission surface against an installed registry."""
+        from spatialflink_tpu.runtime.queryplane import QueryRegistry
+
+        def req(url, method="GET", body=None):
+            r = urllib.request.Request(
+                url, method=method,
+                data=None if body is None else json.dumps(body).encode())
+            try:
+                resp = urllib.request.urlopen(r, timeout=3)
+                return resp.status, json.loads(resp.read())
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read())
+
+        srv = OpServer(port=0).start()
+        reg = QueryRegistry("range", radius=0.5).install()
+        try:
+            u = srv.url
+            code, body = req(u + "/queries", "POST",
+                             {"id": "q1", "x": 116.5, "y": 40.3})
+            assert code == 200 and body["query"]["state"] == "pending"
+            assert body["applies"] == "at the next window boundary"
+            assert req(u + "/queries", "POST", {"id": "q1"})[0] == 400
+            reg.apply()
+            code, body = req(u + "/queries")
+            assert code == 200 and body["fleet"] == ["q1"]
+            assert body["live"] == 1 and body["bucket"] == 1
+            code, body = req(u + "/queries/q1")
+            assert code == 200 and body["state"] == "active"
+            assert req(u + "/queries/ghost")[0] == 404
+            code, body = req(u + "/queries/q1", "DELETE")
+            assert code == 200 and body["query"]["state"] == "draining"
+            reg.apply()
+            assert req(u + "/queries/q1", "DELETE")[0] == 404
+        finally:
+            reg.uninstall()
+            srv.close()
+
     def test_healthz_flips_200_to_503_on_injected_breach(self):
         h = HealthEvaluator.from_spec("watermark_lag_ms=10")
         with scoped_registry() as reg, telemetry_session(health=h) as tel:

@@ -1,0 +1,712 @@
+"""Standing-query control plane tests: registry lifecycle (admit/update/
+retire, drain semantics), the Q-axis size-bucket padding contract
+(padded-slot identity vs a fixed fleet, zero XLA recompiles on
+churn-within-a-bucket), both admission surfaces (Kafka control topic and
+POST /queries) including under ``--chaos``, the ``queries`` coordinated-
+checkpoint component across a crash/resume that straddles an admission,
+per-query Prometheus labels, and the live ``--kafka-follow`` acceptance
+run with per-query window-table identity vs dedicated static runs."""
+
+import glob
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+import yaml
+
+from spatialflink_tpu.index import UniformGrid
+from spatialflink_tpu.models import Point
+from spatialflink_tpu.operators import (PointPointKNNQuery,
+                                        PointPointRangeQuery,
+                                        QueryConfiguration, QueryType)
+from spatialflink_tpu.runtime.opserver import active_server
+from spatialflink_tpu.runtime.queryplane import (ControlTopicConsumer,
+                                                 QueryRegistry, QuerySpec,
+                                                 QuerySpecError, QueryState,
+                                                 bucket_size,
+                                                 load_queries_file)
+from spatialflink_tpu.streams import reset_memory_brokers, resolve_broker
+from spatialflink_tpu.streams.formats import serialize_spatial
+from spatialflink_tpu.utils import metrics as _metrics
+from spatialflink_tpu.utils.metrics import scoped_registry
+from spatialflink_tpu.utils.telemetry import (prometheus_text,
+                                              telemetry_session)
+
+pytestmark = pytest.mark.queryplane
+
+CONF = "conf/spatialflink-conf.yml"
+IN1, OUT = "points.geojson", "output"
+GRID = UniformGrid(115.5, 117.6, 39.6, 41.1, num_grid_partitions=100)
+CONTROL = json.dumps({"geometry": {"type": "control", "coordinates": []}})
+
+
+@pytest.fixture(autouse=True)
+def _fresh_brokers():
+    reset_memory_brokers()
+    yield
+    reset_memory_brokers()
+
+
+def _recs(n=3000, seed=0, dt_ms=20):
+    rng = np.random.default_rng(seed)
+    t0 = 1_700_000_000_000
+    return [Point.create(float(115.5 + rng.random() * 2),
+                         float(39.6 + rng.random() * 1.5), GRID,
+                         obj_id=f"v{i % 13}", timestamp=int(t0 + i * dt_ms))
+            for i in range(n)]
+
+
+def _conf(**kw):
+    kw.setdefault("window_size_ms", 10_000)
+    kw.setdefault("slide_ms", 5_000)
+    return QueryConfiguration(QueryType.WindowBased, **kw)
+
+
+def _reg(points, family="range", radius=0.5, k=None):
+    reg = QueryRegistry(family, radius=radius, k=k)
+    for i, (x, y) in enumerate(points):
+        reg.admit({"id": f"q{i}", "family": family, "x": x, "y": y})
+    reg.apply()
+    return reg
+
+
+QPTS = [(116.5, 40.3), (116.0, 40.0), (117.0, 40.9)]
+
+
+def _oid_table(results, qid):
+    """{window_start: [obj ids]} for one query across dynamic results."""
+    out = {}
+    for w in results:
+        ids = w.extras.get("query_ids", [])
+        if qid in ids:
+            out[w.window_start] = [r.obj_id
+                                   for r in w.records[ids.index(qid)]]
+    return out
+
+
+class TestSpecValidation:
+    def test_schema_errors_name_the_field(self):
+        for bad, frag in [
+            ({"x": 1, "y": 2}, "'id'"),
+            ({"id": "", "x": 1, "y": 2}, "'id'"),
+            ({"id": "a", "x": 1, "y": 2, "family": "join"}, "'family'"),
+            ({"id": "a", "y": 2}, "'x' and 'y'"),
+            ({"id": "a", "x": "wat", "y": 2}, "'x' and 'y'"),
+            ({"id": "a", "x": 1, "y": 2, "route": "smoke:sig"}, "'route'"),
+            ({"id": "a", "x": 1, "y": 2, "route": "file:"}, "'route'"),
+            ({"id": "a", "x": 1, "y": 2, "slo": {"wat": 1}}, "'slo'"),
+            ({"id": "a", "x": 1, "y": 2, "k": "many"}, "'k'"),
+            ({"id": "a", "x": 1, "y": 2, "wobble": 3}, "wobble"),
+            ("not-a-dict", "object"),
+        ]:
+            with pytest.raises(QuerySpecError, match=frag):
+                QuerySpec.from_dict(bad, default_family="range")
+
+    def test_fleet_shared_radius_and_k_enforced(self):
+        reg = QueryRegistry("range", radius=0.5)
+        with pytest.raises(QuerySpecError, match="radius"):
+            reg.admit({"id": "a", "x": 1, "y": 2, "radius": 0.7})
+        reg.admit({"id": "a", "x": 1, "y": 2, "radius": 0.5})  # restate ok
+        regk = QueryRegistry("knn", radius=0.5, k=10)
+        with pytest.raises(QuerySpecError, match="k="):
+            regk.admit({"id": "b", "family": "knn", "x": 1, "y": 2, "k": 3})
+        with pytest.raises(QuerySpecError, match="family"):
+            regk.admit({"id": "c", "family": "range", "x": 1, "y": 2})
+
+    def test_queries_file_names_the_offending_entry(self, tmp_path):
+        p = tmp_path / "q.json"
+        p.write_text(json.dumps({"queries": [
+            {"id": "ok", "x": 1, "y": 2}, {"id": "bad", "x": 1}]}))
+        with pytest.raises(QuerySpecError, match=r"query\[1\]"):
+            load_queries_file(str(p), "range")
+        p.write_text(json.dumps([{"id": "ok", "x": 1, "y": 2}]))
+        assert [s.id for s in load_queries_file(str(p), "range")] == ["ok"]
+
+
+class TestLifecycle:
+    def test_admit_apply_update_retire_state_machine(self):
+        with scoped_registry():
+            reg = QueryRegistry("range", radius=0.5)
+            e = reg.admit({"id": "a", "x": 1, "y": 2})
+            assert e.state is QueryState.PENDING
+            assert reg.fleet_version == 0 and not reg.active_entries()
+            assert reg.apply() and reg.fleet_version == 1
+            assert e.state is QueryState.ACTIVE
+            assert [x.id for x in reg.active_entries()] == ["a"]
+            # re-admit by id = staged update; lands at the next apply
+            reg.admit({"id": "a", "x": 9, "y": 9, "route": "file:/tmp/x"})
+            assert e.spec.x == 1 and e.pending_spec is not None
+            assert reg.apply() and e.spec.x == 9 and reg.fleet_version == 2
+            # retire: active -> draining (still serving) -> retired at apply
+            reg.retire("a")
+            assert e.state is QueryState.DRAINING and e.serving
+            assert [x.id for x in reg.active_entries()] == ["a"]
+            assert reg.apply() and e.state is QueryState.RETIRED
+            assert not reg.active_entries() and reg.fleet_version == 3
+            # idempotence/edges
+            with pytest.raises(KeyError):
+                reg.retire("a")
+            with pytest.raises(KeyError):
+                reg.update("nope", {})
+            # a pending admission retires immediately, never joins
+            p = reg.admit({"id": "b", "x": 1, "y": 2})
+            reg.retire("b")
+            assert p.state is QueryState.RETIRED
+            assert not reg.apply() or "b" not in [
+                x.id for x in reg.active_entries()]
+
+    def test_no_change_no_version_bump(self):
+        reg = _reg(QPTS[:2])
+        v = reg.fleet_version
+        assert not reg.apply()  # nothing staged
+        assert reg.fleet_version == v
+
+    def test_bucket_padding_contract(self):
+        assert [bucket_size(n) for n in (0, 1, 2, 3, 4, 5, 9)] == \
+            [1, 1, 2, 4, 4, 8, 16]
+        reg = _reg(QPTS)  # 3 live
+        entries, pts, valid = reg.padded_fleet(GRID)
+        assert len(entries) == 3 and len(pts) == 4
+        assert valid.tolist() == [True, True, True, False]
+        # pad slots are shape filler copies of the last live point
+        assert pts[3].x == pts[2].x and pts[3].y == pts[2].y
+
+    def test_lifecycle_events_on_the_ring(self):
+        with scoped_registry(), telemetry_session() as tel:
+            reg = _reg(QPTS[:1])
+            reg.retire("q0")
+            reg.apply()
+            kinds = [e["kind"] for e in tel.events.list()]
+            for k in ("query-admitted", "query-active", "query-draining",
+                      "query-retired"):
+                assert k in kinds, kinds
+
+    def test_status_payload_and_slo_verdict(self):
+        with scoped_registry():
+            reg = QueryRegistry("range", radius=0.5)
+            reg.admit({"id": "a", "x": 1, "y": 2,
+                       "slo": {"min_window_records": 2}})
+            reg.apply()
+            entry = reg.active_entries()[0]
+            reg.note_window(entry, 5)
+            assert entry.slo_ok is True and entry.slo_breaches == 0
+            reg.note_window(entry, 1)  # breach
+            reg.note_window(entry, 0)  # sustained: still ONE transition
+            assert entry.slo_ok is False and entry.slo_breaches == 1
+            reg.note_window(entry, 4)  # recovered
+            assert entry.slo_ok is True
+            st = reg.status()
+            assert st["live"] == 1 and st["bucket"] == 1
+            row = st["queries"][0]
+            assert row["windows_emitted"] == 4 and row["records_out"] == 10
+            assert row["slo"] == {"ok": True, "breaches": 1}
+
+
+class TestDynamicIdentity:
+    """The padding/demux contract: a dynamic fleet must be indistinguishable
+    per query from the frozen-fleet run_multi path and from dedicated
+    single-query runs."""
+
+    def test_padded_fleet_matches_fixed_run_multi_range(self):
+        recs = _recs()
+        out = list(PointPointRangeQuery(_conf(), GRID).run_dynamic(
+            iter(recs), _reg(QPTS), 0.5))  # 3 live in a bucket of 4
+        qs = [Point.create(x, y, GRID) for x, y in QPTS]
+        ref = list(PointPointRangeQuery(_conf(), GRID).run_multi(
+            iter(recs), qs, 0.5))
+        assert len(out) == len(ref) and out
+        for a, b in zip(out, ref):
+            assert (a.window_start, a.window_end) == \
+                (b.window_start, b.window_end)
+            assert a.extras["query_ids"] == ["q0", "q1", "q2"]
+            assert [[r.obj_id for r in q] for q in a.records] == \
+                [[r.obj_id for r in q] for q in b.records]
+
+    def test_padded_fleet_matches_fixed_run_multi_knn(self):
+        recs = _recs()
+        reg = _reg(QPTS, family="knn", k=7)
+        out = list(PointPointKNNQuery(_conf(k=7), GRID).run_dynamic(
+            iter(recs), reg, 0.5, 7))
+        qs = [Point.create(x, y, GRID) for x, y in QPTS]
+        ref = list(PointPointKNNQuery(_conf(k=7), GRID).run_multi(
+            iter(recs), qs, 0.5, 7))
+        assert out and len(out) == len(ref)
+        for a, b in zip(out, ref):
+            assert a.records == b.records
+            assert a.extras["k"] == 7 and a.extras["queries"] == 3
+
+    def test_churn_within_bucket_never_recompiles(self):
+        """Admissions/retirements that stay inside one power-of-two size
+        bucket REPAD the fleet arrays; the jitted multi kernels must be
+        cache hits — the ISSUE's zero-XLA-recompiles acceptance bar,
+        asserted on the jit compile counters."""
+        from spatialflink_tpu.ops.range import range_filter_point_multi_masks
+
+        recs = _recs(4000)
+        reg = _reg(QPTS)  # 3 live, bucket 4
+
+        class Churn:
+            def __iter__(self):
+                for i, r in enumerate(recs):
+                    if i == 1200:  # 3 -> 4 live: still bucket 4
+                        reg.admit({"id": "late", "x": 116.8, "y": 40.6})
+                    if i == 2400:  # retire one: 3 live, still bucket 4
+                        reg.retire("q1")
+                    yield r
+
+        # warm the bucket's kernel shape, then churn inside it
+        list(PointPointRangeQuery(_conf(), GRID).run_dynamic(
+            iter(recs[:600]), _reg(QPTS), 0.5))
+        before = range_filter_point_multi_masks._cache_size()
+        out = list(PointPointRangeQuery(_conf(), GRID).run_dynamic(
+            Churn(), reg, 0.5))
+        assert range_filter_point_multi_masks._cache_size() == before, \
+            "fleet churn within a size bucket recompiled the multi kernel"
+        # the fleet actually changed mid-run
+        fleets = [tuple(w.extras["query_ids"]) for w in out]
+        assert ("q0", "q1", "q2") in fleets
+        assert ("q0", "q2", "late") in fleets
+        assert _metrics.REGISTRY.counter("fleet-repads").count >= 2
+
+    def test_admitted_and_retired_match_dedicated_runs(self):
+        recs = _recs(4000)
+        reg = _reg(QPTS[:2])
+
+        class Churn:
+            def __iter__(self):
+                for i, r in enumerate(recs):
+                    if i == 1500:
+                        reg.admit({"id": "late", "x": 116.8, "y": 40.6})
+                    if i == 2600:
+                        reg.retire("q0")
+                    yield r
+
+        out = list(PointPointRangeQuery(_conf(), GRID).run_dynamic(
+            Churn(), reg, 0.5))
+        # each query's windows match a dedicated single-query run of the
+        # SAME case over the full stream, restricted to the windows the
+        # query was live for
+        for qid, (x, y) in [("q0", QPTS[0]), ("q1", QPTS[1]),
+                            ("late", (116.8, 40.6))]:
+            ded = {w.window_start: [r.obj_id for r in w.records]
+                   for w in PointPointRangeQuery(_conf(), GRID).run(
+                       iter(recs), Point.create(x, y, GRID), 0.5)}
+            got = _oid_table(out, qid)
+            assert got, qid
+            for ws, ids in got.items():
+                assert ids == ded[ws], (qid, ws)
+        # q0 retired mid-run, late admitted mid-run
+        assert len(_oid_table(out, "q0")) < len(out)
+        assert 0 < len(_oid_table(out, "late")) < len(out)
+
+    def test_empty_fleet_emits_empty_windows(self):
+        recs = _recs(1200)
+        reg = QueryRegistry("range", radius=0.5)
+        out = list(PointPointRangeQuery(_conf(), GRID).run_dynamic(
+            iter(recs), reg, 0.5))
+        assert out and all(w.records == [] and w.extras["queries"] == 0
+                           for w in out)
+
+    def test_per_query_prometheus_labels(self):
+        """Satellite: '<base>@<qid>' counters/histograms render as proper
+        query=\"<id>\" labels, not flattened names."""
+        with scoped_registry(), telemetry_session() as tel:
+            reg = _reg(QPTS[:1])
+            reg.note_window(reg.active_entries()[0], 3)
+            text = prometheus_text(tel)
+            assert ('spatialflink_counter{name="windows-emitted",'
+                    'query="q0"} 1') in text
+            assert ('spatialflink_histogram_count{name="window-records",'
+                    'query="q0"} 1') in text
+            assert "@" not in "".join(
+                ln for ln in text.splitlines() if "{" in ln)
+        # registry-only (no session) rendering takes the same path
+        with scoped_registry() as r2:
+            r2.counter("records-out@fleet-1").inc(5)
+            text = prometheus_text(None, registry=r2)
+            assert ('spatialflink_counter{name="records-out",'
+                    'query="fleet-1"} 5') in text
+
+
+class TestControlTopic:
+    def test_consumer_applies_and_rejects(self):
+        with scoped_registry() as reg_counters:
+            broker = resolve_broker("memory://ctl-unit")
+            reg = _reg(QPTS[:1])
+            cons = ControlTopicConsumer(broker, "ctl", "g")
+            reg.attach_control(cons)
+            broker.produce("ctl", json.dumps(
+                {"action": "admit",
+                 "query": {"id": "n1", "x": 116.2, "y": 40.2}}))
+            broker.produce("ctl", "not json {")
+            broker.produce("ctl", json.dumps({"action": "wat"}))
+            broker.produce("ctl", json.dumps(
+                {"action": "retire", "id": "ghost"}))
+            broker.produce("ctl", json.dumps(
+                {"action": "update", "id": "q0",
+                 "query": {"route": "kafka:routed"}}))
+            broker.produce("ctl", json.dumps({"action": "retire",
+                                              "id": "n1"}))
+            assert reg.apply()
+            ids = [e.id for e in reg.active_entries()]
+            assert ids == ["q0"]  # n1 admitted then retired pre-apply
+            assert reg.active_entries()[0].spec.route == "kafka:routed"
+            assert reg_counters.counter(
+                "control-records-rejected").count == 3
+            # position committed; a second consumer resumes past history
+            assert cons.position == 6
+            assert ControlTopicConsumer(broker, "ctl", "g").position == 6
+
+    def test_driver_control_admission_under_chaos(self, tmp_path):
+        """Control-topic admissions under transport faults: the admitted
+        query's routed window table is byte-identical to a fault-free
+        dedicated single-query run."""
+        from spatialflink_tpu.driver import main
+
+        recs = _recs(1600, dt_ms=60)
+        with open(CONF) as f:
+            d = yaml.safe_load(f)
+        d["kafkaBootStrapServers"] = "memory://qp-chaos"
+        d["query"]["radius"] = 0.5
+        d["window"].update(interval=10, step=5)
+        cfg = tmp_path / "c.yml"
+        cfg.write_text(yaml.safe_dump(d))
+        route = tmp_path / "late.jsonl"
+        qfile = tmp_path / "q.json"
+        qfile.write_text(json.dumps(
+            [{"id": f"q{i}", "x": x, "y": y}
+             for i, (x, y) in enumerate(QPTS[:2])]))
+        broker = resolve_broker("memory://qp-chaos")
+        for r in recs:
+            broker.produce(IN1, serialize_spatial(r, "GeoJSON"))
+        broker.produce("ctl", json.dumps(
+            {"action": "admit",
+             "query": {"id": "late", "x": 116.8, "y": 40.6,
+                       "route": f"file:{route}"}}))
+        assert main(["--config", str(cfg), "--kafka", "--option", "1",
+                     "--queries-file", str(qfile), "--control-topic", "ctl",
+                     "--chaos", "seed=11,fetch_fail=0.3,duplicate=0.3,"
+                                "reorder=0.5",
+                     "--retry", "attempts=12,base_ms=1,max_ms=20"]) == 0
+        got = {tuple(d["window"]): d["records"] for d in
+               map(json.loads, route.read_text().splitlines())}
+        assert got
+        conf = QueryConfiguration(
+            QueryType.WindowBased, 10_000, 5_000,
+            allowed_lateness_ms=d["query"]["thresholds"][
+                "outOfOrderTuples"] * 1000)
+        ded = {}
+        for w in PointPointRangeQuery(conf, GRID).run(
+                iter(recs), Point.create(116.8, 40.6, GRID), 0.5):
+            ded[(w.window_start, w.window_end)] = [
+                serialize_spatial(r, "GeoJSON") for r in w.records]
+        for win, docs in got.items():
+            assert docs == ded[win], win
+
+
+class TestPostAdmission:
+    def test_post_admission_under_chaos(self, tmp_path):
+        """POST /queries mid-run under --chaos: the admitted query serves
+        from its admission window on and its table matches the dedicated
+        run."""
+        from spatialflink_tpu.driver import main
+
+        recs = _recs(2400, dt_ms=60)
+        with open(CONF) as f:
+            d = yaml.safe_load(f)
+        d["kafkaBootStrapServers"] = "memory://qp-post"
+        d["query"]["radius"] = 0.5
+        d["window"].update(interval=10, step=5)
+        cfg = tmp_path / "c.yml"
+        cfg.write_text(yaml.safe_dump(d))
+        route = tmp_path / "posted.jsonl"
+        qfile = tmp_path / "q.json"
+        qfile.write_text(json.dumps([{"id": "q0", "x": QPTS[0][0],
+                                      "y": QPTS[0][1]}]))
+        broker = resolve_broker("memory://qp-post")
+        for r in recs:
+            broker.produce(IN1, serialize_spatial(r, "GeoJSON"))
+
+        posted = {}
+
+        def post_when_up():
+            deadline = time.monotonic() + 20
+            srv = None
+            while time.monotonic() < deadline and srv is None:
+                srv = active_server()
+                if srv is None or srv.port is None:
+                    srv = None
+                    time.sleep(0.005)
+            if srv is None:
+                posted["error"] = "server never came up"
+                return
+            body = json.dumps({"id": "posted", "x": 116.8, "y": 40.6,
+                               "route": f"file:{route}"}).encode()
+            req = urllib.request.Request(srv.url + "/queries", data=body,
+                                         method="POST")
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                posted["code"] = resp.status
+                posted["body"] = json.loads(resp.read())
+
+        t = threading.Thread(target=post_when_up, daemon=True)
+        t.start()
+        assert main(["--config", str(cfg), "--kafka", "--option", "1",
+                     "--queries-file", str(qfile), "--status-port", "0",
+                     "--chaos", "seed=5,fetch_fail=0.2,latency=0.2,"
+                                "latency_ms=4",
+                     "--retry", "attempts=12,base_ms=1,max_ms=20"]) == 0
+        t.join(timeout=10)
+        assert posted.get("code") == 200, posted
+        assert posted["body"]["query"]["state"] == "pending"
+        got = {tuple(d["window"]): d["records"] for d in
+               map(json.loads, route.read_text().splitlines())}
+        assert got, "the POSTed query never produced a routed window"
+        conf = QueryConfiguration(
+            QueryType.WindowBased, 10_000, 5_000,
+            allowed_lateness_ms=d["query"]["thresholds"][
+                "outOfOrderTuples"] * 1000)
+        ded = {}
+        for w in PointPointRangeQuery(conf, GRID).run(
+                iter(recs), Point.create(116.8, 40.6, GRID), 0.5):
+            ded[(w.window_start, w.window_end)] = [
+                serialize_spatial(r, "GeoJSON") for r in w.records]
+        for win, docs in got.items():
+            assert docs == ded[win], win
+
+
+class TestCheckpointResume:
+    def test_resume_straddles_an_admission_with_mid_drain(self, tmp_path,
+                                                          monkeypatch):
+        """Crash AFTER an admission and a retirement-in-progress were
+        checkpointed: the manifest's ``queries`` component must carry the
+        admitted query AND the mid-drain one; the resumed run restores the
+        fleet (the drain completes at the first window) and the surviving
+        queries' tables equal the uninterrupted run's."""
+        import contextlib
+        import io
+
+        from spatialflink_tpu.driver import main
+        from spatialflink_tpu.runtime import queryplane
+        from spatialflink_tpu.runtime.state import CheckpointableState
+
+        monkeypatch.setenv("SPATIALFLINK_DECODE_CHUNK", "256")
+        recs = _recs(4000, dt_ms=30)
+        inp = tmp_path / "in.geojson"
+        inp.write_text("".join(serialize_spatial(r, "GeoJSON") + "\n"
+                               for r in recs))
+        with open(CONF) as f:
+            d = yaml.safe_load(f)
+        d["query"]["radius"] = 0.5
+        d["window"].update(interval=10, step=5)
+        cfg = tmp_path / "c.yml"
+        cfg.write_text(yaml.safe_dump(d))
+        qfile = tmp_path / "q.json"
+        qfile.write_text(json.dumps(
+            [{"id": f"q{i}", "x": x, "y": y}
+             for i, (x, y) in enumerate(QPTS[:2])]))
+        base = ["--config", str(cfg), "--input1", str(inp), "--option", "1",
+                "--queries-file", str(qfile)]
+
+        class Crash(Exception):
+            pass
+
+        def run(argv, hook=None, crash_at=None):
+            """Drive main() with a per-emitted-window hook on the router;
+            returns the emitted stdout rows (raises Crash mid-run when
+            crash_at is hit — the checkpoint-straddle shape)."""
+            out = io.StringIO()
+            orig = queryplane.QueryRouter.route
+            n = {"w": 0}
+
+            def route(self, result):
+                orig(self, result)
+                n["w"] += 1
+                if hook is not None:
+                    hook(self.registry, n["w"])
+                if crash_at is not None and n["w"] == crash_at:
+                    raise Crash()
+            queryplane.QueryRouter.route = route
+            try:
+                with contextlib.redirect_stdout(out):
+                    if crash_at is not None:
+                        with pytest.raises(Crash):
+                            main(argv)
+                    else:
+                        assert main(argv) == 0
+            finally:
+                queryplane.QueryRouter.route = orig
+            return [eval(ln) for ln in out.getvalue().splitlines()]
+
+        def churn(reg, w):
+            if w == 6:
+                reg.admit({"id": "late", "x": 116.8, "y": 40.6})
+            if w == 8:
+                reg.retire("q1")
+
+        # uninterrupted reference: same admission/retirement windows
+        ref = run(base, hook=churn)
+
+        # crash at window 9: the every-4 checkpoint at window 8 saw the
+        # admission applied and (typically) q1 mid-drain
+        ckpt = tmp_path / "ckpt"
+        got1 = run(base + ["--checkpoint-dir", str(ckpt),
+                           "--checkpoint-every", "4"],
+                   hook=churn, crash_at=9)
+
+        # the newest manifest carries the queries component: the admitted
+        # query live, q1 possibly mid-drain (state depends on which barrier
+        # last fired) — assert presence + states are legal fleet states
+        newest = sorted(glob.glob(str(ckpt / "ckpt-*.npz")))[-1]
+        comp = CheckpointableState.load(newest).meta["components"]["queries"]
+        by_id = {e["spec"]["id"]: e["state"] for e in comp["entries"]}
+        assert "late" in by_id and by_id["late"] in ("pending", "active")
+        assert by_id.get("q1") in ("active", "draining", None)
+        assert comp["fleet_version"] >= 1
+
+        # resume completes the run; the resumed fleet finishes q1's drain
+        def finish_retire(reg, w):
+            # the crashed run staged q1's retirement at window 8; if the
+            # restored manifest predates it, re-stage (idempotent surface:
+            # at-least-once control delivery is the documented contract)
+            if w == 1:
+                try:
+                    reg.retire("q1")
+                except KeyError:
+                    pass
+        got = got1 + run(base + ["--checkpoint-dir", str(ckpt), "--resume"],
+                         hook=finish_retire)
+
+        def table(rows, qid):
+            return {tuple(r["window"]):
+                    r["per_query_counts"][r["query_ids"].index(qid)]
+                    for r in rows if qid in r["query_ids"]}
+
+        # windows may re-emit across the crash (journal suppresses dupes in
+        # the driver's sinks; stdout capture sees each once per process) —
+        # compare as maps
+        for qid in ("q0", "late"):
+            r, g = table(ref, qid), table(got, qid)
+            assert set(r) <= set(g)
+            assert all(g[w] == c for w, c in r.items()), qid
+        # q1 drained in both runs: its live windows match while present
+        r1, g1 = table(ref, "q1"), table(got, "q1")
+        assert all(g1[w] == c for w, c in r1.items() if w in g1)
+        assert len(g1) < len(table(got, "q0"))
+
+
+class TestFollowAcceptance:
+    """The ISSUE acceptance run: ``--kafka-follow --status-port 0`` with a
+    query POSTed in and another DELETEd mid-run; per-query window tables
+    identical to dedicated static runs; GET /queries shows the live
+    ledger; per-query labels visible in /metrics."""
+
+    def test_follow_admit_retire_mid_run(self, tmp_path):
+        from spatialflink_tpu.driver import main
+
+        with open(CONF) as f:
+            d = yaml.safe_load(f)
+        d["kafkaBootStrapServers"] = "memory://qp-follow"
+        d["query"]["radius"] = 0.5
+        d["query"]["thresholds"]["outOfOrderTuples"] = 0
+        d["window"].update(interval=2, step=1)
+        cfg = tmp_path / "c.yml"
+        cfg.write_text(yaml.safe_dump(d))
+        route_a = tmp_path / "qa.jsonl"
+        route_p = tmp_path / "posted.jsonl"
+        qfile = tmp_path / "q.json"
+        qfile.write_text(json.dumps([
+            {"id": "qa", "x": 116.5, "y": 40.5, "route": f"file:{route_a}"},
+            {"id": "qb", "x": 116.0, "y": 40.0}]))
+        broker = resolve_broker("memory://qp-follow")
+        recs = []
+
+        def produce():
+            t0 = int(time.time() * 1000)
+            for i in range(400):
+                p = Point.create(116.4 + 0.002 * (i % 60), 40.5, GRID,
+                                 obj_id=f"veh{i % 7}",
+                                 timestamp=t0 + i * 40)
+                recs.append(p)
+                broker.produce(IN1, serialize_spatial(p, "GeoJSON"))
+                time.sleep(0.004)
+            broker.produce(IN1, CONTROL)
+
+        ops = {}
+
+        def drive_plane():
+            deadline = time.monotonic() + 25
+            srv = None
+            while time.monotonic() < deadline and srv is None:
+                srv = active_server()
+                if srv is None or srv.port is None:
+                    srv = None
+                    time.sleep(0.005)
+            if srv is None:
+                ops["error"] = "no server"
+                return
+
+            def get(p):
+                with urllib.request.urlopen(srv.url + p, timeout=3) as r:
+                    return r.status, (json.loads(r.read())
+                                      if "json" in r.headers.get(
+                                          "Content-Type", "")
+                                      else r.read().decode())
+            # wait for some windows, then admit + retire mid-run
+            while time.monotonic() < deadline:
+                if _metrics.REGISTRY.counter("windows-emitted@qa").count >= 3:
+                    break
+                time.sleep(0.02)
+            body = json.dumps({"id": "posted", "x": 116.45, "y": 40.5,
+                               "route": f"file:{route_p}"}).encode()
+            req = urllib.request.Request(srv.url + "/queries", data=body,
+                                         method="POST")
+            with urllib.request.urlopen(req, timeout=5) as r:
+                ops["post"] = r.status
+            req = urllib.request.Request(srv.url + "/queries/qb",
+                                         method="DELETE")
+            with urllib.request.urlopen(req, timeout=5) as r:
+                ops["delete"] = r.status
+            time.sleep(0.4)  # a few windows under the new fleet
+            ops["queries"] = get("/queries")[1]
+            ops["metrics"] = get("/metrics")[1]
+
+        prod = threading.Thread(target=produce, daemon=True)
+        plane = threading.Thread(target=drive_plane, daemon=True)
+        with scoped_registry():
+            prod.start()
+            plane.start()
+            rc = main(["--config", str(cfg), "--kafka", "--kafka-follow",
+                       "--option", "1", "--status-port", "0",
+                       "--queries-file", str(qfile), "--live-stats",
+                       "--telemetry-interval", "0.3"])
+            prod.join(timeout=30)
+            plane.join(timeout=30)
+        assert rc == 0
+        assert "error" not in ops, ops
+        assert ops["post"] == 200 and ops["delete"] == 200
+        # the live ledger saw the whole lifecycle
+        states = {q["id"]: q["state"] for q in ops["queries"]["queries"]}
+        assert states.get("posted") in ("pending", "active")
+        assert states.get("qb") in ("draining", "retired")
+        assert states.get("qa") == "active"
+        # per-query labels on the live /metrics
+        assert 'query="qa"' in ops["metrics"]
+        # identity: each routed query's windows == the dedicated run over
+        # the records actually produced (same event times -> same windows)
+        conf = QueryConfiguration(QueryType.WindowBased, 2_000, 1_000)
+        for route, (x, y) in [(route_a, (116.5, 40.5)),
+                              (route_p, (116.45, 40.5))]:
+            got = {tuple(doc["window"]): doc["records"] for doc in
+                   map(json.loads, route.read_text().splitlines())}
+            assert got, route
+            ded = {}
+            for w in PointPointRangeQuery(conf, GRID).run(
+                    iter(list(recs)), Point.create(x, y, GRID), 0.5):
+                ded[(w.window_start, w.window_end)] = [
+                    serialize_spatial(r, "GeoJSON") for r in w.records]
+            for win, docs in got.items():
+                assert docs == ded.get(win, []), (route, win)

@@ -304,7 +304,18 @@ def bench_cpu_numpy(grid, xs, ys, oid) -> float:
     return N_POINTS * iters / dt
 
 
-def main():
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="headline kNN bench; prints exactly ONE JSON line")
+    ap.add_argument("--require-backend", choices=("cpu", "tpu", "gpu"),
+                    default=os.environ.get("SPATIALFLINK_REQUIRE_BACKEND")
+                    or None,
+                    help="fail fast (exit 2, no JSON row) when the process "
+                         "would run on any other backend — a silent CPU "
+                         "fallback must refuse, not bank an invalid row")
+    args = ap.parse_args(argv)
     if os.environ.get("SPATIALFLINK_BENCH_PLATFORM") == "cpu":
         _force_cpu()
     elif not _probe_default_backend_ok():
@@ -318,6 +329,12 @@ def main():
     from spatialflink_tpu.utils.telemetry import telemetry_session
 
     backend = jax.default_backend()
+    if args.require_backend and backend != args.require_backend:
+        print(f"bench: --require-backend {args.require_backend} but the "
+              f"process landed on '{backend}'; refusing to measure (run "
+              "python -m spatialflink_tpu.doctor --preflight)",
+              file=sys.stderr)
+        return 2
     # in-memory telemetry session (no reporter): per-stage spans + grid
     # occupancy ride the result row, so BENCH_* files carry a breakdown of
     # where the wall clock went, not just the headline number
@@ -339,6 +356,8 @@ def main():
         # The north-star target (BASELINE.md) is a TPU number; a CPU
         # fallback is reported, but flagged invalid for that target.
         "backend": backend,
+        "device_kind": jax.devices()[0].device_kind,
+        "device_count": jax.device_count(),
         "valid_for_target": backend == "tpu",
         "p50_window_latency_ms": round(p50_ms, 3),
         # measured dispatch->readback distribution per pipeline depth
@@ -361,7 +380,8 @@ def main():
         except (OSError, ValueError):  # missing or corrupted artifact must
             pass                       # not cost the one-JSON-line contract
     print(json.dumps(row))
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    raise SystemExit(main())

@@ -62,6 +62,17 @@ def row_key(row: dict) -> Tuple:
     return tuple((f, str(row[f])) for f in ID_FIELDS if f in row)
 
 
+def _key_sans_backend(key: Tuple) -> Tuple:
+    return tuple((f, v) for f, v in key if f != "backend")
+
+
+def _backend_of(key: Tuple) -> Optional[str]:
+    for f, v in key:
+        if f == "backend":
+            return v
+    return None
+
+
 def parse_rule(spec: str) -> Tuple[Dict[str, str], float]:
     """``k=v[,k=v...]:threshold`` -> (match dict, threshold)."""
     match_part, sep, thr_part = spec.rpartition(":")
@@ -95,15 +106,31 @@ def diff_rows(base_rows: List[dict], cur_rows: List[dict], metric: str,
               lower_is_better: bool = False) -> List[dict]:
     """Pairwise comparison; one result dict per row key, statuses:
     ``ok`` / ``regression`` / ``missing`` (in baseline only) / ``new``
-    (in current only) / ``unmeasured`` (metric absent on either side)."""
+    (in current only) / ``unmeasured`` (metric absent on either side) /
+    ``backend_mismatch`` (identical identity except ``backend`` — the
+    rows refuse to pair; fatal in :func:`main`)."""
     rules = rules or []
     base = {row_key(r): r for r in base_rows}
     cur = {row_key(r): r for r in cur_rows}
+    # rows that pair on every identity field EXCEPT backend were measured
+    # on different hardware: the comparison is meaningless whichever way it
+    # points, so the diff REFUSES them (fatal in main) instead of letting a
+    # TPU baseline silently "regress" against a CPU-fallback current
+    cur_sans = {_key_sans_backend(k): k for k in cur}
+    mismatched_cur: set = set()
     out: List[dict] = []
     for key, b in base.items():
         label = ",".join(f"{k}={v}" for k, v in key)
         c = cur.get(key)
         if c is None:
+            twin = cur_sans.get(_key_sans_backend(key))
+            if twin is not None and _backend_of(twin) != _backend_of(key):
+                mismatched_cur.add(twin)
+                out.append({
+                    "key": label, "status": "backend_mismatch",
+                    "base_backend": _backend_of(key),
+                    "current_backend": _backend_of(twin)})
+                continue
             out.append({"key": label, "status": "missing",
                         "base": b.get(metric)})
             continue
@@ -124,7 +151,7 @@ def diff_rows(base_rows: List[dict], cur_rows: List[dict], metric: str,
             "status": "regression" if change < -thr else "ok",
         })
     for key, c in cur.items():
-        if key not in base:
+        if key not in base and key not in mismatched_cur:
             out.append({"key": ",".join(f"{k}={v}" for k, v in key),
                         "status": "new", "current": c.get(metric)})
     return out
@@ -162,9 +189,16 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     results = diff_rows(base_rows, cur_rows, args.metric, args.threshold,
                         rules, args.lower_is_better)
-    regressions = missing = 0
+    regressions = missing = mismatches = 0
     for r in results:
-        if r["status"] == "regression":
+        if r["status"] == "backend_mismatch":
+            mismatches += 1
+            print(f"BACKEND MISMATCH {r['key']}: baseline measured on "
+                  f"{r['base_backend']!r}, current on "
+                  f"{r['current_backend']!r} — rows refuse to pair "
+                  "(re-measure on the same backend, or use "
+                  "--require-backend on the harness)")
+        elif r["status"] == "regression":
             regressions += 1
             print(f"REGRESSION {r['key']}: {args.metric} "
                   f"{r['base']} -> {r['current']} "
@@ -184,7 +218,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                   f"({r.get('base')!r} -> {r.get('current')!r})")
     compared = sum(r["status"] in ("ok", "regression") for r in results)
     print(f"# {compared} row(s) compared, {regressions} regression(s), "
-          f"{missing} missing", file=sys.stderr)
+          f"{missing} missing, {mismatches} backend mismatch(es)",
+          file=sys.stderr)
+    if mismatches:
+        return 2
     if missing and args.require_all:
         return 2
     return 1 if regressions else 0

@@ -356,17 +356,29 @@ def bench_live_plane(option: int, path: str, n: int) -> list:
         srv.close()
 
     def run_plane(trace: bool):
-        with telemetry_session(trace=trace):
+        from spatialflink_tpu.utils import deviceplane
+
+        with telemetry_session(trace=trace) as tel:
             srv = OpServer(port=0).start()
             live = LiveStats(interval_s=3600.0).start()
+            dp = deviceplane.registry()
+            dp.begin_run()
+            dp.mark_warm("bench live-plane (pre-warmed shapes)")
             try:
-                return run()[1]
+                dt = run()[1]
             finally:
+                dp.end_run()
                 live.close()
                 srv.close()
+            # the device-truth fields the full-plane ledger row carries:
+            # post-warmup compiles (0 = the sentinel stayed silent) and
+            # the per-window dispatch→ready overlap distribution
+            h = tel.histograms.get("dispatch-overlap-ratio")
+            overlap = h.to_dict() if h is not None else {"count": 0}
+            return dt, dp.run_recompiles, overlap
 
-    dt_full = run_plane(trace=False)
-    dt_trace = run_plane(trace=True)
+    dt_full, rc_full, ovl_full = run_plane(trace=False)
+    dt_trace, _rc_t, _ovl_t = run_plane(trace=True)
     base = dict(option=option, records=n, windows=windows)
     return [
         dict(base, path="live_plane_off", wall_s=round(dt_off, 3),
@@ -376,7 +388,9 @@ def bench_live_plane(option: int, path: str, n: int) -> list:
              overhead_vs_off=round(dt_srv / dt_off - 1.0, 4)),
         dict(base, path="live_plane_full", wall_s=round(dt_full, 3),
              records_per_sec=round(n / dt_full),
-             overhead_vs_off=round(dt_full / dt_off - 1.0, 4)),
+             overhead_vs_off=round(dt_full / dt_off - 1.0, 4),
+             post_warmup_compiles=rc_full,
+             dispatch_overlap=ovl_full),
         dict(base, path="live_plane_trace", wall_s=round(dt_trace, 3),
              records_per_sec=round(n / dt_trace),
              overhead_vs_off=round(dt_trace / dt_off - 1.0, 4),
@@ -586,6 +600,12 @@ def main() -> int:
                          "plus a Q-sweep amortization row through the "
                          "registry path vs dedicated per-query pipelines. "
                          "0 (default) disables them")
+    ap.add_argument("--require-backend", choices=("cpu", "tpu", "gpu"),
+                    default=None,
+                    help="fail fast (exit 2) when the process would run on "
+                         "any other backend — the BENCH r05 silent-CPU-"
+                         "fallback condition becomes a refusal instead of "
+                         "an invalid ledger row")
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
 
@@ -594,10 +614,30 @@ def main() -> int:
     settle_backend()
     import jax
 
+    from spatialflink_tpu.utils import deviceplane
+
     backend = jax.default_backend()
+    if args.require_backend and backend != args.require_backend:
+        print(f"bench_e2e: --require-backend {args.require_backend} but "
+              f"the process landed on '{backend}' "
+              f"({deviceplane.backend_provenance()['device_kind']}); "
+              "refusing to measure — run python -m spatialflink_tpu.doctor "
+              "--preflight for the readiness breakdown", file=sys.stderr)
+        return 2
     n = args.n or (1_000_000 if backend == "tpu" else 100_000)
 
     from benchmarks._common import bench_telemetry
+
+    # backend provenance on EVERY row (not just the file header): a ledger
+    # row must carry its own device truth so bench_diff can refuse
+    # cross-backend pairings and a CPU fallback is visible per row
+    prov = deviceplane.backend_provenance()
+
+    def _stamp(row: dict) -> dict:
+        row["backend"] = backend
+        row["device_kind"] = prov["device_kind"]
+        row["valid_for_target"] = prov["valid_for_target"]
+        return row
 
     rows = []
     with tempfile.TemporaryDirectory() as td:
@@ -615,7 +655,7 @@ def main() -> int:
                 snap = tel.snapshot()
             for row in opt_rows:
                 row["telemetry"] = snap
-                row["backend"] = backend
+                _stamp(row)
                 print(json.dumps(row), flush=True)
                 rows.append(row)
         if args.multi > 1:
@@ -627,7 +667,7 @@ def main() -> int:
                 except _BulkDeclined:
                     continue
                 for row in multi_rows:
-                    row["backend"] = backend
+                    _stamp(row)
                     print(json.dumps(row), flush=True)
                     rows.append(row)
         if args.checkpoint_every > 0:
@@ -636,7 +676,7 @@ def main() -> int:
                     continue
                 for row in bench_checkpoint(opt, path, n,
                                             args.checkpoint_every):
-                    row["backend"] = backend
+                    _stamp(row)
                     print(json.dumps(row), flush=True)
                     rows.append(row)
         if args.live_plane:
@@ -644,7 +684,7 @@ def main() -> int:
                 if opt not in [int(x) for x in args.options.split(",")]:
                     continue
                 for row in bench_live_plane(opt, path, n):
-                    row["backend"] = backend
+                    _stamp(row)
                     print(json.dumps(row), flush=True)
                     rows.append(row)
         if args.pane_state_overlap > 1:
@@ -657,12 +697,12 @@ def main() -> int:
                 except _BulkDeclined:
                     continue
                 for row in ps_rows:
-                    row["backend"] = backend
+                    _stamp(row)
                     print(json.dumps(row), flush=True)
                     rows.append(row)
         if args.query_plane > 1:
             for row in bench_query_plane(path, n, args.query_plane):
-                row["backend"] = backend
+                _stamp(row)
                 print(json.dumps(row), flush=True)
                 rows.append(row)
         if args.pane_overlap > 1:
@@ -674,7 +714,7 @@ def main() -> int:
                 except _BulkDeclined:
                     continue
                 for row in pane_rows:
-                    row["backend"] = backend
+                    _stamp(row)
                     print(json.dumps(row), flush=True)
                     rows.append(row)
 

@@ -315,8 +315,47 @@ def bench_query_plane(n: int) -> dict:
     for i in range(q):
         assert [(ws, c[i]) for ws, c in dyn] == ded[i], \
             f"dynamic fleet query {i} diverged from its dedicated run"
+
+    # recompile-sentinel gate over the PR 9 churn acceptance shape (ISSUE
+    # 12): a Q=32 fleet with ONE admit + ONE retire per emitted window —
+    # every change repads within the same power-of-two bucket, so after
+    # the warmup pass the sentinel must record 0 post-warmup XLA compiles
+    from spatialflink_tpu.utils import deviceplane
+
+    q32 = 32
+    pts32 = [(115.5 + rng.random() * 2, 39.6 + rng.random() * 1.5)
+             for _ in range(q32)]
+
+    def run_churn():
+        reg = QueryRegistry("range", radius=0.5)
+        for i, (x, y) in enumerate(pts32):
+            reg.admit({"id": f"q{i}", "x": x, "y": y})
+        reg.apply()
+        op = PointPointRangeQuery(conf, grid)
+        stream = driver.decode_stream(iter(lines), cfg, grid)
+        i = 0
+        for _w in op.run_dynamic(stream, reg, 0.5):
+            reg.admit({"id": f"churn{i}", "x": 115.5 + (i % 10) * 0.1,
+                       "y": 39.6 + (i % 10) * 0.1})
+            reg.retire([e.id for e in reg.active_entries()][0])
+            i += 1
+
+    run_churn()  # warm the Q=32 bucket's shapes
+    dp = deviceplane.registry()
+    dp.begin_run()
+    dp.mark_warm("bench_guard query-plane churn (shapes pre-warmed)")
+    try:
+        run_churn()
+        post_warm = dp.run_recompiles
+    finally:
+        dp.end_run()
+    assert post_warm == 0, (
+        f"recompile sentinel fired {post_warm}x across the Q={q32} "
+        "admit/retire-per-window churn run — in-bucket repadding must "
+        "never recompile (the PR 9 contract, now device-truth-asserted)")
     return dict(path="query_plane", records=n, queries=q,
-                speedup=round(dt_s / dt_d, 2))
+                speedup=round(dt_s / dt_d, 2),
+                churn_post_warmup_compiles=post_warm)
 
 
 def measure(n: int) -> list:

@@ -1,0 +1,286 @@
+"""Post-mortem / preflight doctor for the device-truth plane.
+
+``python -m spatialflink_tpu.doctor`` reads what the flight recorder
+(``--postmortem-dir``) writes and answers the questions an operator has
+BEFORE and AFTER a run:
+
+- ``--preflight [--require-backend tpu]`` — readiness check for the
+  accelerator: backend provenance vs the required target (the BENCH r05
+  silent-CPU-fallback condition exits non-zero instead of being discovered
+  in a ledger tail), device visibility, memory-stats availability, a tiny
+  instrumented-jit probe compile (proves the compile path + registry), and
+  the persistent compilation-cache configuration. Exit 0 = ready.
+- ``summarize BUNDLE`` — one human digest of a post-mortem bundle: dump
+  reason, error, backend, throughput/window counters, health verdict,
+  compile/recompile counts with the hottest trigger signatures, last
+  flight-recorder notes and lifecycle events.
+- ``diff A B`` — compare two bundles (e.g. a crashed run against a healthy
+  baseline): backend equality (cross-backend comparisons are flagged the
+  way ``bench_diff`` refuses them), counter deltas, compile/recompile
+  deltas, health verdicts side by side. Exit 0; structural problems
+  (unreadable bundle, schema mismatch) exit 2.
+
+All output is line-oriented text by default; ``--json`` emits one JSON
+document instead (machine-readable — the same dict the text renders).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional, Tuple
+
+from spatialflink_tpu.utils import deviceplane
+
+
+# --------------------------------------------------------------------- #
+# bundle IO
+
+
+def load_bundle(path: str) -> dict:
+    """Read one flight-recorder bundle directory into a dict keyed by file
+    stem (manifest/status/compile/device/events/traces/flight/config).
+    Raises ValueError on a missing/unreadable manifest or a schema this
+    doctor does not speak."""
+    manifest_path = os.path.join(path, "manifest.json")
+    try:
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        raise ValueError(f"{path}: not a post-mortem bundle "
+                         f"(manifest.json unreadable: {e})")
+    schema = manifest.get("schema")
+    if schema != deviceplane.BUNDLE_SCHEMA:
+        raise ValueError(f"{path}: bundle schema {schema!r} != "
+                         f"{deviceplane.BUNDLE_SCHEMA} (this doctor is too "
+                         "old or the bundle too new)")
+    out = {"manifest": manifest, "path": path}
+    for name in manifest.get("files", []):
+        stem = name[:-5] if name.endswith(".json") else name
+        try:
+            with open(os.path.join(path, name)) as f:
+                out[stem] = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            out[stem] = {"error": f"unreadable: {e}"}
+    return out
+
+
+def _bundle_digest(b: dict) -> dict:
+    """The comparable core of one bundle (summarize renders it, diff
+    subtracts it)."""
+    manifest = b.get("manifest", {})
+    status = b.get("status", {}) or {}
+    st = status.get("status", {}) or {}
+    device = b.get("device", {}) or {}
+    compile_ = b.get("compile", {}) or {}
+    health = status.get("health")
+    return {
+        "path": b.get("path"),
+        "reason": manifest.get("reason"),
+        "ts_ms": manifest.get("ts_ms"),
+        "error": manifest.get("error"),
+        "backend": (device.get("backend") or {}).get("platform"),
+        "device_kind": (device.get("backend") or {}).get("device_kind"),
+        "valid_for_target": (device.get("backend") or {}).get(
+            "valid_for_target"),
+        "records_in": st.get("records_in", 0),
+        "windows": st.get("windows_evaluated", 0),
+        "throughput_rps": st.get("throughput_rps", 0.0),
+        "slo_breaches": st.get("slo_breaches", 0),
+        "healthy": None if health is None else health.get("healthy"),
+        "unhealthy_checks": ([] if health is None else
+                             sorted(n for n, c in health["checks"].items()
+                                    if not c["ok"])),
+        "compiles": compile_.get("total_compiles", 0),
+        "post_warmup_compiles": compile_.get("post_warmup_compiles", 0),
+        "warm": compile_.get("warm"),
+        "mem_bytes_in_use": (device.get("memory") or {}).get("bytes_in_use"),
+        "d2h_bytes": (device.get("transfer") or {}).get("d2h_bytes", 0),
+        "dispatch_overlap_p50": (device.get("dispatch_overlap") or {}).get(
+            "p50"),
+        "events": len((b.get("events") or {}).get("events", [])),
+        "notes": (b.get("flight") or {}).get("total", 0),
+    }
+
+
+# --------------------------------------------------------------------- #
+# commands
+
+
+def summarize(path: str, as_json: bool = False,
+              out=sys.stdout) -> int:
+    b = load_bundle(path)
+    d = _bundle_digest(b)
+    if as_json:
+        print(json.dumps(d, sort_keys=True), file=out)
+        return 0
+    print(f"bundle     {path}", file=out)
+    print(f"reason     {d['reason']}" + (f" — {d['error']}" if d["error"]
+                                         else ""), file=out)
+    print(f"backend    {d['backend']} ({d['device_kind']}), "
+          f"valid_for_target={d['valid_for_target']}", file=out)
+    print(f"pipeline   {d['records_in']} records in, {d['windows']} windows, "
+          f"{d['throughput_rps']:.0f} rec/s", file=out)
+    if d["healthy"] is not None:
+        bad = ",".join(d["unhealthy_checks"]) or "-"
+        print(f"health     {'ok' if d['healthy'] else 'BREACH'} "
+              f"(failing: {bad}; {d['slo_breaches']} breach transition(s))",
+              file=out)
+    print(f"compiles   {d['compiles']} total, "
+          f"{d['post_warmup_compiles']} post-warmup (warm={d['warm']})",
+          file=out)
+    for e in (b.get("compile") or {}).get("entries", [])[:5]:
+        sig = e["signatures"][-1]["signature"] if e["signatures"] else "?"
+        print(f"  {e['compiles']:3d}x {e['name']}  last {sig[:80]}",
+              file=out)
+    if d["dispatch_overlap_p50"] is not None:
+        print(f"overlap    p50 {d['dispatch_overlap_p50']:.2f}", file=out)
+    print(f"transfer   d2h {d['d2h_bytes']} B; device mem in use "
+          f"{d['mem_bytes_in_use']}", file=out)
+    notes = (b.get("flight") or {}).get("notes", [])[-5:]
+    for nte in notes:
+        extra = {k: v for k, v in nte.items() if k not in ("ts_ms", "kind")}
+        print(f"note       {nte.get('kind')} {extra}", file=out)
+    evs = (b.get("events") or {}).get("events", [])[-5:]
+    for ev in evs:
+        print(f"event      #{ev.get('seq')} {ev.get('kind')}", file=out)
+    return 0
+
+
+def diff(path_a: str, path_b: str, as_json: bool = False,
+         out=sys.stdout) -> int:
+    a, b = load_bundle(path_a), load_bundle(path_b)
+    da, db = _bundle_digest(a), _bundle_digest(b)
+    rows = []
+    for key in ("reason", "error", "backend", "device_kind", "healthy",
+                "unhealthy_checks", "records_in", "windows",
+                "throughput_rps", "slo_breaches", "compiles",
+                "post_warmup_compiles", "d2h_bytes",
+                "dispatch_overlap_p50", "mem_bytes_in_use"):
+        va, vb = da.get(key), db.get(key)
+        rows.append({"field": key, "a": va, "b": vb, "equal": va == vb})
+    doc = {"a": path_a, "b": path_b,
+           "cross_backend": da["backend"] != db["backend"],
+           "rows": rows}
+    if as_json:
+        print(json.dumps(doc, sort_keys=True), file=out)
+        return 0
+    print(f"A: {path_a}  ({da['reason']})", file=out)
+    print(f"B: {path_b}  ({db['reason']})", file=out)
+    if doc["cross_backend"]:
+        print(f"WARNING: cross-backend diff ({da['backend']} vs "
+              f"{db['backend']}) — throughput/latency deltas are not "
+              "comparable (the bench_diff pairing rule)", file=out)
+    for r in rows:
+        mark = " " if r["equal"] else "*"
+        print(f"{mark} {r['field']:<22} {r['a']!r:>24} | {r['b']!r}",
+              file=out)
+    return 0
+
+
+def preflight(require_backend: str = "tpu", as_json: bool = False,
+              out=sys.stdout) -> int:
+    """Backend/memory/compile-cache readiness check; exit non-zero when the
+    chip the operator asked for is not what the process would run on."""
+    import time as _time
+
+    checks: List[dict] = []
+
+    def check(name: str, ok: Optional[bool], detail) -> None:
+        checks.append({"check": name, "ok": ok, "detail": detail})
+
+    prov = None
+    try:
+        prov = deviceplane.backend_provenance(target=require_backend)
+        check("backend", prov["platform"] == require_backend,
+              f"platform={prov['platform']} device_kind="
+              f"{prov['device_kind']} x{prov['device_count']} "
+              f"(required: {require_backend})")
+    except Exception as e:
+        check("backend", False, f"backend probe failed: {e}")
+    mem = deviceplane.memory_gauges()
+    check("memory_stats", None if not mem["available"] else True,
+          ("memory_stats available, "
+           f"in_use={mem['bytes_in_use']}" if mem["available"]
+           else "no memory_stats on this backend (normal on CPU)"))
+    # compile probe: a tiny instrumented jit through the registry — proves
+    # the XLA compile path AND that the sentinel would see it
+    try:
+        import jax.numpy as jnp
+
+        reg = deviceplane.registry()
+        before = reg.total_compiles
+        t0 = _time.perf_counter()
+        fn = deviceplane.instrumented_jit(lambda x: (x * 2 + 1).sum())
+        float(fn(jnp.arange(8.0)))
+        dt_ms = (_time.perf_counter() - t0) * 1e3
+        check("compile_probe", reg.total_compiles == before + 1,
+              f"1 compile in {dt_ms:.0f}ms, registry saw it "
+              f"({reg.total_compiles - before} recorded)")
+    except Exception as e:
+        check("compile_probe", False, f"probe compile failed: {e}")
+    try:
+        import jax
+
+        cache_dir = jax.config.jax_compilation_cache_dir
+        check("compilation_cache", None if not cache_dir else True,
+              (f"persistent compilation cache at {cache_dir}" if cache_dir
+               else "no persistent compilation cache configured "
+                    "(jax_compilation_cache_dir unset — every process "
+                    "pays cold compiles)"))
+    except Exception as e:
+        check("compilation_cache", None, f"unreadable: {e}")
+    failed = [c for c in checks if c["ok"] is False]
+    doc = {"ready": not failed, "require_backend": require_backend,
+           "provenance": prov, "checks": checks}
+    if as_json:
+        print(json.dumps(doc, sort_keys=True), file=out)
+    else:
+        for c in checks:
+            mark = {True: "ok  ", False: "FAIL", None: "note"}[c["ok"]]
+            print(f"{mark} {c['check']:<18} {c['detail']}", file=out)
+        print(("ready" if not failed else
+               f"NOT READY ({', '.join(c['check'] for c in failed)})"),
+              file=out)
+    return 0 if not failed else 1
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    # `doctor --preflight` and `doctor preflight` both work (the flag form
+    # is what the flight-recorder banner and ISSUE spell)
+    if "--preflight" in argv:
+        argv[argv.index("--preflight")] = "preflight"
+    ap = argparse.ArgumentParser(
+        prog="python -m spatialflink_tpu.doctor",
+        description="preflight the device plane; summarize/diff "
+                    "post-mortem bundles")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one JSON document instead of text")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p = sub.add_parser("preflight", help="backend/memory/compile readiness")
+    p.add_argument("--require-backend", default="tpu",
+                   choices=("cpu", "tpu", "gpu"),
+                   help="platform the run must land on (default tpu: the "
+                        "CPU-fallback condition exits non-zero)")
+    s = sub.add_parser("summarize", help="digest one bundle")
+    s.add_argument("bundle")
+    d = sub.add_parser("diff", help="compare two bundles")
+    d.add_argument("bundle_a")
+    d.add_argument("bundle_b")
+    args = ap.parse_args(argv)
+    try:
+        if args.cmd == "preflight":
+            return preflight(args.require_backend, as_json=args.json)
+        if args.cmd == "summarize":
+            return summarize(args.bundle, as_json=args.json)
+        return diff(args.bundle_a, args.bundle_b, as_json=args.json)
+    except ValueError as e:
+        print(f"doctor: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1541,12 +1541,40 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="health/SLO thresholds as comma-joined key=value "
                          "pairs, e.g. 'watermark_lag_ms=5000,"
                          "p99_window_ms=250,commit_backlog=10000,"
-                         "checkpoint_age_s=60'. Drives /healthz (503 on "
+                         "checkpoint_age_s=60,recompiles=0,"
+                         "device_mem_bytes=8e9'. Drives /healthz (503 on "
                          "breach), stamps a 'health' verdict into every "
                          "telemetry snapshot and digest line, counts "
                          "breach transitions in the slo-breaches counter, "
                          "and emits slo-breach/slo-recovered (and "
                          "watermark-stall) lifecycle events")
+    ap.add_argument("--postmortem-dir", metavar="DIR", default=None,
+                    help="arm the flight recorder: a bounded ring of run "
+                         "lifecycle notes that dumps a post-mortem bundle "
+                         "directory (status snapshot, event ring, compile "
+                         "registry, recent window traces, device memory "
+                         "profile, config fingerprint) to DIR on crash, "
+                         "first SLO breach, strict-recompile abort, or "
+                         "SIGUSR1 — read it with 'python -m "
+                         "spatialflink_tpu.doctor summarize/diff'. "
+                         "Activates a telemetry session")
+    ap.add_argument("--strict-recompile", action="store_true",
+                    help="abort the run (exit 3, post-mortem bundle if "
+                         "--postmortem-dir) when any XLA kernel compiles "
+                         "AFTER the declared warmup — the PR 8/9 "
+                         "zero-recompile contracts as a hard production "
+                         "invariant instead of a test-time assert. "
+                         "Observational without this flag: post-warmup "
+                         "compiles still count ('device-recompiles', "
+                         "'recompile' events, GET /compile)")
+    ap.add_argument("--sentinel-warmup", type=int, default=1,
+                    metavar="WINDOWS",
+                    help="recompile-sentinel warmup: compiles stop being "
+                         "expected after this many emitted windows "
+                         "(default 1). Streams whose batch sizes keep "
+                         "growing into fresh padding buckets late in the "
+                         "run may need a larger value before "
+                         "--strict-recompile is safe")
     ap.add_argument("--profile", metavar="DIR", default=None,
                     help="capture a jax.profiler trace of the run to DIR "
                          "(TensorBoard/XProf format) with per-operator "
@@ -1986,14 +2014,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                   "--telemetry-dir, or --live-stats (nothing evaluates "
                   "the thresholds)", file=sys.stderr)
 
-    if args.telemetry_dir or args.live_stats or args.trace_dir:
+    if (args.telemetry_dir or args.live_stats or args.trace_dir
+            or args.postmortem_dir):
         from spatialflink_tpu.utils.telemetry import telemetry_session
 
         # the session must wrap the KAFKA WIRING too (taps/sinks capture
         # their gauges at construction), not just the result loop.
-        # --live-stats/--trace-dir without --telemetry-dir run a
-        # reporterless session (instrumentation on; the digest / trace
-        # book are fed from it)
+        # --live-stats/--trace-dir/--postmortem-dir without
+        # --telemetry-dir run a reporterless session (instrumentation on;
+        # the digest / trace book / flight-recorder bundle are fed from
+        # it)
         with telemetry_session(args.telemetry_dir or None,
                                args.telemetry_interval, health=health,
                                trace_dir=args.trace_dir):
@@ -2102,6 +2132,44 @@ def _run_cli(ap, args, params: Params, spec: CaseSpec, skip1: int,
     import contextlib
 
     stack = contextlib.ExitStack()
+    from spatialflink_tpu.utils import deviceplane
+
+    # recompile sentinel: warmup re-opens for this run; after the declared
+    # warmup (--sentinel-warmup emitted windows) every fresh XLA compile is
+    # a 'recompile' event + counter, and an abort under --strict-recompile.
+    # end_run on the stack so an in-process rerun (tests) starts cold.
+    sentinel = deviceplane.registry()
+    sentinel.begin_run(strict=args.strict_recompile)
+    stack.callback(sentinel.end_run)
+    recorder = None
+    if args.postmortem_dir:
+        recorder = deviceplane.FlightRecorder(
+            args.postmortem_dir,
+            config={
+                "job_fingerprint": params.job_fingerprint(),
+                "option": params.query.option,
+                "family": spec.family,
+                "mode": spec.mode,
+                "backend": deviceplane.backend_provenance(),
+                "flags": {
+                    "kafka": bool(args.kafka),
+                    "chaos": args.chaos is not None,
+                    "panes": bool(getattr(args, "panes", False)),
+                    "strict_recompile": args.strict_recompile,
+                    "sentinel_warmup": args.sentinel_warmup,
+                    "slo": args.slo,
+                },
+            })
+        recorder.install_signal()
+        if health is not None:
+            recorder.attach_health(health)
+        stack.callback(recorder.close)
+        recorder.note("run-start", option=params.query.option,
+                      family=spec.family)
+        print(f"# flight recorder armed: post-mortem bundles -> "
+              f"{args.postmortem_dir} (crash / SLO breach / SIGUSR1; "
+              "read with python -m spatialflink_tpu.doctor)",
+              file=sys.stderr)
     repartitioner = getattr(params, "repartitioner", None)
     if repartitioner is not None:
         # chain onto the grid-cell observer hook (decode-time base-cell
@@ -2199,6 +2267,7 @@ def _run_cli(ap, args, params: Params, spec: CaseSpec, skip1: int,
 
     n = 0
     stopped = False
+    strict_abort = False
     it = iter(results)
     try:
         while True:
@@ -2228,10 +2297,34 @@ def _run_cli(ap, args, params: Params, spec: CaseSpec, skip1: int,
             if journal is not None and isinstance(result, WindowResult):
                 journal.record(result)
             n += 1
+            if (not sentinel.warm and isinstance(result, WindowResult)
+                    and n >= args.sentinel_warmup):
+                # declared warmup done: the run's steady-state shapes have
+                # been seen; any later compile is a sentinel event
+                sentinel.mark_warm(
+                    f"{n} window(s) emitted (--sentinel-warmup "
+                    f"{args.sentinel_warmup})")
+            if recorder is not None and isinstance(result, WindowResult):
+                recorder.note("window", start=result.window_start,
+                              records=len(result.records))
     except ControlTupleExit:
         # the remote-stop hook (HelperClass.checkExitControlTuple:441-453) is
         # a graceful shutdown, not an error: finish the summary and exit 0
         stopped = True
+    except deviceplane.RecompileError as e:
+        # --strict-recompile abort: the zero-recompile contract was
+        # violated; capture the moment and exit distinctly (3)
+        if recorder is not None:
+            recorder.dump("strict-recompile", error=e)
+        print(f"# STRICT-RECOMPILE ABORT: {e}", file=sys.stderr)
+        strict_abort = True
+    except BaseException as e:
+        # any other crash: dump the post-mortem bundle (state at the
+        # moment of death — the whole point of the recorder), then
+        # propagate unchanged
+        if recorder is not None:
+            recorder.dump("crash", error=e)
+        raise
     finally:
         stack.close()  # stop the profiler trace before the summary prints
         if out_sink is not None:
@@ -2266,7 +2359,7 @@ def _run_cli(ap, args, params: Params, spec: CaseSpec, skip1: int,
         print(json.dumps({"metrics": REGISTRY.snapshot(),
                           "degradation": degradation_snapshot()},
                          sort_keys=True), file=sys.stderr)
-    return 0
+    return 3 if strict_abort else 0
 
 
 if __name__ == "__main__":

@@ -1428,6 +1428,11 @@ class SpatialOperator:
         costs = tel.costs if tel is not None else None
         if tel is not None:
             backlog = tel.gauge("window-backlog")
+            # per-window dispatch→ready overlap: 1 − blocked/round-trip —
+            # the fraction of the device round-trip hidden behind host
+            # work (pipeline_depth's payoff; ~0 when the drain blocks the
+            # whole time, →1 when readback returns instantly)
+            overlap_hist = tel.histogram("dispatch-overlap-ratio")
             batched = self._spanned_batches(batched, tel, label)
 
         def emit(start, end, sel) -> Iterator[WindowResult]:
@@ -1441,15 +1446,20 @@ class SpatialOperator:
 
         def drain(n: int) -> Iterator[WindowResult]:
             while len(pending) > n:
-                start, end, dfd = pending.popleft()
+                start, end, dfd, t_disp = pending.popleft()
                 if tel is not None:
                     w0 = time.time()
                     with tel.span("merge", query=label):
                         sel = dfd.finish()
+                    w1 = time.time()
                     if book is not None:
-                        book.note(label, start, "merge", w0, time.time())
+                        book.note(label, start, "merge", w0, w1)
                     if costs is not None:
-                        costs.attribute_merge(label, time.time() - w0)
+                        costs.attribute_merge(label, w1 - w0)
+                    total = w1 - t_disp
+                    if total > 0:
+                        overlap_hist.record(
+                            max(0.0, 1.0 - (w1 - w0) / total))
                     backlog.set(len(pending))
                 else:
                     with trace(f"{op_name}.readback"):
@@ -1474,7 +1484,8 @@ class SpatialOperator:
                 with trace(f"{op_name}.dispatch"):
                     sel = eval_batch(payload, start)
             if isinstance(sel, Deferred):
-                pending.append((start, end, sel))
+                pending.append((start, end, sel,
+                                time.time() if tel is not None else 0.0))
                 if tel is not None:
                     backlog.set(len(pending))
                 yield from drain(depth - 1)

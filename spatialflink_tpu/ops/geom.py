@@ -25,11 +25,12 @@ import numpy as np
 
 from spatialflink_tpu.models.batches import EdgeGeomBatch, PointBatch
 from spatialflink_tpu.ops import distances as D
+from spatialflink_tpu.utils.deviceplane import instrumented_jit
 
 _BIG = np.float32(3.4e38)
 
 
-@jax.jit
+@instrumented_jit
 def points_in_geoms(px, py, edges, edge_mask):
     """(N, G) even-odd containment of each point in each geometry's rings."""
     return D.point_in_rings(
@@ -37,7 +38,7 @@ def points_in_geoms(px, py, edges, edge_mask):
     )
 
 
-@jax.jit
+@instrumented_jit
 def points_to_edges_dist(px, py, edges, edge_mask):
     """(N, G) min boundary distance from each point to each edge set."""
     d2 = D.point_segment_dist2(
@@ -51,7 +52,7 @@ def points_to_edges_dist(px, py, edges, edge_mask):
     return jnp.sqrt(jnp.min(jnp.where(edge_mask[None], d2, _BIG), axis=-1))
 
 
-@jax.jit
+@instrumented_jit
 def points_to_geoms_dist(points: PointBatch, geoms: EdgeGeomBatch):
     """(N, G) JTS-style distance from each point to each geometry."""
     bdist = points_to_edges_dist(points.x, points.y, geoms.edges, geoms.edge_mask)
@@ -59,7 +60,7 @@ def points_to_geoms_dist(points: PointBatch, geoms: EdgeGeomBatch):
     return jnp.where(inside & geoms.is_areal[None, :], 0.0, bdist)
 
 
-@partial(jax.jit, static_argnames=("k", "strategy", "approximate"))
+@partial(instrumented_jit, static_argnames=("k", "strategy", "approximate"))
 def knn_points_to_geom_queries(points: PointBatch, geoms: EdgeGeomBatch,
                                nb_masks, *, k: int, strategy: str = "auto",
                                approximate: bool = False):
@@ -103,7 +104,7 @@ def points_to_single_geom_dist(points: PointBatch, edges, edge_mask, is_areal: b
     return PK.pip_dist(points.x, points.y, edges, edge_mask, bool(is_areal))
 
 
-@jax.jit
+@instrumented_jit
 def points_to_single_edges_raw(px, py, edges, edge_mask):
     """(inside, min_dist2) of each point vs ONE edge set — the shared jnp twin
     of the pallas pip kernel. Empty/fully-masked edge sets yield +inf dist2."""
@@ -121,7 +122,7 @@ def points_to_single_edges_raw(px, py, edges, edge_mask):
     return inside, mind2
 
 
-@jax.jit
+@instrumented_jit
 def geoms_to_single_geom_dist(geoms: EdgeGeomBatch, q_edges, q_mask, q_areal: bool):
     """(G,) JTS-style distance from each batch geometry to ONE query geometry.
 
@@ -154,14 +155,14 @@ def geoms_to_single_geom_dist(geoms: EdgeGeomBatch, q_edges, q_mask, q_areal: bo
     return jnp.where(zero, 0.0, jnp.sqrt(bdist2))
 
 
-@jax.jit
+@instrumented_jit
 def geoms_bbox_dist(geoms: EdgeGeomBatch, q_bbox):
     """(G,) bbox-bbox distance to a query bbox — the approximate-mode
     prefilter (DistanceFunctions.java:298-421)."""
     return D.bbox_bbox_dist(geoms.bbox, q_bbox[None, :])
 
 
-@jax.jit
+@instrumented_jit
 def point_to_geoms_dist(px, py, geoms: EdgeGeomBatch):
     """(G,) distance from ONE query point to each batch geometry (the
     polygon-stream x point-query case, ``PolygonPointRangeQuery``)."""
@@ -184,7 +185,7 @@ def _geom_elig_multi(geoms: EdgeGeomBatch, nb_masks):
     return geoms.valid[None, :] & any_in
 
 
-@partial(jax.jit, static_argnames=("k", "strategy", "approximate"))
+@partial(instrumented_jit, static_argnames=("k", "strategy", "approximate"))
 def knn_geoms_to_point_queries(geoms: EdgeGeomBatch, qx, qy, nb_masks, *,
                                k: int, strategy: str = "auto",
                                approximate: bool = False):
@@ -208,7 +209,7 @@ def knn_geoms_to_point_queries(geoms: EdgeGeomBatch, qx, qy, nb_masks, *,
     return res, jnp.sum(elig, axis=1, dtype=jnp.int32)
 
 
-@partial(jax.jit, static_argnames=("k", "strategy", "approximate"))
+@partial(instrumented_jit, static_argnames=("k", "strategy", "approximate"))
 def knn_geoms_to_geom_queries(geoms: EdgeGeomBatch, queries: EdgeGeomBatch,
                               nb_masks, *, k: int, strategy: str = "auto",
                               approximate: bool = False):
@@ -231,7 +232,7 @@ def knn_geoms_to_geom_queries(geoms: EdgeGeomBatch, queries: EdgeGeomBatch,
     return res, jnp.sum(elig, axis=1, dtype=jnp.int32)
 
 
-@partial(jax.jit, static_argnames=("approximate",))
+@partial(instrumented_jit, static_argnames=("approximate",))
 def range_points_to_geom_queries(points: PointBatch, queries: EdgeGeomBatch,
                                  gn_masks, cn_masks, radius, *,
                                  approximate: bool = False):
@@ -265,7 +266,7 @@ def range_points_to_geom_queries(points: PointBatch, queries: EdgeGeomBatch,
     )(d_all, gn_masks, cn_masks)
 
 
-@partial(jax.jit, static_argnames=("approximate",))
+@partial(instrumented_jit, static_argnames=("approximate",))
 def range_geoms_to_point_queries(geoms: EdgeGeomBatch, qx, qy, gn_masks,
                                  nb_masks, radius, *,
                                  approximate: bool = False):
@@ -291,7 +292,7 @@ def range_geoms_to_point_queries(geoms: EdgeGeomBatch, qx, qy, gn_masks,
     return jax.vmap(one)(qx, qy, gn_masks, nb_masks)
 
 
-@partial(jax.jit, static_argnames=("approximate",))
+@partial(instrumented_jit, static_argnames=("approximate",))
 def range_geoms_to_geom_queries(geoms: EdgeGeomBatch, queries: EdgeGeomBatch,
                                 gn_masks, nb_masks, radius, *,
                                 approximate: bool = False):

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from spatialflink_tpu.models.batches import PointBatch
+from spatialflink_tpu.utils.deviceplane import instrumented_jit
 from spatialflink_tpu.ops.range import cheb_layers
 
 _BIG = np.float32(3.4e38)
@@ -101,7 +102,7 @@ def pairwise_dist2_bf16(ax, ay, bx, by, center_x=0.0, center_y=0.0):
     return jnp.maximum(a2 + b2 - 2.0 * cross, 0.0)
 
 
-@partial(jax.jit, static_argnames=("n",))
+@partial(instrumented_jit, static_argnames=("n",))
 def join_mask_bf16_superset(
     a: PointBatch,
     b: PointBatch,
@@ -132,7 +133,7 @@ def _pair_cell_ok(cell_a, cell_b, nb_layers, n):
     return cheb_layers(cell_a[:, None], cell_b[None, :], n) <= nb_layers
 
 
-@partial(jax.jit, static_argnames=("n",))
+@partial(instrumented_jit, static_argnames=("n",))
 def join_mask(
     a: PointBatch,
     b: PointBatch,
@@ -149,7 +150,7 @@ def join_mask(
     return ok & (d2 <= radius * radius) & a.valid[:, None] & b.valid[None, :]
 
 
-@partial(jax.jit, static_argnames=("n", "tile"))
+@partial(instrumented_jit, static_argnames=("n", "tile"))
 def join_counts(
     a: PointBatch,
     b: PointBatch,
@@ -383,7 +384,7 @@ def pair_min_cheb(cells_a, mask_a, cells_b, mask_b, n):
     return jnp.min(jnp.where(valid, ch, jnp.int32(2**30)), axis=(-2, -1))
 
 
-@partial(jax.jit, static_argnames=("n",))
+@partial(instrumented_jit, static_argnames=("n",))
 def join_point_geom_mask(points: PointBatch, geoms, radius, nb_layers, *, n: int):
     """(N, G) join lattice: point stream x polygon/linestring query stream
     (``join/PointPolygonJoinQuery.java``). Cell predicate: the point's cell
@@ -403,7 +404,7 @@ def join_point_geom_mask(points: PointBatch, geoms, radius, nb_layers, *, n: int
     )
 
 
-@partial(jax.jit, static_argnames=("n",))
+@partial(instrumented_jit, static_argnames=("n",))
 def join_geom_geom_mask(a, b, radius, nb_layers, *, n: int):
     """(Ga, Gb) join lattice: polygon/linestring stream x polygon/linestring
     query stream (``join/PolygonPolygonJoinQuery.java`` etc.)."""

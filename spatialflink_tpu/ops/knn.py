@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from spatialflink_tpu.models.batches import PointBatch
+from spatialflink_tpu.utils.deviceplane import instrumented_jit
 from spatialflink_tpu.ops import distances as D
 from spatialflink_tpu.ops.range import cheb_layers
 
@@ -355,7 +356,7 @@ def _knn_point_parts(points, qx, qy, q_cell, radius, nb_layers, n,
     return d, eligible, cell_eligible
 
 
-@partial(jax.jit, static_argnames=("n", "k", "enforce_radius", "strategy"))
+@partial(instrumented_jit, static_argnames=("n", "k", "enforce_radius", "strategy"))
 def knn_point(
     points: PointBatch,
     qx,
@@ -380,7 +381,7 @@ def knn_point(
     return topk_by_distance(points.obj_id, d, eligible, k, strategy)
 
 
-@partial(jax.jit, static_argnames=("n", "k", "enforce_radius", "strategy"))
+@partial(instrumented_jit, static_argnames=("n", "k", "enforce_radius", "strategy"))
 def knn_point_stats(
     points: PointBatch,
     qx,
@@ -408,7 +409,7 @@ def knn_point_stats(
     return res, jnp.sum(cell_eligible, dtype=jnp.int32)
 
 
-@partial(jax.jit, static_argnames=("n", "k", "enforce_radius", "strategy"))
+@partial(instrumented_jit, static_argnames=("n", "k", "enforce_radius", "strategy"))
 def knn_point_multi(
     points: PointBatch,
     qx,
@@ -438,7 +439,7 @@ def knn_point_multi(
     return topk_by_distance_multi(points.obj_id, d, eligible, k, strategy)
 
 
-@partial(jax.jit, static_argnames=("n", "k", "enforce_radius", "strategy"))
+@partial(instrumented_jit, static_argnames=("n", "k", "enforce_radius", "strategy"))
 def knn_point_multi_stats(
     points: PointBatch,
     qx,
@@ -466,7 +467,7 @@ def knn_point_multi_stats(
     return res, evals
 
 
-@partial(jax.jit, static_argnames=("k", "enforce_radius", "strategy"))
+@partial(instrumented_jit, static_argnames=("k", "enforce_radius", "strategy"))
 def knn_with_dists(
     obj_id,
     dists,
@@ -527,7 +528,7 @@ def merge_knn(results, k: int) -> KnnResult:
     return topk_by_distance(obj_id, dist, valid, k)
 
 
-@partial(jax.jit, static_argnames=("k",))
+@partial(instrumented_jit, static_argnames=("k",))
 def _merge_topk_stacked(obj_id, dist, valid, *, k: int) -> KnnResult:
     """(P, k) stacked partials -> merged exact top-k. P*k is tiny (overlap
     panes), so the full-sort dedup is the right strategy and matches the
@@ -536,7 +537,7 @@ def _merge_topk_stacked(obj_id, dist, valid, *, k: int) -> KnnResult:
                             valid.reshape(-1), k, "sort")
 
 
-@partial(jax.jit, static_argnames=("k",))
+@partial(instrumented_jit, static_argnames=("k",))
 def _merge_topk_stacked_multi(obj_id, dist, valid, *, k: int) -> KnnResult:
     """(P, Q, k) stacked multi-query partials -> (Q, k) merged top-k."""
     q = obj_id.shape[1]
@@ -570,7 +571,7 @@ def merge_knn_device_multi(results, k: int) -> KnnResult:
         jnp.stack([r.valid for r in results]), k=k)
 
 
-@partial(jax.jit, static_argnames=("k", "strategy"))
+@partial(instrumented_jit, static_argnames=("k", "strategy"))
 def knn_eligible(obj_id, dists, eligible, *, k: int,
                  strategy: str = "auto") -> KnnResult:
     """Jitted dedup+top-k over caller-computed eligibility and distances —
@@ -578,7 +579,7 @@ def knn_eligible(obj_id, dists, eligible, *, k: int,
     return topk_by_distance(obj_id, dists, eligible, k, strategy)
 
 
-@partial(jax.jit, static_argnames=("k", "strategy"))
+@partial(instrumented_jit, static_argnames=("k", "strategy"))
 def knn_eligible_stats(obj_id, dists, eligible, *, k: int,
                        strategy: str = "auto"):
     """knn_eligible + the candidate count in the same dispatch (the generic

@@ -50,6 +50,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from spatialflink_tpu.utils.deviceplane import instrumented_jit
+
 _BIG = np.float32(3.4e38)
 _F_BIG = 3.4e38  # plain literal for in-kernel use (pallas kernels
 #                  cannot capture traced constants)
@@ -164,7 +166,7 @@ def _pip_kernel(e_ref, m_ref, px_ref, py_ref, cross_ref, mind2_ref):
         mind2_ref[:] = jnp.minimum(mind2_ref[:], mind2)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(instrumented_jit, static_argnames=("interpret",))
 def _pip_pallas(px, py, edges, edge_mask, *, interpret: bool):
     n = px.shape[0]
     # edges arrive pre-bucketed by pip_dist OUTSIDE this jit boundary (to a
@@ -242,7 +244,7 @@ def pip_dist(px, py, edges, edge_mask, is_areal: bool):
 # --------------------------------------------------------------------------- #
 
 
-@functools.partial(jax.jit, static_argnames=("n", "tile"))
+@functools.partial(instrumented_jit, static_argnames=("n", "tile"))
 def _join_reduce_impl(a, b, radius, nb_layers, *, n: int, tile: int):
     """a/b: PointBatch-like namedtuples with .x/.y/.cell/.valid.
 

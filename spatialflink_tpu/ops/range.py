@@ -22,6 +22,7 @@ import jax.numpy as jnp
 
 from spatialflink_tpu.index.uniform_grid import cheb_layers
 from spatialflink_tpu.models.batches import PointBatch
+from spatialflink_tpu.utils.deviceplane import instrumented_jit
 from spatialflink_tpu.ops import distances as D
 
 
@@ -40,7 +41,7 @@ def _range_point_parts(points, qx, qy, q_cell, radius, gn_layers, cn_layers,
     return mask, dists, in_gn, in_cn
 
 
-@partial(jax.jit, static_argnames=("n", "approximate"))
+@partial(instrumented_jit, static_argnames=("n", "approximate"))
 def range_filter_point(
     points: PointBatch,
     qx,
@@ -67,7 +68,7 @@ def range_filter_point(
     return mask, dists
 
 
-@partial(jax.jit, static_argnames=("n", "approximate"))
+@partial(instrumented_jit, static_argnames=("n", "approximate"))
 def range_filter_point_stats(
     points: PointBatch,
     qx,
@@ -95,7 +96,7 @@ def range_filter_point_stats(
     return mask, dists, gn_bypassed, dist_evals
 
 
-@partial(jax.jit, static_argnames=("n", "approximate"))
+@partial(instrumented_jit, static_argnames=("n", "approximate"))
 def range_filter_point_multi(
     points: PointBatch,
     qx,
@@ -131,7 +132,7 @@ def range_filter_point_multi(
     )(qx, qy, q_cell)
 
 
-@partial(jax.jit, static_argnames=("n", "approximate"))
+@partial(instrumented_jit, static_argnames=("n", "approximate"))
 def range_filter_point_multi_masks(
     points: PointBatch,
     qx,
@@ -170,7 +171,7 @@ def _range_masks_parts(points, gn_mask, cn_mask, dists, radius, approximate):
     return mask, in_gn, in_cn
 
 
-@partial(jax.jit, static_argnames=("approximate",))
+@partial(instrumented_jit, static_argnames=("approximate",))
 def range_filter_masks(
     points: PointBatch,
     gn_mask,
@@ -192,7 +193,7 @@ def range_filter_masks(
     return mask
 
 
-@partial(jax.jit, static_argnames=("approximate",))
+@partial(instrumented_jit, static_argnames=("approximate",))
 def range_filter_masks_stats(
     points: PointBatch,
     gn_mask,
@@ -216,7 +217,7 @@ def range_filter_masks_stats(
     return mask, gn_bypassed, dist_evals
 
 
-@jax.jit
+@instrumented_jit
 def range_filter_geom_stream(all_gn, any_nb, dists, radius, valid):
     """Range filter for polygon/linestring STREAMS against any query.
 
@@ -236,7 +237,7 @@ def _geom_stream_mask(all_gn, any_nb, dists, radius, valid):
     return valid & (all_gn | (any_nb & ~all_gn & (dists <= radius)))
 
 
-@jax.jit
+@instrumented_jit
 def range_filter_geom_stream_stats(all_gn, any_nb, dists, radius, valid):
     """range_filter_geom_stream + (gn_bypassed, dist_evals) counts: geometries
     passing on the all-GN rule never consult a distance; every other

@@ -31,6 +31,7 @@ import numpy as np
 
 from spatialflink_tpu.models.batches import PointBatch
 from spatialflink_tpu.ops import distances as D
+from spatialflink_tpu.utils.deviceplane import instrumented_jit
 
 INT32_MIN = np.int32(-(2**31))
 _OID_SENTINEL = np.int32(2**31 - 1)
@@ -83,7 +84,7 @@ def _propagate_run_value(value_at_first, is_first):
     return jax.lax.cummax(seeded)
 
 
-@partial(jax.jit, donate_argnums=(0,))
+@partial(instrumented_jit, donate_argnums=(0,))
 def tstats_update(state: TrajStatsState, batch: PointBatch):
     """-> (new_state, TStatsOut). Batch obj_id must be < state size."""
     n = batch.x.shape[0]
@@ -187,7 +188,7 @@ class TStatsWindowSummary(NamedTuple):
     last_y: jnp.ndarray
 
 
-@partial(jax.jit, static_argnames=("m",))
+@partial(instrumented_jit, static_argnames=("m",))
 def tstats_window_summary(batch: PointBatch, *, m: int) -> TStatsWindowSummary:
     """Fresh-state (windowed) per-trajectory stats of one shard slice."""
     n = batch.x.shape[0]
@@ -241,7 +242,7 @@ def tstats_window_summary(batch: PointBatch, *, m: int) -> TStatsWindowSummary:
                                last_x=lx, last_y=ly)
 
 
-@jax.jit
+@instrumented_jit
 def tstats_stitch_summaries(tabs: TStatsWindowSummary):
     """Merge (D, M) shard summaries (shard-major, in GLOBAL slice order) into
     final per-trajectory stats: spatial = Σ within-shard sums + the boundary
@@ -373,7 +374,7 @@ class TAggregateExtents(NamedTuple):
     first: jnp.ndarray    # (N,) bool marks group representatives
 
 
-@partial(jax.jit, static_argnames=("num_cells",))
+@partial(instrumented_jit, static_argnames=("num_cells",))
 def taggregate_group_extents(batch: PointBatch, *,
                              num_cells: int) -> TAggregateExtents:
     """Group a window by (cell, objID) with per-group [min_ts, max_ts]
@@ -396,7 +397,7 @@ def taggregate_group_extents(batch: PointBatch, *,
                              max_ts=max_ts[gid], first=first)
 
 
-@partial(jax.jit, static_argnames=("num_cells",))
+@partial(instrumented_jit, static_argnames=("num_cells",))
 def taggregate_groups(batch: PointBatch, *, num_cells: int) -> TAggregateGroups:
     """Group a window by (cell, objID); per-group trajectory length =
     max - min timestamp (``tAggregate/TAggregateQuery.java:381-494``)."""
@@ -405,7 +406,7 @@ def taggregate_groups(batch: PointBatch, *, num_cells: int) -> TAggregateGroups:
                             length=e.max_ts - e.min_ts, first=e.first)
 
 
-@partial(jax.jit, static_argnames=("num_cells",))
+@partial(instrumented_jit, static_argnames=("num_cells",))
 def taggregate_merge_extents(cell, oid, min_ts, max_ts, *,
                              num_cells: int) -> TAggregateGroups:
     """Merge (cell, objID) group-extent tables into final groups — the
@@ -428,7 +429,7 @@ def taggregate_merge_extents(cell, oid, min_ts, max_ts, *,
                             length=(g_max - g_min)[gid], first=first)
 
 
-@partial(jax.jit, static_argnames=("num_cells", "agg"))
+@partial(instrumented_jit, static_argnames=("num_cells", "agg"))
 def taggregate_heatmap(groups: TAggregateGroups, *, num_cells: int, agg: str):
     """Dense (num_cells,) heatmap from (cell, objID) groups.
 

@@ -19,6 +19,19 @@ eligibility/distance semantics cannot fork between the two paths.
 All functions are jit-compatible and run under a ``jax.sharding.Mesh`` of any
 size; they are exercised on an 8-device virtual CPU mesh in tests and
 dry-run-compiled by ``__graft_entry__.dryrun_multichip``.
+
+Device-truth coverage contract: this module deliberately has NO raw
+``jax.jit`` sites (enforced by the ``TestJitCoverage`` AST meta-test in
+tier-1). The per-shard bodies are closures over the module-level
+``instrumented_jit`` kernels imported from ``spatialflink_tpu.ops`` —
+their registry hooks live inside the traced bodies, so a fresh shard_map
+trace that misses the inner jaxpr cache feeds the compile registry
+(``utils.deviceplane``) exactly like a single-device compile, and the
+recompile sentinel sees multichip recompiles through the same inner
+entries. Wrapping the per-call ``shard_map`` closures themselves in
+``instrumented_jit`` would register a fresh entry per invocation
+(closure identity churn) and corrupt the per-function compile counters —
+don't.
 """
 
 from __future__ import annotations

@@ -366,6 +366,8 @@ class CheckpointCoordinator:
             for k, a in (arrays or {}).items():
                 cp.arrays[f"{name}/{k}"] = np.asarray(a)
             components[name] = meta
+        from spatialflink_tpu.utils import deviceplane as _deviceplane
+
         self.seq += 1
         cp.meta = {
             "manifest_schema": MANIFEST_SCHEMA_VERSION,
@@ -375,6 +377,11 @@ class CheckpointCoordinator:
             "wall_ms": int(time.time() * 1000),
             "positions": dict(self._positions),
             "components": components,
+            # backend provenance: which device truth wrote this state —
+            # a CPU-written manifest resumed on the TPU (or vice versa)
+            # is legal (host-layout state restores anywhere) but worth a
+            # loud note, and the doctor reads it out of bundles
+            "device": _deviceplane.backend_provenance(),
         }
         path = self._path(self.seq)
         cp.save(path)
@@ -479,6 +486,17 @@ class CheckpointCoordinator:
             self._positions = {k: int(v) for k, v in
                                meta.get("positions", {}).items()}
             self.seq = int(meta.get("seq", seq))
+            written_on = (meta.get("device") or {}).get("platform")
+            if written_on:
+                from spatialflink_tpu.utils import deviceplane as _dp
+
+                here = _dp.backend_provenance()["platform"]
+                if here != written_on:
+                    print(f"# note: resuming a checkpoint written on "
+                          f"'{written_on}' onto '{here}' (host-layout "
+                          "state restores anywhere; device-resident pane "
+                          "values were read back at snapshot time)",
+                          file=sys.stderr)
             self.restored = True
             REGISTRY.counter("checkpoint-restores").inc()
             from spatialflink_tpu.utils.telemetry import emit_event

@@ -45,6 +45,18 @@ def _checkpoint_age(status: dict) -> Optional[float]:
     return (status.get("checkpoint") or {}).get("age_s")
 
 
+def _device_field(field: str) -> Callable[[dict], Optional[float]]:
+    """Reader over the digest's ``device`` stanza (``utils.deviceplane``):
+    ``recompiles`` is the post-warmup compile count (0 is the PR 8/9
+    zero-recompile contract — ``--slo recompiles=0`` turns it into a
+    health verdict), ``mem_bytes_in_use`` the live per-device max (None on
+    backends without memory stats — unknown counts healthy, like every
+    other check)."""
+    def get(status: dict) -> Optional[float]:
+        return (status.get("device") or {}).get(field)
+    return get
+
+
 def _throughput(status: dict) -> Optional[float]:
     # rate is 0.0 before the first record; treat a never-started stream as
     # unknown (records_in == 0), a stalled one (records then silence) as a
@@ -66,6 +78,8 @@ KNOWN_CHECKS: Dict[str, tuple] = {
     "dlq_depth": (_gauge("dlq_depth"), "hi"),
     "breaker_state": (_gauge("breaker_state"), "hi"),
     "min_throughput_rps": (_throughput, "lo"),
+    "recompiles": (_device_field("recompiles"), "hi"),
+    "device_mem_bytes": (_device_field("mem_bytes_in_use"), "hi"),
 }
 
 
@@ -89,6 +103,11 @@ class HealthEvaluator:
                 + ", ".join(sorted(KNOWN_CHECKS)))
         self.thresholds = {k: float(v) for k, v in thresholds.items()}
         self._breached: Dict[str, bool] = {}
+        #: breach-transition observers ``hook(check, value, threshold)`` —
+        #: the flight recorder attaches here so an SLO breach dumps a
+        #: post-mortem bundle at the moment the run went unhealthy; hook
+        #: failures never poison the verdict
+        self.hooks: list = []
         self._lock = threading.Lock()
 
     @classmethod
@@ -131,6 +150,7 @@ class HealthEvaluator:
             status = _telemetry.status_digest(snap)
         checks: Dict[str, dict] = {}
         healthy = True
+        fired: list = []
         with self._lock:
             for name, threshold in sorted(self.thresholds.items()):
                 extract, direction = KNOWN_CHECKS[name]
@@ -148,6 +168,7 @@ class HealthEvaluator:
                     reg.counter("slo-breaches").inc()
                     _telemetry.emit_event("slo-breach", check=name,
                                           value=value, threshold=threshold)
+                    fired.append((name, value, threshold))
                     if name == "watermark_lag_ms":
                         _telemetry.emit_event("watermark-stall",
                                               lag_ms=value,
@@ -156,6 +177,15 @@ class HealthEvaluator:
                     _telemetry.emit_event("slo-recovered", check=name,
                                           value=value)
                 self._breached[name] = not ok
+        # hooks fire OUTSIDE the lock: a flight-recorder dump re-enters
+        # evaluate through status_snapshot, and the transition is already
+        # recorded so the re-entry cannot re-fire the hook
+        for name, value, threshold in fired:
+            for hook in list(self.hooks):
+                try:
+                    hook(name, value, threshold)
+                except Exception:
+                    pass
         return {"healthy": healthy,
                 "status": "ok" if healthy else "breach",
                 "checks": checks}

@@ -79,12 +79,13 @@ _ROUTES = {
     "/events": ("GET",), "/trace/recent": ("GET",),
     "/profile/cells": ("GET",), "/partition": ("GET",),
     "/queries": ("GET", "POST"),
+    "/device": ("GET",), "/compile": ("GET",),
 }
 _PREFIX_ROUTES = {"/trace/": ("GET",), "/queries/": ("GET", "DELETE")}
 
 _ENDPOINTS = ["/healthz", "/status", "/metrics", "/events", "/trace/recent",
               "/trace/<id>", "/profile/cells", "/partition", "/queries",
-              "/queries/<id>"]
+              "/queries/<id>", "/device", "/compile"]
 
 
 def _allowed_methods(path: str):
@@ -203,6 +204,12 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(200, srv.profile_cells_payload())
         elif path == "/partition":
             self._send_json(200, srv.partition_payload())
+        elif path == "/device":
+            self._send_json(200, srv.device_payload())
+        elif path == "/compile":
+            cost_raw = parse_qs(query).get("cost", ["0"])[0]
+            self._send_json(200, srv.compile_payload(
+                with_cost=cost_raw not in ("0", "", "false")))
         elif path == "/queries" and method == "GET":
             self._send_json(200, srv.queries_payload())
         elif path == "/queries" and method == "POST":
@@ -412,6 +419,29 @@ class OpServer:
         return 200, {"query": entry.to_dict(),
                      "fleet_version": reg.fleet_version}
 
+    # ----------------------- device-truth plane ------------------------ #
+
+    def device_payload(self) -> dict:
+        """``GET /device``: backend provenance, per-device live/peak
+        memory, host↔device transfer accounting, the dispatch-overlap
+        distribution, the compile summary, and the flight-recorder state
+        (``utils.deviceplane``). Session-independent — device truth is
+        process truth; the session only adds the per-family transfer and
+        overlap views."""
+        from spatialflink_tpu.utils import deviceplane
+
+        return deviceplane.device_payload(self._tel())
+
+    def compile_payload(self, with_cost: bool = False) -> dict:
+        """``GET /compile``: the compile registry — per-function compile/
+        recompile counts, trigger signatures, trace + backend-compile wall
+        time, sentinel state. ``?cost=1`` adds lazy one-time
+        ``cost_analysis()`` FLOPs/bytes per entry (an AOT compile per
+        function — explicitly requested, never ambient)."""
+        from spatialflink_tpu.utils import deviceplane
+
+        return deviceplane.registry().snapshot(cost=with_cost)
+
     def partition_payload(self) -> dict:
         """``/partition``: the skew-adaptive grid's live layout, policy
         thresholds, epoch progress, and recent split/merge decisions
@@ -512,6 +542,26 @@ def format_digest(snap: dict) -> str:
         total = (snap.get("costs") or {}).get("total_kernel_ms") or 0.0
         share = f" ({cost_ms / total * 100:.0f}%)" if total else ""
         parts.append(f"hot cell {cell} {cost_ms:.0f}ms{share}")
+    dev = st.get("device") or {}
+    be = dev.get("backend") or {}
+    if be:
+        # device truth: backend provenance every digest line (the BENCH
+        # r05 silent-CPU-fallback lesson) + post-warmup recompiles when
+        # the sentinel has fired
+        s = f"dev {be.get('platform')}"
+        if be.get("target") and not be.get("valid_for_target"):
+            s += f"!={be['target']}"
+        if dev.get("recompiles"):
+            s += f" recompiles {dev['recompiles']}"
+        mb = dev.get("mem_bytes_in_use")
+        if mb:
+            s += f" mem {mb / 1e6:.0f}MB"
+        parts.append(s)
+    ov = st.get("dispatch_overlap") or {}
+    if ov.get("count"):
+        # dispatch→ready overlap: how much of the device round-trip hid
+        # behind host work (1.0 = fully hidden — the pipeline_depth payoff)
+        parts.append(f"ovl {ov['p50'] * 100:.0f}%")
     deg = snap.get("degradation") or {}
     if deg:
         parts.append(f"degraded x{sum(deg.values())}")

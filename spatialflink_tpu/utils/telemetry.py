@@ -906,7 +906,12 @@ class Telemetry:
         """One JSON-safe snapshot: stage spans, histogram percentiles,
         gauges, the registry's counters/meters, the degradation digest
         (PR 1's retry/breaker/DLQ/chaos counters — same stream, same
-        timestamp, correlation for free), and grid occupancy."""
+        timestamp, correlation for free), grid occupancy, and the device
+        block (backend provenance, compile/recompile counters, memory
+        gauges — ``utils.deviceplane``; the probe runs once per snapshot,
+        never per record)."""
+        from spatialflink_tpu.utils import deviceplane as _deviceplane
+
         reg = self._registry()
         with self._lock:
             spans = {n: s.to_dict() for n, s in self.spans.items()}
@@ -922,6 +927,7 @@ class Telemetry:
             "degradation": _metrics.degradation_snapshot(reg),
             "grid": self.cells.to_dict(),
             "costs": self.costs.to_dict(),
+            "device": _deviceplane.status_block(self, self._registry()),
             "traces": {
                 "enabled": self.traces is not None,
                 "total": self.traces.total if self.traces is not None else 0,
@@ -1033,6 +1039,14 @@ def status_digest(snap: dict) -> dict:
         # companion to top_cells' occupancy counts (CostProfiles)
         "top_cost_cells": (snap.get("costs") or {}).get(
             "top_cost_cells", []),
+        # device truth (utils.deviceplane): backend provenance, compile/
+        # recompile counters, memory gauges — the --slo recompiles=/
+        # device_mem_bytes= checks and the stderr digest read these
+        "device": snap.get("device") or {},
+        # per-window dispatch→ready vs wall-clock overlap: 1.0 = the whole
+        # device round-trip was hidden behind host work (the
+        # pipeline_depth payoff metric the MULTICHIP ledger wants)
+        "dispatch_overlap": _hist_digest(hists, "dispatch-overlap-ratio"),
     }
 
 
@@ -1043,7 +1057,12 @@ def registry_snapshot(registry: Optional[_metrics.MetricsRegistry] = None
     telemetry session) serves. Spans/histograms/gauges are empty by
     construction: populating them needs the per-record instrumentation a
     session activates, and the no-session contract is a byte-identical
-    record loop."""
+    record loop. The device block IS present — backend provenance and the
+    compile registry are process truth, not session instrumentation, and
+    this snapshot is only ever built on demand (per request), never per
+    record."""
+    from spatialflink_tpu.utils import deviceplane as _deviceplane
+
     reg = registry if registry is not None else _metrics.REGISTRY
     return {
         "ts_ms": int(time.time() * 1000),
@@ -1055,6 +1074,7 @@ def registry_snapshot(registry: Optional[_metrics.MetricsRegistry] = None
         "degradation": _metrics.degradation_snapshot(reg),
         "grid": {},
         "costs": {},
+        "device": _deviceplane.status_block(None, reg),
         "traces": {"enabled": False, "total": 0},
     }
 

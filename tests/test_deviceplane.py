@@ -1,0 +1,776 @@
+"""Device-truth plane suite (ISSUE 12): the compile registry +
+instrumented_jit shim, the recompile sentinel (event/counter/strict abort;
+zero post-warmup compiles across PR 9 query-plane churn and a forced PR 8
+repartition), /device + /compile endpoint schemas, the dispatch-overlap
+ratio, device-plane SLO checks, the flight recorder's crash/SLO/signal
+bundles, the doctor CLI, the jit-coverage meta-test, and the extended
+telemetry-off hot-path spy."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+import yaml
+
+from spatialflink_tpu.index import UniformGrid
+from spatialflink_tpu.models import Point
+from spatialflink_tpu.operators import (PointPointRangeQuery,
+                                        QueryConfiguration, QueryType)
+from spatialflink_tpu.streams.formats import serialize_spatial
+from spatialflink_tpu.utils import deviceplane
+from spatialflink_tpu.utils.metrics import scoped_registry
+from spatialflink_tpu.utils.telemetry import (active, status_snapshot,
+                                              telemetry_session)
+
+pytestmark = pytest.mark.deviceplane
+
+GRID = UniformGrid(115.5, 117.6, 39.6, 41.1, num_grid_partitions=100)
+
+DEVICE_STATUS_KEYS = {"backend", "compiles", "run_compiles", "recompiles",
+                      "warm", "strict", "mem_available", "mem_bytes_in_use",
+                      "mem_peak_bytes", "d2h_bytes"}
+
+
+def _lines(n, span_ms=100_000, t0=1_700_000_000_000):
+    rng = np.random.default_rng(0)
+    return [f"v{i % 53},{t0 + i * span_ms // max(n, 1)},"
+            f"{115.5 + rng.random() * 2:.6f},{39.6 + rng.random() * 1.5:.6f}"
+            for i in range(n)]
+
+
+def _write_points(path, n=60, t0=1_700_000_000_000, step_ms=400):
+    with open(path, "w") as f:
+        for i in range(n):
+            p = Point.create(116.5 + 0.001 * i, 40.5, GRID, obj_id=f"o{i}",
+                             timestamp=t0 + i * step_ms)
+            f.write(serialize_spatial(p, "GeoJSON") + "\n")
+    return str(path)
+
+
+def _cfg():
+    from spatialflink_tpu.config import StreamConfig
+
+    return StreamConfig(format="CSV", date_format=None,
+                        csv_tsv_schema=[0, 1, 2, 3])
+
+
+def _range_windows(stream_lines, conf=None, grid=GRID, radius=0.5):
+    from spatialflink_tpu import driver
+
+    conf = conf or QueryConfiguration(QueryType.WindowBased, 10_000, 5_000)
+    op = PointPointRangeQuery(conf, grid)
+    stream = driver.decode_stream(iter(stream_lines), _cfg(), grid)
+    q = Point.create(116.5, 40.3, grid, obj_id="q")
+    return [(r.window_start, len(r.records)) for r in op.run(stream, q,
+                                                             radius)]
+
+
+# --------------------------------------------------------------------- #
+# compile registry + instrumented_jit
+
+
+class TestCompileRegistry:
+    def test_instrumented_jit_registers_counts_and_signatures(self):
+        import jax.numpy as jnp
+
+        def _probe_fn_a(x, *, k):
+            return (x * 2).sum() + k
+
+        fn = deviceplane.instrumented_jit(_probe_fn_a,
+                                          static_argnames=("k",))
+        reg = deviceplane.registry()
+        key = f"{_probe_fn_a.__module__}.{_probe_fn_a.__qualname__}"
+        entry = reg.entries[key]
+        assert entry.compiles == 0
+        fn(jnp.arange(4.0), k=1)
+        assert entry.compiles == 1
+        fn(jnp.arange(4.0), k=1)          # cache hit: no trace
+        assert entry.compiles == 1
+        fn(jnp.arange(8.0), k=1)          # fresh shape
+        fn(jnp.arange(8.0), k=2)          # fresh static
+        assert entry.compiles == 3
+        assert entry.cache_size() == 3
+        sig = entry.signatures[-1]["signature"]
+        assert "float32[8]" in sig and "k=2" in sig
+        assert entry.trace_ms > 0
+        assert entry.first_compile_ms <= entry.last_compile_ms
+
+    def test_cost_analysis_is_lazy_and_cached(self):
+        import jax.numpy as jnp
+
+        def _probe_fn_b(x):
+            return jnp.sin(x).sum()
+
+        fn = deviceplane.instrumented_jit(_probe_fn_b)
+        fn(jnp.arange(16.0))
+        entry = deviceplane.registry().entries[
+            f"{_probe_fn_b.__module__}.{_probe_fn_b.__qualname__}"]
+        ca = entry.cost_analysis()
+        assert ca is not None and ca["flops"] is not None
+        assert entry.cost_analysis() is ca  # cached
+
+    def test_semantics_identical_to_jax_jit(self):
+        # the shim must not change results, including donated buffers
+        import jax
+        import jax.numpy as jnp
+
+        def body(s, x):
+            return s + x
+
+        plain = jax.jit(body)
+        shim = deviceplane.instrumented_jit(body, donate_argnums=(0,))
+        a = jnp.arange(5.0)
+        assert np.allclose(np.asarray(plain(jnp.zeros(5), a)),
+                           np.asarray(shim(jnp.zeros(5), a)))
+
+    def test_registry_snapshot_schema(self):
+        snap = deviceplane.registry().snapshot()
+        assert {"ts_ms", "functions", "total_compiles", "run_compiles",
+                "post_warmup_compiles", "warm", "warm_reason", "strict",
+                "entries"} <= set(snap)
+        assert snap["functions"] == len(snap["entries"])
+        e = snap["entries"][0]
+        assert {"name", "module", "jit_kwargs", "compiles", "recompiles",
+                "trace_ms", "backend_compile_ms", "cache_size",
+                "signatures"} <= set(e)
+
+
+# --------------------------------------------------------------------- #
+# recompile sentinel
+
+
+class TestRecompileSentinel:
+    def test_post_warmup_compile_fires_event_and_counter(self):
+        import jax.numpy as jnp
+
+        def _sentinel_fn_a(x):
+            return x.sum()
+
+        fn = deviceplane.instrumented_jit(_sentinel_fn_a)
+        reg = deviceplane.registry()
+        with scoped_registry() as mreg, telemetry_session() as tel:
+            fn(jnp.arange(4.0))             # pre-warm shape
+            reg.begin_run(strict=False)
+            reg.mark_warm("test warmup")
+            try:
+                fn(jnp.arange(4.0))         # cache hit: silent
+                assert reg.run_recompiles == 0
+                fn(jnp.arange(32.0))        # fresh shape post-warmup
+                assert reg.run_recompiles == 1
+                assert mreg.counter("device-recompiles").count == 1
+                kinds = [e["kind"] for e in tel.events.list()]
+                assert "sentinel-warm" in kinds and "recompile" in kinds
+                ev = [e for e in tel.events.list()
+                      if e["kind"] == "recompile"][-1]
+                assert "_sentinel_fn_a" in ev["fn"]
+                assert "float32[32]" in ev["signature"]
+            finally:
+                reg.end_run()
+
+    def test_strict_mode_aborts(self):
+        import jax.numpy as jnp
+
+        def _sentinel_fn_b(x):
+            return x.sum()
+
+        fn = deviceplane.instrumented_jit(_sentinel_fn_b)
+        reg = deviceplane.registry()
+        fn(jnp.arange(4.0))
+        reg.begin_run(strict=True)
+        reg.mark_warm("strict test")
+        try:
+            fn(jnp.arange(4.0))  # warm shape: fine
+            with pytest.raises(deviceplane.RecompileError,
+                               match="zero-recompile contract"):
+                fn(jnp.arange(64.0))
+        finally:
+            reg.end_run()
+
+    def test_query_plane_churn_is_recompile_silent(self):
+        """The PR 9 contract device-truth-asserted: admit/retire per window
+        at constant fleet size (Q=32, in-bucket repad) records ZERO
+        post-warmup compiles."""
+        from spatialflink_tpu import driver
+        from spatialflink_tpu.runtime.queryplane import QueryRegistry
+
+        lines = _lines(6000)
+        conf = QueryConfiguration(QueryType.WindowBased, 10_000, 5_000)
+        rng = np.random.default_rng(5)
+        pts = [(float(115.5 + rng.random() * 2),
+                float(39.6 + rng.random() * 1.5)) for _ in range(32)]
+
+        def run_churn():
+            qreg = QueryRegistry("range", radius=0.5)
+            for i, (x, y) in enumerate(pts):
+                qreg.admit({"id": f"q{i}", "x": x, "y": y})
+            qreg.apply()
+            op = PointPointRangeQuery(conf, GRID)
+            stream = driver.decode_stream(iter(lines), _cfg(), GRID)
+            i = 0
+            for _w in op.run_dynamic(stream, qreg, 0.5):
+                qreg.admit({"id": f"c{i}", "x": 116.0 + (i % 9) * 0.1,
+                            "y": 40.0 + (i % 9) * 0.1})
+                qreg.retire([e.id for e in qreg.active_entries()][0])
+                i += 1
+            assert i >= 3
+
+        run_churn()  # warm the Q=32 bucket's kernel shapes
+        reg = deviceplane.registry()
+        reg.begin_run(strict=True)  # strict: a recompile would RAISE here
+        reg.mark_warm("churn test (shapes pre-warmed)")
+        try:
+            run_churn()
+            assert reg.run_recompiles == 0
+        finally:
+            reg.end_run()
+
+    def test_forced_repartition_is_recompile_silent(self):
+        """The PR 8 contract device-truth-asserted: mid-run adaptive-grid
+        layout churn (splits applied and reverted between windows) never
+        recompiles — records keep base cells; adaptivity is a host-side
+        prefilter."""
+        import dataclasses
+
+        from spatialflink_tpu import driver
+        from spatialflink_tpu.index import AdaptiveGrid
+
+        lines = _lines(4000)
+        hot = int(GRID.assign_cell(116.5, 40.3)[0])
+        conf = QueryConfiguration(QueryType.WindowBased, 10_000, 5_000)
+
+        def run_churned(ag):
+            op = PointPointRangeQuery(
+                dataclasses.replace(conf, adaptive_grid=ag), GRID)
+            layouts = [([hot], []), ([], []), ([hot, hot + 1], [])]
+
+            def churn(stream):
+                for i, r in enumerate(stream):
+                    if i % 900 == 0:
+                        ag.apply_layout(*layouts[(i // 900) % len(layouts)])
+                    yield r
+
+            stream = churn(driver.decode_stream(iter(lines), _cfg(), GRID))
+            q = Point.create(116.5, 40.3, GRID, obj_id="q")
+            return [(r.window_start, len(r.records))
+                    for r in op.run(stream, q, 0.5)]
+
+        baseline = run_churned(AdaptiveGrid(GRID, refine=4))  # warm shapes
+        reg = deviceplane.registry()
+        reg.begin_run(strict=True)
+        reg.mark_warm("repartition test (shapes pre-warmed)")
+        try:
+            ag = AdaptiveGrid(GRID, refine=4)
+            got = run_churned(ag)
+            assert ag.version >= 3
+            assert got == baseline
+            assert reg.run_recompiles == 0
+        finally:
+            reg.end_run()
+
+    def test_driver_strict_recompile_aborts_with_bundle(self, tmp_path):
+        """End-to-end in a FRESH process (the jit cache must be cold so the
+        late bucket growth provably compiles): sparse early windows declare
+        warmup, a dense burst forces a new padding bucket -> exit 3, a
+        'strict-recompile' post-mortem bundle, and doctor summarize reads
+        it."""
+        t0 = 1_700_000_000_000
+        rows = []
+        rng = np.random.default_rng(1)
+        for i in range(120):   # ~50 records/window over 4 windows: warmup
+            rows.append(f"v{i},{t0 + i * 200},"
+                        f"{115.5 + rng.random() * 2:.6f},"
+                        f"{39.6 + rng.random() * 1.5:.6f}")
+        for i in range(3000):  # burst inside later windows: fresh bucket
+            rows.append(f"b{i},{t0 + 40_000 + (i % 5000)},"
+                        f"{115.5 + rng.random() * 2:.6f},"
+                        f"{39.6 + rng.random() * 1.5:.6f}")
+        inp = tmp_path / "grow.csv"
+        inp.write_text("\n".join(rows) + "\n")
+        pm = tmp_path / "pm"
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        r = subprocess.run(
+            [sys.executable, "-m", "spatialflink_tpu.driver",
+             "--config", "conf/spatialflink-conf.yml",
+             "--input1", str(inp), "--option", "1", "--format", "CSV",
+             "--strict-recompile", "--postmortem-dir", str(pm)],
+            capture_output=True, text=True, timeout=300, env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        assert r.returncode == 3, (r.stdout[-1000:], r.stderr[-2000:])
+        assert "STRICT-RECOMPILE ABORT" in r.stderr
+        bundles = [d for d in os.listdir(pm) if "strict-recompile" in d]
+        assert bundles, os.listdir(pm)
+        from spatialflink_tpu import doctor
+
+        bundle = os.path.join(str(pm), bundles[0])
+        assert doctor.main(["summarize", bundle]) == 0
+        doc = doctor.load_bundle(bundle)
+        assert doc["manifest"]["reason"] == "strict-recompile"
+        assert "RecompileError" in doc["manifest"]["error"]
+        assert doc["compile"]["post_warmup_compiles"] >= 1
+
+
+# --------------------------------------------------------------------- #
+# device telemetry: provenance, snapshots, overlap
+
+
+class TestDeviceTelemetry:
+    def test_backend_provenance_fields(self):
+        prov = deviceplane.backend_provenance()
+        assert prov["platform"] == "cpu"  # tier-1 pins JAX_PLATFORMS=cpu
+        assert prov["device_count"] >= 1
+        assert prov["target"] == "tpu"
+        assert prov["valid_for_target"] is False
+        assert deviceplane.backend_provenance(
+            target="cpu")["valid_for_target"] is True
+
+    def test_device_memory_explicit_unavailability_on_cpu(self):
+        rows = deviceplane.device_memory()
+        assert rows and all(r["available"] is False for r in rows)
+        g = deviceplane.memory_gauges()
+        assert g["available"] is False and g["bytes_in_use"] is None
+
+    def test_snapshot_and_digest_carry_device_block(self):
+        with telemetry_session() as tel:
+            snap = status_snapshot(tel)
+        assert DEVICE_STATUS_KEYS <= set(snap["device"])
+        st = snap["status"]
+        assert st["device"]["backend"]["platform"] == "cpu"
+        assert "dispatch_overlap" in st
+        # registry-only (no session) snapshots carry it too: device truth
+        # is process truth, and these are only built on demand
+        snap2 = status_snapshot()
+        assert DEVICE_STATUS_KEYS <= set(snap2["device"])
+
+    def test_overlap_ratio_recorded_per_window(self):
+        lines = _lines(4000)
+        _range_windows(lines)  # warm
+        with telemetry_session() as tel:
+            _range_windows(lines)
+            h = tel.histograms.get("dispatch-overlap-ratio")
+            assert h is not None and h.count >= 3
+            p50 = h.percentile(50)
+            assert 0.0 <= p50 <= 1.0
+            snap = status_snapshot(tel)
+        ov = snap["status"]["dispatch_overlap"]
+        assert ov["count"] == h.count and 0.0 <= ov["p99"] <= 1.0
+
+    def test_digest_line_shows_backend_and_overlap(self):
+        from spatialflink_tpu.runtime.opserver import format_digest
+
+        with telemetry_session() as tel:
+            tel.histogram("dispatch-overlap-ratio").record(0.8)
+            line = format_digest(status_snapshot(tel))
+        assert "dev cpu" in line and "!=tpu" in line
+        assert "ovl" in line
+
+
+# --------------------------------------------------------------------- #
+# endpoints
+
+
+class TestEndpoints:
+    def _get(self, url):
+        resp = urllib.request.urlopen(url, timeout=5)
+        return resp.status, json.loads(resp.read())
+
+    def test_device_and_compile_endpoints(self):
+        from spatialflink_tpu.runtime.opserver import OpServer
+
+        with telemetry_session() as tel:
+            tel.histogram("dispatch-overlap-ratio").record(0.5)
+            srv = OpServer(port=0).start()
+            try:
+                code, dev = self._get(srv.url + "/device")
+                assert code == 200
+                assert {"ts_ms", "backend", "memory", "transfer",
+                        "compile", "dispatch_overlap",
+                        "recorder"} <= set(dev)
+                assert dev["backend"]["platform"] == "cpu"
+                assert dev["memory"]["devices"]
+                assert dev["dispatch_overlap"]["count"] == 1
+                assert dev["recorder"]["active"] is False
+                code, comp = self._get(srv.url + "/compile")
+                assert code == 200
+                assert comp["functions"] >= 30  # every ops/* kernel
+                names = {e["name"] for e in comp["entries"]}
+                assert "range_filter_point" in names
+                assert all("cost_analysis" not in e
+                           for e in comp["entries"])
+                # ?cost=1: lazy AOT analysis lands on compiled entries
+                code, compc = self._get(srv.url + "/compile?cost=1")
+                compiled = [e for e in compc["entries"]
+                            if e["compiles"] > 0]
+                assert compiled and any(
+                    (e.get("cost_analysis") or {}).get("flops")
+                    for e in compiled)
+            finally:
+                srv.close()
+
+    def test_device_endpoint_405_and_sessionless(self):
+        from spatialflink_tpu.runtime.opserver import OpServer
+
+        assert active() is None
+        srv = OpServer(port=0).start()
+        try:
+            code, dev = self._get(srv.url + "/device")
+            assert code == 200 and dev["dispatch_overlap"]["count"] == 0
+            req = urllib.request.Request(srv.url + "/device",
+                                         data=b"{}", method="POST")
+            try:
+                urllib.request.urlopen(req, timeout=5)
+                assert False, "POST /device must 405"
+            except urllib.error.HTTPError as e:
+                assert e.code == 405
+                assert e.headers["Allow"] == "GET"
+        finally:
+            srv.close()
+
+
+# --------------------------------------------------------------------- #
+# health checks
+
+
+class TestDevicePlaneHealth:
+    def test_recompiles_check_breaches_on_post_warmup_compiles(self):
+        from spatialflink_tpu.runtime.health import HealthEvaluator
+
+        ev = HealthEvaluator({"recompiles": 0})
+        with scoped_registry():
+            ok = ev.evaluate({"status": {"device": {"recompiles": 0}}})
+            assert ok["healthy"]
+            bad = ev.evaluate({"status": {"device": {"recompiles": 2}}})
+            assert not bad["healthy"]
+            assert bad["checks"]["recompiles"]["value"] == 2
+
+    def test_device_mem_unknown_counts_healthy(self):
+        from spatialflink_tpu.runtime.health import HealthEvaluator
+
+        ev = HealthEvaluator({"device_mem_bytes": 1})
+        with scoped_registry():
+            v = ev.evaluate({"status": {"device":
+                                        {"mem_bytes_in_use": None}}})
+            assert v["healthy"]  # CPU: no stats -> unknown -> healthy
+            v = ev.evaluate({"status": {"device":
+                                        {"mem_bytes_in_use": 2}}})
+            assert not v["healthy"]
+
+    def test_slo_spec_accepts_new_keys(self):
+        from spatialflink_tpu.runtime.health import HealthEvaluator
+
+        ev = HealthEvaluator.from_spec("recompiles=0,device_mem_bytes=8e9")
+        assert ev.thresholds["device_mem_bytes"] == 8e9
+
+
+# --------------------------------------------------------------------- #
+# flight recorder + doctor
+
+
+def _bundle_dirs(pm, reason=None):
+    out = [os.path.join(str(pm), d) for d in sorted(os.listdir(str(pm)))
+           if d.startswith("bundle-") and (reason is None or reason in d)]
+    return out
+
+
+class TestFlightRecorder:
+    def test_dump_on_signal(self, tmp_path):
+        with telemetry_session():
+            rec = deviceplane.FlightRecorder(str(tmp_path / "pm"),
+                                             config={"job": "sig"})
+            rec.install_signal()
+            try:
+                rec.note("run-start")
+                os.kill(os.getpid(), signal.SIGUSR1)
+                time.sleep(0.05)
+            finally:
+                rec.close()
+        bundles = _bundle_dirs(tmp_path / "pm", "signal")
+        assert len(bundles) == 1
+        with open(os.path.join(bundles[0], "manifest.json")) as f:
+            manifest = json.load(f)
+        assert manifest["reason"] == "signal"
+        assert manifest["schema"] == deviceplane.BUNDLE_SCHEMA
+        for name in manifest["files"]:
+            assert os.path.exists(os.path.join(bundles[0], name))
+        with open(os.path.join(bundles[0], "flight.json")) as f:
+            notes = json.load(f)["notes"]
+        assert [n["kind"] for n in notes][:1] == ["run-start"]
+        # the handler was restored
+        assert signal.getsignal(signal.SIGUSR1) not in (
+            None,) and deviceplane.active_recorder() is None
+
+    def test_dump_on_slo_breach_once(self, tmp_path):
+        from spatialflink_tpu.runtime.health import HealthEvaluator
+
+        with scoped_registry(), telemetry_session():
+            health = HealthEvaluator({"min_throughput_rps": 1e9})
+            rec = deviceplane.FlightRecorder(str(tmp_path / "pm"))
+            rec.attach_health(health)
+            try:
+                snap = {"status": {"records_in": 100,
+                                   "throughput_rps": 5.0}}
+                health.evaluate(snap)
+                health.evaluate(snap)  # still breached: no second dump
+            finally:
+                rec.close()
+        bundles = _bundle_dirs(tmp_path / "pm", "slo-breach")
+        assert len(bundles) == 1
+        with open(os.path.join(bundles[0], "manifest.json")) as f:
+            m = json.load(f)
+        assert m["detail"]["check"] == "min_throughput_rps"
+
+    def test_max_dumps_bounds_a_crash_loop(self, tmp_path):
+        rec = deviceplane.FlightRecorder(str(tmp_path / "pm"), max_dumps=2)
+        try:
+            assert rec.dump("a") and rec.dump("b")
+            assert rec.dump("c") is None
+        finally:
+            rec.close()
+        assert len(_bundle_dirs(tmp_path / "pm")) == 2
+
+    def test_driver_slo_breach_dumps_bundle(self, tmp_path, capsys):
+        """Driver acceptance: an un-meetable throughput SLO under the live
+        digest thread dumps exactly one slo-breach bundle mid-run."""
+        from spatialflink_tpu.driver import main
+
+        inp = _write_points(tmp_path / "pts.geojson", n=400)
+        pm = tmp_path / "pm"
+        rc = main(["--config", "conf/spatialflink-conf.yml",
+                   "--input1", inp, "--option", "1",
+                   "--slo", "min_throughput_rps=1e12",
+                   "--live-stats", "--telemetry-interval", "0.05",
+                   "--postmortem-dir", str(pm)])
+        assert rc == 0
+        bundles = _bundle_dirs(pm, "slo-breach")
+        assert len(bundles) == 1
+        with open(os.path.join(bundles[0], "status.json")) as f:
+            status = json.load(f)
+        assert status["health"]["healthy"] is False
+
+    def test_crashed_kafka_chaos_run_roundtrips_through_doctor(
+            self, tmp_path, monkeypatch):
+        """The ISSUE acceptance: a crashed --kafka-follow --chaos run dumps
+        a bundle that round-trips through doctor summarize AND diff
+        against a healthy-run bundle (SIGUSR1 mid-follow); preflight
+        returns non-zero on the CPU-fallback condition."""
+        from spatialflink_tpu import doctor, driver
+        from spatialflink_tpu.streams.kafka import (reset_memory_brokers,
+                                                    resolve_broker)
+
+        def follow_conf(name):
+            with open("conf/spatialflink-conf.yml") as f:
+                d = yaml.safe_load(f)
+            d["kafkaBootStrapServers"] = f"memory://{name}"
+            d["window"].update(interval=1, step=1)
+            d["query"]["thresholds"]["outOfOrderTuples"] = 0
+            p = tmp_path / f"{name}.yml"
+            p.write_text(yaml.safe_dump(d))
+            return str(p), f"memory://{name}"
+
+        control = json.dumps({"geometry": {"type": "control",
+                                           "coordinates": []}})
+
+        def produce(url, n=250, ctrl=True, kill_at=None):
+            broker = resolve_broker(url)
+
+            def run():
+                for i in range(n):
+                    p = Point.create(116.5 + 0.001 * (i % 40), 40.5, GRID,
+                                     obj_id=f"veh{i % 7}",
+                                     timestamp=int(time.time() * 1000))
+                    broker.produce("points.geojson",
+                                   serialize_spatial(p, "GeoJSON"))
+                    time.sleep(0.01)
+                    if kill_at is not None and i == kill_at:
+                        os.kill(os.getpid(), signal.SIGUSR1)
+                if ctrl:
+                    broker.produce("points.geojson", control)
+
+            t = threading.Thread(target=run, daemon=True)
+            t.start()
+            return t
+
+        reset_memory_brokers()
+        try:
+            # --- healthy run: SIGUSR1 mid-follow dumps a signal bundle ---
+            cfg, url = follow_conf("dp-healthy")
+            pm_ok = tmp_path / "pm-ok"
+            t = produce(url, n=150, kill_at=60)
+            rc = main_rc = driver.main(
+                ["--config", cfg, "--kafka", "--kafka-follow",
+                 "--option", "1", "--postmortem-dir", str(pm_ok)])
+            t.join(timeout=30)
+            assert main_rc == 0
+            healthy = _bundle_dirs(pm_ok, "signal")
+            assert healthy, os.listdir(pm_ok)
+
+            # --- crashed run: injected sink crash under --chaos ---
+            reset_memory_brokers()
+            cfg2, url2 = follow_conf("dp-crash")
+            pm_bad = tmp_path / "pm-bad"
+            emits = {"n": 0}
+            orig_emit = driver._emit
+
+            def exploding_emit(result, sink):
+                # crash on the FIRST emitted window: later windows only
+                # seal while the producer keeps advancing the watermark,
+                # so waiting for a deeper emission could outlive the
+                # bounded produce thread and hang the follow loop
+                emits["n"] += 1
+                raise RuntimeError("injected mid-run crash")
+
+            monkeypatch.setattr(driver, "_emit", exploding_emit)
+            t2 = produce(url2, n=250, ctrl=False)
+            with pytest.raises(RuntimeError, match="injected mid-run"):
+                driver.main(
+                    ["--config", cfg2, "--kafka", "--kafka-follow",
+                     "--option", "1",
+                     "--chaos", "seed=3,fail_next_fetches=2",
+                     "--retry", "attempts=8,base_ms=1",
+                     "--postmortem-dir", str(pm_bad)])
+            t2.join(timeout=30)
+            monkeypatch.setattr(driver, "_emit", orig_emit)
+            crashed = _bundle_dirs(pm_bad, "crash")
+            assert crashed, os.listdir(pm_bad)
+            doc = doctor.load_bundle(crashed[0])
+            assert "injected mid-run crash" in doc["manifest"]["error"]
+            # chaos degradation visible in the crashed bundle's status
+            assert doc["status"]["degradation"].get(
+                "chaos-fetch-fail", 0) >= 1
+
+            # --- doctor round-trip: summarize + diff + preflight ---
+            assert doctor.main(["summarize", crashed[0]]) == 0
+            assert doctor.main(["--json", "summarize", crashed[0]]) == 0
+            assert doctor.main(["diff", healthy[0], crashed[0]]) == 0
+            # CPU-fallback condition: default target tpu -> non-zero
+            assert doctor.main(["--preflight"]) == 1
+            assert doctor.main(["preflight",
+                                "--require-backend", "cpu"]) == 0
+            # unreadable bundle -> usage exit
+            assert doctor.main(["summarize", str(tmp_path)]) == 2
+        finally:
+            reset_memory_brokers()
+
+
+# --------------------------------------------------------------------- #
+# jit-coverage meta-test
+
+
+class TestJitCoverage:
+    OPS_DIRS = ("ops", "parallel")
+
+    def _sources(self):
+        root = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "spatialflink_tpu")
+        for sub in self.OPS_DIRS:
+            d = os.path.join(root, sub)
+            for name in sorted(os.listdir(d)):
+                if name.endswith(".py"):
+                    yield f"spatialflink_tpu.{sub}.{name[:-3]}", \
+                        os.path.join(d, name)
+
+    def test_no_raw_jax_jit_in_kernel_modules(self):
+        """No kernel can go dark: every jit in ops/ and parallel/ must go
+        through the instrumented shim (raw ``jax.jit`` attribute usage is
+        a test failure, not a review comment)."""
+        import ast
+
+        offenders = []
+        for mod, path in self._sources():
+            with open(path) as f:
+                tree = ast.parse(f.read(), path)
+            for node in ast.walk(tree):
+                if (isinstance(node, ast.Attribute) and node.attr == "jit"
+                        and isinstance(node.value, ast.Name)
+                        and node.value.id == "jax"):
+                    offenders.append(f"{path}:{node.lineno}")
+        assert not offenders, (
+            "raw jax.jit in kernel modules (use deviceplane."
+            f"instrumented_jit): {offenders}")
+
+    def test_every_instrumented_site_is_registered(self):
+        """Every ``instrumented_jit``-decorated def in ops/ and parallel/
+        appears in the live compile registry after import — a decorator
+        typo or a module bypassing the shim fails here."""
+        import ast
+        import importlib
+
+        def uses_shim(dec) -> bool:
+            for node in ast.walk(dec):
+                if isinstance(node, ast.Name) and \
+                        node.id == "instrumented_jit":
+                    return True
+                if isinstance(node, ast.Attribute) and \
+                        node.attr == "instrumented_jit":
+                    return True
+            return False
+
+        expected = []
+        for mod, path in self._sources():
+            with open(path) as f:
+                tree = ast.parse(f.read(), path)
+            for node in ast.walk(tree):
+                if isinstance(node, ast.FunctionDef) and any(
+                        uses_shim(d) for d in node.decorator_list):
+                    expected.append((mod, node.name))
+            importlib.import_module(mod)
+        assert len(expected) >= 30  # every kernel family is covered
+        entries = deviceplane.registry().entries
+        missing = [f"{m}.{n}" for m, n in expected
+                   if f"{m}.{n}" not in entries]
+        assert not missing, f"decorated but unregistered: {missing}"
+
+
+# --------------------------------------------------------------------- #
+# extended hot-path spy: zero device-plane feeds without a session
+
+
+class TestDevicePlaneHotPath:
+    def test_steady_state_run_feeds_nothing_without_session(
+            self, tmp_path, monkeypatch):
+        """After a warm first pass (shapes compiled), a session-less run
+        must not touch the device plane at all: zero compile-registry
+        feeds, zero memory probes, zero flight-recorder notes, zero
+        snapshot constructions."""
+        from spatialflink_tpu.driver import main
+        from spatialflink_tpu.utils import telemetry as telemetry_mod
+
+        inp = _write_points(tmp_path / "pts.geojson")
+        assert main(["--config", "conf/spatialflink-conf.yml",
+                     "--input1", inp, "--option", "1"]) == 0  # warm pass
+
+        calls = {"trace": 0, "mem": 0, "note": 0, "snap": 0}
+        orig_traced = deviceplane.CompileRegistry._on_traced
+        monkeypatch.setattr(
+            deviceplane.CompileRegistry, "_on_traced",
+            lambda self, *a, **k: (calls.__setitem__(
+                "trace", calls["trace"] + 1),
+                orig_traced(self, *a, **k))[1])
+        orig_mem = deviceplane.device_memory
+        monkeypatch.setattr(
+            deviceplane, "device_memory",
+            lambda *a, **k: (calls.__setitem__("mem", calls["mem"] + 1),
+                             orig_mem(*a, **k))[1])
+        orig_note = deviceplane.FlightRecorder.note
+        monkeypatch.setattr(
+            deviceplane.FlightRecorder, "note",
+            lambda self, *a, **k: (calls.__setitem__(
+                "note", calls["note"] + 1),
+                orig_note(self, *a, **k))[1])
+        orig_snap = telemetry_mod.status_snapshot
+        monkeypatch.setattr(
+            telemetry_mod, "status_snapshot",
+            lambda *a, **k: (calls.__setitem__("snap", calls["snap"] + 1),
+                             orig_snap(*a, **k))[1])
+
+        assert active() is None
+        assert main(["--config", "conf/spatialflink-conf.yml",
+                     "--input1", inp, "--option", "1"]) == 0
+        assert calls == {"trace": 0, "mem": 0, "note": 0, "snap": 0}, calls

@@ -39,7 +39,7 @@ STATUS_KEYS = {"records_in", "throughput_rps", "windows_evaluated",
                "commit_backlog", "window_backlog", "pane_cache",
                "checkpoint", "breaker_state", "dlq_depth",
                "mesh_degradations", "slo_breaches", "top_cells",
-               "skew", "top_cost_cells"}
+               "skew", "top_cost_cells", "device", "dispatch_overlap"}
 
 
 def _get(url, timeout=5):

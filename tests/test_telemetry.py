@@ -286,11 +286,16 @@ class TestLatencySink:
 
 class _CallCounter:
     """Counts every Telemetry.span/observe, StreamingHistogram.record,
-    CostProfiles feed, and WindowTraceBook note process-wide — the
-    telemetry-off hot-path assertion (the PR 6 cost/trace plane must obey
-    the same contract as the PR 2 spans: zero calls without a session)."""
+    CostProfiles feed, WindowTraceBook note, FlightRecorder note, and
+    device-memory probe process-wide — the telemetry-off hot-path
+    assertion (the PR 6 cost/trace plane AND the ISSUE 12 device plane
+    must obey the same contract as the PR 2 spans: zero calls without a
+    session; memory probes happen per snapshot/request only, and no
+    snapshot is built during an unqueried run)."""
 
     def __init__(self, monkeypatch):
+        from spatialflink_tpu.utils import deviceplane as deviceplane_mod
+        from spatialflink_tpu.utils.deviceplane import FlightRecorder
         from spatialflink_tpu.utils.telemetry import (CostProfiles,
                                                       WindowTraceBook)
 
@@ -315,8 +320,17 @@ class _CallCounter:
                           (CostProfiles, "attribute_merge"),
                           (WindowTraceBook, "note"),
                           (WindowTraceBook, "note_any"),
-                          (WindowTraceBook, "seal")):
+                          (WindowTraceBook, "seal"),
+                          (FlightRecorder, "note")):
             wrap(cls, name)
+
+        orig_mem = deviceplane_mod.device_memory
+
+        def mem_spy(*a, **k):
+            counter.calls += 1
+            return orig_mem(*a, **k)
+
+        monkeypatch.setattr(deviceplane_mod, "device_memory", mem_spy)
 
 
 class TestDriverTelemetry:

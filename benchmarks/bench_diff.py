@@ -20,7 +20,22 @@ Usage:
   identity fields match every listed pair (first matching rule wins, in
   argument order) — per-row thresholds for noisy rows (e.g. the scalar
   record path) next to tight ones (the vectorized bulk path).
-- ``--lower-is-better`` flips the comparison (wall_s-style metrics).
+- ``--lower-is-better`` flips the comparison (wall_s / latency-style
+  metrics). Worked example — gate a record→emit p99 latency ledger where
+  the baseline rows carry ceilings::
+
+      # baseline.json: {"rows": [{"path": "latency_record_emit",
+      #                           "p99_ms": 61.0}]}
+      # current.json:  {"rows": [{"path": "latency_record_emit",
+      #                           "p99_ms": 20.3}]}
+      python benchmarks/bench_diff.py baseline.json current.json \
+          --metric p99_ms --lower-is-better --threshold 0.25
+
+  20.3 ms against a 61.0 ms ceiling is a +66.7% improvement (change =
+  (base - current) / base, so positive is always better); the run only
+  fails once current p99 exceeds 61.0 x 1.25 = 76.25 ms. This is exactly
+  how ``bench_guard --check`` gates its ``latency_rows`` next to the
+  higher-is-better speedup floors.
 - Rows present in only one file are reported (``missing`` / ``new``) and
   are non-fatal unless ``--require-all`` (a silently dropped bench row is
   how coverage rots).

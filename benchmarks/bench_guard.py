@@ -34,6 +34,18 @@ Rows (identity field ``path``):
                         the control plane must preserve run_multi's
                         amortization (per-query identity asserted)
 
+plus one LOWER-IS-BETTER row gated by a second ``bench_diff`` pass
+(``--metric p99_ms --lower-is-better`` against the ``latency_rows``
+ceilings in the same baseline file):
+
+- ``latency_record_emit``  record→emit p99 (the latency plane's budget
+                        chain) of a windowed range run at the DEFAULT
+                        decode chunk, at a PINNED record count so the
+                        workload is fixed; window-table identity vs the
+                        uninstrumented run is asserted, and the ceiling
+                        carries a 3x margin (absolute ms is machine-
+                        sensitive in a way the speedup ratios are not)
+
 Usage:
     python benchmarks/bench_guard.py [--n N] [--out PATH]
     python benchmarks/bench_guard.py --check          # exit 1 on regression
@@ -63,6 +75,10 @@ MARGIN = 2.0
 #: to 1.0 and the gate could never catch a silently-broken prefilter
 #: (ratio ~1.0); a tighter margin keeps the floor meaningfully above it
 MARGIN_BY_PATH = {"skew_adaptive": 1.3}
+#: the latency row's CEILING margin (lower-is-better: ceiling = measured x
+#: margin) — generous because absolute milliseconds vary box to box where
+#: the speedup ratios cancel machine speed out
+LATENCY_MARGIN = 3.0
 
 
 def _lines(n: int):
@@ -358,10 +374,51 @@ def bench_query_plane(n: int) -> dict:
                 churn_post_warmup_compiles=post_warm)
 
 
+def bench_latency_record_emit(n: int) -> dict:
+    """Record→emit p99 (ms) through the latency-decomposition plane on a
+    windowed range replay at the DEFAULT decode chunk — the tier-1 gate on
+    the record-to-emission hot path (a regression here is a latency-tier
+    regression even when throughput holds). The record count is PINNED so
+    the absolute-ms ceiling compares a fixed workload; the sum invariant
+    and window-table identity vs the uninstrumented run are asserted so a
+    silently-miswired budget chain can never pass."""
+    from spatialflink_tpu import driver
+    from spatialflink_tpu.models import Point
+    from spatialflink_tpu.operators import (PointPointRangeQuery,
+                                            QueryConfiguration, QueryType)
+    from spatialflink_tpu.utils.telemetry import telemetry_session
+
+    n = 60_000  # pinned: an absolute-ms ceiling needs a fixed workload
+    lines = _lines(n)
+    cfg, grid = _cfg(), _grid()
+    conf = QueryConfiguration(QueryType.WindowBased, 10_000, 5_000)
+    qp = Point.create(116.5, 40.3, grid, obj_id="q")
+
+    def run():
+        op = PointPointRangeQuery(conf, grid)
+        stream = driver.decode_stream(iter(lines), cfg, grid)
+        return [(r.window_start, len(r.records))
+                for r in op.run(stream, qp, 0.5)]
+
+    run()  # warm
+    ref = run()  # uninstrumented reference (identity)
+    with telemetry_session() as tel:
+        got = run()
+        plane = tel.latency
+        p99 = plane.record_emit.percentile(99)
+        assert plane.record_emit.count == len(got) > 0
+        assert plane.max_residual_ms < 1.0, (
+            "stage budget no longer sums to record→emit "
+            f"(max residual {plane.max_residual_ms} ms)")
+    assert got == ref, "instrumented run diverged from uninstrumented"
+    return dict(path="latency_record_emit", records=n,
+                p99_ms=round(p99, 3))
+
+
 def measure(n: int) -> list:
     return [bench_window_assign(n), bench_decode_columnar(n),
             bench_windowed_pipeline(n), bench_skew_adaptive(n),
-            bench_query_plane(n)]
+            bench_query_plane(n), bench_latency_record_emit(n)]
 
 
 def main() -> int:
@@ -388,20 +445,30 @@ def main() -> int:
         r["backend"] = backend
         print(json.dumps(r), flush=True)
 
+    speed_rows = [r for r in rows if "speedup" in r]
+    lat_rows = [r for r in rows if "p99_ms" in r]
+
     if args.write_baseline:
         floors = [dict(path=r["path"],
                        speedup=round(max(
                            r["speedup"] / MARGIN_BY_PATH.get(r["path"],
                                                              MARGIN),
                            1.0), 2))
-                  for r in rows]
+                  for r in speed_rows]
+        ceilings = [dict(path=r["path"],
+                         p99_ms=round(r["p99_ms"] * LATENCY_MARGIN, 1))
+                    for r in lat_rows]
         with open(BASELINE_PATH, "w") as f:
             json.dump({"metric": "speedup",
                        "note": "conservative floors = measured/%.1f "
                                "(skew_adaptive: /%.1f); bench_guard "
-                               "--check trips >25%% below"
-                               % (MARGIN, MARGIN_BY_PATH["skew_adaptive"]),
-                       "rows": floors}, f, indent=1)
+                               "--check trips >25%% below. latency_rows "
+                               "are lower-is-better CEILINGS = measured x "
+                               "%.1f (metric p99_ms)"
+                               % (MARGIN, MARGIN_BY_PATH["skew_adaptive"],
+                                  LATENCY_MARGIN),
+                       "rows": floors, "latency_rows": ceilings},
+                      f, indent=1)
         print(f"# wrote {BASELINE_PATH}", file=sys.stderr)
         return 0
 
@@ -412,18 +479,36 @@ def main() -> int:
     if args.check:
         from benchmarks.bench_diff import main as diff_main
 
-        with tempfile.NamedTemporaryFile("w", suffix=".json",
-                                         delete=False) as f:
-            # identity = path only (the floors are scale/backend-agnostic
-            # ratios; keeping records/backend in the key would unpair rows)
-            json.dump({"rows": [dict(path=r["path"], speedup=r["speedup"])
-                                for r in rows]}, f)
-            fresh = f.name
-        try:
-            return diff_main([BASELINE_PATH, fresh, "--metric", "speedup",
-                              "--threshold", "0.25", "--require-all"])
-        finally:
-            os.unlink(fresh)
+        def run_diff(base_rows, fresh_rows, metric, extra):
+            base_f = tempfile.NamedTemporaryFile("w", suffix=".json",
+                                                 delete=False)
+            fresh_f = tempfile.NamedTemporaryFile("w", suffix=".json",
+                                                  delete=False)
+            try:
+                # identity = path only (the floors are scale/backend-
+                # agnostic; keeping records/backend in the key would
+                # unpair rows)
+                json.dump({"rows": base_rows}, base_f)
+                base_f.close()
+                json.dump({"rows": [dict(path=r["path"],
+                                         **{metric: r[metric]})
+                                    for r in fresh_rows]}, fresh_f)
+                fresh_f.close()
+                return diff_main([base_f.name, fresh_f.name,
+                                  "--metric", metric,
+                                  "--threshold", "0.25",
+                                  "--require-all"] + extra)
+            finally:
+                os.unlink(base_f.name)
+                os.unlink(fresh_f.name)
+
+        base = json.load(open(BASELINE_PATH))
+        rc = run_diff(base.get("rows", []), speed_rows, "speedup", [])
+        # second pass: the latency ceiling, lower-is-better (the worked
+        # example in bench_diff's docs)
+        rc_lat = run_diff(base.get("latency_rows", []), lat_rows,
+                          "p99_ms", ["--lower-is-better"])
+        return rc or rc_lat
     return 0
 
 

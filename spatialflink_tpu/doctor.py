@@ -102,7 +102,53 @@ def _bundle_digest(b: dict) -> dict:
             "p50"),
         "events": len((b.get("events") or {}).get("events", [])),
         "notes": (b.get("flight") or {}).get("total", 0),
+        "record_emit_p99_ms": ((b.get("latency") or {}).get("record_emit")
+                               or {}).get("p99"),
+        "budgeted_windows": ((b.get("latency") or {}).get("sum_check")
+                             or {}).get("windows", 0),
     }
+
+
+def _latency_table(latency: dict) -> List[str]:
+    """The stage-budget table of a bundle's latency decomposition — the
+    offline answer to "which stage blew the budget": per-stage count /
+    p50 / p99 / total, chain stages first (their totals decompose
+    record→emit), downstream sink stages after."""
+    stages = latency.get("stages") or {}
+    if not stages:
+        return []
+    chain = list(latency.get("chain_stages")
+                 or ("buffer", "queue", "dispatch", "inflight", "merge",
+                     "emit"))
+    order = [s for s in chain if s in stages] + sorted(
+        s for s in stages if s not in chain)
+    total_ms = sum((stages[s].get("sum") or 0.0) for s in order
+                   if s in chain)
+    lines = ["stage        windows      p50 ms      p99 ms    total ms  "
+             "share"]
+    for s in order:
+        h = stages[s]
+        share = ((h.get("sum") or 0.0) / total_ms * 100) if total_ms \
+            and s in chain else None
+        lines.append(
+            f"{s:<12} {h.get('count', 0):>7} {h.get('p50', 0.0):>11.3f} "
+            f"{h.get('p99', 0.0):>11.3f} {h.get('sum', 0.0):>11.1f}  "
+            + (f"{share:>4.0f}%" if share is not None else "    -"))
+    re_h = latency.get("record_emit") or {}
+    if re_h.get("count"):
+        lines.append(
+            f"{'record→emit':<12} {re_h['count']:>7} {re_h['p50']:>11.3f} "
+            f"{re_h['p99']:>11.3f} {re_h.get('sum', 0.0):>11.1f}   100%")
+    check = latency.get("sum_check") or {}
+    if check.get("windows"):
+        lines.append(f"sum check    {check['windows']} window(s), max "
+                     f"residual {check.get('max_residual_ms', 0.0)} ms")
+    bp = (latency.get("backpressure") or {}).get("series") or []
+    stalls = sum(1 for bkt in bp if bkt.get("stall"))
+    if bp:
+        lines.append(f"backpressure {len(bp)} bucket(s), {stalls} "
+                     "stalled")
+    return lines
 
 
 # --------------------------------------------------------------------- #
@@ -137,6 +183,8 @@ def summarize(path: str, as_json: bool = False,
               file=out)
     if d["dispatch_overlap_p50"] is not None:
         print(f"overlap    p50 {d['dispatch_overlap_p50']:.2f}", file=out)
+    for line in _latency_table(b.get("latency") or {}):
+        print(f"latency    {line}", file=out)
     print(f"transfer   d2h {d['d2h_bytes']} B; device mem in use "
           f"{d['mem_bytes_in_use']}", file=out)
     notes = (b.get("flight") or {}).get("notes", [])[-5:]
@@ -158,7 +206,8 @@ def diff(path_a: str, path_b: str, as_json: bool = False,
                 "unhealthy_checks", "records_in", "windows",
                 "throughput_rps", "slo_breaches", "compiles",
                 "post_warmup_compiles", "d2h_bytes",
-                "dispatch_overlap_p50", "mem_bytes_in_use"):
+                "dispatch_overlap_p50", "mem_bytes_in_use",
+                "record_emit_p99_ms", "budgeted_windows"):
         va, vb = da.get(key), db.get(key)
         rows.append({"field": key, "a": va, "b": vb, "equal": va == vb})
     doc = {"a": path_a, "b": path_b,

@@ -218,6 +218,10 @@ def decode_chunks(records: Iterable, cfg: StreamConfig, grid: UniformGrid,
     bulk_ok = is_point and fmt in ("csv", "tsv", "geojson")
     interner = interner if interner is not None else IdInterner()
     tel = _telemetry.active()
+    # decode-chunk buffer depth (backpressure timeline): the fill level at
+    # each flush — one gauge set per CHUNK, nothing per record
+    depth_gauge = (tel.gauge("decode.buffer-depth")
+                   if tel is not None else None)
 
     def off_type_filter(objs: List) -> List:
         kept = []
@@ -292,6 +296,8 @@ def decode_chunks(records: Iterable, cfg: StreamConfig, grid: UniformGrid,
         if not buf:
             return None
         t0 = time.perf_counter() if tel is not None else 0.0
+        if depth_gauge is not None:
+            depth_gauge.set(len(buf))
         if kind == "str":
             out = parse_raws(buf)
         elif kind == "obj":
@@ -2285,13 +2291,18 @@ def _run_cli(ap, args, params: Params, spec: CaseSpec, skip1: int,
                 s0 = time.time()
                 with tel.span("sink"):
                     emit_result(result)
-                if (tel.traces is not None
-                        and isinstance(result, WindowResult)):
-                    # the driver's emission stage in the window's trace
-                    # lineage — by window_start: the result no longer
-                    # carries its family label
-                    tel.traces.note_any(result.window_start, "sink",
-                                        s0, time.time())
+                s1 = time.time()
+                if isinstance(result, WindowResult):
+                    # the driver's emission stage, appended by
+                    # window_start (the result no longer carries its
+                    # family label): the latency plane's downstream
+                    # "sink" budget, plus the trace-lineage note when
+                    # tracing is on
+                    tel.latency.note_downstream(
+                        "sink", result.window_start, s0, s1)
+                    if tel.traces is not None:
+                        tel.traces.note_any(result.window_start, "sink",
+                                            s0, s1)
             else:
                 emit_result(result)
             if journal is not None and isinstance(result, WindowResult):

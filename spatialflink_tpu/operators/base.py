@@ -1426,6 +1426,7 @@ class SpatialOperator:
         label = self.telemetry_label or op_name
         book = tel.traces if tel is not None else None
         costs = tel.costs if tel is not None else None
+        lat = tel.latency if tel is not None else None
         if tel is not None:
             backlog = tel.gauge("window-backlog")
             # per-window dispatch→ready overlap: 1 − blocked/round-trip —
@@ -1444,9 +1445,31 @@ class SpatialOperator:
                     book.seal(label, start, end)
                 yield WindowResult(start, end, sel)
 
+        def note_budget(start, end, meta, m0, m1) -> None:
+            # the window's stage-residency budget: consecutive wall-clock
+            # intervals from first-record ingest to emission, so the
+            # stages SUM to record→emit by construction (the invariant
+            # tests assert; ARCHITECTURE.md § Latency decomposition).
+            # meta = (first_ingest_ms, t_seal, t_kernel0, t_kernel1);
+            # m0/m1 bound the merge (equal for non-deferred results).
+            fi, li, t_seal, k0, k1 = meta
+            t_emit = time.time()
+            if fi is not None and fi > t_seal * 1e3:
+                # a seal note from a coarser clock (the int-ms ingest
+                # stamp) must not yield a negative buffer stage
+                fi = None
+            lat.window_complete(label, start, end, fi, {
+                "buffer": (t_seal * 1e3 - fi) if fi is not None else 0.0,
+                "queue": (k0 - t_seal) * 1e3,
+                "dispatch": (k1 - k0) * 1e3,
+                "inflight": (m0 - k1) * 1e3,
+                "merge": (m1 - m0) * 1e3,
+                "emit": (t_emit - m1) * 1e3,
+            }, t_emit, last_ingest_ms=li)
+
         def drain(n: int) -> Iterator[WindowResult]:
             while len(pending) > n:
-                start, end, dfd, t_disp = pending.popleft()
+                start, end, dfd, t_disp, meta = pending.popleft()
                 if tel is not None:
                     w0 = time.time()
                     with tel.span("merge", query=label):
@@ -1461,6 +1484,8 @@ class SpatialOperator:
                         overlap_hist.record(
                             max(0.0, 1.0 - (w1 - w0) / total))
                     backlog.set(len(pending))
+                    if not realtime or sel:
+                        note_budget(start, end, meta, w0, w1)
                 else:
                     with trace(f"{op_name}.readback"):
                         sel = dfd.finish()
@@ -1472,25 +1497,42 @@ class SpatialOperator:
             records_c.inc(count(payload))
             if tel is not None:
                 w0 = time.time()
+                # the chain's seal point: the assembler's sweep noted the
+                # true seal wall clock for every ready window before the
+                # first yielded, so windows pulled later carry their wait
+                # behind earlier windows' eval/drain as "queue"; paths
+                # without a sweeping assembler fall back to the pull time
+                # (queue honestly 0)
+                t_seal = lat.pop_seal(start, w0)
+                fi = self._first_ingest_ms(payload)
+                li = self._last_ingest_ms(payload) if fi is not None \
+                    else None
                 with tel.span("kernel", query=label):
                     sel = eval_batch(payload, start)
+                w1 = time.time()
                 if book is not None:
-                    book.note(label, start, "kernel", w0, time.time())
+                    book.note(label, start, "kernel", w0, w1)
                 if costs is not None:
                     costs.attribute_kernel(
-                        label, time.time() - w0, records=count(payload),
+                        label, w1 - w0, records=count(payload),
                         nbytes=self._payload_nbytes(payload))
+                meta = (fi, li, min(t_seal, w0), w0, w1)
             else:
+                meta = None
                 with trace(f"{op_name}.dispatch"):
                     sel = eval_batch(payload, start)
             if isinstance(sel, Deferred):
-                pending.append((start, end, sel,
-                                time.time() if tel is not None else 0.0))
                 if tel is not None:
+                    pending.append((start, end, sel, w1, meta))
+                    lat.note_dispatch(start, w1)
                     backlog.set(len(pending))
+                else:
+                    pending.append((start, end, sel, 0.0, None))
                 yield from drain(depth - 1)
             else:
                 yield from drain(0)  # keep window order
+                if tel is not None and (sel or not realtime):
+                    note_budget(start, end, meta, w1, w1)
                 yield from emit(start, end, sel)
             if coord is not None:
                 # coordinated-checkpoint barrier: when a checkpoint is due,
@@ -1535,22 +1577,38 @@ class SpatialOperator:
         record lists carry Points with an ``ingestion_time`` stamped at
         parse; pane payloads hold ``(pane_start, records)`` pairs; bulk
         (idx, batch) payloads have no per-record host objects — None."""
+        return SpatialOperator._ingest_ms(payload, -1)
+
+    @staticmethod
+    def _last_ingest_ms(payload):
+        """The LAST record's ingest stamp — with the first-record stamp it
+        bounds the window's buffer-residency spread (a window whose first
+        record waited 9 s and whose last waited 10 ms is normal sliding-
+        window fill; both old means the pipeline sat on a sealed-ready
+        window)."""
+        return SpatialOperator._ingest_ms(payload, +1)
+
+    @staticmethod
+    def _ingest_ms(payload, end: int):
+        """Shared first/last ingest-stamp reader (``end`` = -1 first,
+        +1 last); one record materializes per call, never the window."""
         from spatialflink_tpu.streams.bulk import LazyRecords
 
         try:
             recs = payload
+            pos = 0 if end < 0 else -1
             if isinstance(recs, LazyRecords):
                 # columnar window: materialize ONE record (its
                 # ingestion_time is the chunk's decode stamp)
-                return int(recs[0].ingestion_time) if len(recs) else None
+                return int(recs[pos].ingestion_time) if len(recs) else None
             if not isinstance(recs, list) or not recs:
                 return None
             if (isinstance(recs[0], tuple) and len(recs[0]) == 2
                     and isinstance(recs[0][1], (list, LazyRecords))):
-                recs = recs[0][1]  # pane payload: first pane's records
+                recs = recs[pos][1]  # pane payload: first/last pane
                 if not len(recs):
                     return None
-            ing = getattr(recs[0], "ingestion_time", None)
+            ing = getattr(recs[pos], "ingestion_time", None)
             if isinstance(ing, (int, float)) and ing > 0:
                 return int(ing)
         except Exception:

@@ -57,6 +57,16 @@ def _device_field(field: str) -> Callable[[dict], Optional[float]]:
     return get
 
 
+def _emit_p99(status: dict) -> Optional[float]:
+    """Record→emit p99 off the digest's ``latency`` stanza (the latency-
+    decomposition plane, ``utils.latencyplane``): the end-to-end number
+    per emitted window — first-record ingest to emission — that the
+    latency-tier controller keys on. No windows budgeted yet (or no
+    session) reads None, which counts healthy like every warm-up."""
+    h = (status.get("latency") or {}).get("record_emit_ms") or {}
+    return h.get("p99") if h.get("count") else None
+
+
 def _throughput(status: dict) -> Optional[float]:
     # rate is 0.0 before the first record; treat a never-started stream as
     # unknown (records_in == 0), a stalled one (records then silence) as a
@@ -80,6 +90,7 @@ KNOWN_CHECKS: Dict[str, tuple] = {
     "min_throughput_rps": (_throughput, "lo"),
     "recompiles": (_device_field("recompiles"), "hi"),
     "device_mem_bytes": (_device_field("mem_bytes_in_use"), "hi"),
+    "p99_emit_ms": (_emit_p99, "hi"),
 }
 
 

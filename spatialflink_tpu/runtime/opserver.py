@@ -22,6 +22,9 @@ module adds that plane, stdlib-only:
   /trace/recent    newest window-trace summaries (ids + bounds)
   /trace/<id>      one window's full trace lineage (``--trace-dir``)
   /profile/cells   per-cell / per-family cost profiles + time series
+  /latency         stage-residency latency decomposition (record→emit
+                   budgets per window, per-stage histograms, per-query
+                   record→emit, backpressure time series)
   /queries         GET: the standing-query ledger; POST: admit/update a
                    query (schema-validated JSON body, lands at the next
                    window boundary) — the dynamic query plane
@@ -79,13 +82,13 @@ _ROUTES = {
     "/events": ("GET",), "/trace/recent": ("GET",),
     "/profile/cells": ("GET",), "/partition": ("GET",),
     "/queries": ("GET", "POST"),
-    "/device": ("GET",), "/compile": ("GET",),
+    "/device": ("GET",), "/compile": ("GET",), "/latency": ("GET",),
 }
 _PREFIX_ROUTES = {"/trace/": ("GET",), "/queries/": ("GET", "DELETE")}
 
 _ENDPOINTS = ["/healthz", "/status", "/metrics", "/events", "/trace/recent",
               "/trace/<id>", "/profile/cells", "/partition", "/queries",
-              "/queries/<id>", "/device", "/compile"]
+              "/queries/<id>", "/device", "/compile", "/latency"]
 
 
 def _allowed_methods(path: str):
@@ -202,6 +205,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(code, payload)
         elif path == "/profile/cells":
             self._send_json(200, srv.profile_cells_payload())
+        elif path == "/latency":
+            self._send_json(200, srv.latency_payload())
         elif path == "/partition":
             self._send_json(200, srv.partition_payload())
         elif path == "/device":
@@ -356,6 +361,21 @@ class OpServer:
                     "note": "cost profiles need a telemetry session "
                             "(--telemetry-dir / --live-stats / --trace-dir)"}
         return tel.costs.cells_payload()
+
+    def latency_payload(self) -> dict:
+        """``GET /latency``: the stage-residency decomposition table
+        (per-stage histograms + the newest full per-window budgets with
+        their sum-invariant check), record→emit latency global and per
+        standing query, and the recent backpressure time series
+        (``utils.latencyplane``)."""
+        tel = self._tel()
+        if tel is None:
+            return {"stages": {}, "recent": [], "queries": {},
+                    "backpressure": {"series": []},
+                    "note": "the latency plane needs a telemetry session "
+                            "(--telemetry-dir / --live-stats / --trace-dir "
+                            "/ --postmortem-dir)"}
+        return tel.latency.payload(tel=tel)
 
     # ---------------------- standing-query plane ----------------------- #
 
@@ -562,6 +582,18 @@ def format_digest(snap: dict) -> str:
         # dispatch→ready overlap: how much of the device round-trip hid
         # behind host work (1.0 = fully hidden — the pipeline_depth payoff)
         parts.append(f"ovl {ov['p50'] * 100:.0f}%")
+    la = st.get("latency") or {}
+    re_h = la.get("record_emit_ms") or {}
+    if re_h.get("count"):
+        # record→emit p99 + the stage whose residency dominates — the
+        # one-glance answer to "where is a record's time going" (full
+        # decomposition at GET /latency)
+        s = f"lat p99 {re_h['p99']:.0f}ms"
+        if la.get("dominant_stage"):
+            s += f" ({la['dominant_stage']})"
+        if la.get("stall"):
+            s += " STALL"
+        parts.append(s)
     deg = snap.get("degradation") or {}
     if deg:
         parts.append(f"degraded x{sum(deg.values())}")

@@ -92,7 +92,11 @@ class QueryState(enum.Enum):
 
 _FAMILIES = ("range", "knn")
 _ROUTE_PREFIXES = ("stdout", "file:", "kafka:")
-_SLO_KEYS = ("min_window_records", "max_window_records")
+#: per-query SLO keys: window-record-count bounds, plus the latency class
+#: hook — ``p99_emit_ms`` breaches when the query's record→emit p99 (the
+#: ``record-emit-ms@<id>`` histogram the router feeds at its demux point)
+#: exceeds the threshold. Transition-counted like every other SLO.
+_SLO_KEYS = ("min_window_records", "max_window_records", "p99_emit_ms")
 
 
 @dataclass
@@ -400,6 +404,18 @@ class QueryRegistry:
         with self._lock:
             return [self._entries[q] for q in self._fleet]
 
+    def staged_count(self) -> int:
+        """Fleet changes staged but not yet landed (PENDING admissions,
+        DRAINING retirements, staged updates) — the control-queue depth
+        the backpressure timeline samples: a growing number means windows
+        are not coming fast enough to land admissions."""
+        with self._lock:
+            return sum(
+                1 for e in self._entries.values()
+                if e.state in (QueryState.PENDING, QueryState.DRAINING)
+                or (e.state is QueryState.ACTIVE
+                    and e.pending_spec is not None))
+
     def padded_fleet(self, grid) -> Tuple[List[QueryEntry], list, Any]:
         """``(entries, padded_query_points, valid)`` for the device Q-axis:
         the live fleet's query Points padded to :func:`bucket_size` with
@@ -431,11 +447,16 @@ class QueryRegistry:
             self._restored_control_pos = None
         self._control = consumer
 
-    def note_window(self, entry: QueryEntry, n_records: int) -> None:
+    def note_window(self, entry: QueryEntry, n_records: int,
+                    emit_p99_ms: Optional[float] = None) -> None:
         """Per-query accounting for one demuxed window: the always-on
         counters (rendered as ``query="<id>"`` Prometheus labels), the
         per-query record-count histogram when a session is active, and
-        the per-query SLO verdict."""
+        the per-query SLO verdict. ``emit_p99_ms`` is the query's current
+        record→emit p99 (the router reads it off the latency plane after
+        observing this window) — the ``p99_emit_ms`` latency-class check;
+        None (no session / no ingest stamps yet) counts healthy, the
+        missing-instrument semantics every SLO check shares."""
         from spatialflink_tpu.utils import telemetry as _telemetry
 
         qid = entry.id
@@ -452,6 +473,9 @@ class QueryRegistry:
                 ok = False
             if "max_window_records" in slo and \
                     n_records > slo["max_window_records"]:
+                ok = False
+            if "p99_emit_ms" in slo and emit_p99_ms is not None \
+                    and emit_p99_ms > slo["p99_emit_ms"]:
                 ok = False
             if ok is not entry.slo_ok:
                 if not ok:
@@ -685,14 +709,43 @@ class QueryRouter:
 
     def route(self, result) -> None:
         """Account + fan out one WindowResult carrying
-        ``extras['query_ids']`` (the dynamic drive loop's contract)."""
+        ``extras['query_ids']`` (the dynamic drive loop's contract).
+
+        This demux point is ALSO where per-query latency is observed —
+        the one place every route (stdout, ``file:``, ``kafka:``) passes
+        through: the window feeds ``record-emit-ms@<id>`` (its record→
+        emit latency, looked up on the latency plane's completed-window
+        ring) and the shared ``record-latency-ms`` histogram gets one
+        sample per routed record (``now − ingestion_time``, the same
+        definition the latency-variant cases ship to the latency topic).
+        The old observation lived only in the driver's stdout result loop
+        — windows routed to ``file:``/``kafka:`` never counted."""
+        import time as _time
+
+        from spatialflink_tpu.utils import telemetry as _telemetry
+
         ids = result.extras.get("query_ids") or []
         entries = {e.id: e for e in self.registry.active_entries()}
+        tel = _telemetry.active()
+        rec_hist = (tel.histogram("record-latency-ms")
+                    if tel is not None else None)
+        now_s = _time.time() if tel is not None else 0.0
         for qid, recs in zip(ids, result.records):
             entry = entries.get(qid)
             if entry is None:
                 continue  # retired between dispatch and readback
-            self.registry.note_window(entry, len(recs))
+            emit_p99 = None
+            if tel is not None:
+                tel.latency.query_emit(qid, result.window_start, now_s)
+                emit_p99 = tel.latency.query_p99(qid)
+                if rec_hist is not None and recs:
+                    now_ms = now_s * 1e3
+                    for rec in recs:
+                        obj = rec[0] if isinstance(rec, tuple) else rec
+                        base = getattr(obj, "ingestion_time", None)
+                        if isinstance(base, (int, float)) and base > 0:
+                            rec_hist.record(now_ms - base)
+            self.registry.note_window(entry, len(recs), emit_p99_ms=emit_p99)
             route = entry.spec.route
             if route == "stdout":
                 continue  # the driver's normal sinks already carry it

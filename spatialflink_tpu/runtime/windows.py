@@ -13,6 +13,7 @@ per-cell window operators (the device half is in spatialflink_tpu.ops).
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 from typing import Dict, Iterator, List, Optional, Tuple
 
@@ -63,6 +64,25 @@ def _materialize_buffer(buf: List) -> Iterator:
                 yield chunk.record(j)
         else:
             yield x
+
+
+def _note_seals(starts) -> None:
+    """Stamp the TRUE seal wall clock of every window a watermark sweep
+    made ready, BEFORE any of them yields (the assembly chain is
+    generator-lazy, so a window's own yield executes only when the
+    consumer pulls it — windows behind earlier windows' eval/drain would
+    otherwise read their wait as part of assembly). The latency plane's
+    drive loop pops these to split buffer residency from seal→dispatch
+    queueing. One ``active()`` check per sweep, never per record; a
+    session-less run executes nothing."""
+    from spatialflink_tpu.utils import telemetry as _telemetry
+
+    tel = _telemetry.active()
+    if tel is None or not starts:
+        return
+    now = time.time()
+    for s in starts:
+        tel.latency.note_seal(s, now)
 
 
 def _keep_mask(watermarker, ts):
@@ -334,13 +354,16 @@ class WindowAssembler:
         ready = sorted(
             s for s in self._buffers if s + self.spec.size_ms <= watermark
         )
+        _note_seals(ready)
         for start in ready:
             records = _finalize_buffer(self._buffers.pop(start))
             yield (start, start + self.spec.size_ms, records)
 
     def flush(self) -> Iterator[Tuple[int, int, List]]:
         """Seal every remaining window (end of bounded stream)."""
-        for start in sorted(self._buffers):
+        ready = sorted(self._buffers)
+        _note_seals(ready)
+        for start in ready:
             records = _finalize_buffer(self._buffers.pop(start))
             yield (start, start + self.spec.size_ms, records)
 
@@ -481,6 +504,7 @@ class PaneBuffer:
             while s <= s1:
                 starts.add(s)
                 s += slide
+        _note_seals(sorted(starts))
         for s in sorted(starts):
             panes = [(p, _finalize_buffer(self._panes[p]))
                      for p in range(s, s + size, slide) if p in self._panes]

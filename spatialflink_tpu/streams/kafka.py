@@ -920,13 +920,18 @@ class KafkaWindowSink:
             t0 = time.time()
             with self._tel.span("sink", query="kafka"):
                 self._emit(result)
-            if (self._tel.traces is not None
-                    and hasattr(result, "window_start")):
-                # close the window's trace lineage: records + marker are
-                # on the output topic (suppressed duplicates included —
-                # their dedup check IS the commit-path cost they paid)
-                self._tel.traces.note_any(result.window_start,
-                                          "sink-commit", t0, time.time())
+            t1 = time.time()
+            if hasattr(result, "window_start"):
+                # the window's downstream sink-commit budget (latency
+                # plane), plus — with tracing on — the lineage note that
+                # closes the trace: records + marker are on the output
+                # topic (suppressed duplicates included — their dedup
+                # check IS the commit-path cost they paid)
+                self._tel.latency.note_downstream(
+                    "sink-commit", result.window_start, t0, t1)
+                if self._tel.traces is not None:
+                    self._tel.traces.note_any(result.window_start,
+                                              "sink-commit", t0, t1)
         else:
             self._emit(result)
 

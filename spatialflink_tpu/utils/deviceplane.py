@@ -64,8 +64,11 @@ from typing import Any, Dict, List, Optional
 
 from spatialflink_tpu.utils import metrics as _metrics
 
-#: bundle layout version (doctor refuses bundles it cannot read)
-BUNDLE_SCHEMA = 1
+#: bundle layout version (doctor refuses bundles it cannot read).
+#: 2: + latency.json — the stage-residency decomposition, record→emit
+#: histograms (global + per query) and the backpressure time series, so a
+#: breach bundle answers "which stage blew the budget" offline
+BUNDLE_SCHEMA = 2
 
 
 class RecompileError(Exception):
@@ -696,6 +699,10 @@ class FlightRecorder:
             "recent": (tel.traces.recent(32)
                        if tel is not None and tel.traces is not None else []),
             "enabled": tel is not None and tel.traces is not None})
+        write("latency", lambda: (
+            tel.latency.payload(tel=tel) if tel is not None
+            else {"stages": {}, "recent": [],
+                  "note": "no telemetry session at dump time"}))
         with self._lock:
             ring = list(self._ring)
         write("flight", lambda: {"notes": ring, "total": self.total_notes})

@@ -809,6 +809,13 @@ class Telemetry:
         self.gauges: Dict[str, Gauge] = {}
         self.cells = CellOccupancy()
         self.costs = CostProfiles()
+        #: latency-decomposition plane (stage-residency budgets, record→
+        #: emit histograms, backpressure timeline — utils.latencyplane);
+        #: fed at WINDOW/tick granularity only, so it rides every session
+        #: like the cost profiles do
+        from spatialflink_tpu.utils.latencyplane import LatencyPlane
+
+        self.latency = LatencyPlane()
         #: per-window trace lineage — OPT-IN (``trace=True`` /
         #: ``--trace-dir``): None keeps the plain session's hot-path cost
         #: exactly what PRs 2/5 measured; instrumented sites check this
@@ -913,6 +920,9 @@ class Telemetry:
         from spatialflink_tpu.utils import deviceplane as _deviceplane
 
         reg = self._registry()
+        # close a backpressure bucket at most once per tick interval —
+        # whoever snapshots first (reporter, /status, digest) drives it
+        self.latency.maybe_tick(self)
         with self._lock:
             spans = {n: s.to_dict() for n, s in self.spans.items()}
             hists = {n: h.to_dict() for n, h in self.histograms.items()}
@@ -927,6 +937,7 @@ class Telemetry:
             "degradation": _metrics.degradation_snapshot(reg),
             "grid": self.cells.to_dict(),
             "costs": self.costs.to_dict(),
+            "latency": self.latency.to_dict(),
             "device": _deviceplane.status_block(self, self._registry()),
             "traces": {
                 "enabled": self.traces is not None,
@@ -977,6 +988,13 @@ def emit_event(kind: str, **fields) -> None:
 # the shared "current pipeline state" snapshot (reporter JSONL lines, the
 # status server's /status, and the --live-stats stderr digest all render
 # exactly this — one schema definition)
+
+#: chain-stage membership for the dominant-stage digest (downstream sink
+#: stages run after emit and must not win the "where did record→emit go"
+#: headline)
+CHAIN_STAGES_SET = frozenset(
+    ("buffer", "queue", "dispatch", "inflight", "merge", "emit"))
+
 
 def _hist_digest(hists: dict, name: str) -> dict:
     h = hists.get(name)
@@ -1047,6 +1065,35 @@ def status_digest(snap: dict) -> dict:
         # device round-trip was hidden behind host work (the
         # pipeline_depth payoff metric the MULTICHIP ledger wants)
         "dispatch_overlap": _hist_digest(hists, "dispatch-overlap-ratio"),
+        # latency decomposition (utils.latencyplane): record→emit
+        # percentiles, the stage whose residency dominates, and the
+        # freshest backpressure annotations — the full table lives at
+        # GET /latency
+        "latency": _latency_digest(snap.get("latency") or {}),
+    }
+
+
+def _latency_digest(lat: dict) -> dict:
+    """The compact operator view of the latency plane's snapshot block:
+    record→emit percentiles, the dominant stage by total residency, and
+    the last backpressure bucket's stall/residency signals. Absent plane
+    (no session) renders zero-count, never missing keys."""
+    re_h = lat.get("record_emit") or {}
+    stages = lat.get("stages") or {}
+    dominant = None
+    if stages:
+        totals = {s: (h.get("sum") or 0.0) for s, h in stages.items()
+                  if s in CHAIN_STAGES_SET}
+        if any(totals.values()):
+            dominant = max(totals, key=totals.get)
+    bp = (lat.get("backpressure") or {}).get("last") or {}
+    return {
+        "record_emit_ms": ({k: re_h.get(k) for k in
+                            ("count", "p50", "p95", "p99", "max")}
+                           if re_h.get("count") else {"count": 0}),
+        "dominant_stage": dominant,
+        "stall": bp.get("stall"),
+        "backlog_residency_ms": bp.get("backlog_residency_ms"),
     }
 
 
@@ -1074,6 +1121,7 @@ def registry_snapshot(registry: Optional[_metrics.MetricsRegistry] = None
         "degradation": _metrics.degradation_snapshot(reg),
         "grid": {},
         "costs": {},
+        "latency": {},
         "device": _deviceplane.status_block(None, reg),
         "traces": {"enabled": False, "total": 0},
     }

@@ -26,8 +26,12 @@ def test_bench_guard_passes_thresholds():
             if ln.startswith("{")]
     assert [x["path"] for x in rows] == [
         "window_assign", "decode_columnar", "windowed_pipeline",
-        "skew_adaptive", "query_plane"], r.stdout
-    assert all(x["speedup"] > 0 for x in rows)
+        "skew_adaptive", "query_plane", "latency_record_emit"], r.stdout
+    assert all(x["speedup"] > 0 for x in rows if "speedup" in x)
+    # the lower-is-better latency row (record→emit p99 through the
+    # latency-decomposition plane, gated against its baseline ceiling)
+    lat = [x for x in rows if x["path"] == "latency_record_emit"]
+    assert len(lat) == 1 and lat[0]["p99_ms"] > 0
     assert r.returncode == 0, (
         f"bench_guard regression:\n{r.stdout}\n{r.stderr[-1000:]}")
 
@@ -42,3 +46,7 @@ def test_guard_baseline_rows_exist():
     # the floors assert the batched path (and the skew-adaptive grid on
     # the clustered stream) is actually FASTER than its baseline
     assert all(r["speedup"] >= 1.0 for r in base["rows"])
+    # the latency ceilings (lower-is-better second diff pass)
+    assert {r["path"] for r in base["latency_rows"]} == {
+        "latency_record_emit"}
+    assert all(r["p99_ms"] > 0 for r in base["latency_rows"])

@@ -39,7 +39,8 @@ STATUS_KEYS = {"records_in", "throughput_rps", "windows_evaluated",
                "commit_backlog", "window_backlog", "pane_cache",
                "checkpoint", "breaker_state", "dlq_depth",
                "mesh_degradations", "slo_breaches", "top_cells",
-               "skew", "top_cost_cells", "device", "dispatch_overlap"}
+               "skew", "top_cost_cells", "device", "dispatch_overlap",
+               "latency"}
 
 
 def _get(url, timeout=5):

@@ -296,6 +296,7 @@ class _CallCounter:
     def __init__(self, monkeypatch):
         from spatialflink_tpu.utils import deviceplane as deviceplane_mod
         from spatialflink_tpu.utils.deviceplane import FlightRecorder
+        from spatialflink_tpu.utils.latencyplane import LatencyPlane
         from spatialflink_tpu.utils.telemetry import (CostProfiles,
                                                       WindowTraceBook)
 
@@ -321,7 +322,15 @@ class _CallCounter:
                           (WindowTraceBook, "note"),
                           (WindowTraceBook, "note_any"),
                           (WindowTraceBook, "seal"),
-                          (FlightRecorder, "note")):
+                          (FlightRecorder, "note"),
+                          # the latency-decomposition plane obeys the same
+                          # contract: zero touches without a session
+                          (LatencyPlane, "note_seal"),
+                          (LatencyPlane, "note_dispatch"),
+                          (LatencyPlane, "window_complete"),
+                          (LatencyPlane, "note_downstream"),
+                          (LatencyPlane, "query_emit"),
+                          (LatencyPlane, "tick")):
             wrap(cls, name)
 
         orig_mem = deviceplane_mod.device_memory

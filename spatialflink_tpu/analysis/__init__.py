@@ -1,0 +1,32 @@
+"""Project-specific static analysis: the invariant linter.
+
+``python -m spatialflink_tpu.analysis --check`` proves the engine's
+cross-cutting contracts at the AST level on every tier-1 run; see
+:mod:`spatialflink_tpu.analysis.core` for the framework and
+:mod:`spatialflink_tpu.analysis.rules` for the six invariants plus the
+built-in bug-class lints. ``analysis/ALLOWLIST.toml`` holds the reviewed
+exceptions (ratchet: stale entries fail ``--check``)."""
+
+from spatialflink_tpu.analysis.core import (  # noqa: F401
+    ALLOWLIST_PATH,
+    REPO_ROOT,
+    Allowlist,
+    AllowlistError,
+    Finding,
+    ModuleSource,
+    Report,
+    Rule,
+    all_rules,
+    check_module,
+    check_source,
+    register,
+    resolve_rules,
+    run_analysis,
+)
+
+__all__ = [
+    "ALLOWLIST_PATH", "REPO_ROOT", "Allowlist", "AllowlistError",
+    "Finding", "ModuleSource", "Report", "Rule", "all_rules",
+    "check_module", "check_source", "register", "resolve_rules",
+    "run_analysis",
+]

@@ -1,0 +1,78 @@
+"""CLI for the invariant linter.
+
+``python -m spatialflink_tpu.analysis [--rule ID]... [--format text|json]
+[--check] [--root DIR] [--allowlist FILE] [--list-rules]``
+
+Exit codes: 0 clean (or report-only mode), 1 non-allowlisted findings or
+stale allowlist entries under ``--check``, 2 usage/configuration errors
+(unknown rule, malformed allowlist).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from spatialflink_tpu.analysis.core import (ALLOWLIST_PATH, REPO_ROOT,
+                                            AllowlistError, all_rules,
+                                            run_analysis)
+
+
+def _render_text(report, check: bool, out) -> None:
+    for f in report.findings:
+        print(f.render(), file=out)
+    for f, entry in report.suppressed:
+        print(f"{f.render()}  [allowlisted: {entry.reason}]", file=out)
+    for e in report.stale:
+        print(f"stale allowlist entry — remove stale entry: {e.render()}",
+              file=out)
+    n_active = len(report.findings)
+    print(f"{n_active} finding(s), {len(report.suppressed)} allowlisted, "
+          f"{len(report.stale)} stale allowlist entr"
+          f"{'y' if len(report.stale) == 1 else 'ies'} across "
+          f"{report.files} file(s) [{', '.join(report.rules)}]", file=out)
+    if check:
+        print("check: " + ("PASS" if report.ok else "FAIL"), file=out)
+
+
+def main(argv: Optional[List[str]] = None,
+         out=sys.stdout) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m spatialflink_tpu.analysis",
+        description="invariant linter: prove the engine's contracts at "
+                    "the AST level")
+    ap.add_argument("--rule", action="append", default=None,
+                    metavar="ID", help="run only this rule (repeatable)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 on non-allowlisted findings or stale "
+                         "allowlist entries (the tier-1 gate mode)")
+    ap.add_argument("--root", default=REPO_ROOT,
+                    help="tree to scan (default: this repo)")
+    ap.add_argument("--allowlist", default=ALLOWLIST_PATH,
+                    help="allowlist TOML (default: the committed "
+                         "analysis/ALLOWLIST.toml); 'none' disables")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print rule ids + contracts and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule in all_rules():
+            print(f"{rule.id:<22} {rule.contract}", file=out)
+        return 0
+    allowlist = None if args.allowlist == "none" else args.allowlist
+    try:
+        report = run_analysis(root=args.root, rule_ids=args.rule,
+                              allowlist=allowlist)
+    except AllowlistError as e:
+        print(f"analysis: {e}", file=sys.stderr)
+        return 2
+    if args.format == "json":
+        print(json.dumps(report.to_dict(), sort_keys=True), file=out)
+    else:
+        _render_text(report, args.check, out)
+    if args.check and not report.ok:
+        return 1
+    return 0

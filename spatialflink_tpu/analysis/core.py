@@ -1,0 +1,398 @@
+"""Invariant-lint framework: a small rule engine over ``ast``.
+
+The engine's correctness contracts — zero post-warmup recompiles, zero
+hot-path telemetry without a session, accounted host↔device syncs,
+checkpoint coverage of mutable streaming state, lock discipline on
+cross-thread state — are each enforced at runtime by a sentinel or a spy,
+but only on the code paths a test happens to execute. This package
+promotes them to *static* invariants: every tier-1 run parses the whole
+``spatialflink_tpu`` tree and proves the contracts at the AST level, on
+every path, including ones no benchmark has ever taken.
+
+Pieces:
+
+- :class:`Finding` — one violation: rule id, file/line/col, severity,
+  message, and the enclosing dotted ``symbol`` (``Class.method``) so
+  allowlist entries can anchor to code instead of line numbers.
+- :class:`Rule` — subclass per invariant; ``scope`` globs pick the
+  modules a contract covers, ``check(mod)`` yields findings. Rules
+  self-register via :func:`register`.
+- :class:`ModuleSource` — parsed module plus the parent map / enclosing-
+  scope helpers every rule needs.
+- :class:`Allowlist` — reviewed exceptions loaded from
+  ``analysis/ALLOWLIST.toml``. Every entry needs a ``reason``; an entry
+  that matches no current finding is *stale* and fails ``--check``, so
+  the list can only shrink (ratchet), never accrete dead weight.
+- :func:`run_analysis` — scan a tree, apply rules, split findings into
+  active / allowlisted, report stale entries.
+
+The CLI lives in :mod:`spatialflink_tpu.analysis.cli` and the rule
+implementations in :mod:`spatialflink_tpu.analysis.rules`.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import fnmatch
+import os
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+#: repo root (the directory holding the ``spatialflink_tpu`` package).
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+#: the committed allowlist for the real tree.
+ALLOWLIST_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "ALLOWLIST.toml")
+
+SEVERITIES = ("error", "warning")
+
+
+class AllowlistError(ValueError):
+    """Malformed allowlist file (syntax, missing reason, unknown rule) —
+    a configuration error, distinct from findings (exit 2, not 1)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at one site."""
+
+    rule: str
+    path: str  # repo-relative, forward slashes
+    line: int
+    col: int
+    severity: str
+    message: str
+    symbol: str = ""  # dotted enclosing scope, e.g. "PaneCache.get"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        where = f" [{self.symbol}]" if self.symbol else ""
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule} "
+                f"{self.severity}: {self.message}{where}")
+
+
+class ModuleSource:
+    """A parsed module plus the structural indexes rules share: a
+    child→parent map, enclosing-function/class lookup, and dotted
+    qualnames for findings and symbol-anchored allowlist entries."""
+
+    def __init__(self, path: str, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath.replace(os.sep, "/")
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        self._parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self._parents[child] = parent
+
+    @classmethod
+    def from_source(cls, source: str,
+                    relpath: str = "spatialflink_tpu/snippet.py"
+                    ) -> "ModuleSource":
+        """Build from a source string — the fixture-test entry point."""
+        return cls(relpath, relpath, source)
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._parents.get(node)
+
+    def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
+        """Innermost-first chain of ancestors up to the module node."""
+        cur = self._parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self._parents.get(cur)
+
+    def enclosing_functions(self, node: ast.AST) -> List[ast.AST]:
+        """Enclosing FunctionDef/AsyncFunctionDef/Lambda nodes, innermost
+        first."""
+        return [a for a in self.ancestors(node)
+                if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda))]
+
+    def enclosing_class(self, node: ast.AST) -> Optional[ast.ClassDef]:
+        for a in self.ancestors(node):
+            if isinstance(a, ast.ClassDef):
+                return a
+        return None
+
+    def qualname(self, node: ast.AST) -> str:
+        """Dotted scope name for ``node`` (classes and named functions on
+        the ancestor chain, outermost first; lambdas render as
+        ``<lambda>``)."""
+        parts: List[str] = []
+        for a in self.ancestors(node):
+            if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+                parts.append(a.name)
+            elif isinstance(a, ast.Lambda):
+                parts.append("<lambda>")
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            parts.insert(0, node.name)
+        return ".".join(reversed(parts))
+
+
+class Rule:
+    """One static invariant. Subclasses set ``id``/``contract``/``scope``
+    and implement :meth:`check`; ``runtime_twin`` names the runtime
+    enforcement (sentinel/spy/test) the rule complements — the docs table
+    renders it."""
+
+    id: str = ""
+    contract: str = ""
+    runtime_twin: str = ""
+    severity: str = "error"
+    #: fnmatch globs over repo-relative paths this contract covers.
+    scope: Tuple[str, ...] = ("spatialflink_tpu/**",)
+
+    def applies_to(self, relpath: str) -> bool:
+        rel = relpath.replace(os.sep, "/")
+        return any(fnmatch.fnmatch(rel, pat) for pat in self.scope)
+
+    def check(self, mod: ModuleSource) -> Iterator[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+    def finding(self, mod: ModuleSource, node: ast.AST, message: str,
+                severity: Optional[str] = None) -> Finding:
+        return Finding(rule=self.id, path=mod.relpath,
+                       line=getattr(node, "lineno", 0),
+                       col=getattr(node, "col_offset", 0),
+                       severity=severity or self.severity,
+                       message=message, symbol=mod.qualname(node))
+
+
+#: global rule registry, id → instance (populated by the rule modules).
+RULES: Dict[str, Rule] = {}
+
+
+def register(rule_cls):
+    """Class decorator: instantiate and register a rule by id."""
+    rule = rule_cls()
+    if not rule.id:
+        raise ValueError(f"{rule_cls.__name__} has no id")
+    if rule.id in RULES:
+        raise ValueError(f"duplicate rule id {rule.id!r}")
+    RULES[rule.id] = rule
+    return rule_cls
+
+
+def all_rules() -> List[Rule]:
+    """Every registered rule, importing the rule modules on first use."""
+    from spatialflink_tpu.analysis import rules as _rules  # noqa: F401
+
+    return [RULES[k] for k in sorted(RULES)]
+
+
+def resolve_rules(rule_ids: Optional[Sequence[str]] = None) -> List[Rule]:
+    rules = all_rules()
+    if not rule_ids:
+        return rules
+    unknown = sorted(set(rule_ids) - set(RULES))
+    if unknown:
+        raise AllowlistError(
+            f"unknown rule id(s): {', '.join(unknown)} "
+            f"(known: {', '.join(sorted(RULES))})")
+    return [RULES[r] for r in sorted(set(rule_ids))]
+
+
+# --------------------------------------------------------------------- #
+# allowlist
+
+
+@dataclasses.dataclass
+class AllowEntry:
+    """One reviewed exception. Matches a finding when rule+path agree and
+    the anchor (symbol, line, or neither = whole file) matches. ``count``
+    tracks how many findings the entry absorbed — zero after a full run
+    means the exception is stale and must be removed."""
+
+    rule: str
+    path: str
+    reason: str
+    symbol: Optional[str] = None
+    line: Optional[int] = None
+    count: int = 0
+
+    def matches(self, f: Finding) -> bool:
+        if f.rule != self.rule or f.path != self.path:
+            return False
+        if self.symbol is not None and f.symbol != self.symbol \
+                and not f.symbol.startswith(self.symbol + "."):
+            return False
+        if self.line is not None and f.line != self.line:
+            return False
+        return True
+
+    def render(self) -> str:
+        anchor = (f" symbol={self.symbol}" if self.symbol else "") + \
+            (f" line={self.line}" if self.line is not None else "")
+        return f"{self.rule} @ {self.path}{anchor} ({self.reason})"
+
+
+def _parse_toml(path: str) -> dict:
+    try:
+        import tomllib  # Python ≥3.11
+    except ImportError:  # pragma: no cover - environment-dependent
+        import tomli as tomllib
+    with open(path, "rb") as f:
+        try:
+            return tomllib.load(f)
+        except tomllib.TOMLDecodeError as e:
+            raise AllowlistError(f"{path}: invalid TOML: {e}")
+
+
+class Allowlist:
+    """Reviewed exceptions; see the module docstring for the ratchet."""
+
+    def __init__(self, entries: Optional[List[AllowEntry]] = None):
+        self.entries = entries or []
+
+    @classmethod
+    def load(cls, path: str) -> "Allowlist":
+        if not os.path.exists(path):
+            return cls([])
+        doc = _parse_toml(path)
+        entries: List[AllowEntry] = []
+        for i, raw in enumerate(doc.get("allow", []) or []):
+            if not isinstance(raw, dict):
+                raise AllowlistError(f"{path}: [[allow]] #{i + 1} is not "
+                                     "a table")
+            unknown = set(raw) - {"rule", "path", "reason", "symbol",
+                                  "line"}
+            if unknown:
+                raise AllowlistError(
+                    f"{path}: [[allow]] #{i + 1} has unknown key(s) "
+                    f"{sorted(unknown)}")
+            for key in ("rule", "path", "reason"):
+                if not isinstance(raw.get(key), str) or not raw[key].strip():
+                    raise AllowlistError(
+                        f"{path}: [[allow]] #{i + 1} needs a non-empty "
+                        f"{key!r} string — every exception carries its "
+                        "review reason")
+            entries.append(AllowEntry(
+                rule=raw["rule"], path=raw["path"],
+                reason=raw["reason"].strip(),
+                symbol=raw.get("symbol"), line=raw.get("line")))
+        return cls(entries)
+
+    def apply(self, findings: Iterable[Finding],
+              ran_rules: Iterable[str]) -> Tuple[
+                  List[Finding], List[Tuple[Finding, AllowEntry]],
+                  List[AllowEntry]]:
+        """Split findings into (active, suppressed) and report stale
+        entries. Staleness only considers entries whose rule actually ran
+        — a ``--rule`` subset run must not condemn the others' entries."""
+        ran = set(ran_rules)
+        for e in self.entries:
+            e.count = 0
+        active: List[Finding] = []
+        suppressed: List[Tuple[Finding, AllowEntry]] = []
+        for f in findings:
+            hit = next((e for e in self.entries if e.matches(f)), None)
+            if hit is not None:
+                hit.count += 1
+                suppressed.append((f, hit))
+            else:
+                active.append(f)
+        stale = [e for e in self.entries if e.count == 0 and e.rule in ran]
+        return active, suppressed, stale
+
+
+# --------------------------------------------------------------------- #
+# runner
+
+
+@dataclasses.dataclass
+class Report:
+    """One full pass over a tree."""
+
+    findings: List[Finding]          # active (non-allowlisted)
+    suppressed: List[Tuple[Finding, AllowEntry]]
+    stale: List[AllowEntry]
+    rules: List[str]
+    files: int
+    parse_errors: List[Finding]
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings and not self.stale
+
+    def to_dict(self) -> dict:
+        return {
+            "ok": self.ok,
+            "files": self.files,
+            "rules": self.rules,
+            "findings": [f.to_dict() for f in self.findings],
+            "allowlisted": [{**f.to_dict(), "reason": e.reason}
+                            for f, e in self.suppressed],
+            "stale_allowlist_entries": [
+                {"rule": e.rule, "path": e.path, "symbol": e.symbol,
+                 "line": e.line, "reason": e.reason}
+                for e in self.stale],
+        }
+
+
+def iter_sources(root: str = REPO_ROOT) -> Iterator[Tuple[str, str]]:
+    """(abspath, relpath) for every ``.py`` under ``root``'s
+    ``spatialflink_tpu`` package — the contracts govern the engine, not
+    tests/benchmarks/examples."""
+    pkg = os.path.join(root, "spatialflink_tpu")
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for name in sorted(filenames):
+            if name.endswith(".py"):
+                path = os.path.join(dirpath, name)
+                yield path, os.path.relpath(path, root)
+
+
+def check_module(mod: ModuleSource,
+                 rules: Optional[Sequence[Rule]] = None) -> List[Finding]:
+    """Run ``rules`` (default: all registered) over one parsed module."""
+    out: List[Finding] = []
+    for rule in (rules if rules is not None else all_rules()):
+        if rule.applies_to(mod.relpath):
+            out.extend(rule.check(mod))
+    return out
+
+
+def check_source(source: str, relpath: str = "spatialflink_tpu/snippet.py",
+                 rules: Optional[Sequence[Rule]] = None) -> List[Finding]:
+    """Fixture-test helper: run rules over a source snippet as if it
+    lived at ``relpath``."""
+    return check_module(ModuleSource.from_source(source, relpath), rules)
+
+
+def run_analysis(root: str = REPO_ROOT,
+                 rule_ids: Optional[Sequence[str]] = None,
+                 allowlist: Optional[str] = ALLOWLIST_PATH) -> Report:
+    """The full pass: parse every engine module under ``root``, run the
+    selected rules, apply the allowlist. ``allowlist=None`` disables
+    suppression (raw findings)."""
+    rules = resolve_rules(rule_ids)
+    findings: List[Finding] = []
+    parse_errors: List[Finding] = []
+    files = 0
+    for path, relpath in iter_sources(root):
+        files += 1
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        try:
+            mod = ModuleSource(path, relpath, source)
+        except SyntaxError as e:
+            parse_errors.append(Finding(
+                rule="parse-error", path=relpath.replace(os.sep, "/"),
+                line=e.lineno or 0, col=e.offset or 0, severity="error",
+                message=f"syntax error: {e.msg}"))
+            continue
+        findings.extend(check_module(mod, rules))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    al = Allowlist.load(allowlist) if allowlist else Allowlist([])
+    active, suppressed, stale = al.apply(findings, [r.id for r in rules])
+    active = parse_errors + active
+    return Report(findings=active, suppressed=suppressed, stale=stale,
+                  rules=[r.id for r in rules], files=files,
+                  parse_errors=parse_errors)

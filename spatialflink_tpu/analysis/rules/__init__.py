@@ -1,0 +1,13 @@
+"""Rule implementations. Importing this package registers every rule
+with :data:`spatialflink_tpu.analysis.core.RULES` (the modules
+self-register via the ``@register`` decorator)."""
+
+from spatialflink_tpu.analysis.rules import (  # noqa: F401
+    buglint,
+    checkpoint_coverage,
+    host_sync,
+    jit_coverage,
+    telemetry_gating,
+    thread_shared,
+    trace_safety,
+)

@@ -1,0 +1,137 @@
+"""Generic real-bug lint rules (the in-tree subset of the ruff gate).
+
+``ruff`` is the third-party half of the lint gate (``ruff.toml`` scopes
+it to real-bug classes: undefined names, unused imports, f-string and
+``is``-literal bugs). The container running tier-1 may not ship ruff, so
+the three classes that are cheap to prove from a single module's AST are
+implemented here and always run; ``tests/test_analysis.py`` runs ruff on
+top whenever the binary exists.
+
+- ``unused-import`` (F401): an imported binding never referenced and not
+  re-exported via ``__all__`` or an ``import x as x`` alias.
+- ``fstring-placeholder`` (F541): an f-string with no ``{}`` placeholder
+  — almost always a formatting bug (a brace that never happened).
+- ``is-literal`` (F632): ``is``/``is not`` against a str/bytes/num/tuple
+  literal compares identity, not equality — interpreter-dependent.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Set, Tuple
+
+from spatialflink_tpu.analysis.core import (Finding, ModuleSource, Rule,
+                                            register)
+
+
+def _used_names(tree: ast.AST) -> Set[str]:
+    used: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Assign):
+            # names exported through __all__ count as used
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "__all__" \
+                        and isinstance(node.value, (ast.List, ast.Tuple)):
+                    used.update(
+                        e.value for e in node.value.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str))
+    return used
+
+
+def _imports(tree: ast.AST) -> List[Tuple[str, bool, ast.AST]]:
+    """(bound_name, explicit_reexport, node) for every import binding."""
+    out: List[Tuple[str, bool, ast.AST]] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                bound = a.asname or a.name.split(".")[0]
+                out.append((bound, a.asname == a.name, node))
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "__future__":
+                continue
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                bound = a.asname or a.name
+                out.append((bound, a.asname == a.name, node))
+    return out
+
+
+@register
+class UnusedImportRule(Rule):
+    id = "unused-import"
+    contract = "no dead imports (they hide real dependencies and typos)"
+    runtime_twin = "ruff F401 (when installed)"
+    severity = "warning"
+    scope = ("spatialflink_tpu/**",)
+
+    def check(self, mod: ModuleSource) -> Iterator[Finding]:
+        # __init__.py re-exports by convention (ruff per-file-ignore)
+        if mod.relpath.endswith("__init__.py"):
+            return
+        used = _used_names(mod.tree)
+        lines = mod.source.splitlines()
+        for bound, reexport, node in _imports(mod.tree):
+            if reexport or bound in used or bound == "_":
+                continue
+            line = lines[node.lineno - 1] if node.lineno <= len(lines) \
+                else ""
+            if "noqa" in line:
+                continue
+            yield self.finding(mod, node,
+                               f"import {bound!r} is never used")
+
+
+@register
+class FStringPlaceholderRule(Rule):
+    id = "fstring-placeholder"
+    contract = "f-strings contain at least one placeholder"
+    runtime_twin = "ruff F541 (when installed)"
+    severity = "warning"
+    scope = ("spatialflink_tpu/**",)
+
+    def check(self, mod: ModuleSource) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            # a FormattedValue's format_spec is itself a JoinedStr — only
+            # real f-string literals count
+            if isinstance(node, ast.JoinedStr) and not any(
+                    isinstance(v, ast.FormattedValue)
+                    for v in node.values) \
+                    and not isinstance(mod.parent(node),
+                                       ast.FormattedValue):
+                yield self.finding(
+                    mod, node,
+                    "f-string without placeholders — either a missing "
+                    "brace or a stray f prefix")
+
+
+@register
+class IsLiteralRule(Rule):
+    id = "is-literal"
+    contract = "`is` never compares against str/bytes/num/tuple literals"
+    runtime_twin = "ruff F632 (when installed)"
+    severity = "error"
+    scope = ("spatialflink_tpu/**",)
+
+    def check(self, mod: ModuleSource) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Compare):
+                continue
+            for op, comp in zip(node.ops, node.comparators):
+                if not isinstance(op, (ast.Is, ast.IsNot)):
+                    continue
+                for side in (node.left, comp):
+                    if isinstance(side, ast.Tuple) or (
+                            isinstance(side, ast.Constant)
+                            and isinstance(side.value,
+                                           (str, bytes, int, float,
+                                            complex))
+                            and not isinstance(side.value, bool)):
+                        yield self.finding(
+                            mod, node,
+                            "`is` against a literal tests identity, not "
+                            "equality — use == / !=")
+                        break

@@ -1,0 +1,103 @@
+"""Rule 5 — checkpoint coverage: mutable streaming state must be
+snapshotable.
+
+PR 4's coordinated checkpoints are only exactly-once if *every* piece of
+mutable per-run state participates. The heuristic for "holds streaming
+state": a class in ``runtime/``/``operators/``/``streams/`` that assigns
+an instance attribute *outside* ``__init__`` whose name says it holds
+windows, panes, offsets, partials, watermarks, buffers, or sealed sets.
+Such a class must implement the ``snapshot``/``restore`` pair the
+coordinator registers — or carry an allowlist entry explaining why its
+state is legitimately ephemeral (rebuilt, cache-only, or test-only).
+
+Classes whose state is genuinely derived (caches that recompute, pure
+cursors over immutable inputs) belong in the allowlist *with that
+sentence as the reason* — the point is that someone decided, not that
+the linter guessed.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List
+
+from spatialflink_tpu.analysis.core import (Finding, ModuleSource, Rule,
+                                            register)
+from spatialflink_tpu.analysis.rules.common import attr_write_targets
+
+#: attribute-name fragments that mean "streaming state a resume must not
+#: lose".
+_STATE_PAT = re.compile(
+    r"window|pane|offset|partial|watermark|seal|buffer", re.IGNORECASE)
+
+#: methods whose writes do not make state "live across the run": setup,
+#: the snapshot/restore pair itself, and teardown.
+_EXEMPT_METHODS = {"__init__", "__post_init__", "__new__", "snapshot",
+                   "restore", "reset", "clear", "close", "__exit__"}
+
+
+@register
+class CheckpointCoverageRule(Rule):
+    id = "checkpoint-coverage"
+    contract = ("classes with mutable windows/offsets/partials state "
+                "implement the snapshot/restore checkpoint pair")
+    runtime_twin = ("CheckpointCoordinator barriers + crash/resume "
+                    "identity tests (tests/test_recovery.py)")
+    severity = "warning"
+    scope = ("spatialflink_tpu/runtime/*.py",
+             "spatialflink_tpu/operators/*.py",
+             "spatialflink_tpu/streams/*.py")
+
+    def check(self, mod: ModuleSource) -> Iterator[Finding]:
+        for cls in ast.walk(mod.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            methods = {m.name for m in cls.body
+                       if isinstance(m, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))}
+            state_writes: Dict[str, int] = {}
+            for meth in cls.body:
+                if not isinstance(meth, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)) \
+                        or meth.name in _EXEMPT_METHODS:
+                    continue
+                for stmt in ast.walk(meth):
+                    if not isinstance(stmt, (ast.Assign, ast.AugAssign,
+                                             ast.AnnAssign)):
+                        continue
+                    for attr, node in attr_write_targets(stmt):
+                        if _STATE_PAT.search(attr) \
+                                and attr not in state_writes:
+                            state_writes[attr] = node.lineno
+            if not state_writes:
+                continue
+            missing = [m for m in ("snapshot", "restore")
+                       if m not in methods]
+            if not missing:
+                continue
+            attrs = ", ".join(
+                f"{a} (line {ln})" for a, ln in sorted(
+                    state_writes.items(), key=lambda kv: kv[1]))
+            yield self.finding(
+                mod, cls,
+                f"class mutates streaming state outside __init__ "
+                f"[{attrs}] but lacks {' and '.join(missing)} — register "
+                "it as a checkpoint component or allowlist with the "
+                "reason its state may be lost on resume")
+
+
+def state_attributes(cls: ast.ClassDef) -> List[str]:
+    """Expose the heuristic for tests/docs: the checkpoint-relevant
+    attrs a class mutates outside ``__init__``."""
+    out = []
+    for meth in cls.body:
+        if not isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                or meth.name in _EXEMPT_METHODS:
+            continue
+        for stmt in ast.walk(meth):
+            if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                for attr, _ in attr_write_targets(stmt):
+                    if _STATE_PAT.search(attr) and attr not in out:
+                        out.append(attr)
+    return out

@@ -1,0 +1,247 @@
+"""Shared AST machinery for the invariant rules: dotted-name rendering,
+``instrumented_jit`` decorator parsing, and the None-guard domination
+check the telemetry-gating rule is built on."""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Sequence, Set, Tuple
+
+TERMINATORS = (ast.Return, ast.Continue, ast.Break, ast.Raise)
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """Render a Name/Attribute chain as ``a.b.c``; None when the chain
+    roots in anything else (a call, a subscript, a literal)."""
+    parts: List[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(node: ast.Call) -> Optional[str]:
+    """Dotted name of a call's target (``np.asarray``, ``float``)."""
+    return dotted(node.func)
+
+
+def terminates(stmts: Sequence[ast.stmt]) -> bool:
+    """Does this suite unconditionally leave the enclosing block?"""
+    return bool(stmts) and isinstance(stmts[-1], TERMINATORS)
+
+
+# --------------------------------------------------------------------- #
+# instrumented_jit decorator parsing (trace-safety + jit-coverage)
+
+
+def _is_instrumented_jit(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Name) and node.id == "instrumented_jit") \
+        or (isinstance(node, ast.Attribute)
+            and node.attr == "instrumented_jit")
+
+
+def _const_strings(node: ast.AST) -> List[str]:
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)]
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    return []
+
+
+def _const_ints(node: ast.AST) -> List[int]:
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, int)]
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    return []
+
+
+def jit_static_names(fn: ast.FunctionDef) -> Optional[Set[str]]:
+    """If ``fn`` is decorated with ``instrumented_jit`` (bare, or curried
+    through ``partial(instrumented_jit, static_arg…=…)``), return the set
+    of parameter names the decoration marks static; None when the
+    function is not jitted at all."""
+    params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    for dec in fn.decorator_list:
+        if _is_instrumented_jit(dec):
+            return set()
+        if isinstance(dec, ast.Call):
+            target = None
+            fname = dotted(dec.func) or ""
+            if _is_instrumented_jit(dec.func):
+                target = dec
+            elif fname.split(".")[-1] == "partial" and dec.args \
+                    and _is_instrumented_jit(dec.args[0]):
+                target = dec
+            if target is None:
+                continue
+            statics: Set[str] = set()
+            for kw in target.keywords:
+                if kw.arg == "static_argnames":
+                    statics.update(_const_strings(kw.value))
+                elif kw.arg == "static_argnums":
+                    for i in _const_ints(kw.value):
+                        if 0 <= i < len(params):
+                            statics.add(params[i])
+            return statics
+    return None
+
+
+def function_params(fn) -> List[str]:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names
+
+
+# --------------------------------------------------------------------- #
+# None-guard domination (telemetry-gating)
+#
+# A "session variable" use is guarded when, on every path from its
+# binding, the variable has been proven non-None: either an enclosing
+# ``if var is not None: …`` / ``if var: …`` branch, the matching arm of a
+# ternary, or an earlier sibling ``if var is None: return/continue`` whose
+# body leaves the block. This is a lexical approximation of dominator
+# analysis — deliberately simple, with the allowlist as the escape hatch.
+
+
+def expr_is(node: ast.AST, var: str) -> bool:
+    return dotted(node) == var
+
+
+def _test_implication(test: ast.AST, var: str) -> Optional[str]:
+    """What an If/While/IfExp test proves about ``var``:
+
+    - "body": inside the body, var is non-None
+    - "orelse": inside the else branch, var is non-None
+    - None: the test says nothing about var
+    """
+    if expr_is(test, var):
+        return "body"
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not) \
+            and expr_is(test.operand, var):
+        return "orelse"
+    if isinstance(test, ast.Compare) and len(test.ops) == 1 \
+            and expr_is(test.left, var) \
+            and isinstance(test.comparators[0], ast.Constant) \
+            and test.comparators[0].value is None:
+        if isinstance(test.ops[0], ast.IsNot):
+            return "body"
+        if isinstance(test.ops[0], ast.Is):
+            return "orelse"
+    if isinstance(test, ast.BoolOp):
+        if isinstance(test.op, ast.And):
+            # conjunction: any clause proving non-None narrows the body
+            if any(_test_implication(v, var) == "body"
+                   for v in test.values):
+                return "body"
+        else:  # Or: ¬(a ∨ b) narrows the else branch
+            if any(_test_implication(v, var) == "orelse"
+                   for v in test.values):
+                return "orelse"
+    return None
+
+
+def _branch_of(parent: ast.AST, child: ast.AST) -> Optional[str]:
+    """Which structural field of ``parent`` holds ``child``."""
+    for field in ("body", "orelse", "finalbody"):
+        val = getattr(parent, field, None)
+        if val is None:
+            continue
+        if (isinstance(val, list) and child in val) or child is val:
+            return field
+    return None
+
+
+def _sibling_guard(stmts: Sequence[ast.stmt], before: ast.stmt,
+                   var: str) -> bool:
+    """True when an earlier statement in this suite eliminates the
+    var-is-None path: ``if var is None (or …): return/continue`` or
+    ``assert var is not None``."""
+    for st in stmts:
+        if st is before:
+            return False
+        if isinstance(st, ast.If) and terminates(st.body) \
+                and not st.orelse:
+            # the body runs when the test is true; if the test being
+            # true INCLUDES every var-is-None state, surviving it proves
+            # var is not None.  `if var is None:` and
+            # `if var is None or other:` both qualify.
+            if _none_implies_test(st.test, var):
+                return True
+        if isinstance(st, ast.Assert) \
+                and _test_implication(st.test, var) == "body":
+            return True
+    return False
+
+
+def _none_implies_test(test: ast.AST, var: str) -> bool:
+    """Would ``var is None`` force this test to be true?"""
+    if isinstance(test, ast.Compare) and len(test.ops) == 1 \
+            and expr_is(test.left, var) \
+            and isinstance(test.ops[0], ast.Is) \
+            and isinstance(test.comparators[0], ast.Constant) \
+            and test.comparators[0].value is None:
+        return True
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not) \
+            and expr_is(test.operand, var):
+        return True
+    if isinstance(test, ast.BoolOp) and isinstance(test.op, ast.Or):
+        return any(_none_implies_test(v, var) for v in test.values)
+    return False
+
+
+def is_none_guarded(mod, node: ast.AST, var: str) -> bool:
+    """Is ``node`` (a use of session variable ``var``) dominated by a
+    non-None proof? See the section comment for the recognized shapes."""
+    child = node
+    for parent in mod.ancestors(node):
+        if isinstance(parent, (ast.If, ast.While)):
+            implied = _test_implication(parent.test, var)
+            branch = _branch_of(parent, child)
+            if implied == "body" and branch == "body":
+                return True
+            if implied == "orelse" and branch == "orelse":
+                return True
+        elif isinstance(parent, ast.IfExp):
+            implied = _test_implication(parent.test, var)
+            if implied == "body" and child is parent.body:
+                return True
+            if implied == "orelse" and child is parent.orelse:
+                return True
+        # earlier sibling guards in any suite on the way up
+        for field in ("body", "orelse", "finalbody"):
+            suite = getattr(parent, field, None)
+            if isinstance(suite, list) and child in suite:
+                if _sibling_guard(suite, child, var):
+                    return True
+        child = parent
+    return False
+
+
+def attr_write_targets(stmt: ast.stmt) -> List[Tuple[str, ast.AST]]:
+    """``self.x``-style attribute names written by an Assign/AugAssign/
+    AnnAssign, as (attr_name, node) pairs."""
+    targets: List[ast.AST] = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    out: List[Tuple[str, ast.AST]] = []
+    for t in targets:
+        for el in ast.walk(t) if isinstance(t, (ast.Tuple, ast.List)) \
+                else [t]:
+            if isinstance(el, ast.Attribute) \
+                    and isinstance(el.value, ast.Name) \
+                    and el.value.id == "self":
+                out.append((el.attr, el))
+    return out

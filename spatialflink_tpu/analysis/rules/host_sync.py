@@ -1,0 +1,158 @@
+"""Rule 3 — host-sync discipline: no unaccounted device→host syncs on
+dispatch paths.
+
+The pipelined drive loop only overlaps host assembly with device compute
+if nothing on the dispatch path forces an early readback. In the
+dispatch-path modules (``operators/base.py``, ``ops/*``, ``parallel/*``)
+the implicit sync constructs — ``float()``/``bool()`` on array values,
+``np.asarray``/``np.array`` of non-literal values, ``.item()``,
+``.block_until_ready()`` — are only allowed inside the *accounted
+readback seams*:
+
+- ``Deferred.finish`` and the ``collect*`` closures it runs (built by
+  the ``_defer_*`` helpers — that IS the readback point);
+- any function that calls ``note_readback`` (the CostProfiles
+  bytes-moved accounting);
+- host twins by convention (``*_host`` functions operate on numpy
+  inputs by contract).
+
+Everything else is a finding: either move the sync behind the seam,
+account it, or allowlist it with the reason a reviewer accepted.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from spatialflink_tpu.analysis.core import (Finding, ModuleSource, Rule,
+                                            register)
+from spatialflink_tpu.analysis.rules.common import call_name, dotted
+
+_NP_CONVERTERS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
+                  "onp.asarray", "onp.array"}
+_SYNC_METHODS = {"item", "block_until_ready"}
+_HOST_LITERALS = (ast.List, ast.Tuple, ast.ListComp, ast.GeneratorExp,
+                  ast.Dict, ast.DictComp, ast.Constant, ast.JoinedStr)
+
+
+_JAX_ROOTS = {"jax", "jnp", "lax"}
+
+
+def _jax_rooted(mod: ModuleSource, expr: ast.AST) -> bool:
+    """Does ``expr`` visibly read a jax-produced value? True when the
+    subtree holds a call rooted at jax/jnp/lax, or a name bound from one
+    in an enclosing function. Deliberately under-approximate —
+    ``float()``/``bool()`` on configs and host math is everywhere and
+    fine; the dispatch-overlap histogram is the runtime backstop for
+    flows this cannot see."""
+    calls = [n for n in ast.walk(expr) if isinstance(n, ast.Call)]
+    names = {n.id for n in ast.walk(expr) if isinstance(n, ast.Name)}
+    for c in calls:
+        root = (dotted(c.func) or "").split(".")[0]
+        if root in _JAX_ROOTS:
+            return True
+    if not names:
+        return False
+    for fn in mod.enclosing_functions(expr):
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and node.targets[0].id in names \
+                    and isinstance(node.value, ast.Call):
+                root = (dotted(node.value.func) or "").split(".")[0]
+                if root in _JAX_ROOTS:
+                    return True
+    return False
+
+
+def _is_defer_call(node: ast.Call) -> bool:
+    leaf = (dotted(node.func) or "").split(".")[-1]
+    return leaf == "Deferred" or leaf.startswith("_defer")
+
+
+def _contains_note_readback(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "note_readback":
+            return True
+    return False
+
+
+def _fn_name(fn: ast.AST) -> str:
+    return fn.name if isinstance(fn, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)) else "<lambda>"
+
+
+@register
+class HostSyncRule(Rule):
+    id = "host-sync"
+    contract = ("implicit device→host syncs on dispatch paths only inside "
+                "accounted readback seams (Deferred.finish / collect "
+                "closures / note_readback callers / *_host twins)")
+    runtime_twin = ("readback counters + CostProfiles.note_readback "
+                    "bytes_moved accounting; dispatch-overlap histogram")
+    severity = "error"
+    scope = ("spatialflink_tpu/operators/base.py",
+             "spatialflink_tpu/ops/*.py",
+             "spatialflink_tpu/parallel/*.py")
+
+    def _in_seam(self, mod: ModuleSource, node: ast.AST) -> bool:
+        fns = mod.enclosing_functions(node)
+        for fn in fns:
+            name = _fn_name(fn)
+            if name.startswith(("collect", "_defer")) \
+                    or name.endswith("_host") or name == "finish":
+                return True
+            if _contains_note_readback(fn):
+                return True
+            # a closure handed to Deferred(...) or a _defer_* helper IS
+            # the collect seam, whatever it is called locally — inline
+            # (lambda argument) or by name
+            parent = mod.parent(fn)
+            if isinstance(parent, ast.Call) and _is_defer_call(parent):
+                return True
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                container = mod.parent(fn)
+                for n in ast.walk(container) if container is not None \
+                        else ():
+                    if isinstance(n, ast.Call) and _is_defer_call(n) \
+                            and any(isinstance(a, ast.Name)
+                                    and a.id == fn.name for a in n.args):
+                        return True
+        # module-level code (imports/constants) never dispatches
+        return not fns
+
+    def check(self, mod: ModuleSource) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            msg = self._classify(mod, node)
+            if msg is None:
+                continue
+            if self._in_seam(mod, node):
+                continue
+            yield self.finding(mod, node, msg)
+
+    def _classify(self, mod: ModuleSource, node: ast.Call):
+        name = call_name(node)
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _SYNC_METHODS:
+            return (f".{node.func.attr}() forces a device→host sync on "
+                    "the dispatch path — defer it into the collect seam "
+                    "or account it via note_readback")
+        if name in _NP_CONVERTERS:
+            arg = node.args[0] if node.args else None
+            if arg is None or isinstance(arg, _HOST_LITERALS):
+                return None  # building a host array from host data
+            return (f"{name}(...) of a non-literal value is an implicit "
+                    "device→host transfer when the value is a jax array "
+                    "— move it behind the Deferred/collect seam, account "
+                    "it with note_readback, or allowlist with a reason")
+        if name in ("float", "bool") and len(node.args) == 1 \
+                and _jax_rooted(mod, node.args[0]):
+            return (f"{name}() of a jax-produced value blocks on the "
+                    "device — readbacks on dispatch paths must go "
+                    "through the accounted seams")
+        return None

@@ -1,0 +1,56 @@
+"""Rule 1 — jit-coverage: no kernel goes dark.
+
+Every jit in ``ops/`` and ``parallel/`` must go through
+``deviceplane.instrumented_jit`` so the compile registry and the
+recompile sentinel see it. Raw ``jax.jit`` (attribute use, a
+``from jax import jit`` binding, or an aliased module attribute) is a
+finding, not a review comment. This migrates the AST meta-test that
+lived in ``tests/test_deviceplane.py`` into the framework; the test that
+remains just asserts the rule is registered and the tree is clean.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Tuple
+
+from spatialflink_tpu.analysis.core import (Finding, ModuleSource, Rule,
+                                            register)
+from spatialflink_tpu.analysis.rules.common import jit_static_names
+
+
+@register
+class JitCoverageRule(Rule):
+    id = "jit-coverage"
+    contract = ("kernels in ops/ and parallel/ compile through "
+                "instrumented_jit, never raw jax.jit")
+    runtime_twin = ("CompileRegistry + recompile sentinel "
+                    "(utils/deviceplane.py)")
+    severity = "error"
+    scope = ("spatialflink_tpu/ops/*.py", "spatialflink_tpu/parallel/*.py")
+
+    def check(self, mod: ModuleSource) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Attribute) and node.attr == "jit" \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id == "jax":
+                yield self.finding(
+                    mod, node,
+                    "raw jax.jit bypasses the compile registry — use "
+                    "deviceplane.instrumented_jit so the recompile "
+                    "sentinel sees this kernel")
+            elif isinstance(node, ast.ImportFrom) and node.module == "jax" \
+                    and any(a.name == "jit" for a in node.names):
+                yield self.finding(
+                    mod, node,
+                    "`from jax import jit` binds the uninstrumented jit — "
+                    "use deviceplane.instrumented_jit")
+
+
+def instrumented_sites(tree: ast.AST) -> List[Tuple[str, int]]:
+    """(function_name, lineno) for every ``instrumented_jit``-decorated
+    def in ``tree`` — shared with the deviceplane registration test so no
+    walker code is duplicated outside the framework."""
+    return [(node.name, node.lineno) for node in ast.walk(tree)
+            if isinstance(node, ast.FunctionDef)
+            and jit_static_names(node) is not None]

@@ -1,0 +1,141 @@
+"""Rule 6 — thread-shared-state: lock discipline on cross-thread
+classes.
+
+The opserver, reporter, control-topic, and LiveStats threads all read —
+and in the query plane's case write — state owned by the pipeline
+thread. Two checks:
+
+1. **Write discipline.** Any class that creates an instance lock in
+   ``__init__`` (``self._lock = threading.Lock()/RLock()/Condition()``)
+   has opted into lock-protected state; every instance-attribute write
+   in its other methods must happen under ``with self._lock`` (or in a
+   method documented as caller-locked: name ending ``_locked`` or a
+   docstring saying the lock is held).
+2. **Documented coverage.** The classes the architecture documents as
+   cross-thread — ``QueryRegistry``, ``EventRing``, ``MetricsRegistry``,
+   ``CheckpointCoordinator`` — must own an instance lock at all; a
+   documented-shared class with no lock is a finding even before any
+   write is inspected.
+
+Reads are deliberately out of scope (GIL-atomic snapshots of ints are
+this codebase's documented idiom); it is unsynchronized *writes* that
+corrupt dicts and deques.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional
+
+from spatialflink_tpu.analysis.core import (Finding, ModuleSource, Rule,
+                                            register)
+from spatialflink_tpu.analysis.rules.common import attr_write_targets, dotted
+
+#: classes the architecture documents as cross-thread (ARCHITECTURE.md
+#: "Static invariants"); each must own an instance lock.
+DOCUMENTED_CROSS_THREAD = ("QueryRegistry", "EventRing", "MetricsRegistry",
+                           "CheckpointCoordinator")
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+_HELD_DOC_MARKERS = ("lock held", "lock is held", "caller holds",
+                     "holds the lock", "under the lock",
+                     "caller-locked")
+
+
+def _lock_attr(cls: ast.ClassDef) -> Optional[str]:
+    """The instance-lock attribute name assigned in ``__init__``."""
+    for meth in cls.body:
+        if isinstance(meth, ast.FunctionDef) and meth.name == "__init__":
+            for stmt in ast.walk(meth):
+                if not isinstance(stmt, ast.Assign):
+                    continue
+                if not isinstance(stmt.value, ast.Call):
+                    continue
+                name = dotted(stmt.value.func) or ""
+                if name.split(".")[-1] not in _LOCK_FACTORIES:
+                    continue
+                for attr, _ in attr_write_targets(stmt):
+                    return attr
+    return None
+
+
+def _caller_locked(meth: ast.FunctionDef) -> bool:
+    if meth.name.endswith("_locked"):
+        return True
+    doc = ast.get_docstring(meth) or ""
+    low = doc.lower()
+    return any(marker in low for marker in _HELD_DOC_MARKERS)
+
+
+@register
+class ThreadSharedStateRule(Rule):
+    id = "thread-shared-state"
+    contract = ("cross-thread classes own an instance lock and write "
+                "instance state only while holding it")
+    runtime_twin = ("liveops/queryplane concurrency tests (mid-run HTTP "
+                    "mutation under --chaos)")
+    severity = "error"
+    scope = ("spatialflink_tpu/**",)
+
+    def check(self, mod: ModuleSource) -> Iterator[Finding]:
+        for cls in ast.walk(mod.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            lock = _lock_attr(cls)
+            if lock is None:
+                if cls.name in DOCUMENTED_CROSS_THREAD:
+                    yield self.finding(
+                        mod, cls,
+                        f"{cls.name} is documented cross-thread but owns "
+                        "no instance lock — give it one (writes from the "
+                        "opserver/reporter/control threads race the "
+                        "pipeline) or allowlist with the reviewed reason")
+                continue
+            yield from self._check_writes(mod, cls, lock)
+
+    def _check_writes(self, mod: ModuleSource, cls: ast.ClassDef,
+                      lock: str) -> Iterator[Finding]:
+        for meth in cls.body:
+            if not isinstance(meth, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if meth.name in ("__init__", "__post_init__", "__new__") \
+                    or _caller_locked(meth):
+                continue
+            for stmt in ast.walk(meth):
+                if not isinstance(stmt, (ast.Assign, ast.AugAssign,
+                                         ast.AnnAssign)):
+                    continue
+                for attr, node in attr_write_targets(stmt):
+                    if attr == lock:
+                        continue
+                    if self._under_lock(mod, stmt, lock):
+                        continue
+                    yield self.finding(
+                        mod, node,
+                        f"write to self.{attr} outside `with self.{lock}` "
+                        f"in lock-disciplined class {cls.name} — "
+                        "cross-thread writes must hold the instance lock "
+                        "(or mark the method caller-locked)")
+
+    def _under_lock(self, mod: ModuleSource, stmt: ast.stmt,
+                    lock: str) -> bool:
+        for anc in mod.ancestors(stmt):
+            if isinstance(anc, ast.With):
+                for item in anc.items:
+                    expr = item.context_expr
+                    # `with self._lock:` or `with self._lock.acquire…`
+                    name = dotted(expr) if not isinstance(expr, ast.Call) \
+                        else dotted(expr.func)
+                    if name in (f"self.{lock}", f"self.{lock}.acquire"):
+                        return True
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # stop at the method boundary — a lock taken by a caller
+                # is invisible here and must be declared via _locked
+                return False
+        return False
+
+
+def documented_classes() -> List[str]:
+    """Expose the documented-cross-thread list for docs/tests."""
+    return list(DOCUMENTED_CROSS_THREAD)

@@ -1,0 +1,168 @@
+"""Rule 2 — trace-safety: jitted kernels cannot hide retrace triggers.
+
+Inside an ``instrumented_jit``-decorated function, Python-level control
+flow on a *traced* argument either fails at trace time on some path the
+tests never execute, or — the worse case — silently succeeds per
+concrete value and triggers the post-warmup recompiles the runtime
+sentinel aborts on. The rule flags, per jitted kernel:
+
+- ``if``/``while``/ternary tests and ``for`` iteration over traced
+  arguments (error);
+- ``int(...)`` / ``bool(...)`` / ``float(...)`` coercion of traced
+  values (error — a concretization point);
+- tests that branch on ``.shape``/``.ndim``/``.size``/``.dtype`` of a
+  traced argument (warning — legal under jit but every distinct shape is
+  a fresh compile, which the padding discipline exists to avoid);
+- ``static_argnums``/``static_argnames`` parameters with list/dict/set
+  defaults or annotations (error — unhashable statics raise at call
+  time, but only on the first uncached call signature).
+
+Static parameters (named by the decoration) are exempt everywhere:
+branching on ``n``/``k`` statics is the repo's core padding idiom.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional, Set
+
+from spatialflink_tpu.analysis.core import (Finding, ModuleSource, Rule,
+                                            register)
+from spatialflink_tpu.analysis.rules.common import (function_params,
+                                                    jit_static_names)
+
+_SHAPE_ATTRS = {"shape", "ndim", "size", "dtype"}
+_COERCIONS = {"int", "bool", "float"}
+_UNHASHABLE_ANNOS = {"list", "List", "dict", "Dict", "set", "Set"}
+
+
+def _shadowed(mod: ModuleSource, node: ast.AST, name: str,
+              stop: ast.FunctionDef) -> bool:
+    """Is ``name`` rebound by a nested def/lambda between ``node`` and
+    the jitted function ``stop``?"""
+    for fn in mod.enclosing_functions(node):
+        if fn is stop:
+            return False
+        if name in function_params(fn):
+            return True
+    return False
+
+
+class _TracedUse:
+    """Classification of traced-argument references inside one test or
+    call-argument expression."""
+
+    def __init__(self, mod: ModuleSource, root: ast.FunctionDef,
+                 traced: Set[str]):
+        self.mod = mod
+        self.root = root
+        self.traced = traced
+
+    def classify(self, expr: ast.AST) -> Optional[str]:
+        """"value" when the expression reads a traced argument's value,
+        "shape" when every traced reference sits under a shape-like
+        attribute, None when no traced argument is involved."""
+        hits = []
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Name) and node.id in self.traced \
+                    and not _shadowed(self.mod, node, node.id, self.root):
+                parent = self.mod.parent(node)
+                if isinstance(parent, ast.Attribute) \
+                        and parent.attr in _SHAPE_ATTRS:
+                    hits.append("shape")
+                else:
+                    hits.append("value")
+        if not hits:
+            return None
+        return "value" if "value" in hits else "shape"
+
+
+@register
+class TraceSafetyRule(Rule):
+    id = "trace-safety"
+    contract = ("no Python control flow / concretization on traced "
+                "arguments inside instrumented_jit kernels; statics stay "
+                "hashable")
+    runtime_twin = ("recompile sentinel + --strict-recompile abort "
+                    "(utils/deviceplane.py)")
+    severity = "error"
+    scope = ("spatialflink_tpu/**",)
+
+    def check(self, mod: ModuleSource) -> Iterator[Finding]:
+        for fn in ast.walk(mod.tree):
+            if not isinstance(fn, ast.FunctionDef):
+                continue
+            statics = jit_static_names(fn)
+            if statics is None:
+                continue
+            yield from self._check_statics(mod, fn, statics)
+            traced = set(function_params(fn)) - statics
+            uses = _TracedUse(mod, fn, traced)
+            for node in ast.walk(fn):
+                yield from self._check_node(mod, node, uses)
+
+    def _check_statics(self, mod, fn, statics) -> Iterator[Finding]:
+        args = fn.args
+        pos = args.posonlyargs + args.args
+        defaults = dict(zip([a.arg for a in pos[len(pos)
+                                               - len(args.defaults):]],
+                            args.defaults))
+        defaults.update({a.arg: d for a, d in zip(args.kwonlyargs,
+                                                  args.kw_defaults)
+                         if d is not None})
+        annos = {a.arg: a.annotation for a in pos + args.kwonlyargs
+                 if a.annotation is not None}
+        for name in sorted(statics):
+            d = defaults.get(name)
+            if isinstance(d, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                              ast.DictComp, ast.SetComp)):
+                yield self.finding(
+                    mod, d,
+                    f"static argument {name!r} defaults to an unhashable "
+                    "container — jit statics must hash; use a tuple")
+            anno = annos.get(name)
+            if anno is not None:
+                base = anno.value if isinstance(anno, ast.Subscript) \
+                    else anno
+                aname = base.id if isinstance(base, ast.Name) else \
+                    base.attr if isinstance(base, ast.Attribute) else None
+                if aname in _UNHASHABLE_ANNOS:
+                    yield self.finding(
+                        mod, anno,
+                        f"static argument {name!r} is annotated as an "
+                        "unhashable container — jit statics must hash; "
+                        "use a tuple")
+
+    def _check_node(self, mod, node, uses: _TracedUse) -> Iterator[Finding]:
+        if isinstance(node, (ast.If, ast.While, ast.IfExp)):
+            kind = uses.classify(node.test)
+            if kind == "value":
+                yield self.finding(
+                    mod, node,
+                    "Python control flow on a traced argument — this "
+                    "either fails at trace time or concretizes and "
+                    "retraces per value; use lax.cond/jnp.where or mark "
+                    "the argument static")
+            elif kind == "shape":
+                yield self.finding(
+                    mod, node,
+                    "branch on a traced argument's shape/dtype — legal, "
+                    "but every distinct shape is a fresh XLA compile the "
+                    "sentinel will flag post-warmup; pad to bucketed "
+                    "shapes or hoist the branch behind a static",
+                    severity="warning")
+        elif isinstance(node, ast.For):
+            if uses.classify(node.iter) == "value":
+                yield self.finding(
+                    mod, node,
+                    "Python iteration over a traced argument unrolls (or "
+                    "fails) at trace time — use lax.scan/fori_loop or a "
+                    "static length")
+        elif isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                and node.func.id in _COERCIONS and node.args:
+            if uses.classify(node.args[0]) == "value":
+                yield self.finding(
+                    mod, node,
+                    f"{node.func.id}() concretizes a traced value inside "
+                    "a jitted kernel — a silent retrace trigger (shape "
+                    "reads are fine; values are not)")

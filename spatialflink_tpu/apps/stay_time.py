@@ -37,10 +37,8 @@ from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
 
 import numpy as np
 
-from spatialflink_tpu.index import UniformGrid
 from spatialflink_tpu.models import Point, Polygon
 from spatialflink_tpu.operators.base import (
-    QueryConfiguration,
     SpatialOperator,
     WindowResult,
 )

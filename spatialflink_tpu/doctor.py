@@ -30,7 +30,7 @@ import argparse
 import json
 import os
 import sys
-from typing import List, Optional, Tuple
+from typing import List, Optional
 
 from spatialflink_tpu.utils import deviceplane
 
@@ -156,7 +156,10 @@ def _latency_table(latency: dict) -> List[str]:
 
 
 def summarize(path: str, as_json: bool = False,
-              out=sys.stdout) -> int:
+              out=None) -> int:
+    # resolve at call time: a def-time sys.stdout default would pin
+    # whatever stream was installed at first import (pytest capture)
+    out = sys.stdout if out is None else out
     b = load_bundle(path)
     d = _bundle_digest(b)
     if as_json:
@@ -198,7 +201,8 @@ def summarize(path: str, as_json: bool = False,
 
 
 def diff(path_a: str, path_b: str, as_json: bool = False,
-         out=sys.stdout) -> int:
+         out=None) -> int:
+    out = sys.stdout if out is None else out
     a, b = load_bundle(path_a), load_bundle(path_b)
     da, db = _bundle_digest(a), _bundle_digest(b)
     rows = []
@@ -230,9 +234,10 @@ def diff(path_a: str, path_b: str, as_json: bool = False,
 
 
 def preflight(require_backend: str = "tpu", as_json: bool = False,
-              out=sys.stdout) -> int:
+              out=None) -> int:
     """Backend/memory/compile-cache readiness check; exit non-zero when the
     chip the operator asked for is not what the process would run on."""
+    out = sys.stdout if out is None else out
     import time as _time
 
     checks: List[dict] = []
@@ -281,9 +286,38 @@ def preflight(require_backend: str = "tpu", as_json: bool = False,
                     "pays cold compiles)"))
     except Exception as e:
         check("compilation_cache", None, f"unreadable: {e}")
+    # static invariants: the same pass the tier-1 gate runs — a dirty
+    # tree fails preflight exactly like a CPU fallback would
+    analysis_summary = None
+    try:
+        from spatialflink_tpu.analysis import run_analysis
+
+        rep = run_analysis()
+        rep_doc = rep.to_dict()
+        analysis_summary = {
+            "ok": rep.ok,
+            "findings": len(rep_doc["findings"]),
+            "allowlisted": len(rep_doc["allowlisted"]),
+            "stale_allowlist_entries": len(
+                rep_doc["stale_allowlist_entries"]),
+            "files": rep_doc["files"],
+            "rules": rep_doc["rules"],
+        }
+        stale = analysis_summary["stale_allowlist_entries"]
+        check("static_analysis", rep.ok,
+              f"{analysis_summary['findings']} non-allowlisted "
+              f"finding(s), {analysis_summary['allowlisted']} allowlisted,"
+              f" {stale} stale allowlist entr"
+              f"{'y' if stale == 1 else 'ies'} across "
+              f"{analysis_summary['files']} file(s)"
+              + ("" if rep.ok else
+                 " — run `python -m spatialflink_tpu.analysis --check`"))
+    except Exception as e:
+        check("static_analysis", False, f"analysis pass failed: {e}")
     failed = [c for c in checks if c["ok"] is False]
     doc = {"ready": not failed, "require_backend": require_backend,
-           "provenance": prov, "checks": checks}
+           "provenance": prov, "checks": checks,
+           "analysis": analysis_summary}
     if as_json:
         print(json.dumps(doc, sort_keys=True), file=out)
     else:

@@ -33,7 +33,7 @@ import numpy as np
 from spatialflink_tpu import operators as ops
 from spatialflink_tpu.config import Params, StreamConfig
 from spatialflink_tpu.index import UniformGrid
-from spatialflink_tpu.models import Point, SpatialObject
+from spatialflink_tpu.models import SpatialObject
 from spatialflink_tpu.operators import QueryConfiguration, QueryType, WindowResult
 from spatialflink_tpu.streams.formats import parse_spatial, serialize_spatial
 

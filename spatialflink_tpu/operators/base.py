@@ -1452,6 +1452,8 @@ class SpatialOperator:
             # tests assert; ARCHITECTURE.md § Latency decomposition).
             # meta = (first_ingest_ms, t_seal, t_kernel0, t_kernel1);
             # m0/m1 bound the merge (equal for non-deferred results).
+            if lat is None:  # every caller gates on tel, but the latency
+                return       # contract must hold locally on every path
             fi, li, t_seal, k0, k1 = meta
             t_emit = time.time()
             if fi is not None and fi > t_seal * 1e3:

@@ -21,8 +21,6 @@ from spatialflink_tpu.models import Point
 from spatialflink_tpu.operators.base import (
     Deferred,
     GeomQueryMixin,
-    QueryConfiguration,
-    QueryType,
     SpatialOperator,
     WindowResult,
 )

@@ -11,13 +11,11 @@ from __future__ import annotations
 from typing import Iterable, Iterator, List, Optional
 
 import jax.numpy as jnp
-import numpy as np
 
 from spatialflink_tpu.models import Point
 from spatialflink_tpu.operators.base import (
     Deferred,
     GeomQueryMixin,
-    QueryConfiguration,
     QueryType,
     SpatialOperator,
     WindowResult,

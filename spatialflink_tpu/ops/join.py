@@ -244,7 +244,7 @@ def adaptive_block_min_cells() -> int:
 
 
 def _lattice_strategy() -> str:
-    """'f32' (default) or 'bf16': which lattice _tiled_pairs runs. bf16 is
+    """'f32' (default) or 'bf16': which lattice _tiled_pairs_host runs. bf16 is
     the single-pass MXU superset + exact f32 re-check on survivors — the
     same pair sets up to f32 ties EXACTLY on the radius boundary (the
     re-check computes dx^2+dy^2 directly, which is slightly MORE accurate
@@ -318,17 +318,18 @@ def join_pairs_host(a: PointBatch, b: PointBatch, radius, grid, tile: int = 4096
         pad_valid = np.asarray(a.valid)[idx]
         pad_valid[rows.size:] = False
         sub = sub._replace(valid=pad_valid)
-        for ai, bi in _tiled_pairs(sub, b, radius, nb_layers, cx, cy,
+        for ai, bi in _tiled_pairs_host(sub, b, radius, nb_layers, cx, cy,
                                    grid.n, tile):
             keep = ai < rows.size
             if keep.any():
                 yield rows[ai[keep]], bi[keep]
         return
 
-    yield from _tiled_pairs(a, b, radius, nb_layers, cx, cy, grid.n, tile)
+    yield from _tiled_pairs_host(a, b, radius, nb_layers, cx, cy, grid.n,
+                                 tile)
 
 
-def _tiled_pairs(a: PointBatch, b: PointBatch, radius, nb_layers, cx, cy,
+def _tiled_pairs_host(a: PointBatch, b: PointBatch, radius, nb_layers, cx, cy,
                  n: int, tile: int):
     import numpy as np
 

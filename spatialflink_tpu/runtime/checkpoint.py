@@ -34,10 +34,10 @@ exactly-once output.
 from __future__ import annotations
 
 import base64
-import json
 import os
 import re
 import sys
+import threading
 import time
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
@@ -288,6 +288,12 @@ class CheckpointCoordinator:
         self.layout = layout
         self.restored = False
         self.written = 0
+        # the coordinator is driven by the pipeline thread at barriers,
+        # but the opserver/reporter threads read seq/age through the
+        # gauges and the doctor may probe concurrently — writes to the
+        # cadence/sequence state hold this lock (RLock: commit() spans
+        # participant snapshot callbacks)
+        self._lock = threading.RLock()
         self._snapshots: Dict[str, Callable[[], Tuple[dict, Any]]] = {}
         self._pending: Dict[str, Tuple[dict, Any]] = {}
         self._positions: Dict[str, int] = {}
@@ -328,7 +334,8 @@ class CheckpointCoordinator:
     # ------------------------------ cadence --------------------------- #
 
     def note_batch(self) -> None:
-        self._batches += 1
+        with self._lock:
+            self._batches += 1
 
     def due(self) -> bool:
         if self._batches - self._last_batches >= self.every_batches:
@@ -368,7 +375,8 @@ class CheckpointCoordinator:
             components[name] = meta
         from spatialflink_tpu.utils import deviceplane as _deviceplane
 
-        self.seq += 1
+        with self._lock:
+            self.seq += 1
         cp.meta = {
             "manifest_schema": MANIFEST_SCHEMA_VERSION,
             "job": self.job,
@@ -386,9 +394,10 @@ class CheckpointCoordinator:
         path = self._path(self.seq)
         cp.save(path)
         self._prune()
-        self.written += 1
-        self._last_batches = self._batches
-        self._last_time = time.monotonic()
+        with self._lock:
+            self.written += 1
+            self._last_batches = self._batches
+            self._last_time = time.monotonic()
         REGISTRY.counter("checkpoints-written").inc()
         tel = _telemetry.active()
         if tel is not None:
@@ -403,7 +412,8 @@ class CheckpointCoordinator:
                 tel.gauge("checkpoint.age-s",
                           lambda: time.monotonic() - self._last_time)
                 tel.gauge("checkpoint.seq", lambda: float(self.seq))
-                self._age_gauge_installed = True
+                with self._lock:
+                    self._age_gauge_installed = True
         return path
 
     def _manifests(self) -> List[Tuple[int, str]]:
@@ -479,13 +489,15 @@ class CheckpointCoordinator:
             for k, arr in cp.arrays.items():
                 name, _, sub = k.partition("/")
                 grouped.setdefault(name, {})[sub] = arr
-            self._pending = {
-                name: (grouped.get(name, {}), comp_meta)
-                for name, comp_meta in meta.get("components", {}).items()
-            }
-            self._positions = {k: int(v) for k, v in
-                               meta.get("positions", {}).items()}
-            self.seq = int(meta.get("seq", seq))
+            with self._lock:
+                self._pending = {
+                    name: (grouped.get(name, {}), comp_meta)
+                    for name, comp_meta in
+                    meta.get("components", {}).items()
+                }
+                self._positions = {k: int(v) for k, v in
+                                   meta.get("positions", {}).items()}
+                self.seq = int(meta.get("seq", seq))
             written_on = (meta.get("device") or {}).get("platform")
             if written_on:
                 from spatialflink_tpu.utils import deviceplane as _dp
@@ -497,7 +509,8 @@ class CheckpointCoordinator:
                           "state restores anywhere; device-resident pane "
                           "values were read back at snapshot time)",
                           file=sys.stderr)
-            self.restored = True
+            with self._lock:
+                self.restored = True
             REGISTRY.counter("checkpoint-restores").inc()
             from spatialflink_tpu.utils.telemetry import emit_event
 

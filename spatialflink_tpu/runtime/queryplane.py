@@ -442,10 +442,11 @@ class QueryRegistry:
         A checkpoint-restored control position seeks the consumer first,
         so resumed runs do not replay control records the restored fleet
         already reflects."""
-        if self._restored_control_pos is not None:
-            consumer.seek(self._restored_control_pos)
-            self._restored_control_pos = None
-        self._control = consumer
+        with self._lock:
+            if self._restored_control_pos is not None:
+                consumer.seek(self._restored_control_pos)
+                self._restored_control_pos = None
+            self._control = consumer
 
     def note_window(self, entry: QueryEntry, n_records: int,
                     emit_p99_ms: Optional[float] = None) -> None:

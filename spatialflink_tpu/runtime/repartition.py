@@ -38,7 +38,7 @@ from __future__ import annotations
 
 import threading
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Callable, List, Optional
 
 import numpy as np
@@ -151,14 +151,17 @@ class RepartitionController:
             if _ACTIVE is self:
                 _ACTIVE = None
 
-        self._restore_observer = restore
+        with self._lock:
+            self._restore_observer = restore
         _ACTIVE = self
         return self
 
     def uninstall(self) -> None:
-        if self._restore_observer is not None:
-            self._restore_observer()
+        with self._lock:
+            restore_fn = self._restore_observer
             self._restore_observer = None
+        if restore_fn is not None:
+            restore_fn()
 
     def note_cells(self, cells) -> None:
         """One decoded chunk's base-cell ids (any shape; -1 = outside the
@@ -289,7 +292,8 @@ class RepartitionController:
         from spatialflink_tpu.utils import telemetry as _telemetry
         from spatialflink_tpu.utils.metrics import REGISTRY
 
-        self.repartitions += 1
+        with self._lock:
+            self.repartitions += 1
         REGISTRY.counter("repartitions").inc()
         REGISTRY.counter("grid-splits").inc(len(new_splits))
         REGISTRY.counter("grid-merges").inc(len(merged))

@@ -32,7 +32,7 @@ from __future__ import annotations
 
 import json
 import time
-from dataclasses import dataclass, fields
+from dataclasses import dataclass
 from typing import Any, Callable, Iterator, List, Optional, Tuple
 
 from spatialflink_tpu.runtime.faults import TransientBrokerError, parse_spec

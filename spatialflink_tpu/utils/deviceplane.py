@@ -60,7 +60,7 @@ import signal
 import threading
 import time
 from collections import deque
-from typing import Any, Dict, List, Optional
+from typing import Dict, List, Optional
 
 from spatialflink_tpu.utils import metrics as _metrics
 
@@ -621,12 +621,14 @@ class FlightRecorder:
         "what is it doing" capture). Main-thread only; silently skipped
         elsewhere (threaded test harnesses)."""
         try:
-            self._old_handler = signal.signal(
-                signum, lambda s, f: self.dump("signal"))
-            self._signum = signum
-            self._signal_installed = True
+            old = signal.signal(signum, lambda s, f: self.dump("signal"))
+            with self._lock:
+                self._old_handler = old
+                self._signum = signum
+                self._signal_installed = True
         except ValueError:
-            self._signal_installed = False
+            with self._lock:
+                self._signal_installed = False
 
     def attach_health(self, health) -> None:
         """Hook the SLO evaluator's breach transitions: the FIRST breach of
@@ -646,12 +648,16 @@ class FlightRecorder:
 
     def close(self) -> None:
         global _ACTIVE_RECORDER
-        if self._signal_installed and self._old_handler is not None:
+        with self._lock:
+            restore = (self._old_handler
+                       if self._signal_installed else None)
+            signum = self._signum
+            self._signal_installed = False
+        if restore is not None:
             try:
-                signal.signal(self._signum, self._old_handler)
+                signal.signal(signum, restore)
             except ValueError:
                 pass
-            self._signal_installed = False
         if _ACTIVE_RECORDER is self:
             _ACTIVE_RECORDER = None
 

@@ -178,12 +178,11 @@ class LatencyPlane:
         for stage, dur in stages.items():
             self._stage_hist(stage).record(max(0.0, dur))
         total = None
+        residual = None
         if first_ingest_ms is not None:
             total = emit_s * 1e3 - first_ingest_ms
             self.record_emit.record(max(0.0, total))
             residual = abs(total - sum(stages.values()))
-            if residual > self.max_residual_ms:
-                self.max_residual_ms = residual
         row = {"query": label, "window_start": ws,
                "window_end": int(window_end),
                "first_ingest_ms": first_ingest_ms,
@@ -196,6 +195,8 @@ class LatencyPlane:
                "stages": {k: round(v, 3) for k, v in stages.items()}}
         with self._lock:
             self.windows += 1
+            if residual is not None and residual > self.max_residual_ms:
+                self.max_residual_ms = residual
             if self._max_window_end is None \
                     or window_end > self._max_window_end:
                 self._max_window_end = int(window_end)
@@ -266,7 +267,8 @@ class LatencyPlane:
         from spatialflink_tpu.utils import telemetry as _telemetry
 
         now = time.time()
-        self._last_tick_s = now
+        with self._lock:
+            self._last_tick_s = now
         gauges = tel.gauges if tel is not None else {}
 
         def g(name):
@@ -293,7 +295,10 @@ class LatencyPlane:
         with self._lock:
             wm = self._max_window_end
             stage_totals = {s: h.total for s, h in self.stages.items()}
-        prev = self._tick_state
+            prev = self._tick_state
+            self._tick_state = {"ts": now, "wm": wm,
+                                "records_in": records_in,
+                                "stages": stage_totals}
         slope = None
         if wm is not None and prev.get("wm") is not None \
                 and now > prev["ts"]:
@@ -304,8 +309,6 @@ class LatencyPlane:
         stage_delta = {
             s: round(t - prev.get("stages", {}).get(s, 0.0), 6)
             for s, t in stage_totals.items()}
-        self._tick_state = {"ts": now, "wm": wm, "records_in": records_in,
-                            "stages": stage_totals}
         bucket = {
             "ts_ms": int(now * 1000),
             "decode_buffer_depth": g("decode.buffer-depth"),
@@ -326,10 +329,12 @@ class LatencyPlane:
                 **{f"{s.replace('-', '_')}_s": d
                    for s, d in stage_delta.items()},
                 windows=self.windows, stall=stall)
-        if stall and not self._stalled:
+        with self._lock:
+            was_stalled = self._stalled
+            self._stalled = stall
+        if stall and not was_stalled:
             _telemetry.emit_event("backpressure-stall",
                                   event_time_ms=wm, records_in=records_in)
-        self._stalled = stall
         return bucket
 
     # ------------------------------ readers ---------------------------- #

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import contextlib
 import json
+import threading
 import time
 from collections import deque
 from typing import Dict, Iterable, Iterator, Optional
@@ -102,34 +103,47 @@ class Meter:
 
 
 class MetricsRegistry:
-    """Named counters and meters; ``snapshot()`` for scraping/logging."""
+    """Named counters and meters; ``snapshot()`` for scraping/logging.
+
+    The registry is cross-thread (pipeline threads create handles while
+    the reporter/opserver threads snapshot), so handle creation, reset,
+    and snapshot iteration hold the instance lock. The handles themselves
+    stay lock-free: ``Counter.inc``/``Meter.mark`` are the per-record hot
+    path and rely on the GIL's atomic int bump."""
 
     def __init__(self):
         self.counters: Dict[str, Counter] = {}
         self.meters: Dict[str, Meter] = {}
+        self._lock = threading.Lock()
 
     def reset(self) -> None:
         """Drop every counter and meter. Handles created before the reset
         stay usable but are no longer scraped — callers that cache a
         counter across a reset should re-fetch it."""
-        self.counters.clear()
-        self.meters.clear()
+        with self._lock:
+            self.counters.clear()
+            self.meters.clear()
 
     def counter(self, name: str) -> Counter:
-        if name not in self.counters:
-            self.counters[name] = Counter(name)
-        return self.counters[name]
+        with self._lock:
+            if name not in self.counters:
+                self.counters[name] = Counter(name)
+            return self.counters[name]
 
     def meter(self, name: str, window_s: float = 60.0) -> Meter:
-        if name not in self.meters:
-            self.meters[name] = Meter(name, window_s)
-        return self.meters[name]
+        with self._lock:
+            if name not in self.meters:
+                self.meters[name] = Meter(name, window_s)
+            return self.meters[name]
 
     def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            counters = list(self.counters.items())
+            meters = list(self.meters.items())
         out: Dict[str, float] = {}
-        for n, c in self.counters.items():
+        for n, c in counters:
             out[n] = c.count
-        for n, m in self.meters.items():
+        for n, m in meters:
             out[f"{n}.count"] = m.count
             out[f"{n}.rate"] = m.rate()
         return out

@@ -2,10 +2,15 @@
 
 ``python -m spatialflink_tpu.analysis --check`` proves the engine's
 cross-cutting contracts at the AST level on every tier-1 run; see
-:mod:`spatialflink_tpu.analysis.core` for the framework and
-:mod:`spatialflink_tpu.analysis.rules` for the six invariants plus the
-built-in bug-class lints. ``analysis/ALLOWLIST.toml`` holds the reviewed
-exceptions (ratchet: stale entries fail ``--check``)."""
+:mod:`spatialflink_tpu.analysis.core` for the framework,
+:mod:`spatialflink_tpu.analysis.callgraph` /
+:mod:`spatialflink_tpu.analysis.dataflow` for the interprocedural layer
+(project call graph + taint cores), and
+:mod:`spatialflink_tpu.analysis.rules` for the seven invariants plus the
+built-in bug-class lints. Reviewed exceptions live in
+``analysis/ALLOWLIST.toml`` or as inline ``# analysis:
+allow(<rule-id>): <reason>`` pragmas — both under the shrink-only
+ratchet (stale entries/pragmas fail ``--check``)."""
 
 from spatialflink_tpu.analysis.core import (  # noqa: F401
     ALLOWLIST_PATH,
@@ -14,11 +19,13 @@ from spatialflink_tpu.analysis.core import (  # noqa: F401
     AllowlistError,
     Finding,
     ModuleSource,
+    Pragma,
     Report,
     Rule,
     all_rules,
     check_module,
     check_source,
+    extract_pragmas,
     register,
     resolve_rules,
     run_analysis,
@@ -26,7 +33,7 @@ from spatialflink_tpu.analysis.core import (  # noqa: F401
 
 __all__ = [
     "ALLOWLIST_PATH", "REPO_ROOT", "Allowlist", "AllowlistError",
-    "Finding", "ModuleSource", "Report", "Rule", "all_rules",
-    "check_module", "check_source", "register", "resolve_rules",
-    "run_analysis",
+    "Finding", "ModuleSource", "Pragma", "Report", "Rule", "all_rules",
+    "check_module", "check_source", "extract_pragmas", "register",
+    "resolve_rules", "run_analysis",
 ]

@@ -1,0 +1,126 @@
+"""Per-module findings cache for the invariant linter.
+
+The tier-1 suite runs the full pass several times per session (the tree
+gate, the CLI contract tests, a subprocess spawn, ``doctor
+--preflight``) over a tree that does not change between them. Findings
+are a pure function of (module source, analysis code, and — for
+cross-module rules — the rest of the tree), so they cache:
+
+- key per (module, rule): ``sha256(source) : sha256(analysis package)``,
+  widened with the whole-tree hash for ``Rule.interprocedural`` rules
+  (a kernel signature change in ``ops/`` must re-judge every call site
+  in ``operators/``);
+- storage: one JSON file per scanned root under the system temp dir
+  (never inside the repo), written atomically; any corruption or
+  version skew is treated as a cold cache, never an error;
+- the cached value is the findings' ``to_dict()`` list — the warm pass
+  is required (and tested) to be byte-identical to the cold one.
+
+Pragmas and the allowlist are applied OUTSIDE the cache on every run:
+they are suppression, not analysis, and their staleness ratchets must
+see the real findings.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+from typing import Dict, List, Optional
+
+_FORMAT_VERSION = 1
+_PKG_HASH: Optional[str] = None
+
+
+def package_hash() -> str:
+    """Hash of every ``.py`` source in the analysis package — editing a
+    rule (or this file) invalidates every cached finding. Memoized per
+    process."""
+    global _PKG_HASH
+    if _PKG_HASH is None:
+        pkg = os.path.dirname(os.path.abspath(__file__))
+        h = hashlib.sha256()
+        for dirpath, dirnames, filenames in os.walk(pkg):
+            dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    path = os.path.join(dirpath, name)
+                    h.update(os.path.relpath(path, pkg).encode())
+                    with open(path, "rb") as f:
+                        h.update(f.read())
+        _PKG_HASH = h.hexdigest()[:16]
+    return _PKG_HASH
+
+
+class AnalysisCache:
+    """Findings keyed by (module relpath, rule id, content key)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.data: Dict[str, dict] = {}
+        self._dirty = False
+        try:
+            with open(path, encoding="utf-8") as f:
+                doc = json.load(f)
+            if isinstance(doc, dict) \
+                    and doc.get("version") == _FORMAT_VERSION \
+                    and isinstance(doc.get("modules"), dict):
+                self.data = doc["modules"]
+        except (OSError, ValueError):
+            self.data = {}
+
+    @classmethod
+    def default_path(cls, root: str) -> str:
+        tag = hashlib.sha256(
+            os.path.abspath(root).encode()).hexdigest()[:12]
+        return os.path.join(tempfile.gettempdir(),
+                            f"spatialflink-analysis-{tag}.json")
+
+    @classmethod
+    def open(cls, root: str,
+             cache: Optional[str]) -> Optional["AnalysisCache"]:
+        """``cache`` is "auto" (per-root temp file), an explicit path, or
+        None/"" to disable. ``SPATIALFLINK_ANALYSIS_CACHE=off`` disables
+        globally (CI hermeticity escape hatch); any other value of the
+        env var overrides the path."""
+        if not cache:
+            return None  # explicit disable (--no-cache) beats the env
+        env = os.environ.get("SPATIALFLINK_ANALYSIS_CACHE")
+        if env is not None:
+            if env.lower() in ("off", "0", "none", ""):
+                return None
+            return cls(env)
+        if cache == "auto":
+            cache = cls.default_path(root)
+        return cls(cache)
+
+    def get(self, relpath: str, rule_id: str,
+            key: str) -> Optional[List[dict]]:
+        entry = self.data.get(relpath, {}).get(rule_id)
+        if entry is None or entry.get("key") != key:
+            return None
+        findings = entry.get("findings")
+        return findings if isinstance(findings, list) else None
+
+    def put(self, relpath: str, rule_id: str, key: str,
+            findings: List[dict]) -> None:
+        self.data.setdefault(relpath, {})[rule_id] = {
+            "key": key, "findings": findings}
+        self._dirty = True
+
+    def save(self) -> None:
+        if not self._dirty:
+            return
+        doc = {"version": _FORMAT_VERSION, "modules": self.data}
+        tmp = f"{self.path}.{os.getpid()}.tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(doc, f, sort_keys=True)
+            os.replace(tmp, self.path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+        self._dirty = False

@@ -1,0 +1,370 @@
+"""Project-wide call graph for the interprocedural rules.
+
+PR 12's rules were lexical: each looked at one expression, one method,
+one decorator at a time. The deep invariants — lock discipline, field
+checkpoint coverage, host-sync taint, the recompile surface — are
+properties of *paths through calls*, so this module gives the rules a
+shared, deliberately small call graph:
+
+- :class:`FunctionInfo` — one function or method: qualname, params, the
+  ``instrumented_jit`` statics when the def is a kernel.
+- :class:`ModuleGraph` — per-module resolution + edges. Three
+  resolution rules (documented in ARCHITECTURE.md with their blind
+  spots):
+
+  1. **module-level names** — ``f(...)`` resolves to the module's
+     top-level ``def f`` unless a *later* top-level binding (an import,
+     an assignment, a second def) shadows it, or any enclosing function
+     rebinds the name (param, local assign, nested def). A
+     function-level ``from m import f`` re-points the name at ``m.f``.
+  2. **self-methods** — ``self.m(...)`` inside ``class C`` resolves to
+     ``C.m`` when ``C`` defines it (base classes are out of scope: an
+     inherited or overridden method is a documented blind spot).
+  3. **by-name references** — a function passed *by name* as a call
+     argument (``Thread(target=self._loop)``, ``_defer(collect)``)
+     creates a ``by-name`` edge: the callee will run later, from a
+     context the caller's lexical locks/gates do not cover.
+
+- :class:`Project` — the cross-module layer: ``from pkg.mod import f``
+  and ``import pkg.mod as m; m.f(...)`` resolve into the other module's
+  graph when that module is part of the scanned tree. This is what lets
+  the recompile-surface rule see every call site of a kernel that
+  ``ops/*`` defines and ``operators/*`` invokes.
+
+Everything here is name-based AST resolution — no imports are executed,
+so a scan can never run engine code.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from spatialflink_tpu.analysis.core import ModuleSource
+from spatialflink_tpu.analysis.astutils import (dotted, function_params,
+                                                 jit_static_names)
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    """One function or method in the project."""
+
+    qualname: str
+    name: str
+    node: ast.AST
+    module: str  # repo-relative path of the defining module
+    cls: Optional[str]  # immediate enclosing class (methods only)
+    params: List[str]
+    #: ``instrumented_jit`` static parameter names; None when not jitted.
+    statics: Optional[Set[str]]
+
+    @property
+    def is_method(self) -> bool:
+        return self.cls is not None
+
+    @property
+    def is_kernel(self) -> bool:
+        return self.statics is not None
+
+
+@dataclasses.dataclass
+class CallSite:
+    """One resolved edge: ``caller`` invokes (or references) ``callee``."""
+
+    caller: Optional[FunctionInfo]  # None for module-level code
+    callee: FunctionInfo
+    node: ast.AST  # the Call node; for by-name edges, the Name/Attribute
+    kind: str  # "direct" | "self" | "by-name"
+
+    @property
+    def deferred(self) -> bool:
+        """By-name references run later, outside the caller's lexical
+        context (locks taken at the reference site are NOT held)."""
+        return self.kind == "by-name"
+
+
+class ModuleGraph:
+    """Call graph of one module (see the module docstring for the
+    resolution rules)."""
+
+    def __init__(self, mod: ModuleSource):
+        self.mod = mod
+        #: qualname -> FunctionInfo for every def in the module.
+        self.functions: Dict[str, FunctionInfo] = {}
+        self._by_node: Dict[ast.AST, FunctionInfo] = {}
+        #: top-level name -> FunctionInfo | "class" | "import" | "other"
+        #: (last top-level binding wins — the shadowing rule).
+        self.module_bindings: Dict[str, object] = {}
+        #: imported name -> (dotted module, symbol-or-None), module- and
+        #: function-level alike (used for cross-module resolution).
+        self.imports: Dict[str, Tuple[str, Optional[str]]] = {}
+        self.calls: List[CallSite] = []
+        self._callers: Dict[str, List[CallSite]] = {}
+        self._collect_functions()
+        self._collect_bindings(mod.tree.body)
+        self._collect_imports()
+        self._collect_calls()
+
+    # ------------------------------ indexing -------------------------- #
+
+    def _collect_functions(self) -> None:
+        for node in ast.walk(self.mod.tree):
+            if not isinstance(node, _FUNC_NODES):
+                continue
+            parent = self.mod.parent(node)
+            cls = parent.name if isinstance(parent, ast.ClassDef) else None
+            statics = jit_static_names(node) \
+                if isinstance(node, ast.FunctionDef) else None
+            info = FunctionInfo(
+                qualname=self.mod.qualname(node), name=node.name,
+                node=node, module=self.mod.relpath, cls=cls,
+                params=function_params(node), statics=statics)
+            self.functions[info.qualname] = info
+            self._by_node[node] = info
+
+    def _collect_bindings(self, body: Sequence[ast.stmt]) -> None:
+        """Top-level bindings in statement order — the last binder of a
+        name wins, so an import after a def shadows the def (and vice
+        versa). Recurses into top-level If/Try suites (TYPE_CHECKING
+        blocks) in order."""
+        for stmt in body:
+            if isinstance(stmt, _FUNC_NODES):
+                self.module_bindings[stmt.name] = self._by_node[stmt]
+            elif isinstance(stmt, ast.ClassDef):
+                self.module_bindings[stmt.name] = "class"
+            elif isinstance(stmt, ast.Import):
+                for a in stmt.names:
+                    bound = a.asname or a.name.split(".")[0]
+                    self.module_bindings[bound] = "import"
+            elif isinstance(stmt, ast.ImportFrom):
+                for a in stmt.names:
+                    if a.name != "*":
+                        self.module_bindings[a.asname or a.name] = "import"
+            elif isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                    else [stmt.target]
+                for t in targets:
+                    for el in ast.walk(t):
+                        if isinstance(el, ast.Name):
+                            self.module_bindings[el.id] = "other"
+            elif isinstance(stmt, (ast.If, ast.Try)):
+                for suite in ("body", "orelse", "finalbody", "handlers"):
+                    sub = getattr(stmt, suite, None) or []
+                    for h in sub:
+                        if isinstance(h, ast.ExceptHandler):
+                            self._collect_bindings(h.body)
+                    self._collect_bindings(
+                        [s for s in sub if isinstance(s, ast.stmt)])
+
+    def _collect_imports(self) -> None:
+        """Every import binding in the module (any nesting level) — this
+        repo imports kernels *inside* methods routinely, so the
+        cross-module map must see function-level imports too."""
+        for node in ast.walk(self.mod.tree):
+            if isinstance(node, ast.ImportFrom) and node.module \
+                    and node.level == 0:
+                for a in node.names:
+                    if a.name != "*":
+                        self.imports[a.asname or a.name] = (node.module,
+                                                            a.name)
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        self.imports[a.asname] = (a.name, None)
+                    else:
+                        self.imports[a.name.split(".")[0]] = (
+                            a.name.split(".")[0], None)
+
+    # ------------------------------ resolution ------------------------ #
+
+    def _local_shadow(self, node: ast.AST, name: str) -> Optional[str]:
+        """How the innermost enclosing function binding of ``name``
+        (param / local assign / nested def / local import) shadows it:
+        "import" (resolvable via self.imports), "other" (opaque), or
+        None (no function-level binding)."""
+        for fn in self.mod.enclosing_functions(node):
+            if name in function_params(fn):
+                return "other"
+            verdict = None
+            for sub in ast.walk(fn):
+                if isinstance(sub, _FUNC_NODES) and sub is not fn \
+                        and sub.name == name:
+                    verdict = "def"
+                elif isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                    targets = sub.targets if isinstance(sub, ast.Assign) \
+                        else [sub.target]
+                    for t in targets:
+                        for el in ast.walk(t):
+                            if isinstance(el, ast.Name) and el.id == name:
+                                verdict = "other"
+                elif isinstance(sub, ast.ImportFrom):
+                    if any((a.asname or a.name) == name
+                           for a in sub.names):
+                        verdict = "import"
+                elif isinstance(sub, (ast.For, ast.comprehension)):
+                    tgt = sub.target
+                    for el in ast.walk(tgt):
+                        if isinstance(el, ast.Name) and el.id == name:
+                            verdict = "other"
+            if verdict == "def":
+                # a nested def by this name: resolve to it
+                return "nested-def"
+            if verdict is not None:
+                return verdict
+        return None
+
+    def resolve_local(self, node: ast.AST,
+                      func: ast.AST) -> Optional[FunctionInfo]:
+        """Resolve ``func`` (the callable expression, at ``node``'s
+        position) to a function defined in THIS module; None when the
+        target is imported, dynamic, or shadowed."""
+        # self.m(...) -> method of the enclosing class
+        chain = dotted(func)
+        if chain is not None and chain.startswith("self.") \
+                and chain.count(".") == 1:
+            cls = self.mod.enclosing_class(node)
+            if cls is not None:
+                return self.functions.get(f"{cls.name}.{chain[5:]}")
+            return None
+        if isinstance(func, ast.Name):
+            shadow = self._local_shadow(node, func.id)
+            if shadow == "nested-def":
+                for fn in self.mod.enclosing_functions(node):
+                    for sub in ast.walk(fn):
+                        if isinstance(sub, _FUNC_NODES) \
+                                and sub.name == func.id:
+                            return self._by_node.get(sub)
+            if shadow is not None:
+                return None
+            bound = self.module_bindings.get(func.id)
+            return bound if isinstance(bound, FunctionInfo) else None
+        return None
+
+    def info_for(self, fn_node: ast.AST) -> Optional[FunctionInfo]:
+        return self._by_node.get(fn_node)
+
+    def enclosing_info(self, node: ast.AST) -> Optional[FunctionInfo]:
+        fns = self.mod.enclosing_functions(node)
+        for fn in fns:
+            info = self._by_node.get(fn)
+            if info is not None:
+                return info
+        return None
+
+    # ------------------------------ edges ----------------------------- #
+
+    def _collect_calls(self) -> None:
+        for node in ast.walk(self.mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            caller = self.enclosing_info(node)
+            callee = self.resolve_local(node, node.func)
+            if callee is not None:
+                kind = "self" if (isinstance(node.func, ast.Attribute)
+                                  and callee.is_method) else "direct"
+                self._add(CallSite(caller, callee, node, kind))
+            # by-name references handed into any call
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                ref = self._resolve_reference(node, arg)
+                if ref is not None:
+                    self._add(CallSite(caller, ref, arg, "by-name"))
+
+    def _resolve_reference(self, at: ast.AST,
+                           expr: ast.AST) -> Optional[FunctionInfo]:
+        """A bare Name / self.attr argument that names a known function —
+        a callback passed by name."""
+        if isinstance(expr, (ast.Name, ast.Attribute)):
+            return self.resolve_local(at, expr)
+        return None
+
+    def _add(self, site: CallSite) -> None:
+        self.calls.append(site)
+        self._callers.setdefault(site.callee.qualname, []).append(site)
+
+    def callers_of(self, qualname: str) -> List[CallSite]:
+        """Every intra-module site that calls (or by-name references)
+        ``qualname``."""
+        return list(self._callers.get(qualname, ()))
+
+    def class_sites(self, cls_name: str) -> Dict[str, List[CallSite]]:
+        """method name -> intra-class call/reference sites, for every
+        method of ``cls_name`` (the lockset rule's edge map)."""
+        out: Dict[str, List[CallSite]] = {}
+        for site in self.calls:
+            if site.callee.cls == cls_name:
+                out.setdefault(site.callee.name, []).append(site)
+        return out
+
+
+class Project:
+    """All scanned modules + cross-module resolution."""
+
+    def __init__(self, mods: Sequence[ModuleSource]):
+        self.modules: Dict[str, ModuleSource] = {m.relpath: m for m in mods}
+        self.graphs: Dict[str, ModuleGraph] = {
+            rel: ModuleGraph(m) for rel, m in self.modules.items()}
+        self._by_dotted: Dict[str, str] = {}
+        for rel in self.modules:
+            name = rel[:-3].replace("/", ".")
+            if name.endswith(".__init__"):
+                self._by_dotted[name[:-len(".__init__")]] = rel
+            self._by_dotted[name] = rel
+
+    @classmethod
+    def of_module(cls, mod: ModuleSource) -> "Project":
+        """Single-module project — the fixture-test entry point."""
+        return cls([mod])
+
+    def graph(self, mod: ModuleSource) -> ModuleGraph:
+        g = self.graphs.get(mod.relpath)
+        if g is None:  # a module outside the scanned set (fixtures)
+            g = ModuleGraph(mod)
+            self.graphs[mod.relpath] = g
+        return g
+
+    def function(self, module_dotted: str,
+                 symbol: str) -> Optional[FunctionInfo]:
+        rel = self._by_dotted.get(module_dotted)
+        if rel is None:
+            return None
+        return self.graphs[rel].functions.get(symbol)
+
+    def resolve_call(self, mod: ModuleSource,
+                     call: ast.Call) -> Optional[FunctionInfo]:
+        """Full resolution of a call: local first, then through the
+        module's import map (``from m import f`` / ``m.f(...)``)."""
+        graph = self.graph(mod)
+        local = graph.resolve_local(call, call.func)
+        if local is not None:
+            return local
+        func = call.func
+        if isinstance(func, ast.Name):
+            if graph._local_shadow(call, func.id) == "other":
+                return None  # a param/local rebinding, not the import
+            origin = graph.imports.get(func.id)
+            if origin is not None and origin[1] is not None:
+                return self.function(origin[0], origin[1])
+            return None
+        chain = dotted(func)
+        if chain is None or "." not in chain:
+            return None
+        root, rest = chain.split(".", 1)
+        origin = graph.imports.get(root)
+        if origin is None:
+            return None
+        base, symbol = origin
+        if symbol is not None:  # from pkg import mod; mod.f(...)
+            base = f"{base}.{symbol}"
+        if "." in rest:  # alias.sub.f(...) — alias of a package
+            prefix, rest = rest.rsplit(".", 1)
+            base = f"{base}.{prefix}"
+        return self.function(base, rest)
+
+    def kernels(self) -> Iterator[FunctionInfo]:
+        for graph in self.graphs.values():
+            for info in graph.functions.values():
+                if info.is_kernel:
+                    yield info

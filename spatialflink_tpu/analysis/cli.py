@@ -1,11 +1,17 @@
 """CLI for the invariant linter.
 
-``python -m spatialflink_tpu.analysis [--rule ID]... [--format text|json]
-[--check] [--root DIR] [--allowlist FILE] [--list-rules]``
+``python -m spatialflink_tpu.analysis [--rule ID]...
+[--format text|json|sarif] [--check] [--root DIR] [--allowlist FILE]
+[--no-cache] [--list-rules]``
 
 Exit codes: 0 clean (or report-only mode), 1 non-allowlisted findings or
-stale allowlist entries under ``--check``, 2 usage/configuration errors
-(unknown rule, malformed allowlist).
+stale allowlist entries / stale pragmas under ``--check``, 2
+usage/configuration errors (unknown rule, malformed allowlist).
+
+``--format sarif`` emits SARIF 2.1.0 so CI viewers render findings as
+code annotations; suppressed findings ride along with their
+``suppressions`` field filled (``inSource`` for pragmas, ``external``
+for allowlist entries).
 """
 
 from __future__ import annotations
@@ -16,8 +22,11 @@ import sys
 from typing import List, Optional
 
 from spatialflink_tpu.analysis.core import (ALLOWLIST_PATH, REPO_ROOT,
-                                            AllowlistError, all_rules,
-                                            run_analysis)
+                                            AllowlistError, Report,
+                                            all_rules, run_analysis)
+
+_SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                 "master/Schemata/sarif-schema-2.1.0.json")
 
 
 def _render_text(report, check: bool, out) -> None:
@@ -25,16 +34,78 @@ def _render_text(report, check: bool, out) -> None:
         print(f.render(), file=out)
     for f, entry in report.suppressed:
         print(f"{f.render()}  [allowlisted: {entry.reason}]", file=out)
+    for f, pragma in report.pragma_suppressed:
+        print(f"{f.render()}  [pragma: {pragma.reason}]", file=out)
     for e in report.stale:
         print(f"stale allowlist entry — remove stale entry: {e.render()}",
               file=out)
+    for p in report.stale_pragmas:
+        print(f"stale pragma — remove stale pragma: {p.render()}",
+              file=out)
     n_active = len(report.findings)
-    print(f"{n_active} finding(s), {len(report.suppressed)} allowlisted, "
-          f"{len(report.stale)} stale allowlist entr"
-          f"{'y' if len(report.stale) == 1 else 'ies'} across "
+    n_supp = len(report.suppressed) + len(report.pragma_suppressed)
+    n_stale = len(report.stale) + len(report.stale_pragmas)
+    print(f"{n_active} finding(s), {n_supp} allowlisted, "
+          f"{n_stale} stale suppression"
+          f"{'' if n_stale == 1 else 's'} across "
           f"{report.files} file(s) [{', '.join(report.rules)}]", file=out)
     if check:
         print("check: " + ("PASS" if report.ok else "FAIL"), file=out)
+
+
+def _sarif_result(f, suppression: Optional[dict] = None) -> dict:
+    level = "error" if f.severity == "error" else "warning"
+    result = {
+        "ruleId": f.rule,
+        "level": level,
+        "message": {"text": f.message +
+                    (f" [{f.symbol}]" if f.symbol else "")},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {"uri": f.path},
+                "region": {"startLine": max(1, f.line),
+                           "startColumn": max(1, f.col + 1)},
+            },
+        }],
+    }
+    if suppression is not None:
+        result["suppressions"] = [suppression]
+    return result
+
+
+def render_sarif(report: Report) -> dict:
+    """The findings as a SARIF 2.1.0 document (one run, one driver)."""
+    known = {r.id: r for r in all_rules()}
+    rules_meta = []
+    for rid in report.rules:
+        rule = known.get(rid)
+        meta = {"id": rid}
+        if rule is not None:
+            meta["shortDescription"] = {"text": rule.contract}
+            meta["defaultConfiguration"] = {
+                "level": "error" if rule.severity == "error"
+                else "warning"}
+        rules_meta.append(meta)
+    results = [_sarif_result(f) for f in report.findings]
+    results += [_sarif_result(f, {"kind": "external",
+                                  "justification": e.reason})
+                for f, e in report.suppressed]
+    results += [_sarif_result(f, {"kind": "inSource",
+                                  "justification": p.reason})
+                for f, p in report.pragma_suppressed]
+    return {
+        "$schema": _SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "spatialflink-analysis",
+                "informationUri":
+                    "https://example.invalid/spatialflink-tpu/analysis",
+                "rules": rules_meta,
+            }},
+            "results": results,
+        }],
+    }
 
 
 def main(argv: Optional[List[str]] = None,
@@ -45,15 +116,19 @@ def main(argv: Optional[List[str]] = None,
                     "the AST level")
     ap.add_argument("--rule", action="append", default=None,
                     metavar="ID", help="run only this rule (repeatable)")
-    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--format", choices=("text", "json", "sarif"),
+                    default="text")
     ap.add_argument("--check", action="store_true",
-                    help="exit 1 on non-allowlisted findings or stale "
-                         "allowlist entries (the tier-1 gate mode)")
+                    help="exit 1 on non-allowlisted findings, stale "
+                         "allowlist entries, or stale pragmas (the "
+                         "tier-1 gate mode)")
     ap.add_argument("--root", default=REPO_ROOT,
                     help="tree to scan (default: this repo)")
     ap.add_argument("--allowlist", default=ALLOWLIST_PATH,
                     help="allowlist TOML (default: the committed "
                          "analysis/ALLOWLIST.toml); 'none' disables")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="disable the per-module findings cache")
     ap.add_argument("--list-rules", action="store_true",
                     help="print rule ids + contracts and exit")
     args = ap.parse_args(argv)
@@ -65,12 +140,15 @@ def main(argv: Optional[List[str]] = None,
     allowlist = None if args.allowlist == "none" else args.allowlist
     try:
         report = run_analysis(root=args.root, rule_ids=args.rule,
-                              allowlist=allowlist)
+                              allowlist=allowlist,
+                              cache=None if args.no_cache else "auto")
     except AllowlistError as e:
         print(f"analysis: {e}", file=sys.stderr)
         return 2
     if args.format == "json":
         print(json.dumps(report.to_dict(), sort_keys=True), file=out)
+    elif args.format == "sarif":
+        print(json.dumps(render_sarif(report), sort_keys=True), file=out)
     else:
         _render_text(report, args.check, out)
     if args.check and not report.ok:

@@ -23,8 +23,17 @@ Pieces:
   ``analysis/ALLOWLIST.toml``. Every entry needs a ``reason``; an entry
   that matches no current finding is *stale* and fails ``--check``, so
   the list can only shrink (ratchet), never accrete dead weight.
-- :func:`run_analysis` — scan a tree, apply rules, split findings into
-  active / allowlisted, report stale entries.
+- :class:`Pragma` — the line-anchored twin of an allowlist entry:
+  ``# analysis: allow(<rule-id>): <reason>`` on the offending line
+  suppresses that rule there, under the same shrink-only ratchet (a
+  pragma whose line no longer triggers the rule is stale and fails
+  ``--check``).
+- :func:`run_analysis` — scan a tree, run the rules over the shared
+  project call graph (:mod:`spatialflink_tpu.analysis.callgraph`),
+  apply pragmas then the allowlist, report stale entries of both kinds.
+  Per-module findings are cached under the source content hash
+  (:mod:`spatialflink_tpu.analysis.cache`) so the repeated tier-1
+  passes reparse nothing on an unchanged tree.
 
 The CLI lives in :mod:`spatialflink_tpu.analysis.cli` and the rule
 implementations in :mod:`spatialflink_tpu.analysis.rules`.
@@ -35,7 +44,9 @@ from __future__ import annotations
 import ast
 import dataclasses
 import fnmatch
+import hashlib
 import os
+import re
 from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
 
 #: repo root (the directory holding the ``spatialflink_tpu`` package).
@@ -67,6 +78,10 @@ class Finding:
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Finding":
+        return cls(**d)
 
     def render(self) -> str:
         where = f" [{self.symbol}]" if self.symbol else ""
@@ -140,12 +155,19 @@ class Rule:
     """One static invariant. Subclasses set ``id``/``contract``/``scope``
     and implement :meth:`check`; ``runtime_twin`` names the runtime
     enforcement (sentinel/spy/test) the rule complements — the docs table
-    renders it."""
+    renders it. ``depth`` documents how far the rule reasons ("lexical"
+    or "interprocedural"); ``interprocedural`` additionally marks rules
+    whose findings depend on OTHER modules (cross-module call-graph
+    resolution), which widens their cache key to the whole-tree hash."""
 
     id: str = ""
     contract: str = ""
     runtime_twin: str = ""
     severity: str = "error"
+    #: "lexical" or "interprocedural" — the docs-table depth column.
+    depth: str = "lexical"
+    #: findings depend on modules beyond the one being checked.
+    interprocedural: bool = False
     #: fnmatch globs over repo-relative paths this contract covers.
     scope: Tuple[str, ...] = ("spatialflink_tpu/**",)
 
@@ -153,7 +175,12 @@ class Rule:
         rel = relpath.replace(os.sep, "/")
         return any(fnmatch.fnmatch(rel, pat) for pat in self.scope)
 
-    def check(self, mod: ModuleSource) -> Iterator[Finding]:  # pragma: no cover
+    def check(self, mod: ModuleSource,
+              project=None) -> Iterator[Finding]:  # pragma: no cover
+        """Yield findings for ``mod``. ``project`` is the shared
+        :class:`~spatialflink_tpu.analysis.callgraph.Project` (never None
+        when invoked through the runner; rules needing it should fall
+        back to a single-module project for direct calls)."""
         raise NotImplementedError
 
     def finding(self, mod: ModuleSource, node: ast.AST, message: str,
@@ -303,6 +330,110 @@ class Allowlist:
 
 
 # --------------------------------------------------------------------- #
+# inline suppression pragmas
+
+#: a full, well-formed pragma (the ``allow(<id>): <reason>`` comment
+#: form documented in ARCHITECTURE.md).
+PRAGMA_RE = re.compile(
+    r"#\s*analysis:\s*allow\(\s*([A-Za-z0-9_-]+)\s*\)\s*:\s*(\S.*)$")
+#: anything that LOOKS like it wants to be a pragma — a malformed one
+#: must fail loudly, not silently suppress nothing.
+PRAGMA_HINT_RE = re.compile(r"#\s*analysis:")
+
+
+def _comment_tokens(source: str) -> Iterator[Tuple[int, str]]:
+    """(line, comment text) for every real COMMENT token — a pragma in a
+    docstring or string literal is prose, not suppression."""
+    import io
+    import tokenize
+
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                yield tok.start[0], tok.string
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return
+
+
+@dataclasses.dataclass
+class Pragma:
+    """One line-anchored reviewed exception, living in the source itself.
+    Same ratchet as :class:`AllowEntry`: a pragma whose line no longer
+    triggers its rule is stale and fails ``--check``."""
+
+    rule: str
+    path: str
+    line: int
+    reason: str
+    count: int = 0
+
+    def matches(self, f: Finding) -> bool:
+        return (f.rule == self.rule and f.path == self.path
+                and f.line == self.line)
+
+    def render(self) -> str:
+        return f"{self.rule} @ {self.path}:{self.line} ({self.reason})"
+
+
+def extract_pragmas(source: str, relpath: str,
+                    known_rules: Iterable[str]
+                    ) -> Tuple[List[Pragma], List[Finding]]:
+    """(pragmas, pragma-error findings) for one module's source. A
+    comment matching ``# analysis:`` that is not a well-formed
+    ``allow(<known-rule>): <reason>`` is an error finding — a typo'd
+    pragma that silently suppressed nothing would be worse than none."""
+    known = set(known_rules)
+    pragmas: List[Pragma] = []
+    errors: List[Finding] = []
+    rel = relpath.replace(os.sep, "/")
+    for lineno, text in _comment_tokens(source):
+        if not PRAGMA_HINT_RE.search(text):
+            continue
+        m = PRAGMA_RE.search(text)
+        if m is None:
+            errors.append(Finding(
+                rule="pragma-error", path=rel, line=lineno, col=0,
+                severity="error",
+                message="malformed analysis pragma — the form is "
+                        "`# analysis: allow(<rule-id>): <reason>` "
+                        "(the reason is mandatory)"))
+            continue
+        rule, reason = m.group(1), m.group(2).strip()
+        if rule not in known:
+            errors.append(Finding(
+                rule="pragma-error", path=rel, line=lineno, col=0,
+                severity="error",
+                message=f"pragma names unknown rule {rule!r} "
+                        f"(known: {', '.join(sorted(known))})"))
+            continue
+        pragmas.append(Pragma(rule=rule, path=rel, line=lineno,
+                              reason=reason))
+    return pragmas, errors
+
+
+def apply_pragmas(findings: Iterable[Finding], pragmas: List[Pragma],
+                  ran_rules: Iterable[str]) -> Tuple[
+                      List[Finding], List[Tuple[Finding, Pragma]],
+                      List[Pragma]]:
+    """Split findings into (active, pragma-suppressed) and report stale
+    pragmas — mirror of :meth:`Allowlist.apply`, line-anchored."""
+    ran = set(ran_rules)
+    for p in pragmas:
+        p.count = 0
+    active: List[Finding] = []
+    suppressed: List[Tuple[Finding, Pragma]] = []
+    for f in findings:
+        hit = next((p for p in pragmas if p.matches(f)), None)
+        if hit is not None:
+            hit.count += 1
+            suppressed.append((f, hit))
+        else:
+            active.append(f)
+    stale = [p for p in pragmas if p.count == 0 and p.rule in ran]
+    return active, suppressed, stale
+
+
+# --------------------------------------------------------------------- #
 # runner
 
 
@@ -310,16 +441,31 @@ class Allowlist:
 class Report:
     """One full pass over a tree."""
 
-    findings: List[Finding]          # active (non-allowlisted)
+    findings: List[Finding]          # active (non-suppressed)
     suppressed: List[Tuple[Finding, AllowEntry]]
     stale: List[AllowEntry]
     rules: List[str]
     files: int
     parse_errors: List[Finding]
+    pragma_suppressed: List[Tuple[Finding, Pragma]] = \
+        dataclasses.field(default_factory=list)
+    stale_pragmas: List[Pragma] = dataclasses.field(default_factory=list)
+    cache_hits: int = 0
+    cache_misses: int = 0
 
     @property
     def ok(self) -> bool:
-        return not self.findings and not self.stale
+        return not self.findings and not self.stale \
+            and not self.stale_pragmas
+
+    def findings_by_rule(self) -> Dict[str, int]:
+        """Active-finding count per rule that ran (zeros included), plus
+        any pseudo-rules (parse-error / pragma-error) that fired — the
+        per-rule breakdown ``doctor --preflight`` reports."""
+        out = {r: 0 for r in self.rules}
+        for f in self.findings:
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return out
 
     def to_dict(self) -> dict:
         return {
@@ -327,12 +473,21 @@ class Report:
             "files": self.files,
             "rules": self.rules,
             "findings": [f.to_dict() for f in self.findings],
+            "findings_by_rule": self.findings_by_rule(),
             "allowlisted": [{**f.to_dict(), "reason": e.reason}
                             for f, e in self.suppressed],
+            "pragma_allowlisted": [{**f.to_dict(), "reason": p.reason}
+                                   for f, p in self.pragma_suppressed],
             "stale_allowlist_entries": [
                 {"rule": e.rule, "path": e.path, "symbol": e.symbol,
                  "line": e.line, "reason": e.reason}
                 for e in self.stale],
+            "stale_pragmas": [
+                {"rule": p.rule, "path": p.path, "line": p.line,
+                 "reason": p.reason}
+                for p in self.stale_pragmas],
+            "cache": {"hits": self.cache_hits,
+                      "misses": self.cache_misses},
         }
 
 
@@ -350,12 +505,19 @@ def iter_sources(root: str = REPO_ROOT) -> Iterator[Tuple[str, str]]:
 
 
 def check_module(mod: ModuleSource,
-                 rules: Optional[Sequence[Rule]] = None) -> List[Finding]:
-    """Run ``rules`` (default: all registered) over one parsed module."""
+                 rules: Optional[Sequence[Rule]] = None,
+                 project=None) -> List[Finding]:
+    """Run ``rules`` (default: all registered) over one parsed module.
+    Without an explicit ``project`` the module is analyzed as a
+    single-module project (the fixture-test mode)."""
+    if project is None:
+        from spatialflink_tpu.analysis.callgraph import Project
+
+        project = Project.of_module(mod)
     out: List[Finding] = []
     for rule in (rules if rules is not None else all_rules()):
         if rule.applies_to(mod.relpath):
-            out.extend(rule.check(mod))
+            out.extend(rule.check(mod, project))
     return out
 
 
@@ -366,33 +528,113 @@ def check_source(source: str, relpath: str = "spatialflink_tpu/snippet.py",
     return check_module(ModuleSource.from_source(source, relpath), rules)
 
 
+def _sha(data: str) -> str:
+    return hashlib.sha256(data.encode("utf-8")).hexdigest()
+
+
 def run_analysis(root: str = REPO_ROOT,
                  rule_ids: Optional[Sequence[str]] = None,
-                 allowlist: Optional[str] = ALLOWLIST_PATH) -> Report:
+                 allowlist: Optional[str] = ALLOWLIST_PATH,
+                 cache: Optional[str] = "auto") -> Report:
     """The full pass: parse every engine module under ``root``, run the
-    selected rules, apply the allowlist. ``allowlist=None`` disables
-    suppression (raw findings)."""
+    selected rules over the shared project call graph, apply inline
+    pragmas then the allowlist. ``allowlist=None`` disables file-based
+    suppression (raw findings; pragmas still apply — they live in the
+    sources being judged). ``cache`` is ``"auto"`` (a per-root file under
+    the system temp dir), an explicit path, or None to disable."""
+    from spatialflink_tpu.analysis.cache import AnalysisCache, package_hash
+    from spatialflink_tpu.analysis.callgraph import Project
+
     rules = resolve_rules(rule_ids)
-    findings: List[Finding] = []
-    parse_errors: List[Finding] = []
-    files = 0
+    ran_ids = [r.id for r in rules]
+    raw: List[Tuple[str, str, str, str]] = []  # path, rel, source, hash
     for path, relpath in iter_sources(root):
-        files += 1
         with open(path, encoding="utf-8") as f:
             source = f.read()
-        try:
-            mod = ModuleSource(path, relpath, source)
-        except SyntaxError as e:
-            parse_errors.append(Finding(
-                rule="parse-error", path=relpath.replace(os.sep, "/"),
-                line=e.lineno or 0, col=e.offset or 0, severity="error",
-                message=f"syntax error: {e.msg}"))
-            continue
-        findings.extend(check_module(mod, rules))
+        raw.append((path, relpath.replace(os.sep, "/"), source,
+                    _sha(source)))
+    files = len(raw)
+    tree_hash = _sha("\n".join(f"{rel}:{h}" for _, rel, _, h in raw))
+    pkg_hash = package_hash()
+    cache_obj = AnalysisCache.open(root, cache)
+
+    findings_map: Dict[Tuple[str, str], List[Finding]] = {}
+    parse_map: Dict[str, List[Finding]] = {}
+    needed: List[Tuple[str, Optional[Rule], str]] = []
+    hits = 0
+    for _, rel, _, h in raw:
+        # parse status rides the cache as a pseudo-rule so a --rule
+        # subset run still reports syntax errors in out-of-scope modules
+        pkey = f"{h}:{pkg_hash}"
+        got = cache_obj.get(rel, "__parse__", pkey) if cache_obj else None
+        if got is None:
+            needed.append((rel, None, pkey))
+        else:
+            hits += 1
+            parse_map[rel] = [Finding.from_dict(d) for d in got]
+        for rule in rules:
+            if not rule.applies_to(rel):
+                continue
+            key = pkey if not rule.interprocedural \
+                else f"{pkey}:{tree_hash}"
+            got = cache_obj.get(rel, rule.id, key) if cache_obj else None
+            if got is None:
+                needed.append((rel, rule, key))
+            else:
+                hits += 1
+                findings_map[(rel, rule.id)] = [Finding.from_dict(d)
+                                                for d in got]
+
+    if needed:
+        mods: Dict[str, ModuleSource] = {}
+        for path, rel, source, _ in raw:
+            try:
+                mods[rel] = ModuleSource(path, rel, source)
+            except SyntaxError as e:
+                parse_map[rel] = [Finding(
+                    rule="parse-error", path=rel,
+                    line=e.lineno or 0, col=e.offset or 0,
+                    severity="error", message=f"syntax error: {e.msg}")]
+            else:
+                parse_map.setdefault(rel, [])
+        project = Project(list(mods.values()))
+        for rel, rule, key in needed:
+            if rule is None:
+                if cache_obj is not None:
+                    cache_obj.put(rel, "__parse__", key,
+                                  [f.to_dict()
+                                   for f in parse_map.get(rel, [])])
+                continue
+            mod = mods.get(rel)
+            if mod is None:  # unparseable: the parse-error finding gates
+                continue
+            fs = list(rule.check(mod, project))
+            findings_map[(rel, rule.id)] = fs
+            if cache_obj is not None:
+                cache_obj.put(rel, rule.id, key,
+                              [f.to_dict() for f in fs])
+        if cache_obj is not None:
+            cache_obj.save()
+    parse_errors = [f for fs in parse_map.values() for f in fs]
+    parse_errors.sort(key=lambda f: (f.path, f.line))
+
+    findings = [f for fs in findings_map.values() for f in fs]
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+
+    pragmas: List[Pragma] = []
+    pragma_errors: List[Finding] = []
+    for _, rel, source, _ in raw:
+        ps, errs = extract_pragmas(source, rel, RULES)
+        pragmas.extend(ps)
+        pragma_errors.extend(errs)
+    findings, pragma_suppressed, stale_pragmas = apply_pragmas(
+        findings, pragmas, ran_ids)
+
     al = Allowlist.load(allowlist) if allowlist else Allowlist([])
-    active, suppressed, stale = al.apply(findings, [r.id for r in rules])
-    active = parse_errors + active
+    active, suppressed, stale = al.apply(findings, ran_ids)
+    active = parse_errors + pragma_errors + active
     return Report(findings=active, suppressed=suppressed, stale=stale,
-                  rules=[r.id for r in rules], files=files,
-                  parse_errors=parse_errors)
+                  rules=ran_ids, files=files, parse_errors=parse_errors,
+                  pragma_suppressed=pragma_suppressed,
+                  stale_pragmas=stale_pragmas,
+                  cache_hits=hits, cache_misses=len(needed))

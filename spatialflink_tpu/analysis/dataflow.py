@@ -1,0 +1,224 @@
+"""Small forward-dataflow / taint cores shared by the deep rules.
+
+Three analyses, all deliberately bounded (the depth limits are part of
+the documented contract — ARCHITECTURE.md lists them as blind spots):
+
+- **jax-return summaries** (:func:`jax_returning`) — the set of module
+  functions whose return value is visibly jax-produced: the return
+  expression holds a ``jax.*``/``jnp.*``/``lax.*`` call, a local bound
+  from one, or a call to another function already in the set. Iterated
+  ``depth`` times, so a value is tracked through one-to-two levels of
+  intra-module helpers — ``float(_total(x))`` is a readback even though
+  ``float``'s argument is lexically just a Name.
+- **sink-param summaries** (:func:`sink_params`) — per function, the
+  parameters that flow into a ``float()``/``bool()`` concretization
+  sink inside it (directly, or by being handed to another helper's sink
+  parameter). The host-sync rule flags the *call site* that feeds a
+  jax value into such a parameter.
+- **shape-churn taint** (:func:`shape_churn_source`) — for the
+  recompile-surface rule: is a static (shape-determining) kernel
+  argument derived from data-dependent sources (``len(...)``,
+  ``.shape``/``.size``/``.ndim``/``.nbytes``) without passing through
+  the power-of-two bucketing seam (``bucket_size``)? Constants, config/
+  geometry attribute chains, and caller parameters are churn-safe by
+  convention; the bucket helpers sanitize everything beneath them.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Callable, Dict, Optional, Set
+
+from spatialflink_tpu.analysis.callgraph import ModuleGraph
+from spatialflink_tpu.analysis.astutils import dotted, function_params
+
+#: module roots whose calls produce device values.
+JAX_ROOTS = {"jax", "jnp", "lax"}
+#: attribute reads that are data-dependent shape sources.
+DYNAMIC_SHAPE_ATTRS = {"shape", "size", "ndim", "nbytes"}
+#: callables that bucket a data-dependent size into the padded fleet's
+#: power-of-two shape classes — the sanitizer seam.
+SHAPE_SANITIZERS = {"bucket_size"}
+
+
+def _innermost_fn(graph: ModuleGraph, node: ast.AST) -> Optional[ast.AST]:
+    fns = graph.mod.enclosing_functions(node)
+    return fns[0] if fns else None
+
+
+# --------------------------------------------------------------------- #
+# jax-return summaries
+
+
+def _fn_returns_jax(graph: ModuleGraph, info, jaxset: Set[str]) -> bool:
+    tainted: Set[str] = set()
+
+    def expr_tainted(expr: ast.AST) -> bool:
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Call):
+                root = (dotted(n.func) or "").split(".")[0]
+                if root in JAX_ROOTS:
+                    return True
+                callee = graph.resolve_local(n, n.func)
+                if callee is not None and callee.qualname in jaxset:
+                    return True
+            elif isinstance(n, ast.Name) and n.id in tainted:
+                return True
+        return False
+
+    # two sweeps pick up chained local bindings (a = jnp…; b = a)
+    for _ in range(2):
+        for n in ast.walk(info.node):
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                    and isinstance(n.targets[0], ast.Name) \
+                    and expr_tainted(n.value):
+                tainted.add(n.targets[0].id)
+    for n in ast.walk(info.node):
+        if isinstance(n, ast.Return) and n.value is not None \
+                and _innermost_fn(graph, n) is info.node \
+                and expr_tainted(n.value):
+            return True
+    return False
+
+
+def jax_returning(graph: ModuleGraph, depth: int = 2) -> Set[str]:
+    """Qualnames of module functions whose return value is jax-rooted,
+    tracked through up to ``depth`` levels of intra-module calls."""
+    out: Set[str] = set()
+    for _ in range(max(1, depth)):
+        new = {q for q, info in graph.functions.items()
+               if q not in out and _fn_returns_jax(graph, info, out)}
+        if not new:
+            break
+        out |= new
+    return out
+
+
+# --------------------------------------------------------------------- #
+# sink-param summaries
+
+
+def map_call_args(callee_params, call: ast.Call) -> Dict[str, ast.AST]:
+    """Call arguments keyed by the callee's parameter names (best
+    effort: starred args / unknown keywords end the mapping)."""
+    out: Dict[str, ast.AST] = {}
+    for i, a in enumerate(call.args):
+        if isinstance(a, ast.Starred):
+            break
+        if i < len(callee_params):
+            out[callee_params[i]] = a
+    for kw in call.keywords:
+        if kw.arg is not None:
+            out[kw.arg] = kw.value
+    return out
+
+
+def sink_params(graph: ModuleGraph, depth: int = 2,
+                exclude: Optional[Callable[[object], bool]] = None
+                ) -> Dict[str, Set[str]]:
+    """qualname -> parameter names that reach a ``float()``/``bool()``
+    concretization sink inside the function (or, transitively up to
+    ``depth`` levels, inside an intra-module helper it forwards them
+    to). Functions matched by ``exclude`` (the accounted seams) never
+    acquire sink params."""
+    out: Dict[str, Set[str]] = {}
+    for _ in range(max(1, depth)):
+        changed = False
+        for qual, info in graph.functions.items():
+            if exclude is not None and exclude(info):
+                continue
+            params = set(info.params)
+            if not params:
+                continue
+            hits = out.setdefault(qual, set())
+            before = len(hits)
+            for n in ast.walk(info.node):
+                if not isinstance(n, ast.Call):
+                    continue
+                if isinstance(n.func, ast.Name) \
+                        and n.func.id in ("float", "bool") and n.args:
+                    for sub in ast.walk(n.args[0]):
+                        if isinstance(sub, ast.Name) and sub.id in params:
+                            hits.add(sub.id)
+                callee = graph.resolve_local(n, n.func)
+                if callee is None or not out.get(callee.qualname):
+                    continue
+                for pname, arg in map_call_args(callee.params, n).items():
+                    if pname not in out[callee.qualname]:
+                        continue
+                    for sub in ast.walk(arg):
+                        if isinstance(sub, ast.Name) and sub.id in params:
+                            hits.add(sub.id)
+            changed = changed or len(hits) != before
+        if not changed:
+            break
+    return {q: s for q, s in out.items() if s}
+
+
+# --------------------------------------------------------------------- #
+# shape-churn taint (recompile surface)
+
+
+def shape_churn_source(graph: ModuleGraph, expr: ast.AST,
+                       at: ast.AST) -> Optional[str]:
+    """The first data-dependent, un-bucketed size source inside a
+    static-argument expression, as a short human label — or None when
+    the expression is churn-safe (constant, config/geometry attribute,
+    caller parameter, or sanitized through :data:`SHAPE_SANITIZERS`).
+
+    ``at`` is the call site; Name bindings are chased through the
+    enclosing functions' simple assignments (bounded, cycle-safe)."""
+    mod = graph.mod
+
+    def name_binding(name: str, seen: Set[str]) -> Optional[str]:
+        if name in seen:
+            return None
+        seen = seen | {name}
+        for fn in mod.enclosing_functions(at):
+            if name in function_params(fn):
+                return None  # caller-provided: the contract hoists
+            for n in ast.walk(fn):
+                if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                        and isinstance(n.targets[0], ast.Name) \
+                        and n.targets[0].id == name:
+                    bad = classify(n.value, seen)
+                    if bad is not None:
+                        return bad
+        return None
+
+    def classify(e: ast.AST, seen: Set[str]) -> Optional[str]:
+        if isinstance(e, ast.Call):
+            leaf = (dotted(e.func) or "").split(".")[-1]
+            if leaf in SHAPE_SANITIZERS:
+                return None  # bucketed: everything beneath is repadded
+            if isinstance(e.func, ast.Name) and e.func.id == "len":
+                return "len(...)"
+            for child in list(e.args) + [kw.value for kw in e.keywords]:
+                bad = classify(child, seen)
+                if bad is not None:
+                    return bad
+            return None
+        if isinstance(e, ast.Attribute):
+            if e.attr in DYNAMIC_SHAPE_ATTRS:
+                return f".{e.attr}"
+            if dotted(e) is not None:
+                return None  # plain attribute chain: run-constant idiom
+            return classify(e.value, seen)
+        if isinstance(e, ast.Name):
+            return name_binding(e.id, seen)
+        if isinstance(e, ast.Constant):
+            return None
+        for child in ast.iter_child_nodes(e):
+            if isinstance(child, (ast.expr, ast.keyword)):
+                val = child.value if isinstance(child, ast.keyword) else child
+                bad = classify(val, seen)
+                if bad is not None:
+                    return bad
+        return None
+
+    return classify(expr, set())
+
+
+__all__ = ["JAX_ROOTS", "DYNAMIC_SHAPE_ATTRS", "SHAPE_SANITIZERS",
+           "jax_returning", "sink_params", "shape_churn_source",
+           "map_call_args"]

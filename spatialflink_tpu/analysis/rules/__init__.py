@@ -7,6 +7,7 @@ from spatialflink_tpu.analysis.rules import (  # noqa: F401
     checkpoint_coverage,
     host_sync,
     jit_coverage,
+    recompile_surface,
     telemetry_gating,
     thread_shared,
     trace_safety,

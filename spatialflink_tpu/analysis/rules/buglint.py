@@ -68,7 +68,8 @@ class UnusedImportRule(Rule):
     severity = "warning"
     scope = ("spatialflink_tpu/**",)
 
-    def check(self, mod: ModuleSource) -> Iterator[Finding]:
+    def check(self, mod: ModuleSource,
+              project=None) -> Iterator[Finding]:
         # __init__.py re-exports by convention (ruff per-file-ignore)
         if mod.relpath.endswith("__init__.py"):
             return
@@ -93,7 +94,8 @@ class FStringPlaceholderRule(Rule):
     severity = "warning"
     scope = ("spatialflink_tpu/**",)
 
-    def check(self, mod: ModuleSource) -> Iterator[Finding]:
+    def check(self, mod: ModuleSource,
+              project=None) -> Iterator[Finding]:
         for node in ast.walk(mod.tree):
             # a FormattedValue's format_spec is itself a JoinedStr — only
             # real f-string literals count
@@ -116,7 +118,8 @@ class IsLiteralRule(Rule):
     severity = "error"
     scope = ("spatialflink_tpu/**",)
 
-    def check(self, mod: ModuleSource) -> Iterator[Finding]:
+    def check(self, mod: ModuleSource,
+              project=None) -> Iterator[Finding]:
         for node in ast.walk(mod.tree):
             if not isinstance(node, ast.Compare):
                 continue

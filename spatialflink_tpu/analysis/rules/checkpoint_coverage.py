@@ -1,14 +1,39 @@
 """Rule 5 — checkpoint coverage: mutable streaming state must be
-snapshotable.
+snapshotable, FIELD BY FIELD since PR 15.
 
 PR 4's coordinated checkpoints are only exactly-once if *every* piece of
 mutable per-run state participates. The heuristic for "holds streaming
-state": a class in ``runtime/``/``operators/``/``streams/`` that assigns
+state": a class in ``runtime/``/``operators/``/``streams/`` that mutates
 an instance attribute *outside* ``__init__`` whose name says it holds
-windows, panes, offsets, partials, watermarks, buffers, or sealed sets.
-Such a class must implement the ``snapshot``/``restore`` pair the
-coordinator registers — or carry an allowlist entry explaining why its
-state is legitimately ephemeral (rebuilt, cache-only, or test-only).
+windows, panes, offsets, partials, watermarks, buffers, sealed sets — or
+the query plane's registry state (fleets, entries, specs, staged
+changes; the PR 9 plane was invisible to PR 12's pattern and is now in
+scope).
+
+Two depths of check:
+
+1. **Pair existence** (PR 12's check, kept): such a class must implement
+   the ``snapshot``/``restore`` pair the coordinator registers — or
+   carry a reviewed exception explaining why its state is legitimately
+   ephemeral.
+2. **Field coverage** (new): a pair that *exists* is not a pair that
+   *covers*. Every state attribute mutated outside ``__init__`` must be
+   actually READ somewhere in ``snapshot()`` and actually ASSIGNED
+   somewhere in ``restore()`` — directly, or inside an intra-class
+   helper the method reaches through self-calls (three levels). This is
+   the "added a pane ring, forgot to checkpoint it" bug class: the PR 4
+   barriers serialize whatever ``snapshot`` returns and cannot notice a
+   field that never made it in.
+
+Mutation detection covers plain stores, ``self.x[k] = v`` subscript
+stores, and the container mutators (``append``/``update``/``pop``/…) —
+PR 12 saw only ``self.x = …``, so a class that only ever *grew* its
+dict looked stateless. ``self.__dict__.update(state)`` and a
+non-constant ``setattr(self, name, …)`` in ``restore`` count as
+assigning every field (the bulk-restore idiom); a ``restore`` that is a
+classmethod constructor is exempt from field checks (it builds a fresh
+instance — attribute flow through ``cls(...)`` is a documented blind
+spot).
 
 Classes whose state is genuinely derived (caches that recompute, pure
 cursors over immutable inputs) belong in the allowlist *with that
@@ -20,84 +45,245 @@ from __future__ import annotations
 
 import ast
 import re
-from typing import Dict, Iterator, List
+from typing import Dict, Iterator, List, Set, Tuple
 
 from spatialflink_tpu.analysis.core import (Finding, ModuleSource, Rule,
                                             register)
-from spatialflink_tpu.analysis.rules.common import attr_write_targets
 
 #: attribute-name fragments that mean "streaming state a resume must not
-#: lose".
+#: lose". fleet/entries/specs/staged bring the query plane's registry
+#: state (runtime/queryplane.py) into scope.
 _STATE_PAT = re.compile(
-    r"window|pane|offset|partial|watermark|seal|buffer", re.IGNORECASE)
+    r"window|pane|offset|partial|watermark|seal|buffer"
+    r"|fleet|entries|specs|staged", re.IGNORECASE)
 
 #: methods whose writes do not make state "live across the run": setup,
 #: the snapshot/restore pair itself, and teardown.
 _EXEMPT_METHODS = {"__init__", "__post_init__", "__new__", "snapshot",
                    "restore", "reset", "clear", "close", "__exit__"}
 
+#: method calls that mutate the receiver container in place.
+_MUTATORS = {"append", "appendleft", "add", "update", "setdefault",
+             "extend", "insert", "pop", "popleft", "popitem", "remove",
+             "discard", "clear", "push"}
+
+#: sentinel meaning "every attribute" (self.__dict__.update / dynamic
+#: setattr in restore).
+_ALL = "*"
+
+
+def _self_attr(node: ast.AST) -> str:
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return ""
+
+
+def _mutations(meth: ast.AST) -> Dict[str, ast.AST]:
+    """attr -> first mutating node in ``meth``: plain/subscript stores,
+    augmented assigns, and in-place container mutator calls on
+    ``self.<attr>``."""
+    out: Dict[str, ast.AST] = {}
+
+    def note(attr: str, node: ast.AST) -> None:
+        if attr and attr not in out:
+            out[attr] = node
+
+    for stmt in ast.walk(meth):
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                else [stmt.target]
+            for t in targets:
+                els = ast.walk(t) if isinstance(t, (ast.Tuple, ast.List)) \
+                    else [t]
+                for el in els:
+                    note(_self_attr(el), el)
+                    if isinstance(el, ast.Subscript):
+                        note(_self_attr(el.value), el)
+        elif isinstance(stmt, ast.Call) \
+                and isinstance(stmt.func, ast.Attribute) \
+                and stmt.func.attr in _MUTATORS:
+            note(_self_attr(stmt.func.value), stmt)
+        elif isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                note(_self_attr(t), t)
+                if isinstance(t, ast.Subscript):
+                    note(_self_attr(t.value), t)
+    return out
+
+
+def _assigned_attrs(meth: ast.AST) -> Set[str]:
+    """Attributes ``meth`` (re)establishes: everything `_mutations` sees
+    plus the bulk-restore idioms."""
+    out = set(_mutations(meth))
+    for node in ast.walk(meth):
+        if not isinstance(node, ast.Call):
+            continue
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "update":
+            tgt = node.func.value
+            if isinstance(tgt, ast.Attribute) and tgt.attr == "__dict__" \
+                    and isinstance(tgt.value, ast.Name) \
+                    and tgt.value.id == "self":
+                out.add(_ALL)
+        if isinstance(node.func, ast.Name) and node.func.id == "setattr" \
+                and node.args and isinstance(node.args[0], ast.Name) \
+                and node.args[0].id == "self":
+            if len(node.args) > 1 and isinstance(node.args[1], ast.Constant):
+                out.add(str(node.args[1].value))
+            else:
+                out.add(_ALL)
+    return out
+
+
+def _read_attrs(meth: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(meth):
+        attr = _self_attr(node)
+        if attr and isinstance(node.ctx, ast.Load):
+            out.add(attr)
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                and node.func.id in ("getattr", "vars") and node.args \
+                and isinstance(node.args[0], ast.Name) \
+                and node.args[0].id == "self":
+            out.add(_ALL)
+    for node in ast.walk(meth):
+        if isinstance(node, ast.Attribute) and node.attr == "__dict__" \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "self":
+            out.add(_ALL)
+    return out
+
+
+def _reachable(graph, cls: ast.ClassDef, start: ast.AST,
+               depth: int = 3) -> List[ast.AST]:
+    """``start`` plus the intra-class methods it reaches through
+    self-calls (call or by-name) within ``depth`` hops."""
+    methods = {m.name: m for m in cls.body
+               if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    seen = [start]
+    if graph is None:
+        return seen
+    frontier = [start]
+    for _ in range(depth):
+        nxt = []
+        for meth in frontier:
+            for site in graph.calls:
+                if site.caller is None or site.caller.node is not meth:
+                    continue
+                callee = site.callee
+                if callee.cls == cls.name and callee.name in methods:
+                    node = methods[callee.name]
+                    if node not in seen:
+                        seen.append(node)
+                        nxt.append(node)
+        frontier = nxt
+        if not frontier:
+            break
+    return seen
+
+
+def _is_classmethod(meth: ast.AST) -> bool:
+    for dec in meth.decorator_list:
+        if isinstance(dec, ast.Name) and dec.id in ("classmethod",
+                                                    "staticmethod"):
+            return True
+    return False
+
 
 @register
 class CheckpointCoverageRule(Rule):
     id = "checkpoint-coverage"
-    contract = ("classes with mutable windows/offsets/partials state "
-                "implement the snapshot/restore checkpoint pair")
+    contract = ("classes with mutable windows/offsets/partials/fleet "
+                "state implement snapshot/restore AND cover every such "
+                "field in both")
     runtime_twin = ("CheckpointCoordinator barriers + crash/resume "
                     "identity tests (tests/test_recovery.py)")
     severity = "warning"
+    depth = "interprocedural (snapshot/restore reach via self-calls)"
     scope = ("spatialflink_tpu/runtime/*.py",
              "spatialflink_tpu/operators/*.py",
              "spatialflink_tpu/streams/*.py")
 
-    def check(self, mod: ModuleSource) -> Iterator[Finding]:
+    def check(self, mod: ModuleSource,
+              project=None) -> Iterator[Finding]:
+        graph = project.graph(mod) if project is not None else None
         for cls in ast.walk(mod.tree):
             if not isinstance(cls, ast.ClassDef):
                 continue
-            methods = {m.name for m in cls.body
+            methods = {m.name: m for m in cls.body
                        if isinstance(m, (ast.FunctionDef,
                                          ast.AsyncFunctionDef))}
-            state_writes: Dict[str, int] = {}
+            state_writes: Dict[str, ast.AST] = {}
             for meth in cls.body:
                 if not isinstance(meth, (ast.FunctionDef,
                                          ast.AsyncFunctionDef)) \
                         or meth.name in _EXEMPT_METHODS:
                     continue
-                for stmt in ast.walk(meth):
-                    if not isinstance(stmt, (ast.Assign, ast.AugAssign,
-                                             ast.AnnAssign)):
-                        continue
-                    for attr, node in attr_write_targets(stmt):
-                        if _STATE_PAT.search(attr) \
-                                and attr not in state_writes:
-                            state_writes[attr] = node.lineno
+                for attr, node in _mutations(meth).items():
+                    if _STATE_PAT.search(attr) \
+                            and attr not in state_writes:
+                        state_writes[attr] = node
             if not state_writes:
                 continue
             missing = [m for m in ("snapshot", "restore")
                        if m not in methods]
-            if not missing:
+            if missing:
+                attrs = ", ".join(
+                    f"{a} (line {n.lineno})" for a, n in sorted(
+                        state_writes.items(),
+                        key=lambda kv: kv[1].lineno))
+                yield self.finding(
+                    mod, cls,
+                    f"class mutates streaming state outside __init__ "
+                    f"[{attrs}] but lacks {' and '.join(missing)} — "
+                    "register it as a checkpoint component or allowlist "
+                    "with the reason its state may be lost on resume")
                 continue
-            attrs = ", ".join(
-                f"{a} (line {ln})" for a, ln in sorted(
-                    state_writes.items(), key=lambda kv: kv[1]))
+            yield from self._field_coverage(mod, graph, cls, methods,
+                                            state_writes)
+
+    def _field_coverage(self, mod: ModuleSource, graph,
+                        cls: ast.ClassDef, methods: Dict[str, ast.AST],
+                        state_writes: Dict[str, ast.AST]
+                        ) -> Iterator[Finding]:
+        snap_reads: Set[str] = set()
+        for meth in _reachable(graph, cls, methods["snapshot"]):
+            snap_reads |= _read_attrs(meth)
+        restore = methods["restore"]
+        rest_writes: Set[str] = set()
+        if _is_classmethod(restore):
+            rest_writes.add(_ALL)  # constructor-style restore: blind spot
+        else:
+            for meth in _reachable(graph, cls, restore):
+                rest_writes |= _assigned_attrs(meth)
+        for attr, node in sorted(state_writes.items(),
+                                 key=lambda kv: kv[1].lineno):
+            gaps: List[str] = []
+            if attr not in snap_reads and _ALL not in snap_reads:
+                gaps.append("never read in snapshot()")
+            if attr not in rest_writes and _ALL not in rest_writes:
+                gaps.append("never assigned in restore()")
+            if not gaps:
+                continue
             yield self.finding(
-                mod, cls,
-                f"class mutates streaming state outside __init__ "
-                f"[{attrs}] but lacks {' and '.join(missing)} — register "
-                "it as a checkpoint component or allowlist with the "
-                "reason its state may be lost on resume")
+                mod, node,
+                f"state attr self.{attr} is mutated outside __init__ "
+                f"but {' and '.join(gaps)} — a crash/resume silently "
+                "loses it; serialize it in the pair or allowlist with "
+                "the reviewed reason it is rebuildable")
 
 
-def state_attributes(cls: ast.ClassDef) -> List[str]:
+def state_attributes(cls: ast.ClassDef) -> List[Tuple[str, int]]:
     """Expose the heuristic for tests/docs: the checkpoint-relevant
-    attrs a class mutates outside ``__init__``."""
-    out = []
+    (attr, first-mutation line) pairs a class mutates outside
+    ``__init__`` — subscript stores and container mutators included."""
+    out: Dict[str, int] = {}
     for meth in cls.body:
         if not isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)) \
                 or meth.name in _EXEMPT_METHODS:
             continue
-        for stmt in ast.walk(meth):
-            if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
-                for attr, _ in attr_write_targets(stmt):
-                    if _STATE_PAT.search(attr) and attr not in out:
-                        out.append(attr)
-    return out
+        for attr, node in _mutations(meth).items():
+            if _STATE_PAT.search(attr) and attr not in out:
+                out[attr] = node.lineno
+    return sorted(out.items(), key=lambda kv: kv[1])

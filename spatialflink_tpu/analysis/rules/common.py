@@ -5,102 +5,24 @@ check the telemetry-gating rule is built on."""
 from __future__ import annotations
 
 import ast
-from typing import List, Optional, Sequence, Set, Tuple
+from typing import List, Optional, Sequence, Tuple
+
+from spatialflink_tpu.analysis.astutils import (  # noqa: F401
+    _const_ints,
+    _const_strings,
+    _is_instrumented_jit,
+    call_name,
+    dotted,
+    function_params,
+    jit_static_names,
+)
 
 TERMINATORS = (ast.Return, ast.Continue, ast.Break, ast.Raise)
-
-
-def dotted(node: ast.AST) -> Optional[str]:
-    """Render a Name/Attribute chain as ``a.b.c``; None when the chain
-    roots in anything else (a call, a subscript, a literal)."""
-    parts: List[str] = []
-    cur = node
-    while isinstance(cur, ast.Attribute):
-        parts.append(cur.attr)
-        cur = cur.value
-    if isinstance(cur, ast.Name):
-        parts.append(cur.id)
-        return ".".join(reversed(parts))
-    return None
-
-
-def call_name(node: ast.Call) -> Optional[str]:
-    """Dotted name of a call's target (``np.asarray``, ``float``)."""
-    return dotted(node.func)
 
 
 def terminates(stmts: Sequence[ast.stmt]) -> bool:
     """Does this suite unconditionally leave the enclosing block?"""
     return bool(stmts) and isinstance(stmts[-1], TERMINATORS)
-
-
-# --------------------------------------------------------------------- #
-# instrumented_jit decorator parsing (trace-safety + jit-coverage)
-
-
-def _is_instrumented_jit(node: ast.AST) -> bool:
-    return (isinstance(node, ast.Name) and node.id == "instrumented_jit") \
-        or (isinstance(node, ast.Attribute)
-            and node.attr == "instrumented_jit")
-
-
-def _const_strings(node: ast.AST) -> List[str]:
-    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
-        return [e.value for e in node.elts
-                if isinstance(e, ast.Constant) and isinstance(e.value, str)]
-    if isinstance(node, ast.Constant) and isinstance(node.value, str):
-        return [node.value]
-    return []
-
-
-def _const_ints(node: ast.AST) -> List[int]:
-    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
-        return [e.value for e in node.elts
-                if isinstance(e, ast.Constant) and isinstance(e.value, int)]
-    if isinstance(node, ast.Constant) and isinstance(node.value, int):
-        return [node.value]
-    return []
-
-
-def jit_static_names(fn: ast.FunctionDef) -> Optional[Set[str]]:
-    """If ``fn`` is decorated with ``instrumented_jit`` (bare, or curried
-    through ``partial(instrumented_jit, static_arg…=…)``), return the set
-    of parameter names the decoration marks static; None when the
-    function is not jitted at all."""
-    params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
-    for dec in fn.decorator_list:
-        if _is_instrumented_jit(dec):
-            return set()
-        if isinstance(dec, ast.Call):
-            target = None
-            fname = dotted(dec.func) or ""
-            if _is_instrumented_jit(dec.func):
-                target = dec
-            elif fname.split(".")[-1] == "partial" and dec.args \
-                    and _is_instrumented_jit(dec.args[0]):
-                target = dec
-            if target is None:
-                continue
-            statics: Set[str] = set()
-            for kw in target.keywords:
-                if kw.arg == "static_argnames":
-                    statics.update(_const_strings(kw.value))
-                elif kw.arg == "static_argnums":
-                    for i in _const_ints(kw.value):
-                        if 0 <= i < len(params):
-                            statics.add(params[i])
-            return statics
-    return None
-
-
-def function_params(fn) -> List[str]:
-    a = fn.args
-    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
-    if a.vararg:
-        names.append(a.vararg.arg)
-    if a.kwarg:
-        names.append(a.kwarg.arg)
-    return names
 
 
 # --------------------------------------------------------------------- #

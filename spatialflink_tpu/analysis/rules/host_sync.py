@@ -1,5 +1,5 @@
 """Rule 3 — host-sync discipline: no unaccounted device→host syncs on
-dispatch paths.
+dispatch paths, taint-tracked through helpers since PR 15.
 
 The pipelined drive loop only overlaps host assembly with device compute
 if nothing on the dispatch path forces an early readback. In the
@@ -16,15 +16,33 @@ readback seams*:
 - host twins by convention (``*_host`` functions operate on numpy
   inputs by contract).
 
+PR 12 matched each sink expression in isolation, so one helper call hid
+a readback in either direction: ``float(_total(x))`` passed because
+``_total`` is not lexically a jax call (even though it returns
+``jnp.sum(x)``), and ``_log(jnp.sum(x))`` passed because the
+``float()`` lives inside ``_log``, where its argument is an unknowable
+parameter. This version runs both directions through the module's call
+graph (:mod:`spatialflink_tpu.analysis.dataflow`, one-to-two levels of
+intra-module helpers):
+
+- **source summaries** — a call to a helper whose return value is
+  jax-rooted taints the value, so ``float()``/``bool()`` over it is a
+  finding at the sink;
+- **sink summaries** — passing a jax-rooted value into a helper
+  parameter that flows to a ``float()``/``bool()`` concretization
+  inside the (non-seam) helper is a finding at the call site.
+
 Everything else is a finding: either move the sync behind the seam,
-account it, or allowlist it with the reason a reviewer accepted.
+account it, or suppress it with the reviewed reason (allowlist entry or
+inline ``# analysis: allow(host-sync): …`` pragma).
 """
 
 from __future__ import annotations
 
 import ast
-from typing import Iterator
+from typing import Dict, Iterator, Optional, Set
 
+from spatialflink_tpu.analysis import dataflow
 from spatialflink_tpu.analysis.core import (Finding, ModuleSource, Rule,
                                             register)
 from spatialflink_tpu.analysis.rules.common import call_name, dotted
@@ -35,35 +53,12 @@ _SYNC_METHODS = {"item", "block_until_ready"}
 _HOST_LITERALS = (ast.List, ast.Tuple, ast.ListComp, ast.GeneratorExp,
                   ast.Dict, ast.DictComp, ast.Constant, ast.JoinedStr)
 
+_JAX_ROOTS = dataflow.JAX_ROOTS
 
-_JAX_ROOTS = {"jax", "jnp", "lax"}
 
-
-def _jax_rooted(mod: ModuleSource, expr: ast.AST) -> bool:
-    """Does ``expr`` visibly read a jax-produced value? True when the
-    subtree holds a call rooted at jax/jnp/lax, or a name bound from one
-    in an enclosing function. Deliberately under-approximate —
-    ``float()``/``bool()`` on configs and host math is everywhere and
-    fine; the dispatch-overlap histogram is the runtime backstop for
-    flows this cannot see."""
-    calls = [n for n in ast.walk(expr) if isinstance(n, ast.Call)]
-    names = {n.id for n in ast.walk(expr) if isinstance(n, ast.Name)}
-    for c in calls:
-        root = (dotted(c.func) or "").split(".")[0]
-        if root in _JAX_ROOTS:
-            return True
-    if not names:
-        return False
-    for fn in mod.enclosing_functions(expr):
-        for node in ast.walk(fn):
-            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
-                    and isinstance(node.targets[0], ast.Name) \
-                    and node.targets[0].id in names \
-                    and isinstance(node.value, ast.Call):
-                root = (dotted(node.value.func) or "").split(".")[0]
-                if root in _JAX_ROOTS:
-                    return True
-    return False
+def _seam_name(name: str) -> bool:
+    return name.startswith(("collect", "_defer")) \
+        or name.endswith("_host") or name == "finish"
 
 
 def _is_defer_call(node: ast.Call) -> bool:
@@ -85,15 +80,68 @@ def _fn_name(fn: ast.AST) -> str:
                                       ast.AsyncFunctionDef)) else "<lambda>"
 
 
+class _ModuleTaint:
+    """Per-module interprocedural context: the jax-returning helper set
+    and the sink-param summaries (seam helpers excluded — a sync inside
+    a seam is the accounted readback, not a leak)."""
+
+    def __init__(self, mod: ModuleSource, graph):
+        self.mod = mod
+        self.graph = graph
+        if graph is not None:
+            self.jax_fns: Set[str] = dataflow.jax_returning(graph)
+            self.sinks: Dict[str, Set[str]] = dataflow.sink_params(
+                graph, exclude=lambda info: _seam_name(info.name)
+                or _contains_note_readback(info.node))
+        else:
+            self.jax_fns = set()
+            self.sinks = {}
+
+    def _call_is_jax(self, call: ast.Call) -> bool:
+        root = (dotted(call.func) or "").split(".")[0]
+        if root in _JAX_ROOTS:
+            return True
+        if self.graph is None:
+            return False
+        callee = self.graph.resolve_local(call, call.func)
+        return callee is not None and callee.qualname in self.jax_fns
+
+    def jax_rooted(self, expr: ast.AST) -> bool:
+        """Does ``expr`` visibly read a jax-produced value? True when the
+        subtree holds a jax-rooted call (directly ``jnp.*``-style, or a
+        helper the summaries proved jax-returning), or a name bound from
+        one in an enclosing function. Deliberately under-approximate —
+        ``float()``/``bool()`` on configs and host math is everywhere
+        and fine; the dispatch-overlap histogram is the runtime backstop
+        for flows this cannot see."""
+        for c in ast.walk(expr):
+            if isinstance(c, ast.Call) and self._call_is_jax(c):
+                return True
+        names = {n.id for n in ast.walk(expr) if isinstance(n, ast.Name)}
+        if not names:
+            return False
+        for fn in self.mod.enclosing_functions(expr):
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name) \
+                        and node.targets[0].id in names \
+                        and isinstance(node.value, ast.Call) \
+                        and self._call_is_jax(node.value):
+                    return True
+        return False
+
+
 @register
 class HostSyncRule(Rule):
     id = "host-sync"
     contract = ("implicit device→host syncs on dispatch paths only inside "
                 "accounted readback seams (Deferred.finish / collect "
-                "closures / note_readback callers / *_host twins)")
+                "closures / note_readback callers / *_host twins), "
+                "tracked through intra-module helper calls")
     runtime_twin = ("readback counters + CostProfiles.note_readback "
                     "bytes_moved accounting; dispatch-overlap histogram")
     severity = "error"
+    depth = "interprocedural (intra-module taint, depth 2)"
     scope = ("spatialflink_tpu/operators/base.py",
              "spatialflink_tpu/ops/*.py",
              "spatialflink_tpu/parallel/*.py")
@@ -101,9 +149,7 @@ class HostSyncRule(Rule):
     def _in_seam(self, mod: ModuleSource, node: ast.AST) -> bool:
         fns = mod.enclosing_functions(node)
         for fn in fns:
-            name = _fn_name(fn)
-            if name.startswith(("collect", "_defer")) \
-                    or name.endswith("_host") or name == "finish":
+            if _seam_name(_fn_name(fn)):
                 return True
             if _contains_note_readback(fn):
                 return True
@@ -124,18 +170,24 @@ class HostSyncRule(Rule):
         # module-level code (imports/constants) never dispatches
         return not fns
 
-    def check(self, mod: ModuleSource) -> Iterator[Finding]:
+    def check(self, mod: ModuleSource,
+              project=None) -> Iterator[Finding]:
+        graph = project.graph(mod) if project is not None else None
+        taint = _ModuleTaint(mod, graph)
         for node in ast.walk(mod.tree):
             if not isinstance(node, ast.Call):
                 continue
-            msg = self._classify(mod, node)
+            msg = self._classify(taint, node)
+            if msg is None:
+                msg = self._classify_helper_sink(taint, node)
             if msg is None:
                 continue
             if self._in_seam(mod, node):
                 continue
             yield self.finding(mod, node, msg)
 
-    def _classify(self, mod: ModuleSource, node: ast.Call):
+    def _classify(self, taint: _ModuleTaint,
+                  node: ast.Call) -> Optional[str]:
         name = call_name(node)
         if isinstance(node.func, ast.Attribute) \
                 and node.func.attr in _SYNC_METHODS:
@@ -151,8 +203,30 @@ class HostSyncRule(Rule):
                     "— move it behind the Deferred/collect seam, account "
                     "it with note_readback, or allowlist with a reason")
         if name in ("float", "bool") and len(node.args) == 1 \
-                and _jax_rooted(mod, node.args[0]):
+                and taint.jax_rooted(node.args[0]):
             return (f"{name}() of a jax-produced value blocks on the "
                     "device — readbacks on dispatch paths must go "
-                    "through the accounted seams")
+                    "through the accounted seams (the value may arrive "
+                    "through a helper return — the taint follows it)")
+        return None
+
+    def _classify_helper_sink(self, taint: _ModuleTaint,
+                              node: ast.Call) -> Optional[str]:
+        """A jax-rooted value handed to a helper parameter that flows to
+        a float()/bool() sink inside the (non-seam) helper."""
+        if taint.graph is None:
+            return None
+        callee = taint.graph.resolve_local(node, node.func)
+        if callee is None:
+            return None
+        sink_names = taint.sinks.get(callee.qualname)
+        if not sink_names:
+            return None
+        for pname, arg in dataflow.map_call_args(callee.params, node).items():
+            if pname in sink_names and taint.jax_rooted(arg):
+                return (f"jax-produced value flows into {callee.name}() "
+                        f"parameter {pname!r}, which {callee.name} "
+                        "concretizes via float()/bool() — an implicit "
+                        "device→host sync one call level down; defer it "
+                        "into the collect seam or account it")
         return None

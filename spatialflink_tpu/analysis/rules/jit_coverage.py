@@ -29,7 +29,8 @@ class JitCoverageRule(Rule):
     severity = "error"
     scope = ("spatialflink_tpu/ops/*.py", "spatialflink_tpu/parallel/*.py")
 
-    def check(self, mod: ModuleSource) -> Iterator[Finding]:
+    def check(self, mod: ModuleSource,
+              project=None) -> Iterator[Finding]:
         for node in ast.walk(mod.tree):
             if isinstance(node, ast.Attribute) and node.attr == "jit" \
                     and isinstance(node.value, ast.Name) \

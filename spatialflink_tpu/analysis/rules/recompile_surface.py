@@ -1,0 +1,101 @@
+"""Rule 7 — recompile-surface: shape-determining kernel arguments come
+from the bucketing seams, proven statically.
+
+The engine's zero-recompile contract (padded window batches since the
+seed, the PR 9 power-of-two fleet buckets, the PR 10 sentinel that
+aborts on post-warmup compiles) hinges on one discipline: every value a
+jitted kernel treats as *static* — sizes like ``n``/``k``/``m``/
+``num_cells``/``tile`` that select an XLA program — must move through a
+finite set of shape classes. The runtime sentinel observes violations
+only on executed paths; this rule proves the discipline at every call
+site in the tree.
+
+Mechanics (the project call graph + shape-churn taint of
+:mod:`spatialflink_tpu.analysis.dataflow`):
+
+- every call that resolves — locally or across modules through the
+  import map — to an ``instrumented_jit``-decorated kernel is a checked
+  site; the kernel's ``static_argnames``/``static_argnums`` name the
+  static parameters, and the *shape-determining* subset is selected by
+  name (:data:`SHAPE_STATIC_PAT` — integer sizes, not mode flags like
+  ``approximate``/``strategy``/``interpret``);
+- the argument expression feeding each such static is classified:
+  constants, plain attribute chains (``self.grid.n`` — run-constant
+  geometry/config by convention), and caller parameters (the contract
+  hoists to the caller, which is itself a checked or padded site) are
+  churn-safe; ``bucket_size(...)`` sanitizes everything beneath it;
+- anything that reaches the static from a data-dependent source —
+  ``len(records)``, ``batch.xs.shape[0]``-style reads, arithmetic over
+  them, a local bound from one — WITHOUT passing through the bucketing
+  seam is a finding: that call site recompiles per distinct size, i.e.
+  per churn event, exactly what the padded-fleet helpers exist to
+  prevent.
+
+Blind spots (documented): values laundered through instance attributes
+(``self._n = len(...)`` then ``n=self._n``), kernels invoked through
+dynamic dispatch tables, and `*args` forwarding — the runtime sentinel
+remains the backstop for those.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from spatialflink_tpu.analysis import dataflow
+from spatialflink_tpu.analysis.core import (Finding, ModuleSource, Rule,
+                                            register)
+
+#: static parameter names that determine compiled shapes. Mode flags
+#: (approximate/strategy/enforce_radius/interpret/agg) take a few fixed
+#: values and are deliberately not matched.
+SHAPE_STATIC_PAT = re.compile(
+    r"^(n|m|k|b|q|tile|pad|npad|cap|capacity|size|length"
+    r"|num_\w+|\w+_size|\w+_len|min_bucket)$")
+
+
+@register
+class RecompileSurfaceRule(Rule):
+    id = "recompile-surface"
+    contract = ("every shape-determining static argument at an "
+                "instrumented_jit call site derives from the bucketing "
+                "seams (bucket_size / run-constant geometry / caller "
+                "params), never raw data-dependent sizes")
+    runtime_twin = ("recompile sentinel + --strict-recompile abort; "
+                    "fleet-churn jit cache-counter assertions "
+                    "(tests/test_queryplane.py)")
+    severity = "error"
+    depth = "interprocedural (cross-module call graph)"
+    interprocedural = True
+    scope = ("spatialflink_tpu/**",)
+
+    def check(self, mod: ModuleSource,
+              project=None) -> Iterator[Finding]:
+        if project is None:
+            from spatialflink_tpu.analysis.callgraph import Project
+
+            project = Project.of_module(mod)
+        graph = project.graph(mod)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            info = project.resolve_call(mod, node)
+            if info is None or not info.statics:
+                continue
+            argmap = dataflow.map_call_args(info.params, node)
+            for sname in sorted(info.statics):
+                if sname not in argmap \
+                        or not SHAPE_STATIC_PAT.match(sname):
+                    continue
+                src = dataflow.shape_churn_source(graph, argmap[sname],
+                                                  node)
+                if src is None:
+                    continue
+                yield self.finding(
+                    mod, node,
+                    f"static arg {sname!r} of kernel {info.name} is "
+                    f"data-dependent ({src}) and not bucketed — every "
+                    "distinct value compiles a fresh XLA program under "
+                    "churn; route it through bucket_size / the padded "
+                    "fleet so it repads instead of recompiling")

@@ -95,7 +95,8 @@ class TelemetryGatingRule(Rule):
              "spatialflink_tpu/runtime/windows.py",
              "spatialflink_tpu/operators/base.py")
 
-    def check(self, mod: ModuleSource) -> Iterator[Finding]:
+    def check(self, mod: ModuleSource,
+              project=None) -> Iterator[Finding]:
         session_names: Dict[ast.AST, Dict[str, Optional[str]]] = {
             fn: _session_names(fn) for fn in ast.walk(mod.tree)
             if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef,

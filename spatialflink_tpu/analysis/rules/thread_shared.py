@@ -1,31 +1,44 @@
 """Rule 6 — thread-shared-state: lock discipline on cross-thread
-classes.
+classes, interprocedural since PR 15.
 
 The opserver, reporter, control-topic, and LiveStats threads all read —
 and in the query plane's case write — state owned by the pipeline
-thread. Two checks:
+thread. Three checks:
 
-1. **Write discipline.** Any class that creates an instance lock in
-   ``__init__`` (``self._lock = threading.Lock()/RLock()/Condition()``)
-   has opted into lock-protected state; every instance-attribute write
-   in its other methods must happen under ``with self._lock`` (or in a
-   method documented as caller-locked: name ending ``_locked`` or a
-   docstring saying the lock is held).
-2. **Documented coverage.** The classes the architecture documents as
+1. **Write discipline with locksets.** Any class that creates an
+   instance lock in ``__init__`` (``self._lock = threading.Lock()/
+   RLock()/Condition()``) has opted into lock-protected state; every
+   instance-attribute write must happen while the lock is held. PR 12
+   proved this lexically (the write sits under ``with self._lock``);
+   this version follows calls: a *private* helper method whose
+   intra-class call sites ALL hold the lock (lexically, or because the
+   calling method itself is lock-held-on-entry — a fixpoint over the
+   class's self-call edges) is lock-held-on-entry, and its writes are
+   clean. A helper passed *by name* (``Thread(target=self._loop)``)
+   runs later without the caller's lock, so a by-name reference never
+   counts as a locked site. Public methods are never inferred — any
+   external caller can invoke them unlocked.
+2. **Caller-locked contract, both directions.** A method documented as
+   caller-locked (name ending ``_locked`` or a docstring saying the
+   lock is held) keeps its write exemption — but every intra-class call
+   site of it must now actually hold the lock; a ``_locked`` method
+   reached from an unlocked path is exactly the race the marker
+   pretends away, and PR 12 could not see it.
+3. **Documented coverage.** The classes the architecture documents as
    cross-thread — ``QueryRegistry``, ``EventRing``, ``MetricsRegistry``,
-   ``CheckpointCoordinator`` — must own an instance lock at all; a
-   documented-shared class with no lock is a finding even before any
-   write is inspected.
+   ``CheckpointCoordinator`` — must own an instance lock at all.
 
 Reads are deliberately out of scope (GIL-atomic snapshots of ints are
 this codebase's documented idiom); it is unsynchronized *writes* that
-corrupt dicts and deques.
+corrupt dicts and deques. Blind spots (documented in ARCHITECTURE.md):
+inherited methods, and external callers of ``_locked`` helpers in other
+modules.
 """
 
 from __future__ import annotations
 
 import ast
-from typing import Iterator, List, Optional
+from typing import Dict, Iterator, List, Optional, Set
 
 from spatialflink_tpu.analysis.core import (Finding, ModuleSource, Rule,
                                             register)
@@ -40,6 +53,7 @@ _LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
 _HELD_DOC_MARKERS = ("lock held", "lock is held", "caller holds",
                      "holds the lock", "under the lock",
                      "caller-locked")
+_EXEMPT = ("__init__", "__post_init__", "__new__")
 
 
 def _lock_attr(cls: ast.ClassDef) -> Optional[str]:
@@ -59,7 +73,7 @@ def _lock_attr(cls: ast.ClassDef) -> Optional[str]:
     return None
 
 
-def _caller_locked(meth: ast.FunctionDef) -> bool:
+def _caller_locked(meth: ast.AST) -> bool:
     if meth.name.endswith("_locked"):
         return True
     doc = ast.get_docstring(meth) or ""
@@ -67,17 +81,101 @@ def _caller_locked(meth: ast.FunctionDef) -> bool:
     return any(marker in low for marker in _HELD_DOC_MARKERS)
 
 
+def _under_lock(mod: ModuleSource, node: ast.AST, lock: str) -> bool:
+    """Is ``node`` lexically inside ``with self.<lock>`` within its own
+    method? (A lock taken by a caller is handled by the lockset, not
+    here.)"""
+    for anc in mod.ancestors(node):
+        if isinstance(anc, ast.With):
+            for item in anc.items:
+                expr = item.context_expr
+                name = dotted(expr) if not isinstance(expr, ast.Call) \
+                    else dotted(expr.func)
+                if name in (f"self.{lock}", f"self.{lock}.acquire"):
+                    return True
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and isinstance(mod.parent(anc), ast.ClassDef):
+            return False
+    return False
+
+
+class _Lockset:
+    """Per-class lock-held-on-entry computation over the intra-class
+    self-call edges of the project call graph."""
+
+    def __init__(self, mod: ModuleSource, graph, cls: ast.ClassDef,
+                 lock: str):
+        self.mod = mod
+        self.cls = cls
+        self.lock = lock
+        self.methods: Dict[str, ast.AST] = {
+            m.name: m for m in cls.body
+            if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        self.marked: Set[str] = {n for n, m in self.methods.items()
+                                 if _caller_locked(m)}
+        #: callee method name -> intra-class sites (calls + by-name refs)
+        self.sites = graph.class_sites(cls.name) if graph is not None \
+            else {}
+        self.held = self._fixpoint()
+
+    def _private(self, name: str) -> bool:
+        return name.startswith("_") and not name.startswith("__")
+
+    def _site_locked(self, site, held: Set[str]) -> bool:
+        if site.deferred:
+            return False  # by-name: runs later, outside the with-block
+        if _under_lock(self.mod, site.node, self.lock):
+            return True
+        caller = site.caller
+        return caller is not None and caller.cls == self.cls.name \
+            and caller.name in held
+
+    def _fixpoint(self) -> Set[str]:
+        """Greatest fixpoint: start from every candidate (marked, or
+        private with at least one intra-class site) and demote any
+        method with an unlocked site until stable. Marked methods stay —
+        their contract is asserted, and check 2 audits it."""
+        held = set(self.marked) | {
+            n for n in self.methods
+            if self._private(n) and self.sites.get(n)}
+        while True:
+            demote = {
+                n for n in held - self.marked
+                if not all(self._site_locked(s, held)
+                           for s in self.sites.get(n, ()))}
+            if not demote:
+                return held
+            held -= demote
+
+    def write_ok(self, meth: ast.AST, stmt: ast.stmt) -> bool:
+        return meth.name in self.held \
+            or _under_lock(self.mod, stmt, self.lock)
+
+    def unlocked_marked_sites(self):
+        """(method name, site) for every intra-class call of a
+        caller-locked method from a path that does not hold the lock —
+        check 2's finding sites."""
+        for name in sorted(self.marked):
+            for site in self.sites.get(name, ()):
+                if not self._site_locked(site, self.held):
+                    yield name, site
+
+
 @register
 class ThreadSharedStateRule(Rule):
     id = "thread-shared-state"
     contract = ("cross-thread classes own an instance lock and write "
-                "instance state only while holding it")
+                "instance state only on lock-held paths (lexical with, "
+                "or a helper whose every call site holds the lock)")
     runtime_twin = ("liveops/queryplane concurrency tests (mid-run HTTP "
                     "mutation under --chaos)")
     severity = "error"
+    depth = "interprocedural (intra-class locksets)"
     scope = ("spatialflink_tpu/**",)
 
-    def check(self, mod: ModuleSource) -> Iterator[Finding]:
+    def check(self, mod: ModuleSource,
+              project=None) -> Iterator[Finding]:
+        graph = project.graph(mod) if project is not None else None
         for cls in ast.walk(mod.tree):
             if not isinstance(cls, ast.ClassDef):
                 continue
@@ -91,16 +189,25 @@ class ThreadSharedStateRule(Rule):
                         "opserver/reporter/control threads race the "
                         "pipeline) or allowlist with the reviewed reason")
                 continue
-            yield from self._check_writes(mod, cls, lock)
+            lockset = _Lockset(mod, graph, cls, lock)
+            yield from self._check_writes(mod, cls, lock, lockset)
+            for name, site in lockset.unlocked_marked_sites():
+                how = "passed by name (runs without the caller's lock)" \
+                    if site.deferred else "called"
+                yield self.finding(
+                    mod, site.node,
+                    f"caller-locked method {cls.name}.{name} is {how} "
+                    f"from a path that does not hold self.{lock} — the "
+                    "_locked contract says every caller must; take the "
+                    "lock at this site or drop the marker")
 
     def _check_writes(self, mod: ModuleSource, cls: ast.ClassDef,
-                      lock: str) -> Iterator[Finding]:
+                      lock: str, lockset: _Lockset) -> Iterator[Finding]:
         for meth in cls.body:
             if not isinstance(meth, (ast.FunctionDef,
                                      ast.AsyncFunctionDef)):
                 continue
-            if meth.name in ("__init__", "__post_init__", "__new__") \
-                    or _caller_locked(meth):
+            if meth.name in _EXEMPT:
                 continue
             for stmt in ast.walk(meth):
                 if not isinstance(stmt, (ast.Assign, ast.AugAssign,
@@ -109,31 +216,16 @@ class ThreadSharedStateRule(Rule):
                 for attr, node in attr_write_targets(stmt):
                     if attr == lock:
                         continue
-                    if self._under_lock(mod, stmt, lock):
+                    if lockset.write_ok(meth, stmt):
                         continue
                     yield self.finding(
                         mod, node,
-                        f"write to self.{attr} outside `with self.{lock}` "
-                        f"in lock-disciplined class {cls.name} — "
-                        "cross-thread writes must hold the instance lock "
-                        "(or mark the method caller-locked)")
-
-    def _under_lock(self, mod: ModuleSource, stmt: ast.stmt,
-                    lock: str) -> bool:
-        for anc in mod.ancestors(stmt):
-            if isinstance(anc, ast.With):
-                for item in anc.items:
-                    expr = item.context_expr
-                    # `with self._lock:` or `with self._lock.acquire…`
-                    name = dotted(expr) if not isinstance(expr, ast.Call) \
-                        else dotted(expr.func)
-                    if name in (f"self.{lock}", f"self.{lock}.acquire"):
-                        return True
-            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                # stop at the method boundary — a lock taken by a caller
-                # is invisible here and must be declared via _locked
-                return False
-        return False
+                        f"write to self.{attr} on an unlocked path in "
+                        f"lock-disciplined class {cls.name} — hold "
+                        f"self.{lock} here, or make every call site of "
+                        f"{meth.name} lock-held (private helpers infer "
+                        "it; public methods and by-name references "
+                        "cannot)")
 
 
 def documented_classes() -> List[str]:

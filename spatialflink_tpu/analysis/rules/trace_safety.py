@@ -88,7 +88,8 @@ class TraceSafetyRule(Rule):
     severity = "error"
     scope = ("spatialflink_tpu/**",)
 
-    def check(self, mod: ModuleSource) -> Iterator[Finding]:
+    def check(self, mod: ModuleSource,
+              project=None) -> Iterator[Finding]:
         for fn in ast.walk(mod.tree):
             if not isinstance(fn, ast.FunctionDef):
                 continue

@@ -294,21 +294,28 @@ def preflight(require_backend: str = "tpu", as_json: bool = False,
 
         rep = run_analysis()
         rep_doc = rep.to_dict()
+        by_rule = rep_doc["findings_by_rule"]
         analysis_summary = {
             "ok": rep.ok,
             "findings": len(rep_doc["findings"]),
-            "allowlisted": len(rep_doc["allowlisted"]),
+            "findings_by_rule": by_rule,
+            "allowlisted": len(rep_doc["allowlisted"])
+            + len(rep_doc["pragma_allowlisted"]),
             "stale_allowlist_entries": len(
                 rep_doc["stale_allowlist_entries"]),
+            "stale_pragmas": len(rep_doc["stale_pragmas"]),
             "files": rep_doc["files"],
             "rules": rep_doc["rules"],
         }
-        stale = analysis_summary["stale_allowlist_entries"]
+        stale = analysis_summary["stale_allowlist_entries"] \
+            + analysis_summary["stale_pragmas"]
+        dirty = ", ".join(f"{r}={n}" for r, n in sorted(by_rule.items())
+                          if n) or "all rules clean"
         check("static_analysis", rep.ok,
-              f"{analysis_summary['findings']} non-allowlisted "
-              f"finding(s), {analysis_summary['allowlisted']} allowlisted,"
-              f" {stale} stale allowlist entr"
-              f"{'y' if stale == 1 else 'ies'} across "
+              f"per-rule findings: {dirty}; "
+              f"{analysis_summary['allowlisted']} allowlisted,"
+              f" {stale} stale suppression"
+              f"{'' if stale == 1 else 's'} across "
               f"{analysis_summary['files']} file(s)"
               + ("" if rep.ok else
                  " — run `python -m spatialflink_tpu.analysis --check`"))

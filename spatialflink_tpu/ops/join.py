@@ -220,7 +220,7 @@ def adaptive_block_min_cells() -> int:
             cell=np.zeros(n, np.int32), pad=n)
 
     def run(a, b):
-        np.asarray(join_mask(a, b, 0.1, 4, 0.5, 0.5, n=4))
+        np.asarray(join_mask(a, b, 0.1, 4, 0.5, 0.5, n=4))  # analysis: allow(host-sync): one-shot per-process calibration probe — the blocking readback IS the measurement (per-dispatch cost floor for the join block coalescer)
 
     sa, sb = batch(256), batch(128)
     ba, bb = batch(4096), batch(1024)

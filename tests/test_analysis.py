@@ -71,14 +71,28 @@ class TestTreeGate:
         # apply() ran inside full_report; nothing stale
         assert not full_report.stale
 
-    def test_all_six_invariant_rules_registered(self):
+    def test_all_seven_invariant_rules_registered(self):
         ids = {r.id for r in analysis.all_rules()}
         assert {"jit-coverage", "trace-safety", "host-sync",
                 "telemetry-gating", "checkpoint-coverage",
-                "thread-shared-state"} <= ids
+                "thread-shared-state", "recompile-surface"} <= ids
         # the built-in bug-class lints ride along
         assert {"unused-import", "fstring-placeholder",
                 "is-literal"} <= ids
+
+    def test_depth_column_documented(self):
+        """Every rule carries the depth the docs table renders; the four
+        deep rules declare themselves interprocedural."""
+        by_id = {r.id: r for r in analysis.all_rules()}
+        for rid in ("thread-shared-state", "checkpoint-coverage",
+                    "host-sync", "recompile-surface"):
+            assert by_id[rid].depth.startswith("interprocedural"), rid
+        for rid in ("jit-coverage", "telemetry-gating", "trace-safety",
+                    "unused-import"):
+            assert by_id[rid].depth == "lexical", rid
+        # only cross-MODULE analysis widens the cache key to the tree
+        assert by_id["recompile-surface"].interprocedural
+        assert not by_id["thread-shared-state"].interprocedural
 
     def test_scan_covers_the_engine_tree(self, full_report):
         assert full_report.files >= 60  # the whole package, not a subdir
@@ -380,13 +394,19 @@ class TestCheckpointCoverageRule:
         fs = check_source(self.BAD, "spatialflink_tpu/runtime/x.py")
         assert "checkpoint-coverage" in _ids(fs)
 
-    def test_pair_present_is_clean(self):
+    def test_pair_present_and_covering_is_clean(self):
+        """Since the field-level upgrade the pair must actually COVER the
+        state attrs — a snapshot/restore that reads/assigns them all is
+        clean (the merely-existing pair is TestFieldCoverage's bad
+        fixture in test_analysis_interproc.py)."""
         src = self.BAD + textwrap.dedent("""
             def snapshot(self):
-                return {}, {"windows": list(self.windows)}
+                return {}, {"windows": list(self.windows),
+                            "wm": self.watermark}
 
             def restore(self, state, decode):
-                pass
+                self.windows = dict(state["windows"])
+                self.watermark = state["wm"]
             """).replace("\n", "\n    ")
         fs = check_source(src, "spatialflink_tpu/runtime/x.py")
         assert "checkpoint-coverage" not in _ids(fs)
@@ -690,6 +710,12 @@ class TestPreflightIntegration:
         assert doc["analysis"]["ok"] is True
         assert doc["analysis"]["findings"] == 0
         assert doc["analysis"]["files"] >= 60
+        # per-rule finding counts, not one opaque total: every ran rule
+        # reports (zero, on a clean tree)
+        by_rule = doc["analysis"]["findings_by_rule"]
+        assert set(by_rule) >= set(doc["analysis"]["rules"])
+        assert all(n == 0 for n in by_rule.values())
+        assert doc["analysis"]["stale_pragmas"] == 0
 
     def test_preflight_fails_on_dirty_tree(self, tmp_path, monkeypatch,
                                            capsys):

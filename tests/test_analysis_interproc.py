@@ -1,0 +1,913 @@
+"""Interprocedural dataflow layer of the invariant linter (PR 15).
+
+Four families of tests, each proving a *depth* upgrade over the PR 12
+lexical rules — every "bad" fixture here is one the lexical version
+provably missed (or wrongly flagged), asserted as regression fixtures:
+
+- **call graph** — the three resolution rules (module-level names with
+  shadowing, self-methods, by-name callbacks) and cross-module import
+  resolution;
+- **deep rules** — lockset thread-shared-state, field-level
+  checkpoint-coverage, taint-through-helpers host-sync, and the new
+  recompile-surface proof;
+- **suppression** — the inline ``# analysis: allow(rule): reason``
+  pragma lifecycle (suppress + stale ratchet + malformed errors) next
+  to the ALLOWLIST.toml one;
+- **infrastructure** — SARIF output, the per-module findings cache
+  (byte-identical + measurably faster warm pass), per-rule counts in
+  ``doctor --preflight``.
+"""
+
+import ast
+import json
+import textwrap
+import time
+
+import pytest
+
+from spatialflink_tpu.analysis import check_source, run_analysis
+from spatialflink_tpu.analysis.callgraph import ModuleGraph, Project
+from spatialflink_tpu.analysis.core import REPO_ROOT, ModuleSource
+from spatialflink_tpu.analysis import dataflow
+
+pytestmark = pytest.mark.analysis
+
+
+def _ids(findings):
+    return [f.rule for f in findings]
+
+
+def _mod(source, relpath="spatialflink_tpu/utils/x.py"):
+    return ModuleSource.from_source(textwrap.dedent(source), relpath)
+
+
+def _calls_of(graph, name):
+    return [s for s in graph.calls if s.callee.name == name]
+
+
+# --------------------------------------------------------------------- #
+# call-graph resolution rules
+
+
+class TestCallGraphResolution:
+    def test_module_level_name_resolves(self):
+        g = ModuleGraph(_mod("""
+            def helper():
+                return 1
+
+            def main():
+                return helper()
+            """))
+        sites = _calls_of(g, "helper")
+        assert len(sites) == 1
+        assert sites[0].kind == "direct"
+        assert sites[0].caller.name == "main"
+
+    def test_import_after_def_shadows(self):
+        """Last top-level binding wins: an import below the def re-binds
+        the name, so the call must NOT resolve to the local def."""
+        g = ModuleGraph(_mod("""
+            def helper():
+                return 1
+
+            from os.path import join as helper
+
+            def main():
+                return helper()
+            """))
+        assert not _calls_of(g, "helper")
+
+    def test_def_after_import_shadows_import(self):
+        g = ModuleGraph(_mod("""
+            from os.path import join as helper
+
+            def helper():
+                return 1
+
+            def main():
+                return helper()
+            """))
+        assert len(_calls_of(g, "helper")) == 1
+
+    def test_local_rebinding_shadows(self):
+        """A function-local assignment of the name hides the module
+        function for calls inside that function."""
+        g = ModuleGraph(_mod("""
+            def helper():
+                return 1
+
+            def main(helper):
+                return helper()
+            """))
+        assert not _calls_of(g, "helper")
+
+    def test_self_method_edge(self):
+        g = ModuleGraph(_mod("""
+            class C:
+                def a(self):
+                    return self.b()
+
+                def b(self):
+                    return 1
+            """))
+        sites = _calls_of(g, "b")
+        assert len(sites) == 1
+        assert sites[0].kind == "self"
+        assert sites[0].callee.qualname == "C.b"
+        assert sites[0].caller.qualname == "C.a"
+
+    def test_by_name_callback_edge_is_deferred(self):
+        g = ModuleGraph(_mod("""
+            import threading
+
+            class C:
+                def _loop(self):
+                    return 1
+
+                def start(self):
+                    return threading.Thread(target=self._loop)
+            """))
+        sites = _calls_of(g, "_loop")
+        assert len(sites) == 1
+        assert sites[0].kind == "by-name" and sites[0].deferred
+
+    def test_cross_module_from_import(self, tmp_path):
+        pkg = tmp_path / "spatialflink_tpu"
+        (pkg / "ops").mkdir(parents=True)
+        (pkg / "ops" / "k.py").write_text(
+            "from spatialflink_tpu.utils.deviceplane import "
+            "instrumented_jit\n\n"
+            "@instrumented_jit\ndef kernel(x):\n    return x\n")
+        (pkg / "ops" / "u.py").write_text(
+            "from spatialflink_tpu.ops.k import kernel\n\n"
+            "def use(b):\n    return kernel(b)\n")
+        mods = [ModuleSource(str(pkg / "ops" / n),
+                             f"spatialflink_tpu/ops/{n}",
+                             (pkg / "ops" / n).read_text())
+                for n in ("k.py", "u.py")]
+        proj = Project(mods)
+        use_mod = mods[1]
+        call = next(n for n in ast.walk(use_mod.tree)
+                    if isinstance(n, ast.Call)
+                    and getattr(n.func, "id", "") == "kernel")
+        info = proj.resolve_call(use_mod, call)
+        assert info is not None and info.is_kernel
+        assert info.module == "spatialflink_tpu/ops/k.py"
+
+    def test_module_alias_attribute_call(self):
+        mod = _mod("""
+            def helper():
+                return 1
+            """, "spatialflink_tpu/ops/a.py")
+        user = _mod("""
+            import spatialflink_tpu.ops.a as A
+
+            def main():
+                return A.helper()
+            """, "spatialflink_tpu/ops/b.py")
+        proj = Project([mod, user])
+        call = next(n for n in ast.walk(user.tree)
+                    if isinstance(n, ast.Call))
+        info = proj.resolve_call(user, call)
+        assert info is not None and info.name == "helper"
+
+
+# --------------------------------------------------------------------- #
+# deep rule 1: lockset thread-shared-state
+
+
+LOCKED_CLASS = """
+    import threading
+
+    class Ring:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.total = 0
+
+        def append(self, ev):
+            with self._lock:
+                self._bump()
+
+        def _bump(self):
+            self.total += 1
+    """
+
+
+class TestLocksetRule:
+    SCOPE = "spatialflink_tpu/utils/x.py"
+
+    def _check(self, src):
+        return [f for f in check_source(textwrap.dedent(src), self.SCOPE)
+                if f.rule == "thread-shared-state"]
+
+    def test_helper_reached_only_under_lock_is_clean(self):
+        """PR 12 flagged this (write not lexically under `with`); the
+        lockset proves every call site holds the lock."""
+        assert not self._check(LOCKED_CLASS)
+
+    def test_helper_with_one_unlocked_site_is_flagged(self):
+        fs = self._check(LOCKED_CLASS + """
+        def poke(self):
+            self._bump()
+    """)
+        assert fs and "unlocked path" in fs[0].message
+
+    def test_two_hop_lock_inference(self):
+        """_outer is locked at its only site; _bump is called only from
+        _outer — the fixpoint proves both."""
+        assert not self._check("""
+            import threading
+
+            class Ring:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def append(self, ev):
+                    with self._lock:
+                        self._outer()
+
+                def _outer(self):
+                    self._bump()
+
+                def _bump(self):
+                    self.total = 1
+            """)
+
+    def test_public_method_never_inferred(self):
+        """A public method's writes need the lexical lock even if every
+        intra-class call site holds it — external callers exist."""
+        fs = self._check("""
+            import threading
+
+            class Ring:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def drive(self):
+                    with self._lock:
+                        self.bump()
+
+                def bump(self):
+                    self.total = 1
+            """)
+        assert fs and "self.total" in fs[0].message
+
+    def test_locked_suffix_called_from_unlocked_path(self):
+        """THE bug PR 12 provably missed: _locked methods were exempt
+        from the write check AND nobody audited their call sites."""
+        fs = self._check("""
+            import threading
+
+            class Ring:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def _flush_locked(self):
+                    self.total = 0
+
+                def flush(self):
+                    self._flush_locked()
+            """)
+        assert fs and "caller-locked" in fs[0].message
+        # regression half: the lexical write-check alone sees nothing
+        # here (the only write sits in an exempt _locked method)
+        assert all("caller-locked" in f.message for f in fs)
+
+    def test_locked_suffix_called_under_lock_is_clean(self):
+        assert not self._check("""
+            import threading
+
+            class Ring:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def _flush_locked(self):
+                    self.total = 0
+
+                def flush(self):
+                    with self._lock:
+                        self._flush_locked()
+            """)
+
+    def test_by_name_reference_never_counts_as_locked(self):
+        """Passing self._loop by name (a thread target) runs it later
+        without the with-block — the helper stays unlocked even though
+        the reference site holds the lock."""
+        fs = self._check("""
+            import threading
+
+            class Ring:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def _loop(self):
+                    self.total = 1
+
+                def start(self):
+                    with self._lock:
+                        self.t = threading.Thread(target=self._loop)
+            """)
+        assert any("self.total" in f.message for f in fs)
+
+
+# --------------------------------------------------------------------- #
+# deep rule 2: field-level checkpoint coverage
+
+
+PAIRED = """
+    class Assembler:
+        def __init__(self):
+            self.windows = {}
+            self.pane_ring = []
+
+        def add(self, rec):
+            self.windows[rec.key] = rec
+            self.pane_ring.append(rec)
+
+        def snapshot(self, encode):
+            return {"windows": dict(self.windows),
+                    "panes": list(self.pane_ring)}
+
+        def restore(self, state, decode):
+            self.windows = dict(state["windows"])
+            self.pane_ring = list(state["panes"])
+    """
+
+
+class TestFieldCoverage:
+    SCOPE = "spatialflink_tpu/runtime/x.py"
+
+    def _check(self, src):
+        return [f for f in check_source(textwrap.dedent(src), self.SCOPE)
+                if f.rule == "checkpoint-coverage"]
+
+    def test_covered_pair_is_clean(self):
+        assert not self._check(PAIRED)
+
+    def test_forgotten_pane_ring_in_snapshot(self):
+        """THE bug PR 12 provably missed: the pair exists, but the new
+        pane ring never made it into snapshot() — the lexical rule only
+        checked method presence."""
+        src = PAIRED.replace(',\n                    "panes": '
+                             'list(self.pane_ring)', '')
+        fs = self._check(src)
+        assert fs and "pane_ring" in fs[0].message
+        assert "never read in snapshot()" in fs[0].message
+
+    def test_forgotten_field_in_restore(self):
+        src = PAIRED.replace(
+            "            self.pane_ring = list(state[\"panes\"])\n", "")
+        fs = self._check(src)
+        assert fs and "never assigned in restore()" in fs[0].message
+
+    def test_container_mutation_counts_as_state_write(self):
+        """`self.windows[k] = v` / `.append` made the class look
+        stateless to PR 12's attr-assign detector."""
+        fs = self._check("""
+            class Grower:
+                def __init__(self):
+                    self.windows = {}
+
+                def add(self, rec):
+                    self.windows[rec.key] = rec
+            """)
+        assert fs and "lacks snapshot and restore" in fs[0].message
+
+    def test_snapshot_via_helper_counts(self):
+        """snapshot() delegating to a self-method still covers the field
+        (call-graph reach, depth 3)."""
+        assert not self._check("""
+            class Assembler:
+                def __init__(self):
+                    self.windows = {}
+
+                def add(self, rec):
+                    self.windows[rec.key] = rec
+
+                def _encode_windows(self):
+                    return dict(self.windows)
+
+                def snapshot(self, encode):
+                    return {"windows": self._encode_windows()}
+
+                def restore(self, state, decode):
+                    self.windows = dict(state["windows"])
+            """)
+
+    def test_classmethod_restore_is_exempt(self):
+        """Constructor-style restore (TrajStateStore idiom) builds a
+        fresh instance — field flow through cls(...) is a documented
+        blind spot, not a finding."""
+        assert not self._check("""
+            class Store:
+                def __init__(self):
+                    self.offsets = {}
+
+                def add(self, rec):
+                    self.offsets[rec.p] = rec.o
+
+                def snapshot(self):
+                    return {"offsets": dict(self.offsets)}
+
+                @classmethod
+                def restore(cls, state):
+                    st = cls()
+                    return st
+            """)
+
+    def test_queryplane_registry_state_in_scope(self):
+        """The _STATE_PAT fix: fleet/entries/specs/staged attrs (mutated
+        via container ops) now require the pair — PR 12 grandfathered
+        the whole query plane."""
+        fs = self._check("""
+            class Registry:
+                def __init__(self):
+                    self._fleet = []
+                    self._entries = {}
+
+                def admit(self, q):
+                    self._entries[q.id] = q
+                    self._fleet.append(q.id)
+            """)
+        assert fs
+        msg = fs[0].message
+        assert "_fleet" in msg and "_entries" in msg
+
+    def test_dict_update_restore_covers_everything(self):
+        assert not self._check("""
+            class Assembler:
+                def __init__(self):
+                    self.windows = {}
+
+                def add(self, rec):
+                    self.windows[rec.key] = rec
+
+                def snapshot(self, encode):
+                    return dict(self.__dict__)
+
+                def restore(self, state, decode):
+                    self.__dict__.update(state)
+            """)
+
+
+# --------------------------------------------------------------------- #
+# deep rule 3: host-sync taint through helpers
+
+
+class TestHostSyncTaint:
+    SCOPE = "spatialflink_tpu/ops/x.py"
+
+    def _check(self, src):
+        return [f for f in check_source(textwrap.dedent(src), self.SCOPE)
+                if f.rule == "host-sync"]
+
+    def test_float_of_jax_returning_helper(self):
+        """THE flow PR 12 provably missed: float()'s argument is
+        lexically a plain call, but _total returns jnp.sum(x)."""
+        fs = self._check("""
+            import jax.numpy as jnp
+
+            def _total(x):
+                return jnp.sum(x)
+
+            def dispatch(x):
+                return float(_total(x))
+            """)
+        assert fs and "float()" in fs[0].message
+
+    def test_two_level_helper_chain(self):
+        fs = self._check("""
+            import jax.numpy as jnp
+
+            def _inner(x):
+                return jnp.sum(x)
+
+            def _outer(x):
+                return _inner(x)
+
+            def dispatch(x):
+                return float(_outer(x))
+            """)
+        assert fs and "float()" in fs[0].message
+
+    def test_jax_value_into_helper_sink_param(self):
+        """The other direction: the float() hides inside the helper; the
+        call site feeding it a jax value is the finding."""
+        fs = self._check("""
+            import jax.numpy as jnp
+
+            def _log(v, out):
+                out.append(float(v))
+
+            def dispatch(x, out):
+                _log(jnp.sum(x), out)
+            """)
+        assert fs and "_log" in fs[0].message and "parameter 'v'" \
+            in fs[0].message
+
+    def test_host_helper_return_is_clean(self):
+        assert not self._check("""
+            def _total(xs):
+                return sum(xs)
+
+            def dispatch(xs):
+                return float(_total(xs))
+            """)
+
+    def test_seam_helper_sink_param_is_clean(self):
+        """A collect*/_defer*/*_host helper IS the accounted readback
+        seam — feeding it jax values is the design, not a leak."""
+        assert not self._check("""
+            import jax.numpy as jnp
+
+            def collect_total(v):
+                return float(v)
+
+            def finish(x):
+                return collect_total(jnp.sum(x))
+            """)
+
+    def test_sink_call_inside_seam_function_is_clean(self):
+        assert not self._check("""
+            import jax.numpy as jnp
+
+            def _total(x):
+                return jnp.sum(x)
+
+            def merge_host(x):
+                return float(_total(x))
+            """)
+
+
+# --------------------------------------------------------------------- #
+# deep rule 4 (new): recompile-surface
+
+
+KERNEL_PREAMBLE = """
+    from functools import partial
+    from spatialflink_tpu.utils.deviceplane import instrumented_jit
+    from spatialflink_tpu.utils.padding import bucket_size
+
+    @partial(instrumented_jit, static_argnames=("n",))
+    def kernel(x, n):
+        return x[:n]
+    """
+
+
+class TestRecompileSurface:
+    SCOPE = "spatialflink_tpu/ops/x.py"
+
+    def _check(self, body, scope=None):
+        src = textwrap.dedent(KERNEL_PREAMBLE) + textwrap.dedent(body)
+        return [f for f in check_source(src, scope or self.SCOPE)
+                if f.rule == "recompile-surface"]
+
+    def test_raw_len_static_is_flagged(self):
+        """The deliberately unbucketed kernel call of the acceptance
+        bar: n follows the record count, so every distinct chunk size
+        compiles a fresh XLA program. Invisible to every PR 12 rule."""
+        fs = self._check("""
+            def dispatch(records, batch):
+                return kernel(batch, n=len(records))
+            """)
+        assert fs and "data-dependent (len(...))" in fs[0].message
+
+    def test_bucketed_len_is_clean(self):
+        assert not self._check("""
+            def dispatch(records, batch):
+                return kernel(batch, n=bucket_size(len(records)))
+            """)
+
+    def test_shape_read_static_is_flagged(self):
+        fs = self._check("""
+            def dispatch(records, batch):
+                return kernel(batch, n=batch.shape[0])
+            """)
+        assert fs and ".shape" in fs[0].message
+
+    def test_taint_through_local_name(self):
+        fs = self._check("""
+            def dispatch(records, batch):
+                m = len(records)
+                return kernel(batch, n=m)
+            """)
+        assert fs
+
+    def test_bucketed_local_name_is_clean(self):
+        assert not self._check("""
+            def dispatch(records, batch):
+                m = bucket_size(len(records))
+                return kernel(batch, n=m)
+            """)
+
+    def test_caller_param_is_contract(self):
+        """A static fed from the enclosing function's parameter hoists
+        the obligation to the caller (the repo's `k=k` idiom)."""
+        assert not self._check("""
+            def dispatch(batch, n):
+                return kernel(batch, n=n)
+            """)
+
+    def test_run_constant_attribute_is_clean(self):
+        assert not self._check("""
+            def dispatch(self_like, batch):
+                return kernel(batch, n=self_like.grid.n)
+            """)
+
+    def test_mode_flag_statics_are_not_shape(self):
+        """strategy/approximate-style statics take a few fixed values —
+        only size-like names are churn surface."""
+        src = """
+            from functools import partial
+            from spatialflink_tpu.utils.deviceplane import instrumented_jit
+
+            @partial(instrumented_jit, static_argnames=("strategy",))
+            def kernel2(x, strategy):
+                return x
+
+            def dispatch(batch, conf):
+                return kernel2(batch, strategy=conf.pick())
+            """
+        fs = [f for f in check_source(textwrap.dedent(src), self.SCOPE)
+              if f.rule == "recompile-surface"]
+        assert not fs
+
+    def test_cross_module_call_site(self, tmp_path):
+        """Kernel in ops/, unbucketed call in operators/ — only the
+        project-wide graph can see it; injected via run_analysis."""
+        pkg = tmp_path / "spatialflink_tpu"
+        (pkg / "ops").mkdir(parents=True)
+        (pkg / "operators").mkdir(parents=True)
+        (pkg / "ops" / "k.py").write_text(textwrap.dedent("""
+            from functools import partial
+            from spatialflink_tpu.utils.deviceplane import instrumented_jit
+
+            @partial(instrumented_jit, static_argnames=("n",))
+            def kernel(x, n):
+                return x[:n]
+            """))
+        (pkg / "operators" / "u.py").write_text(textwrap.dedent("""
+            from spatialflink_tpu.ops.k import kernel
+
+            def evaluate(records, batch):
+                return kernel(batch, n=len(records))
+            """))
+        report = run_analysis(root=str(tmp_path), allowlist=None,
+                              cache=None,
+                              rule_ids=["recompile-surface"])
+        assert [f.rule for f in report.findings] == ["recompile-surface"]
+        assert report.findings[0].path == "spatialflink_tpu/operators/u.py"
+
+    def test_real_tree_is_clean_for_recompile_surface(self):
+        report = run_analysis(rule_ids=["recompile-surface"],
+                              allowlist=None, cache=None)
+        assert not report.findings, \
+            "\n".join(f.render() for f in report.findings)
+
+
+# --------------------------------------------------------------------- #
+# dataflow unit coverage
+
+
+class TestDataflowCores:
+    def test_jax_returning_depth(self):
+        g = ModuleGraph(_mod("""
+            import jax.numpy as jnp
+
+            def a(x):
+                return jnp.sum(x)
+
+            def b(x):
+                return a(x)
+
+            def c(xs):
+                return sum(xs)
+            """))
+        fns = dataflow.jax_returning(g)
+        assert {"a", "b"} <= fns and "c" not in fns
+
+    def test_sink_params_transitive(self):
+        g = ModuleGraph(_mod("""
+            def inner(v):
+                return float(v)
+
+            def outer(w):
+                return inner(w)
+            """))
+        sinks = dataflow.sink_params(g)
+        assert sinks["inner"] == {"v"} and sinks["outer"] == {"w"}
+
+
+# --------------------------------------------------------------------- #
+# inline pragma lifecycle (the line-anchored ratchet)
+
+
+def _tree(tmp_path, source, name="streams/bad.py"):
+    target = tmp_path / "spatialflink_tpu" / name
+    target.parent.mkdir(parents=True, exist_ok=True)
+    target.write_text(textwrap.dedent(source))
+    return str(tmp_path)
+
+
+UNGATED = """
+    from spatialflink_tpu.utils import telemetry as _t
+
+
+    def drive(stream):
+        tel = _t.active()
+        tel.observe('ingest', 1.0){pragma}
+    """
+
+
+class TestPragmaLifecycle:
+    def test_pragma_suppresses_on_its_line(self, tmp_path):
+        root = _tree(tmp_path, UNGATED.format(
+            pragma="  # analysis: allow(telemetry-gating): fixture —"
+                   " reviewed, gate lives one frame up"))
+        report = run_analysis(root=root, allowlist=None, cache=None)
+        assert report.ok
+        assert len(report.pragma_suppressed) == 1
+        f, p = report.pragma_suppressed[0]
+        assert f.rule == "telemetry-gating"
+        assert "reviewed" in p.reason
+
+    def test_pragma_on_wrong_line_does_not_suppress(self, tmp_path):
+        root = _tree(tmp_path, UNGATED.format(pragma="") +
+                     "# analysis: allow(telemetry-gating): wrong line\n")
+        report = run_analysis(root=root, allowlist=None, cache=None)
+        assert not report.ok
+        rules = {f.rule for f in report.findings}
+        assert "telemetry-gating" in rules
+        assert report.stale_pragmas  # and the pragma itself is stale
+
+    def test_stale_pragma_fails_check(self, tmp_path):
+        """The ratchet: fix the finding, the pragma must go too."""
+        root = _tree(tmp_path,
+                     "X = 1  # analysis: allow(telemetry-gating): "
+                     "obsolete exception\n")
+        report = run_analysis(root=root, allowlist=None, cache=None)
+        assert not report.ok and len(report.stale_pragmas) == 1
+
+        from spatialflink_tpu.analysis.cli import main
+        import io
+
+        out = io.StringIO()
+        rc = main(["--root", root, "--allowlist", "none", "--no-cache",
+                   "--check"], out=out)
+        assert rc == 1
+        assert "remove stale pragma" in out.getvalue()
+
+    def test_stale_only_judged_for_rules_that_ran(self, tmp_path):
+        root = _tree(tmp_path,
+                     "X = 1  # analysis: allow(telemetry-gating): "
+                     "entry for a rule not in this run\n")
+        report = run_analysis(root=root, rule_ids=["host-sync"],
+                              allowlist=None, cache=None)
+        assert report.ok
+
+    def test_malformed_pragma_is_an_error(self, tmp_path):
+        root = _tree(tmp_path,
+                     "X = 1  # analysis: allow(telemetry-gating)\n")
+        report = run_analysis(root=root, allowlist=None, cache=None)
+        assert any(f.rule == "pragma-error"
+                   and "malformed" in f.message
+                   for f in report.findings)
+
+    def test_unknown_rule_pragma_is_an_error(self, tmp_path):
+        root = _tree(tmp_path,
+                     "X = 1  # analysis: allow(no-such-rule): why\n")
+        report = run_analysis(root=root, allowlist=None, cache=None)
+        assert any(f.rule == "pragma-error"
+                   and "unknown rule" in f.message
+                   for f in report.findings)
+
+    def test_pragma_text_in_docstring_is_prose(self, tmp_path):
+        root = _tree(tmp_path, '''
+            """Docs may say `# analysis: allow(telemetry-gating): x`
+            without creating a suppression."""
+
+            X = 1
+            ''')
+        report = run_analysis(root=root, allowlist=None, cache=None)
+        assert report.ok and not report.stale_pragmas
+
+
+# --------------------------------------------------------------------- #
+# SARIF output
+
+
+class TestSarif:
+    def _run(self, *args):
+        from spatialflink_tpu.analysis.cli import main
+        import io
+
+        out = io.StringIO()
+        rc = main(list(args), out=out)
+        return rc, out.getvalue()
+
+    def test_sarif_schema_on_real_tree(self):
+        rc, out = self._run("--format", "sarif")
+        assert rc == 0
+        doc = json.loads(out)
+        assert doc["version"] == "2.1.0"
+        run = doc["runs"][0]
+        driver = run["tool"]["driver"]
+        assert driver["name"] == "spatialflink-analysis"
+        rule_ids = {r["id"] for r in driver["rules"]}
+        assert "recompile-surface" in rule_ids
+        # the clean tree still ships its allowlisted findings, marked
+        # suppressed, so CI viewers can render the reviewed exceptions
+        assert all("suppressions" in r for r in run["results"])
+        assert any(s["kind"] == "external"
+                   for r in run["results"] for s in r["suppressions"])
+
+    def test_sarif_results_carry_locations(self, tmp_path):
+        root = _tree(tmp_path, UNGATED.format(pragma=""))
+        rc, out = self._run("--root", root, "--allowlist", "none",
+                            "--no-cache", "--format", "sarif")
+        doc = json.loads(out)
+        results = doc["runs"][0]["results"]
+        assert results
+        loc = results[0]["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"] \
+            == "spatialflink_tpu/streams/bad.py"
+        assert loc["region"]["startLine"] >= 1
+        assert results[0]["level"] in ("error", "warning")
+
+
+# --------------------------------------------------------------------- #
+# per-module findings cache
+
+
+class TestAnalysisCache:
+    def test_warm_pass_is_identical_and_faster(self, tmp_path):
+        cache = str(tmp_path / "cache.json")
+        t0 = time.perf_counter()
+        cold = run_analysis(root=REPO_ROOT, allowlist=None, cache=cache)
+        t_cold = time.perf_counter() - t0
+        assert cold.cache_misses > 0
+        t0 = time.perf_counter()
+        warm = run_analysis(root=REPO_ROOT, allowlist=None, cache=cache)
+        t_warm = time.perf_counter() - t0
+        assert warm.cache_misses == 0
+        assert warm.cache_hits == cold.cache_hits + cold.cache_misses
+        cold_doc, warm_doc = cold.to_dict(), warm.to_dict()
+        cold_doc.pop("cache"), warm_doc.pop("cache")
+        assert json.dumps(cold_doc, sort_keys=True) \
+            == json.dumps(warm_doc, sort_keys=True)
+        # "measurably faster": the warm pass skips parsing + every rule
+        assert t_warm * 1.5 < t_cold, (t_warm, t_cold)
+
+    def test_module_edit_invalidates_that_module(self, tmp_path):
+        cache = str(tmp_path / "cache.json")
+        root = _tree(tmp_path / "tree", "X = 1\n")
+        first = run_analysis(root=root, allowlist=None, cache=cache)
+        assert first.ok
+        _tree(tmp_path / "tree", UNGATED.format(pragma=""))
+        second = run_analysis(root=root, allowlist=None, cache=cache)
+        assert not second.ok
+        assert any(f.rule == "telemetry-gating" for f in second.findings)
+
+    def test_parse_errors_survive_subset_runs_and_cache(self, tmp_path):
+        """Syntax errors gate even when the only ran rule does not scope
+        the broken module, warm or cold (parse status is a cached
+        pseudo-rule)."""
+        cache = str(tmp_path / "cache.json")
+        root = _tree(tmp_path, "def f(:\n", name="runtime/broken.py")
+        for _ in range(2):
+            report = run_analysis(root=root, rule_ids=["host-sync"],
+                                  allowlist=None, cache=cache)
+            assert [f.rule for f in report.findings] == ["parse-error"]
+        assert report.cache_misses == 0
+
+    def test_interprocedural_key_widens_to_tree(self, tmp_path):
+        """Changing ONE module re-judges recompile-surface everywhere:
+        its cache key embeds the tree hash."""
+        cache = str(tmp_path / "cache.json")
+        pkg = tmp_path / "t" / "spatialflink_tpu"
+        (pkg / "ops").mkdir(parents=True)
+        (pkg / "ops" / "k.py").write_text(textwrap.dedent("""
+            from functools import partial
+            from spatialflink_tpu.utils.deviceplane import instrumented_jit
+
+            @partial(instrumented_jit, static_argnames=("n",))
+            def kernel(x, n):
+                return x[:n]
+            """))
+        (pkg / "ops" / "u.py").write_text(textwrap.dedent("""
+            from spatialflink_tpu.ops.k import kernel
+
+            def use(records, batch):
+                return kernel(batch, n=len(records))
+            """))
+        root = str(tmp_path / "t")
+        first = run_analysis(root=root, allowlist=None, cache=cache,
+                             rule_ids=["recompile-surface"])
+        assert len(first.findings) == 1
+        # un-jit the kernel WITHOUT touching u.py: the call site there
+        # must be re-judged (and come back clean)
+        (pkg / "ops" / "k.py").write_text(
+            "def kernel(x, n):\n    return x[:n]\n")
+        second = run_analysis(root=root, allowlist=None, cache=cache,
+                              rule_ids=["recompile-surface"])
+        assert not second.findings

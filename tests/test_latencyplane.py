@@ -558,7 +558,14 @@ class TestFollowAcceptance:
             broker = resolve_broker("memory://latencyplane-follow")
 
             def produce():
-                for i in range(260):
+                # span ≥4 wall-clock window boundaries REGARDLESS of the
+                # phase the test starts at within the second: a deferred
+                # window's budget only lands when the NEXT window seals,
+                # so the poller needs three windows sealed while the
+                # stream is still live — 2.6s of production crossed 2 or
+                # 3 boundaries depending on start phase and the
+                # acceptance flaked on the wall clock
+                for i in range(420):
                     p = Point.create(116.5 + 0.001 * (i % 40), 40.5, GRID,
                                      obj_id=f"veh{i % 7}",
                                      timestamp=int(time.time() * 1000))

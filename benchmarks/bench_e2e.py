@@ -561,6 +561,95 @@ def bench_query_plane(path: str, n: int, q: int = 32) -> list:
     return rows
 
 
+def bench_fleet(n: int) -> list:
+    """Supervised multi-worker fleet rows (``--fleet``): wall clock and
+    records/s for N=1/2/4 worker fleets over the 95%-hot clustered
+    GeoJSON stream, plus the plain single-process run of the same replay
+    as the overhead reference (``fleet_solo``). Merged-digest identity is
+    asserted across every N — the exactly-once global merge — and each
+    fleet row carries the supervisor's restart and post-warmup-recompile
+    ledger fields. On a one-host CPU box these rows are honest about the
+    supervision price: spawn + per-line routing dominate, so N>1 buys
+    fault isolation, not throughput (BASELINE.md)."""
+    import contextlib
+    import io
+
+    from spatialflink_tpu.driver import main as driver_main
+    from spatialflink_tpu.runtime import fleet as fleet_mod
+    from spatialflink_tpu.streams.synthetic import clustered_lines
+
+    conf = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "conf", "spatialflink-conf.yml")
+    grid = _params(1).grids()[0]
+    lines = clustered_lines(grid, n, 0.95, seed=7, fmt="geojson", dt_ms=1)
+    rows = []
+    with tempfile.TemporaryDirectory(prefix="bench-fleet-") as td:
+        # workers are fresh processes: a persistent compile cache lets the
+        # per-N warm run actually warm the measured one
+        os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                              os.path.join(td, "xla-cache"))
+        os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
+                              "0")
+        path1 = os.path.join(td, "in.geojson")
+        with open(path1, "w") as f:
+            f.write("\n".join(lines) + "\n")
+
+        def solo():
+            t0 = time.perf_counter()
+            with contextlib.redirect_stdout(io.StringIO()):
+                rc = driver_main(["--config", conf, "--option", "1",
+                                  "--input1", path1])
+            dt = time.perf_counter() - t0
+            assert rc == 0
+            return dt
+
+        def fleet(workers, tag):
+            fdir = os.path.join(td, f"fleet-{tag}")
+            t0 = time.perf_counter()
+            with contextlib.redirect_stdout(sys.stderr):
+                rc = driver_main([
+                    "--config", conf, "--option", "1", "--input1", path1,
+                    "--fleet", str(workers), "--fleet-dir", fdir,
+                    # no mid-run rebalance inside a timed row
+                    "--fleet-epoch-records", str(10**9)])
+            dt = time.perf_counter() - t0
+            assert rc == 0
+            res = fleet_mod.read_json(os.path.join(fdir,
+                                                   fleet_mod.RESULT_FILE))
+            return res, dt
+
+        solo()  # warm the in-process jit shapes
+        dt_solo = solo()
+        rows.append(dict(path="fleet_solo", workers=0, records=n,
+                         wall_s=round(dt_solo, 3),
+                         records_per_sec=round(n / dt_solo)))
+        digest = None
+        dt_f1 = None
+        for workers in (1, 2, 4):
+            fleet(workers, f"warm{workers}")  # per-N padding buckets
+            res, dt = fleet(workers, f"n{workers}")
+            if digest is None:
+                digest = res["digest"]
+                dt_f1 = dt
+            else:
+                assert res["digest"] == digest, (
+                    f"fleet N={workers} merged digest diverged — the "
+                    "exactly-once global merge is partition-dependent")
+            row = dict(path=f"fleet_n{workers}", workers=workers,
+                       records=n, wall_s=round(dt, 3),
+                       records_per_sec=round(n / dt),
+                       merged_windows=res["merged_windows"],
+                       merged_digest=res["digest"],
+                       restarts=sum(int(v)
+                                    for v in res["restarts"].values()),
+                       post_warmup_compiles=res["post_warmup_compiles"],
+                       overhead_vs_solo=round(dt / dt_solo, 2))
+            if workers > 1:
+                row["speedup_vs_fleet1"] = round(dt_f1 / dt, 2)
+            rows.append(row)
+    return rows
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=None,
@@ -600,6 +689,13 @@ def main() -> int:
                          "plus a Q-sweep amortization row through the "
                          "registry path vs dedicated per-query pipelines. "
                          "0 (default) disables them")
+    ap.add_argument("--fleet", action="store_true",
+                    help="supervised multi-worker fleet rows: a single-"
+                         "process reference run vs --fleet N=1/2/4 worker "
+                         "fleets over a 95%%-hot clustered stream "
+                         "(merged-digest identity asserted across every "
+                         "N; rows carry restart + post-warmup-recompile "
+                         "ledger fields)")
     ap.add_argument("--require-backend", choices=("cpu", "tpu", "gpu"),
                     default=None,
                     help="fail fast (exit 2) when the process would run on "
@@ -702,6 +798,11 @@ def main() -> int:
                     rows.append(row)
         if args.query_plane > 1:
             for row in bench_query_plane(path, n, args.query_plane):
+                _stamp(row)
+                print(json.dumps(row), flush=True)
+                rows.append(row)
+        if args.fleet:
+            for row in bench_fleet(n):
                 _stamp(row)
                 print(json.dumps(row), flush=True)
                 rows.append(row)

@@ -38,6 +38,20 @@ plus one LOWER-IS-BETTER row gated by a second ``bench_diff`` pass
 (``--metric p99_ms --lower-is-better`` against the ``latency_rows``
 ceilings in the same baseline file):
 
+and one fleet overhead row gated by a third lower-is-better pass
+(``--metric overhead_x`` against the ``fleet_rows`` ceiling):
+
+- ``fleet_scaling``     absolute wall clock of a single-worker supervised
+                        fleet (supervisor routing -> worker subprocess ->
+                        exactly-once merge) at a PINNED record count —
+                        the supervision machinery's cost ceiling (metric
+                        ``wall_fleet1_s``); the overhead-vs-single-process
+                        ratio and the N=2 scaling ratio ride along
+                        ungated (a one-host CPU box is spawn/routing-
+                        dominated — BASELINE.md carries the honest
+                        numbers) and merged-digest identity across
+                        N=1/N=2 is asserted in-run
+
 - ``latency_record_emit``  record→emit p99 (the latency plane's budget
                         chain) of a windowed range run at the DEFAULT
                         decode chunk, at a PINNED record count so the
@@ -79,6 +93,11 @@ MARGIN_BY_PATH = {"skew_adaptive": 1.3}
 #: margin) — generous because absolute milliseconds vary box to box where
 #: the speedup ratios cancel machine speed out
 LATENCY_MARGIN = 3.0
+#: the fleet row's CEILING margin on absolute single-worker-fleet wall
+#: seconds (lower-is-better, like the latency row: ceiling = measured x
+#: margin) — worker process spawn and the supervisor's per-line routing
+#: are machine-sensitive absolute costs, so the margin is generous
+FLEET_MARGIN = 3.0
 
 
 def _lines(n: int):
@@ -415,10 +434,96 @@ def bench_latency_record_emit(n: int) -> dict:
                 p99_ms=round(p99, 3))
 
 
+def bench_fleet_scaling(n: int) -> dict:
+    """Supervised-fleet overhead gate (lower-is-better): wall clock of a
+    single-worker fleet (supervisor routing -> worker subprocess ->
+    exactly-once global merge) over the SAME replay run single-process —
+    the price of the supervision machinery, which must stay bounded. The
+    N=2 scaling ratio rides along informationally: spatial partitioning
+    on a one-host CPU box is spawn/routing-dominated at this scale, so it
+    is NOT gated (BASELINE.md carries the honest numbers). Merged-digest
+    identity across N=1 and N=2 — the exactly-once contract — is asserted
+    in the same run.
+
+    The GATED metric is the absolute single-worker-fleet wall
+    (``wall_fleet1_s``) at the pinned record count, against a generous
+    x3 ceiling: the overhead-vs-single-process ratio divides by a
+    sub-second batched run and would flap on denominator noise."""
+    import contextlib
+    import io
+    import shutil
+
+    from spatialflink_tpu.driver import main as driver_main
+    from spatialflink_tpu.runtime import fleet as fleet_mod
+    from spatialflink_tpu.streams.synthetic import clustered_lines
+
+    n = 30_000  # pinned: the overhead ratio mixes fixed (spawn) and
+    # per-record (routing) cost, so the ceiling needs a fixed workload
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    conf = os.path.join(root, "conf", "spatialflink-conf.yml")
+    lines = clustered_lines(_grid(), n, 0.95, seed=7, fmt="geojson",
+                            dt_ms=1)
+    td = tempfile.mkdtemp(prefix="bench-fleet-")
+    # workers are fresh processes: without a persistent compile cache the
+    # warm runs below could not actually warm the measured ones
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                          os.path.join(td, "xla-cache"))
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+    try:
+        path1 = os.path.join(td, "in.geojson")
+        with open(path1, "w") as f:
+            f.write("\n".join(lines) + "\n")
+
+        def solo():
+            t0 = time.perf_counter()
+            with contextlib.redirect_stdout(io.StringIO()):
+                rc = driver_main(["--config", conf, "--option", "1",
+                                  "--input1", path1])
+            dt = time.perf_counter() - t0
+            assert rc == 0
+            return dt
+
+        def fleet(workers, tag):
+            fdir = os.path.join(td, f"fleet-{tag}")
+            t0 = time.perf_counter()
+            with contextlib.redirect_stdout(sys.stderr):
+                rc = driver_main([
+                    "--config", conf, "--option", "1", "--input1", path1,
+                    "--fleet", str(workers), "--fleet-dir", fdir,
+                    # no mid-run rebalance: a shape change would compile
+                    # inside the timed region
+                    "--fleet-epoch-records", str(10**9)])
+            dt = time.perf_counter() - t0
+            assert rc == 0
+            res = fleet_mod.read_json(os.path.join(fdir,
+                                                   fleet_mod.RESULT_FILE))
+            return res, dt
+
+        solo()          # warm the in-process jit shapes
+        fleet(1, "w1")  # warm the workers' persistent cache: full-window
+        fleet(2, "w2")  # and split-window padding buckets compile here
+        dt_solo = solo()
+        r1, dt_f1 = fleet(1, "n1")
+        r2, dt_f2 = fleet(2, "n2")
+        assert r1["digest"] == r2["digest"], \
+            "fleet merged digest diverged between N=1 and N=2 workers"
+        assert r1["merged_windows"] > 0
+        return dict(path="fleet_scaling", records=n, workers=2,
+                    merged_windows=r1["merged_windows"],
+                    wall_solo_s=round(dt_solo, 3),
+                    wall_fleet1_s=round(dt_f1, 3),
+                    wall_fleet2_s=round(dt_f2, 3),
+                    scaling_n2=round(dt_f1 / dt_f2, 2),
+                    overhead_x=round(dt_f1 / dt_solo, 2))
+    finally:
+        shutil.rmtree(td, ignore_errors=True)
+
+
 def measure(n: int) -> list:
     return [bench_window_assign(n), bench_decode_columnar(n),
             bench_windowed_pipeline(n), bench_skew_adaptive(n),
-            bench_query_plane(n), bench_latency_record_emit(n)]
+            bench_query_plane(n), bench_latency_record_emit(n),
+            bench_fleet_scaling(n)]
 
 
 def main() -> int:
@@ -447,6 +552,7 @@ def main() -> int:
 
     speed_rows = [r for r in rows if "speedup" in r]
     lat_rows = [r for r in rows if "p99_ms" in r]
+    fleet_rows = [r for r in rows if "wall_fleet1_s" in r]
 
     if args.write_baseline:
         floors = [dict(path=r["path"],
@@ -458,16 +564,25 @@ def main() -> int:
         ceilings = [dict(path=r["path"],
                          p99_ms=round(r["p99_ms"] * LATENCY_MARGIN, 1))
                     for r in lat_rows]
+        fleet_ceilings = [dict(path=r["path"],
+                               wall_fleet1_s=round(
+                                   r["wall_fleet1_s"] * FLEET_MARGIN, 1))
+                          for r in fleet_rows]
         with open(BASELINE_PATH, "w") as f:
             json.dump({"metric": "speedup",
                        "note": "conservative floors = measured/%.1f "
                                "(skew_adaptive: /%.1f); bench_guard "
                                "--check trips >25%% below. latency_rows "
                                "are lower-is-better CEILINGS = measured x "
-                               "%.1f (metric p99_ms)"
+                               "%.1f (metric p99_ms); fleet_rows are "
+                               "lower-is-better CEILINGS = measured x "
+                               "%.1f (metric wall_fleet1_s: absolute "
+                               "single-worker supervised-fleet wall at "
+                               "the pinned record count)"
                                % (MARGIN, MARGIN_BY_PATH["skew_adaptive"],
-                                  LATENCY_MARGIN),
-                       "rows": floors, "latency_rows": ceilings},
+                                  LATENCY_MARGIN, FLEET_MARGIN),
+                       "rows": floors, "latency_rows": ceilings,
+                       "fleet_rows": fleet_ceilings},
                       f, indent=1)
         print(f"# wrote {BASELINE_PATH}", file=sys.stderr)
         return 0
@@ -508,7 +623,11 @@ def main() -> int:
         # example in bench_diff's docs)
         rc_lat = run_diff(base.get("latency_rows", []), lat_rows,
                           "p99_ms", ["--lower-is-better"])
-        return rc or rc_lat
+        # third pass: the fleet supervision-cost ceiling, also
+        # lower-is-better (metric wall_fleet1_s)
+        rc_fleet = run_diff(base.get("fleet_rows", []), fleet_rows,
+                            "wall_fleet1_s", ["--lower-is-better"])
+        return rc or rc_lat or rc_fleet
     return 0
 
 

@@ -337,6 +337,112 @@ def preflight(require_backend: str = "tpu", as_json: bool = False,
     return 0 if not failed else 1
 
 
+def fleet(path: str, as_json: bool = False, out=None) -> int:
+    """One table over a whole fleet directory: per worker, every
+    incarnation's run summary (``runs.jsonl``), the newest post-mortem
+    bundle's verdict when one exists, restart reasons from the fleet
+    result, recompile events, and record→emit p99 — "who died, why, and
+    did the respawn stay warm" in one read."""
+    from spatialflink_tpu.runtime import fleet as fleet_mod
+
+    out = sys.stdout if out is None else out
+    if not os.path.isdir(path):
+        raise ValueError(f"{path}: not a fleet directory")
+    result = fleet_mod.read_json(
+        os.path.join(path, fleet_mod.RESULT_FILE)) or {}
+    worker_ids = sorted(
+        int(name[len("worker"):]) for name in os.listdir(path)
+        if name.startswith("worker")
+        and name[len("worker"):].isdigit()
+        and os.path.isdir(os.path.join(path, name)))
+    if not worker_ids:
+        raise ValueError(f"{path}: no worker directories (is this a "
+                         "--fleet-dir?)")
+    restart_reasons: dict = {}
+    for r in result.get("restart_log", []):
+        restart_reasons.setdefault(int(r.get("worker", -1)),
+                                   []).append(r.get("reason"))
+    rows = []
+    for wid in worker_ids:
+        wd = fleet_mod.worker_dir(path, wid)
+        runs = fleet_mod.read_runs(wd)
+        last = runs[-1] if runs else {}
+        bundle_digest = None
+        pm_dir = os.path.join(wd, "postmortem")
+        if os.path.isdir(pm_dir):
+            bundles = sorted(
+                os.path.join(pm_dir, b) for b in os.listdir(pm_dir)
+                if os.path.isdir(os.path.join(pm_dir, b)))
+            for b in reversed(bundles):  # newest bundle that loads
+                try:
+                    bundle_digest = _bundle_digest(load_bundle(b))
+                    break
+                except ValueError:
+                    continue
+        windows = fleet_mod.read_outbox(
+            os.path.join(wd, fleet_mod.OUTBOX_FILE))
+        rows.append({
+            "worker": wid,
+            "incarnations": len(runs),
+            "restarts": len(restart_reasons.get(wid, [])),
+            "restart_reasons": restart_reasons.get(wid, []),
+            "windows": len(windows),
+            "emitted": last.get("emitted"),
+            "last_rc": last.get("rc"),
+            "graceful": last.get("graceful"),
+            "resumed": last.get("resumed"),
+            "post_warmup_compiles": sum(
+                int(r.get("post_warmup_compiles") or 0) for r in runs),
+            "last_verdict": (None if bundle_digest is None
+                             else bundle_digest.get("reason")),
+            "bundle_healthy": (None if bundle_digest is None
+                               else bundle_digest.get("healthy")),
+            "record_emit_p99_ms": (
+                last.get("record_emit_p99_ms")
+                if last.get("record_emit_p99_ms") is not None
+                else (bundle_digest or {}).get("record_emit_p99_ms")),
+        })
+    doc = {"path": path,
+           "digest": result.get("digest"),
+           "merged_windows": result.get("merged_windows"),
+           "routed": result.get("routed"),
+           "epochs": result.get("epochs"),
+           "graceful": result.get("graceful"),
+           "post_warmup_compiles": result.get("post_warmup_compiles"),
+           "workers": rows}
+    if as_json:
+        print(json.dumps(doc, sort_keys=True), file=out)
+        return 0
+    print(f"fleet      {path}", file=out)
+    if result:
+        digest = result.get("digest") or "?"
+        print(f"result     {result.get('merged_windows')} merged windows "
+              f"from {result.get('workers')} workers, "
+              f"{result.get('routed')} routed, digest {digest[:16]}",
+              file=out)
+        print(f"compiles   {result.get('post_warmup_compiles')} "
+              "post-warmup across all incarnations", file=out)
+    else:
+        print("result     (no fleet_result.json — run incomplete or "
+              "killed)", file=out)
+    hdr = (f"{'worker':>6} {'inc':>4} {'restarts':>8} {'windows':>8} "
+           f"{'last rc':>7} {'compiles':>8} {'p99 ms':>8}  last verdict")
+    print(hdr, file=out)
+    for r in rows:
+        p99 = r["record_emit_p99_ms"]
+        verdict = r["last_verdict"] or (
+            "graceful stop" if r.get("graceful") else "-")
+        print(f"{r['worker']:>6} {r['incarnations']:>4} "
+              f"{r['restarts']:>8} {r['windows']:>8} "
+              f"{('-' if r['last_rc'] is None else r['last_rc']):>7} "
+              f"{r['post_warmup_compiles']:>8} "
+              f"{('-' if p99 is None else f'{p99:.1f}'):>8}  {verdict}",
+              file=out)
+        for reason in r["restart_reasons"]:
+            print(f"{'':>6} restart: {reason}", file=out)
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     # `doctor --preflight` and `doctor preflight` both work (the flag form
@@ -360,12 +466,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     d = sub.add_parser("diff", help="compare two bundles")
     d.add_argument("bundle_a")
     d.add_argument("bundle_b")
+    fl = sub.add_parser("fleet", help="one table over a --fleet-dir: "
+                                      "who died, restarts, recompiles, "
+                                      "per-worker p99")
+    fl.add_argument("fleet_dir")
     args = ap.parse_args(argv)
     try:
         if args.cmd == "preflight":
             return preflight(args.require_backend, as_json=args.json)
         if args.cmd == "summarize":
             return summarize(args.bundle, as_json=args.json)
+        if args.cmd == "fleet":
+            return fleet(args.fleet_dir, as_json=args.json)
         return diff(args.bundle_a, args.bundle_b, as_json=args.json)
     except ValueError as e:
         print(f"doctor: {e}", file=sys.stderr)

@@ -205,6 +205,7 @@ def decode_chunks(records: Iterable, cfg: StreamConfig, grid: UniformGrid,
     from spatialflink_tpu.streams import bulk as B
     from spatialflink_tpu.streams.kafka import STARVED
     from spatialflink_tpu.utils import IdInterner
+    from spatialflink_tpu.utils import metrics as _metrics
     from spatialflink_tpu.utils import telemetry as _telemetry
     from spatialflink_tpu.utils.metrics import (REGISTRY, ControlTupleExit,
                                                 check_exit_control_tuple)
@@ -313,7 +314,22 @@ def decode_chunks(records: Iterable, cfg: StreamConfig, grid: UniformGrid,
         kind = None
         return out if len(out) else None
 
-    for rec in records:
+    src = iter(records)
+    shutdown_requested = _metrics.shutdown_requested  # hoisted: per-record
+    while True:
+        try:
+            rec = next(src)
+        except StopIteration:
+            break
+        except ControlTupleExit:
+            # a source-raised stop (a tailing fleet source seeing the
+            # shutdown flag while idle): drain the buffer downstream
+            # first — every record already read must reach its window
+            # before the stop propagates (positions were tap-counted)
+            out = flush()
+            if out is not None:
+                yield out
+            raise
         if rec is STARVED:
             # quiet live topic: hand everything buffered downstream so a
             # chunk never waits out dead air (latency bound = one poll)
@@ -338,6 +354,15 @@ def decode_chunks(records: Iterable, cfg: StreamConfig, grid: UniformGrid,
             t_first = time.perf_counter()
         buf.append(rec)
         kind = k
+        if shutdown_requested():
+            # SIGTERM landed between records: the current record is
+            # already buffered (tap-counted — dropping it would lose it
+            # from the final checkpoint), so drain the chunk and stop
+            out = flush()
+            if out is not None:
+                yield out
+            raise _metrics.GracefulShutdown(
+                "shutdown requested (SIGTERM): buffered records drained")
         # size OR age flush: a slow live source without a starvation
         # sentinel (direct KafkaSource feeds) must not hold records hostage
         # to a chunk fill — `max_buffer_s` bounds the added decode latency
@@ -1730,6 +1755,51 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "warns when an uncompacted topic makes it large) "
                          "— accepts that windows committed before the "
                          "scanned tail can be re-produced on re-delivery")
+    ap.add_argument("--fleet", type=int, default=None, metavar="N",
+                    help="supervised multi-worker fleet: spawn N full "
+                         "worker pipelines (each with its own checkpoint "
+                         "manifest and opserver), partition --input1 by "
+                         "grid leaf, restart dead workers from their "
+                         "latest checkpoint, and merge the windowAll "
+                         "results exactly-once (windowed range/kNN file "
+                         "replays; aggregated view at GET /fleet)")
+    ap.add_argument("--fleet-dir", metavar="DIR", default=None,
+                    help="fleet working directory: per-worker partitions, "
+                         "outboxes, logs, checkpoints, the fleet manifest, "
+                         "and the merged result (required with --fleet; "
+                         "inspect with python -m spatialflink_tpu.doctor "
+                         "fleet DIR)")
+    ap.add_argument("--fleet-role", choices=["supervisor", "worker"],
+                    default=None,
+                    help="process role under --fleet (workers are spawned "
+                         "by the supervisor with this set; not for direct "
+                         "use)")
+    ap.add_argument("--fleet-worker-id", type=int, default=0,
+                    metavar="ID", help="this worker's id (supervisor-set)")
+    ap.add_argument("--fleet-heartbeat", type=float, default=1.0,
+                    metavar="SECONDS",
+                    help="worker heartbeat interval; the supervisor "
+                         "declares a worker dead after ~5 missed beats "
+                         "(default: 1.0)")
+    ap.add_argument("--fleet-epoch-records", type=int, default=20000,
+                    metavar="N",
+                    help="repartition epoch length in routed records: at "
+                         "each boundary the supervisor compares worker "
+                         "backpressure and may move leaves off the hottest "
+                         "worker (default: 20000)")
+    ap.add_argument("--fleet-restart-cap", type=int, default=3,
+                    metavar="N",
+                    help="max restarts per worker before the fleet aborts "
+                         "(default: 3)")
+    ap.add_argument("--fleet-slo-p99-ms", type=float, default=None,
+                    metavar="MS",
+                    help="optional SLO supervision: restart a worker whose "
+                         "record->emit p99 stays above MS for 3 "
+                         "consecutive polls (default: off)")
+    ap.add_argument("--fleet-chaos-kill", metavar="WID:N", default=None,
+                    help="fault-injection hook: SIGKILL worker WID once "
+                         "its outbox holds N windows (recovery tests and "
+                         "the fault bench row)")
     args = ap.parse_args(argv)
 
     _enable_compilation_cache()
@@ -1798,6 +1868,41 @@ def main(argv: Optional[List[str]] = None) -> int:
     if spec is None:
         print(f"unknown queryOption {params.query.option}", file=sys.stderr)
         return 2
+    if args.fleet is not None and args.fleet_role != "worker":
+        # supervised multi-worker fleet: validate here (argparse-grade
+        # errors), then hand the whole run to the supervisor — workers
+        # re-enter main() as plain single-process pipelines
+        if args.fleet < 1:
+            ap.error("--fleet needs N >= 1 workers")
+        if not args.fleet_dir:
+            ap.error("--fleet requires --fleet-dir (worker partitions, "
+                     "outboxes, and the fleet manifest live there)")
+        if args.kafka or not args.input1:
+            ap.error("--fleet partitions a file replay and needs "
+                     "--input1 (kafka transport stays single-process)")
+        if spec.mode != "window" or spec.family not in ("range", "knn"):
+            ap.error("--fleet supports windowed range/kNN cases (the "
+                     "windowAll merge families); option "
+                     f"{params.query.option} is {spec.family}/{spec.mode}")
+        if args.bulk or params.query.multi_query:
+            ap.error("--fleet does not compose with --bulk or "
+                     "--multi-query")
+        if args.queries_file or args.control_topic:
+            ap.error("--fleet does not compose with the dynamic query "
+                     "plane (each worker runs the static configured "
+                     "query)")
+        if args.adaptive_grid is not None:
+            ap.error("--fleet owns the leaf placement layout; "
+                     "--adaptive-grid inside workers does not compose")
+        from spatialflink_tpu.runtime import fleetsup
+
+        base_argv = list(sys.argv[1:] if argv is None else argv)
+        return fleetsup.run_supervisor(args, params, spec, base_argv)
+    if args.fleet_role == "worker" and not (
+            args.fleet_dir and args.input1 and args.checkpoint_dir):
+        ap.error("--fleet-role worker needs --fleet-dir, --input1 and "
+                 "--checkpoint-dir (workers are spawned by the "
+                 "supervisor, not launched directly)")
     if args.kafka and args.bulk and args.kafka_follow:
         ap.error("--kafka-follow and --bulk are mutually exclusive "
                  "(bulk is a bounded vectorized drain, not a live stream)")
@@ -2056,6 +2161,13 @@ def _run_cli(ap, args, params: Params, spec: CaseSpec, skip1: int,
     from spatialflink_tpu.streams.sources import FileReplaySource
 
     coord = getattr(params, "checkpointer", None)
+    wctx = None
+    if getattr(args, "fleet_role", None) == "worker":
+        from spatialflink_tpu.runtime.fleet import WorkerContext
+
+        # fleet worker glue: heartbeat + canonical outbox + tailing
+        # partition source; everything else is the normal pipeline
+        wctx = WorkerContext.from_args(args, spec).start()
     kafka = None
     if args.kafka:
         try:
@@ -2078,14 +2190,17 @@ def _run_cli(ap, args, params: Params, spec: CaseSpec, skip1: int,
         skip_a = coord.position("file:1", 0)
         lim_a = (max(0, args.limit - skip_a)
                  if args.limit is not None else None)
-        stream1 = CheckpointTap(
-            FileReplaySource(args.input1, limit=lim_a, skip=skip_a),
-            coord, "file:1", base=skip_a)
+        src_a = (wctx.tailing_source(limit=lim_a, skip=skip_a)
+                 if wctx is not None else
+                 FileReplaySource(args.input1, limit=lim_a, skip=skip_a))
+        stream1 = CheckpointTap(src_a, coord, "file:1", base=skip_a)
         if skip_a:
             print(f"# resume: skipping {skip_a} already-reflected records "
                   "of --input1", file=sys.stderr)
     else:
-        stream1 = FileReplaySource(args.input1, limit=limit1, skip=skip1)
+        stream1 = (wctx.tailing_source(limit=limit1, skip=skip1)
+                   if wctx is not None else
+                   FileReplaySource(args.input1, limit=limit1, skip=skip1))
     if not args.kafka:
         stream2 = None
         if args.input2 and coord is not None:
@@ -2138,6 +2253,24 @@ def _run_cli(ap, args, params: Params, spec: CaseSpec, skip1: int,
     import contextlib
 
     stack = contextlib.ExitStack()
+    if wctx is not None:
+        stack.callback(wctx.close)
+    import signal as _signal
+    import threading as _threading
+
+    from spatialflink_tpu.utils import metrics as _metrics_mod
+
+    if _threading.current_thread() is _threading.main_thread():
+        # SIGTERM = graceful drain: the decode loop sees the flag at the
+        # next record boundary, flushes its buffer into the pipeline, and
+        # raises GracefulShutdown — which exits 0 below after a final
+        # checkpoint. Cleared at run start so an earlier run's late signal
+        # can't stop this one; handler restored by the stack.
+        _metrics_mod.clear_shutdown()
+        _prev_term = _signal.signal(
+            _signal.SIGTERM,
+            lambda signum, frame: _metrics_mod.request_shutdown())
+        stack.callback(_signal.signal, _signal.SIGTERM, _prev_term)
     from spatialflink_tpu.utils import deviceplane
 
     # recompile sentinel: warmup re-opens for this run; after the declared
@@ -2217,6 +2350,10 @@ def _run_cli(ap, args, params: Params, spec: CaseSpec, skip1: int,
         # stop or a crash — so the port never outlives the run
         opserver = OpServer(port=args.status_port, health=health).start()
         stack.callback(opserver.close)
+        if wctx is not None:
+            # the supervisor discovers the ephemeral port through this
+            # drop file and aggregates /status + /latency into /fleet
+            wctx.write_url(opserver.url)
         print(f"# status server: {opserver.url} "
               "(/healthz /status /metrics /events)", file=sys.stderr)
     if args.live_stats or (args.kafka_follow and tel is not None):
@@ -2273,6 +2410,7 @@ def _run_cli(ap, args, params: Params, spec: CaseSpec, skip1: int,
 
     n = 0
     stopped = False
+    graceful_stop = False
     strict_abort = False
     it = iter(results)
     try:
@@ -2287,6 +2425,12 @@ def _run_cli(ap, args, params: Params, spec: CaseSpec, skip1: int,
             if (journal is not None and isinstance(result, WindowResult)
                     and journal.seen(result)):
                 continue  # delivered by the pre-crash process
+            if wctx is not None and isinstance(result, WindowResult):
+                # canonical outbox line BEFORE the emit and the journal
+                # record: a kill between outbox and journal re-appends an
+                # identical line on resume, which the merge dedups — the
+                # exactly-once ordering the fleet merge relies on
+                wctx.note_window(result)
             if tel is not None:
                 s0 = time.time()
                 with tel.span("sink"):
@@ -2318,10 +2462,13 @@ def _run_cli(ap, args, params: Params, spec: CaseSpec, skip1: int,
             if recorder is not None and isinstance(result, WindowResult):
                 recorder.note("window", start=result.window_start,
                               records=len(result.records))
-    except ControlTupleExit:
+    except ControlTupleExit as e:
         # the remote-stop hook (HelperClass.checkExitControlTuple:441-453) is
-        # a graceful shutdown, not an error: finish the summary and exit 0
+        # a graceful shutdown, not an error: finish the summary and exit 0.
+        # A SIGTERM-raised stop additionally writes a final checkpoint
+        # below — buffered records were drained into the pipeline first.
         stopped = True
+        graceful_stop = isinstance(e, _metrics_mod.GracefulShutdown)
     except deviceplane.RecompileError as e:
         # --strict-recompile abort: the zero-recompile contract was
         # violated; capture the moment and exit distinctly (3)
@@ -2342,6 +2489,25 @@ def _run_cli(ap, args, params: Params, spec: CaseSpec, skip1: int,
             out_sink.close()
         if journal is not None:
             journal.close()
+    if graceful_stop and coord is not None:
+        # a signal-driven stop writes one FINAL coordinated checkpoint:
+        # the decode buffer drained into the pipeline before the stop
+        # propagated, so operator state + source positions cover every
+        # record read — a later --resume completes the stream with
+        # nothing lost and nothing re-emitted
+        final_path = coord.commit()
+        print(f"# graceful shutdown: final checkpoint seq {coord.seq} "
+              f"({final_path})", file=sys.stderr)
+    if wctx is not None:
+        wctx.write_run_summary(
+            rc=3 if strict_abort else 0,
+            stopped=stopped,
+            graceful=graceful_stop,
+            resumed=bool(coord is not None and coord.restored),
+            emitted=n,
+            suppressed=journal.suppressed if journal is not None else 0,
+            post_warmup_compiles=sentinel.run_recompiles,
+            checkpoint_seq=(coord.seq if coord is not None else None))
     if kafka is not None:
         if not stopped:
             # fully drained bounded topic: full positions are safe to commit.

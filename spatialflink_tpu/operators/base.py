@@ -310,6 +310,42 @@ class WindowResult:
         return self.records
 
 
+def merge_window_records(family: str, parts: List[List], *, k=None,
+                         tie_key=None) -> List:
+    """The per-family GLOBAL merge seam: combine one window's record lists
+    from disjoint partitions of the stream into the windowAll result a
+    single unpartitioned run would have produced.
+
+    This is the fleet's merge stage, and it deliberately reuses the pane/
+    shard merge twins rather than inventing a third semantics: filter
+    families (range/tRange/join — any family whose window is a SELECTION
+    of its input records) merge by union, exactly the host pane
+    concatenation, because a record routed to exactly one partition
+    appears in exactly one part; kNN merges through
+    :func:`~spatialflink_tpu.ops.knn.merge_topk_host` (concatenate, dedup
+    by id keeping the min distance, re-top-k) — exact by the same covering
+    argument as the pane/shard merges, since every partition emits its
+    local top-k over a superset-free subset of the candidates.
+
+    ``tie_key`` for kNN must reproduce the single-run tie order at the
+    k-th place (see ``merge_topk_host``); partitioned runs that cannot
+    share an interner pass a content key (e.g. ``str``) and accept that
+    exact-distance ties may order differently from a single-process run.
+    """
+    if family == "knn":
+        if not k:
+            raise ValueError("kNN merge needs k (the fleet's per-window "
+                             "re-top-k bound)")
+        from spatialflink_tpu.ops.knn import merge_topk_host
+
+        return merge_topk_host(parts, int(k), tie_key=tie_key)
+    # selection families: disjoint-partition union (the host pane merge)
+    out: List = []
+    for part in parts:
+        out.extend(part)
+    return out
+
+
 class _LeafMaskCache:
     """One query's leaf-space mask under the adaptive grid, invalidated by
     the grid's monotonic version stamp: a repartition bumps ``version`` and

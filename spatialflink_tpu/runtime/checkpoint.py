@@ -34,6 +34,7 @@ exactly-once output.
 from __future__ import annotations
 
 import base64
+import json
 import os
 import re
 import sys
@@ -72,6 +73,29 @@ def check_job_fingerprint(saved: Optional[str], current: Optional[str],
             "group); restoring it would produce wrong state. Use a fresh "
             "checkpoint location, or rerun with the original "
             "configuration.")
+
+
+def atomic_write_json(path: str, doc: dict) -> None:
+    """Durable small-JSON write (fsync + rename): the fleet manifest,
+    worker run summaries, and partition-done markers ride the same
+    atomicity discipline as the checkpoint manifests, without the npz
+    envelope — a reader never observes a torn document."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def read_json(path: str, default=None):
+    """Best-effort JSON read: ``default`` on a missing/torn file (the
+    atomic-write discipline makes torn mean mid-rename crash debris)."""
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return default
 
 
 # --------------------------------------------------------------------- #

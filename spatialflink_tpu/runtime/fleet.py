@@ -1,0 +1,523 @@
+"""Fleet worker plumbing: the pieces a supervised multi-worker run adds
+INSIDE each worker process, plus the global-merge machinery both sides
+share.
+
+The reference runs GeoFlink at Flink parallelism 30: a JobManager places
+keyed subtasks on TaskManagers and restarts the ones that die. The
+rebuild's equivalent (``runtime/fleetsup.py``) spawns N full pipelines —
+each worker is the EXISTING single-process driver with its own PaneCache,
+checkpoint manifest, and opserver on an ephemeral port — and partitions
+the stream by grid leaf (PR 8's leaf layout as the placement unit). This
+module is the worker half and the shared contracts:
+
+- :class:`TailingReplaySource` — a file-replay source that FOLLOWS its
+  partition file while the supervisor is still routing records into it,
+  and treats the durable ``partition.done`` marker as EOF. Resume-aware
+  exactly like ``FileReplaySource`` (``skip``/``limit`` compose with
+  ``CheckpointTap``), and shutdown-aware: a SIGTERM that lands while the
+  source is idle raises :class:`~spatialflink_tpu.utils.metrics
+  .GracefulShutdown` so the drain path runs instead of a hang.
+- :class:`HeartbeatWriter` — a daemon thread touching a heartbeat file
+  every interval; the supervisor's liveness probe that works even when
+  the worker's pipeline thread is busy inside a kernel dispatch.
+- :class:`OutboxWriter` / :func:`read_outbox` — the worker's durable
+  per-window emission log for the global merge stage: one canonical JSON
+  line per emitted window (fingerprinted, flushed before the journal
+  records the window), so the supervisor can merge windowAll results
+  without re-parsing worker stdout. Appended only for windows the
+  emitted-window journal has NOT seen — a crash between the outbox
+  append and the journal record re-appends an IDENTICAL line on resume,
+  which the merge dedups by window key (and cross-checks by
+  fingerprint): exactly-once output identity across a kill.
+- :class:`FleetManifest` — the supervisor's durable state (leaf→worker
+  assignment, repartition epoch, restart counts) with the
+  ``snapshot``/``restore`` pair the checkpoint-coverage linter rule
+  proves field-by-field.
+- :class:`WorkerContext` — the driver's one handle on all of the above
+  when it runs under ``--fleet-role worker``.
+
+Merging reuses the per-family pane/shard merge twins through
+:func:`~spatialflink_tpu.operators.base.merge_window_records` — see
+:func:`merge_outboxes`.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from spatialflink_tpu.runtime.checkpoint import (atomic_write_json,
+                                                 read_json)
+from spatialflink_tpu.utils.metrics import (GracefulShutdown,
+                                            shutdown_requested)
+
+#: files inside one worker's fleet directory (``<fleet-dir>/worker<i>/``)
+PARTITION_FILE = "partition.ndjson"
+DONE_MARKER = "partition.done"
+OUTBOX_FILE = "outbox.jsonl"
+HEARTBEAT_FILE = "heartbeat"
+URL_FILE = "opserver.url"
+RUNS_FILE = "runs.jsonl"
+#: supervisor-owned files at the fleet root
+MANIFEST_FILE = "fleet.json"
+MERGED_FILE = "merged.jsonl"
+RESULT_FILE = "fleet_result.json"
+
+
+def worker_dir(fleet_dir: str, worker_id: int) -> str:
+    return os.path.join(fleet_dir, f"worker{int(worker_id)}")
+
+
+class FleetMergeError(RuntimeError):
+    """Two outbox lines for the SAME window key disagree on content — the
+    exactly-once identity the journal + canonical outbox guarantee was
+    violated (or two different jobs shared a fleet dir)."""
+
+
+# --------------------------------------------------------------------- #
+# tailing partition source
+
+
+class TailingReplaySource:
+    """Replay a partition file the supervisor is still appending to.
+
+    Yields complete stripped lines; a partial tail line (the supervisor
+    flushes whole lines, but the OS may expose a torn read mid-write) is
+    held back until its newline arrives. EOF is the durable
+    ``partition.done`` marker: once observed, one final read drains
+    anything appended before the marker, then iteration ends — so a
+    bounded fleet run terminates exactly like a file replay.
+
+    ``skip``/``limit`` mirror :class:`~spatialflink_tpu.streams.sources
+    .FileReplaySource` so ``CheckpointTap`` resume semantics carry over
+    unchanged. ``stall_timeout_s`` bounds how long the worker waits with
+    no new data and no marker (a dead supervisor must not leave orphan
+    workers spinning forever)."""
+
+    def __init__(self, path: str, done_path: str, *,
+                 limit: Optional[int] = None, skip: int = 0,
+                 poll_s: float = 0.05, stall_timeout_s: float = 300.0):
+        self._path = path
+        self._done_path = done_path
+        self._limit = limit
+        self._skip = max(0, int(skip))
+        self._poll_s = poll_s
+        self._stall_timeout_s = stall_timeout_s
+
+    def __iter__(self) -> Iterator[str]:
+        if self._limit is not None and self._limit <= 0:
+            return
+        f = None
+        tail = ""
+        skipped = 0
+        yielded = 0
+        last_data = time.monotonic()
+        try:
+            while True:
+                if f is None:
+                    if os.path.exists(self._path):
+                        f = open(self._path)
+                    elif os.path.exists(self._done_path):
+                        return  # empty partition, already final
+                    else:
+                        self._wait(last_data)
+                        continue
+                chunk = f.read(1 << 16)
+                if chunk:
+                    last_data = time.monotonic()
+                    tail += chunk
+                    lines = tail.split("\n")
+                    tail = lines.pop()
+                    for line in lines:
+                        if not line:
+                            continue
+                        if skipped < self._skip:
+                            skipped += 1
+                            continue
+                        yield line
+                        yielded += 1
+                        if (self._limit is not None
+                                and yielded >= self._limit):
+                            return
+                    continue
+                # at EOF: the marker is written AFTER the final flush, so
+                # observing it means one more read drains everything
+                if os.path.exists(self._done_path):
+                    chunk = f.read(1 << 16)
+                    if chunk:
+                        last_data = time.monotonic()
+                        tail += chunk
+                        continue
+                    if tail.strip() and skipped >= self._skip:
+                        yield tail.strip()  # defensively drain a torn tail
+                    return
+                self._wait(last_data)
+        finally:
+            if f is not None:
+                f.close()
+
+    def _wait(self, last_data: float) -> None:
+        if shutdown_requested():
+            raise GracefulShutdown(
+                "shutdown requested while tailing the partition file")
+        if time.monotonic() - last_data > self._stall_timeout_s:
+            raise RuntimeError(
+                f"partition file {self._path} stalled for "
+                f"{self._stall_timeout_s:g}s with no done marker — "
+                "supervisor dead?")
+        time.sleep(self._poll_s)
+
+
+# --------------------------------------------------------------------- #
+# heartbeat
+
+
+class HeartbeatWriter:
+    """Touch ``path`` every ``interval_s`` from a daemon thread. The
+    supervisor reads the file's mtime age as the liveness signal — a
+    worker wedged hard enough to stop a daemon thread (or SIGKILLed) goes
+    stale within one interval."""
+
+    def __init__(self, path: str, interval_s: float = 1.0):
+        self._path = path
+        self._interval_s = max(0.05, float(interval_s))
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "HeartbeatWriter":
+        self._touch()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="fleet-heartbeat", daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._interval_s):
+            self._touch()
+
+    def _touch(self) -> None:
+        with open(self._path, "a"):
+            os.utime(self._path, None)
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+
+def heartbeat_age_s(path: str) -> Optional[float]:
+    """Seconds since the worker last touched its heartbeat, or None when
+    the file does not exist yet (worker still booting)."""
+    try:
+        return max(0.0, time.time() - os.stat(path).st_mtime)
+    except OSError:
+        return None
+
+
+# --------------------------------------------------------------------- #
+# canonical outbox
+
+
+def _record_encoder():
+    from spatialflink_tpu.streams.formats import serialize_spatial
+
+    def encode(rec):
+        try:
+            return serialize_spatial(rec, "GeoJSON", date_format=None)
+        except (AttributeError, TypeError, ValueError):
+            return json.dumps(rec, sort_keys=True, default=str)
+
+    return encode
+
+
+def window_key(result) -> str:
+    """The journal's idempotent window-sink key (``start:end:cell``) —
+    the outbox keys windows identically so the two logs cross-check."""
+    from spatialflink_tpu.runtime.checkpoint import EmittedWindowJournal
+
+    return EmittedWindowJournal.key(result)
+
+
+def canonical_window_doc(result, family: str) -> dict:
+    """One outbox line: the window's identity plus its records in a
+    canonical, order-independent serialization (selection families sort
+    encoded records; kNN keeps its (distance, id) top-k order, which IS
+    canonical). The fingerprint seals the content so duplicate appends
+    across a crash are provably identical."""
+    if family == "knn":
+        records = [[str(oid), float(d)] for oid, d in result.records]
+    else:
+        enc = _record_encoder()
+        records = sorted(enc(r) for r in result.flat_records())
+    payload = json.dumps(records, sort_keys=True)
+    return {
+        "key": window_key(result),
+        "window": [int(result.window_start), int(result.window_end)],
+        "cell": result.extras.get("cell"),
+        "count": len(records),
+        "records": records,
+        "fp": hashlib.sha256(payload.encode()).hexdigest()[:16],
+    }
+
+
+class OutboxWriter:
+    """Append-only canonical window log, one flushed JSON line per emitted
+    window. Flushed BEFORE the emitted-window journal records the window:
+    a ``kill -9`` between the two re-appends the identical line on resume
+    (the journal did not suppress it), and :func:`read_outbox` dedups by
+    key — never a lost window, never a divergent one."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._f = open(path, "a")
+        self.appended = 0
+
+    def append(self, doc: dict) -> None:
+        self._f.write(json.dumps(doc, sort_keys=True) + "\n")
+        self._f.flush()
+        self.appended += 1
+
+    def close(self) -> None:
+        self._f.close()
+
+
+def read_outbox(path: str) -> Dict[str, dict]:
+    """Parse one worker's outbox into ``key -> doc``, deduplicating the
+    crash-replay duplicates (first occurrence wins) and raising
+    :class:`FleetMergeError` if a duplicate DISAGREES — that would mean a
+    resumed worker emitted different window contents than its pre-crash
+    incarnation, exactly the bug the exactly-once machinery exists to
+    make impossible."""
+    out: Dict[str, dict] = {}
+    if not os.path.exists(path):
+        return out
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                doc = json.loads(line)
+            except ValueError:
+                continue  # torn tail from a kill mid-write: replayed later
+            key = doc.get("key")
+            if key is None:
+                continue
+            prev = out.get(key)
+            if prev is None:
+                out[key] = doc
+            elif prev.get("fp") != doc.get("fp"):
+                raise FleetMergeError(
+                    f"outbox {path}: window {key} re-emitted with "
+                    f"different content (fp {prev.get('fp')} vs "
+                    f"{doc.get('fp')}) — exactly-once identity violated")
+    return out
+
+
+# --------------------------------------------------------------------- #
+# global merge
+
+
+def merge_outboxes(per_worker: Dict[int, Dict[str, dict]], family: str,
+                   *, k: Optional[int] = None) -> List[dict]:
+    """The fleet's global merge stage: combine every worker's deduped
+    outbox into the windowAll table a single unpartitioned run would have
+    produced, through the per-family merge seam
+    (:func:`~spatialflink_tpu.operators.base.merge_window_records`).
+    Workers merge in worker-id order and selection-family unions re-sort,
+    so the result is independent of BOTH the leaf assignment and emission
+    timing — the property the identity tests pin."""
+    from spatialflink_tpu.operators.base import merge_window_records
+
+    by_key: Dict[str, List[Tuple[int, dict]]] = {}
+    for wid in sorted(per_worker):
+        for key, doc in per_worker[wid].items():
+            by_key.setdefault(key, []).append((wid, doc))
+    merged: List[dict] = []
+    for key, docs in by_key.items():
+        parts = [d["records"] for _, d in docs]
+        if family == "knn":
+            records = [[str(oid), float(d)] for oid, d in
+                       merge_window_records(
+                           family, [[(r[0], r[1]) for r in p]
+                                    for p in parts], k=k, tie_key=str)]
+        else:
+            records = sorted(merge_window_records(family, parts))
+        first = docs[0][1]
+        merged.append({
+            "key": key,
+            "window": first["window"],
+            "cell": first.get("cell"),
+            "count": len(records),
+            "records": records,
+            "workers": [wid for wid, _ in docs],
+        })
+    merged.sort(key=lambda d: (d["window"][0], d["window"][1],
+                               str(d.get("cell"))))
+    return merged
+
+
+def merged_table_digest(merged: List[dict]) -> str:
+    """Stable content digest of the merged window table (identity column
+    excludes which workers contributed — two fleets with different leaf
+    assignments must digest identically)."""
+    canon = [{"key": d["key"], "records": d["records"]} for d in merged]
+    return hashlib.sha256(
+        json.dumps(canon, sort_keys=True).encode()).hexdigest()
+
+
+# --------------------------------------------------------------------- #
+# fleet manifest (supervisor durable state)
+
+
+class FleetManifest:
+    """The supervisor's durable state: leaf→worker assignment, the
+    repartition epoch, and per-worker restart counts, written atomically
+    to ``<fleet-dir>/fleet.json`` after every mutation that must survive
+    a supervisor crash. The ``snapshot``/``restore`` pair is the same
+    contract the checkpoint coordinator registers — and the
+    checkpoint-coverage linter rule proves every ``fleet_*`` field is
+    carried by both, so a field added later cannot silently stop being
+    durable."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.fleet_assignment: Dict[int, int] = {}
+        self.fleet_epoch = 0
+        self.fleet_restarts: Dict[int, int] = {}
+        loaded = read_json(path)
+        if loaded:
+            self.restore(loaded)
+
+    def assign(self, leaf: int, worker: int) -> None:
+        self.fleet_assignment[int(leaf)] = int(worker)
+
+    def assign_all(self, assignment: Dict[int, int]) -> None:
+        for leaf, worker in assignment.items():
+            self.fleet_assignment[int(leaf)] = int(worker)
+
+    def advance_epoch(self) -> int:
+        self.fleet_epoch += 1
+        return self.fleet_epoch
+
+    def note_restart(self, worker: int) -> int:
+        w = int(worker)
+        self.fleet_restarts[w] = self.fleet_restarts.get(w, 0) + 1
+        return self.fleet_restarts[w]
+
+    def snapshot(self) -> dict:
+        return {
+            "assignment": {str(k): v
+                           for k, v in self.fleet_assignment.items()},
+            "epoch": self.fleet_epoch,
+            "restarts": {str(k): v
+                         for k, v in self.fleet_restarts.items()},
+        }
+
+    def restore(self, state: dict) -> None:
+        self.fleet_assignment = {int(k): int(v) for k, v in
+                                 (state.get("assignment") or {}).items()}
+        self.fleet_epoch = int(state.get("epoch", 0))
+        self.fleet_restarts = {int(k): int(v) for k, v in
+                               (state.get("restarts") or {}).items()}
+
+    def save(self) -> None:
+        atomic_write_json(self.path, self.snapshot())
+
+
+# --------------------------------------------------------------------- #
+# worker context (driver glue)
+
+
+class WorkerContext:
+    """Everything ``--fleet-role worker`` adds to a driver run: the
+    worker's fleet directory layout, the heartbeat, the canonical outbox,
+    the opserver-URL drop file, and the per-incarnation run summary the
+    supervisor and ``doctor fleet`` read."""
+
+    def __init__(self, fleet_dir: str, worker_id: int, *,
+                 family: str, k: Optional[int] = None,
+                 heartbeat_s: float = 1.0):
+        self.worker_id = int(worker_id)
+        self.dir = worker_dir(fleet_dir, worker_id)
+        os.makedirs(self.dir, exist_ok=True)
+        self.family = family
+        self.k = k
+        self._t0 = time.time()
+        self._heartbeat = HeartbeatWriter(
+            os.path.join(self.dir, HEARTBEAT_FILE), heartbeat_s)
+        self.outbox = OutboxWriter(os.path.join(self.dir, OUTBOX_FILE))
+
+    @staticmethod
+    def from_args(args, spec) -> Optional["WorkerContext"]:
+        """The driver's constructor: a context iff this run is a fleet
+        worker (validated in ``main``)."""
+        if getattr(args, "fleet_role", None) != "worker":
+            return None
+        return WorkerContext(args.fleet_dir, args.fleet_worker_id,
+                             family=spec.family,
+                             heartbeat_s=args.fleet_heartbeat)
+
+    @property
+    def partition_path(self) -> str:
+        return os.path.join(self.dir, PARTITION_FILE)
+
+    @property
+    def done_path(self) -> str:
+        return os.path.join(self.dir, DONE_MARKER)
+
+    def start(self) -> "WorkerContext":
+        self._heartbeat.start()
+        return self
+
+    def tailing_source(self, *, limit: Optional[int] = None,
+                       skip: int = 0) -> TailingReplaySource:
+        return TailingReplaySource(self.partition_path, self.done_path,
+                                   limit=limit, skip=skip)
+
+    def write_url(self, url: str) -> None:
+        atomic_write_json(os.path.join(self.dir, URL_FILE), {"url": url})
+
+    def note_window(self, result) -> None:
+        """Outbox-append one emitted window (called only for windows the
+        journal has NOT suppressed; flushed before the journal records
+        it — see :class:`OutboxWriter` for the crash ordering)."""
+        self.outbox.append(canonical_window_doc(result, self.family))
+
+    def write_run_summary(self, **fields) -> None:
+        """Append this incarnation's exit record to ``runs.jsonl``."""
+        doc = {"ts_ms": int(time.time() * 1000),
+               "wall_s": round(time.time() - self._t0, 3),
+               "worker": self.worker_id,
+               "windows_appended": self.outbox.appended}
+        doc.update(fields)
+        with open(os.path.join(self.dir, RUNS_FILE), "a") as f:
+            f.write(json.dumps(doc, sort_keys=True) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+
+    def close(self) -> None:
+        self._heartbeat.close()
+        self.outbox.close()
+
+
+def read_runs(workdir: str) -> List[dict]:
+    """All incarnation summaries for one worker dir, oldest first."""
+    path = os.path.join(workdir, RUNS_FILE)
+    out: List[dict] = []
+    if not os.path.exists(path):
+        return out
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    continue
+    return out

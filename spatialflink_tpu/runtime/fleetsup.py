@@ -1,0 +1,752 @@
+"""Fleet supervisor: N supervised worker pipelines, leaf-partitioned
+input, crash-recovering restarts, exactly-once global merge.
+
+The reference deploys GeoFlink at parallelism 30: Flink's JobManager
+places keyed subtasks on TaskManagers, restarts dead ones from the last
+checkpoint, and windowAll stages merge the keyed partials into one global
+result. The rebuild's supervisor is that control plane shrunk to one
+process:
+
+- **Placement** — the stream partitions by grid LEAF (PR 8's adaptive
+  layout as the placement unit; the default layout is one leaf per base
+  cell). A seed scan of the input head feeds
+  :func:`~spatialflink_tpu.runtime.repartition.balance_leaves` (greedy
+  LPT) for the initial leaf→worker assignment; unseen leaves route by
+  ``leaf % N``.
+- **Workers** — each is the FULL existing single-process driver
+  (``--fleet-role worker``): own PaneCache, own checkpoint manifest, own
+  emitted-window journal, own opserver on an ephemeral port. The
+  supervisor only routes lines into per-worker partition files and reads
+  canonical outboxes back — no shared mutable state between pipelines.
+- **Supervision** — a monitor thread watches exit codes, heartbeat-file
+  age, and (optionally) record→emit p99 SLO breaches from the worker's
+  ``/latency`` payload. A dead worker restarts from its latest
+  checkpoint manifest with ``--resume``; the per-incarnation run summary
+  carries the recompile sentinel's post-warmup count, so the respawn
+  PROVES it never silently recompiled instead of asserting it by hope.
+- **Rebalance** — at repartition epochs the supervisor compares worker
+  loads (backpressure/latency signals when present, routed-record counts
+  otherwise) and :func:`~spatialflink_tpu.runtime.repartition
+  .pick_rebalance` moves leaves off the most loaded worker (with
+  hysteresis) — the fleet analogue of PR 8's in-process repartitioner.
+- **Exactly-once merge** — workers append canonical fingerprinted window
+  docs to their outboxes BEFORE journaling them; the supervisor dedups
+  by window key, merges per-family through
+  :func:`~spatialflink_tpu.operators.base.merge_window_records`, and the
+  merged table's digest is byte-stable against a fault-free
+  single-worker run — the property the tier-1 kill test pins.
+- **Drain** — SIGTERM stops routing, forwards the signal to every
+  worker (each drains open windows and writes a final checkpoint via the
+  driver's graceful-shutdown path), then merges whatever was emitted and
+  exits 0.
+
+``GET /fleet`` on the supervisor's own opserver serves the aggregated
+view (:meth:`FleetSupervisor.fleet_view` via :func:`active_fleet`, the
+same module-global hook pattern as ``repartition.active_controller``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+from typing import Dict, List, Optional, Tuple
+
+from spatialflink_tpu.runtime import fleet as F
+from spatialflink_tpu.runtime.checkpoint import atomic_write_json
+from spatialflink_tpu.runtime.repartition import (balance_leaves,
+                                                  pick_rebalance)
+from spatialflink_tpu.utils import metrics as _metrics
+
+_ACTIVE_FLEET: Optional["FleetSupervisor"] = None
+
+
+def active_fleet() -> Optional["FleetSupervisor"]:
+    """The running supervisor, if any (the ``/fleet`` endpoint's data
+    source — same pattern as ``repartition.active_controller``)."""
+    return _ACTIVE_FLEET
+
+
+def _set_active(sup: Optional["FleetSupervisor"]) -> None:
+    global _ACTIVE_FLEET
+    _ACTIVE_FLEET = sup
+
+
+# --------------------------------------------------------------------- #
+# worker argv
+
+
+#: flags the supervisor OWNS per worker (stripped from the inherited argv
+#: and re-issued with worker-specific values) or that must not recurse
+#: into a worker process; value = number of value tokens the flag takes.
+_WORKER_STRIP = {
+    "--fleet": 1, "--fleet-role": 1, "--fleet-dir": 1,
+    "--fleet-worker-id": 1, "--fleet-heartbeat": 1,
+    "--fleet-epoch-records": 1, "--fleet-restart-cap": 1,
+    "--fleet-chaos-kill": 1, "--fleet-slo-p99-ms": 1,
+    "--input1": 1, "--checkpoint-dir": 1, "--status-port": 1,
+    "--output": 1, "--postmortem-dir": 1, "--resume": 0,
+    "--limit": 1, "--telemetry-dir": 1, "--trace-dir": 1, "--profile": 1,
+}
+
+
+def _strip_flags(argv: List[str], spec: Dict[str, int]) -> List[str]:
+    out: List[str] = []
+    i = 0
+    while i < len(argv):
+        tok = argv[i]
+        name = tok.split("=", 1)[0]
+        if name in spec:
+            i += 1
+            if spec[name] and "=" not in tok:
+                i += spec[name]
+            continue
+        out.append(tok)
+        i += 1
+    return out
+
+
+def worker_argv(base_argv: List[str], *, fleet_dir: str, worker_id: int,
+                heartbeat_s: float, resume: bool) -> List[str]:
+    """A worker's driver argv: the supervisor's own argv minus the
+    fleet/placement flags, plus the worker-role glue. Everything else
+    (config, query option, panes, strict-recompile, SLO, metrics…)
+    inherits unchanged — a worker IS the single-process pipeline."""
+    wd = F.worker_dir(fleet_dir, worker_id)
+    argv = _strip_flags(list(base_argv), _WORKER_STRIP)
+    argv += [
+        "--fleet-role", "worker",
+        "--fleet-dir", fleet_dir,
+        "--fleet-worker-id", str(worker_id),
+        "--fleet-heartbeat", f"{heartbeat_s:g}",
+        "--input1", os.path.join(wd, F.PARTITION_FILE),
+        "--checkpoint-dir", os.path.join(wd, "ckpt"),
+        "--postmortem-dir", os.path.join(wd, "postmortem"),
+        "--status-port", "0",
+    ]
+    if resume:
+        argv.append("--resume")
+    return argv
+
+
+def _parse_chaos(spec: Optional[str]) -> Optional[Tuple[int, int]]:
+    """``WID:NWINDOWS`` — SIGKILL worker WID once its outbox holds
+    NWINDOWS lines (the deterministic kill hook the recovery tests and
+    the bench fault row use)."""
+    if not spec:
+        return None
+    wid, _, n = str(spec).partition(":")
+    return int(wid), max(1, int(n or 1))
+
+
+def _http_json(url: str, timeout: float = 1.0) -> Optional[dict]:
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return json.loads(r.read().decode())
+    except Exception:
+        return None
+
+
+def _worker_load(poll: dict) -> Optional[float]:
+    """A comparable load scalar from a worker's polled ops payloads:
+    prefer the backpressure/latency plane (record→emit p99), fall back to
+    None (caller then uses routed-record counts)."""
+    lat = (poll or {}).get("latency") or {}
+    re_h = lat.get("record_emit") or {}
+    for key in ("p99_ms", "p99"):
+        v = re_h.get(key)
+        if isinstance(v, (int, float)):
+            return float(v)
+    return None
+
+
+# --------------------------------------------------------------------- #
+# supervisor
+
+
+class FleetSupervisor:
+    """One supervisor process: spawns/monitors/restarts N worker drivers,
+    routes the input stream into per-worker partition files by grid leaf,
+    and merges the workers' canonical outboxes into the global window
+    table.
+
+    Cross-thread discipline: the monitor thread and the main routing loop
+    share process/poll state, so EVERY instance-attribute write outside
+    ``__init__`` holds ``self._lock`` (the invariant linter's
+    thread-shared-state rule proves this at the AST level). Durable state
+    (assignment, epoch, restart counts) lives in
+    :class:`~spatialflink_tpu.runtime.fleet.FleetManifest`, whose
+    snapshot/restore pair the checkpoint-coverage rule proves
+    field-by-field."""
+
+    def __init__(self, args, params, spec, base_argv: List[str]):
+        self._lock = threading.RLock()
+        self.n_workers = int(args.fleet)
+        self.root = args.fleet_dir
+        self.args = args
+        self.params = params
+        self.case = spec
+        self.base_argv = list(base_argv)
+        self.heartbeat_s = float(getattr(args, "fleet_heartbeat", 1.0))
+        self.hb_timeout_s = max(5.0, 5.0 * self.heartbeat_s)
+        self.boot_timeout_s = 120.0
+        self.epoch_records = max(1, int(getattr(args, "fleet_epoch_records",
+                                                20000) or 20000))
+        self.restart_cap = int(getattr(args, "fleet_restart_cap", 3))
+        self.slo_p99_ms = getattr(args, "fleet_slo_p99_ms", None)
+        self.manifest = F.FleetManifest(
+            os.path.join(self.root, F.MANIFEST_FILE))
+        self._chaos = _parse_chaos(getattr(args, "fleet_chaos_kill", None))
+        self._chaos_fired = False
+        self._procs: Dict[int, subprocess.Popen] = {}
+        self._logs: Dict[int, object] = {}
+        self._spawned_at: Dict[int, float] = {}
+        self._incarnations: Dict[int, int] = {}
+        self._urls: Dict[int, str] = {}
+        self._polls: Dict[int, dict] = {}
+        self._slo_strikes: Dict[int, int] = {}
+        self._kill_reason: Dict[int, str] = {}
+        self._rcs: Dict[int, int] = {}
+        self._restart_log: List[dict] = []
+        self._routed = 0
+        self._routed_by_worker: Dict[int, int] = {}
+        self._done_feeding = False
+        self._draining = False
+        self._stopping = False
+        self._failed: Optional[Tuple[int, int]] = None
+        self._monitor_thread: Optional[threading.Thread] = None
+
+    # -------------------------------------------------------------- #
+    # placement
+
+    def _leaf_fn(self):
+        """Vectorized line→leaf router over PR 8's leaf layout (default
+        layout = one leaf per base cell of the configured uniform grid)."""
+        from spatialflink_tpu.index.adaptive_grid import AdaptiveGrid
+        from spatialflink_tpu.streams.formats import parse_spatial
+
+        cfg = self.params.input1
+        grid = self.params.grids()[0]
+        refine = getattr(self.args, "adaptive_grid", None) or 4
+        leaves = AdaptiveGrid(grid, refine=refine)
+        geometry = self.case.stream
+        kw = cfg.geojson_kwargs()
+
+        def leaf_of(line: str) -> Optional[int]:
+            try:
+                obj = parse_spatial(line, cfg.format, grid,
+                                    delimiter=cfg.delimiter,
+                                    schema=cfg.csv_tsv_schema,
+                                    geometry=geometry, **kw)
+                if hasattr(obj, "x"):
+                    xs, ys = obj.x, obj.y
+                else:  # edge geometries place by bbox centroid
+                    b = obj.bbox
+                    xs, ys = (b[0] + b[2]) / 2, (b[1] + b[3]) / 2
+                leaf = leaves.assign_leaf(xs, ys)
+            except Exception:
+                return None
+            v = int(leaf if getattr(leaf, "ndim", 0) == 0 else leaf.flat[0])
+            return v if v >= 0 else None
+
+        return leaf_of
+
+    def _seed_assignment(self, leaf_of) -> None:
+        """Occupancy-seeded LPT packing from the input head (bounded by
+        one epoch of records, capped — seeding is a sample-based estimate
+        and must not re-parse a huge replay before routing starts); a
+        resumed supervisor keeps its manifest's assignment so worker
+        checkpoints stay aligned with their leaves."""
+        if self.manifest.fleet_assignment:
+            return
+        occ: Dict[int, int] = {}
+        scanned = 0
+        head = min(self.epoch_records, 10_000)
+        with open(self.args.input1) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                leaf = leaf_of(line)
+                if leaf is not None:
+                    occ[leaf] = occ.get(leaf, 0) + 1
+                scanned += 1
+                if scanned >= head:
+                    break
+        assignment = balance_leaves(occ, self.n_workers)
+        self.manifest.assign_all(assignment)
+        self.manifest.save()
+
+    # -------------------------------------------------------------- #
+    # worker lifecycle
+
+    def _spawn_locked(self, wid: int, *, resume: bool, reason: str) -> None:
+        wd = F.worker_dir(self.root, wid)
+        os.makedirs(wd, exist_ok=True)
+        inc = self._incarnations.get(wid, 0) + 1
+        self._incarnations[wid] = inc
+        argv = worker_argv(self.base_argv, fleet_dir=self.root,
+                           worker_id=wid, heartbeat_s=self.heartbeat_s,
+                           resume=resume)
+        log = self._logs.get(wid)
+        if log is None:
+            log = open(os.path.join(wd, "worker.log"), "a")
+            self._logs[wid] = log
+        log.write(f"--- incarnation {inc} ({reason}) ---\n")
+        log.flush()
+        self._procs[wid] = subprocess.Popen(
+            [sys.executable, "-m", "spatialflink_tpu.driver"] + argv,
+            stdout=log, stderr=subprocess.STDOUT,
+            start_new_session=True)  # controlled drain: WE forward signals
+        self._spawned_at[wid] = time.monotonic()
+        self._urls.pop(wid, None)
+        self._slo_strikes[wid] = 0
+
+    def _restart_locked(self, wid: int, rc: Optional[int],
+                        reason: str) -> None:
+        n = self.manifest.note_restart(wid)
+        self.manifest.save()
+        self._restart_log.append({"ts_ms": int(time.time() * 1000),
+                                  "worker": wid, "rc": rc,
+                                  "reason": reason, "restart": n})
+        if n > self.restart_cap:
+            self._failed = (wid, rc if rc is not None else -1)
+            return
+        self._spawn_locked(wid, resume=True, reason=reason)
+
+    def _monitor_loop(self) -> None:
+        next_poll = 0.0
+        while True:
+            with self._lock:
+                if self._stopping or self._failed:
+                    return
+                procs = dict(self._procs)
+            now = time.monotonic()
+            poll_ops = now >= next_poll
+            if poll_ops:
+                next_poll = now + max(1.0, self.heartbeat_s)
+            for wid, proc in procs.items():
+                rc = proc.poll()
+                if rc is not None:
+                    self._on_exit(wid, proc, rc)
+                    continue
+                self._check_liveness(wid, proc)
+                if poll_ops:
+                    self._poll_ops(wid)
+            self._check_chaos()
+            time.sleep(0.2)
+
+    def _on_exit(self, wid: int, proc: subprocess.Popen, rc: int) -> None:
+        with self._lock:
+            if self._procs.get(wid) is not proc:
+                return
+            del self._procs[wid]
+            self._rcs[wid] = rc
+            done = os.path.exists(
+                os.path.join(F.worker_dir(self.root, wid), F.DONE_MARKER))
+            if self._draining or self._stopping or (rc == 0 and done):
+                return  # clean finish after EOF, or drain in progress
+            reason = self._kill_reason.pop(wid, None) or (
+                f"exit rc={rc}" if rc != 0
+                else "exited before partition EOF")
+            self._restart_locked(wid, rc, reason)
+
+    def _check_liveness(self, wid: int, proc: subprocess.Popen) -> None:
+        hb = os.path.join(F.worker_dir(self.root, wid), F.HEARTBEAT_FILE)
+        age = F.heartbeat_age_s(hb)
+        with self._lock:
+            booted_s = time.monotonic() - self._spawned_at.get(wid, 0.0)
+        if age is None:
+            if booted_s > self.boot_timeout_s:
+                self._kill(wid, proc, "no heartbeat after boot timeout")
+        elif age > self.hb_timeout_s and booted_s > self.hb_timeout_s:
+            self._kill(wid, proc, f"heartbeat stale {age:.1f}s")
+
+    def _kill(self, wid: int, proc: subprocess.Popen, reason: str) -> None:
+        with self._lock:
+            self._kill_reason[wid] = reason
+        try:
+            proc.kill()
+        except OSError:
+            pass
+
+    def _poll_ops(self, wid: int) -> None:
+        url = self._resolve_url(wid)
+        if not url:
+            return
+        status = _http_json(f"{url}/status")
+        latency = _http_json(f"{url}/latency")
+        if status is None and latency is None:
+            return
+        with self._lock:
+            self._polls[wid] = {"status": status, "latency": latency,
+                                "ts_ms": int(time.time() * 1000)}
+        if self.slo_p99_ms:
+            p99 = _worker_load({"latency": latency})
+            with self._lock:
+                if p99 is not None and p99 > float(self.slo_p99_ms):
+                    self._slo_strikes[wid] = self._slo_strikes.get(wid,
+                                                                   0) + 1
+                    strikes = self._slo_strikes[wid]
+                else:
+                    self._slo_strikes[wid] = 0
+                    strikes = 0
+                proc = self._procs.get(wid)
+            if strikes >= 3 and proc is not None:
+                self._kill(wid, proc,
+                           f"slo breach: record_emit p99 {p99:.1f}ms > "
+                           f"{float(self.slo_p99_ms):g}ms x{strikes}")
+
+    def _resolve_url(self, wid: int) -> Optional[str]:
+        with self._lock:
+            url = self._urls.get(wid)
+        if url:
+            return url
+        doc = F.read_json(os.path.join(F.worker_dir(self.root, wid),
+                                       F.URL_FILE))
+        url = (doc or {}).get("url")
+        if url:
+            with self._lock:
+                self._urls[wid] = url
+        return url
+
+    def _check_chaos(self) -> None:
+        if self._chaos is None:
+            return
+        with self._lock:
+            if self._chaos_fired:
+                return
+            wid, n = self._chaos
+            proc = self._procs.get(wid)
+        if proc is None:
+            return
+        outbox = os.path.join(F.worker_dir(self.root, wid), F.OUTBOX_FILE)
+        try:
+            with open(outbox) as f:
+                lines = sum(1 for ln in f if ln.strip())
+        except OSError:
+            return
+        if lines >= n:
+            with self._lock:
+                self._chaos_fired = True
+            self._kill(wid, proc, f"chaos kill at {lines} windows")
+
+    # -------------------------------------------------------------- #
+    # routing
+
+    def _route(self, leaf_of) -> int:
+        """Feed the input file into per-worker partition files, one epoch
+        at a time; at each epoch boundary, flush, rebalance if a worker
+        is hot, and persist the manifest. Returns routed-record count."""
+        outs = {}
+        for wid in range(self.n_workers):
+            wd = F.worker_dir(self.root, wid)
+            os.makedirs(wd, exist_ok=True)
+            outs[wid] = open(os.path.join(wd, F.PARTITION_FILE), "a")
+        assignment = dict(self.manifest.fleet_assignment)
+        occ: Dict[int, int] = {}
+        routed = 0
+        epoch_n = 0
+        epoch_by_worker = {wid: 0 for wid in outs}
+        try:
+            with open(self.args.input1) as f:
+                for line in f:
+                    if _metrics.shutdown_requested():
+                        break
+                    with self._lock:
+                        if self._failed:
+                            break
+                    line = line.rstrip("\n")
+                    if not line.strip():
+                        continue
+                    if '"control"' in line:
+                        # stop tuples fan out: every worker must see one
+                        for w, out in outs.items():
+                            out.write(line + "\n")
+                            out.flush()
+                        routed += 1
+                        continue
+                    leaf = leaf_of(line)
+                    wid = (assignment.get(leaf, leaf % self.n_workers)
+                           if leaf is not None else routed % self.n_workers)
+                    outs[wid].write(line + "\n")
+                    routed += 1
+                    epoch_n += 1
+                    epoch_by_worker[wid] += 1
+                    if leaf is not None:
+                        occ[leaf] = occ.get(leaf, 0) + 1
+                    if epoch_n % 512 == 0:
+                        outs[wid].flush()
+                    if epoch_n >= self.epoch_records:
+                        for out in outs.values():
+                            out.flush()
+                        assignment = self._epoch_boundary(
+                            assignment, occ, epoch_by_worker)
+                        epoch_n = 0
+                        epoch_by_worker = {w: 0 for w in outs}
+                    if (self.args.limit is not None
+                            and routed >= self.args.limit):
+                        break
+            for out in outs.values():
+                out.flush()
+                os.fsync(out.fileno())
+        finally:
+            for out in outs.values():
+                out.close()
+        with self._lock:
+            self._routed = routed
+            for w, n in epoch_by_worker.items():
+                self._routed_by_worker[w] = (
+                    self._routed_by_worker.get(w, 0) + n)
+        return routed
+
+    def _epoch_boundary(self, assignment: Dict[int, int],
+                        occ: Dict[int, int],
+                        epoch_by_worker: Dict[int, int]) -> Dict[int, int]:
+        """Rebalance decision at an epoch boundary: worker loads come from
+        the polled backpressure/latency plane when available (record→emit
+        p99), else from this epoch's routed-record counts; leaves move
+        smallest-first from donor to receiver until roughly half the
+        spread is covered."""
+        with self._lock:
+            for w, n in epoch_by_worker.items():
+                self._routed_by_worker[w] = (
+                    self._routed_by_worker.get(w, 0) + n)
+            polls = dict(self._polls)
+        loads: Dict[int, float] = {}
+        for wid in range(self.n_workers):
+            sig = _worker_load(polls.get(wid, {}))
+            loads[wid] = (sig if sig is not None
+                          else float(epoch_by_worker.get(wid, 0)))
+        pair = pick_rebalance(loads)
+        if pair is not None:
+            donor, receiver = pair
+            donor_leaves = sorted(
+                (leaf for leaf, w in assignment.items() if w == donor),
+                key=lambda leaf: occ.get(leaf, 0))
+            budget = sum(occ.get(l, 0) for l in donor_leaves) // 4
+            moved = []
+            for leaf in donor_leaves[:-1]:  # never strip the last leaf
+                if budget <= 0:
+                    break
+                assignment[leaf] = receiver
+                budget -= occ.get(leaf, 0)
+                moved.append(leaf)
+            if moved:
+                self.manifest.assign_all({l: receiver for l in moved})
+                print(f"# fleet epoch {self.manifest.fleet_epoch + 1}: "
+                      f"moved {len(moved)} leaves worker{donor} -> "
+                      f"worker{receiver}", flush=True)
+        self.manifest.advance_epoch()
+        self.manifest.save()
+        return assignment
+
+    def _write_done_markers(self, routed: int) -> None:
+        for wid in range(self.n_workers):
+            atomic_write_json(
+                os.path.join(F.worker_dir(self.root, wid), F.DONE_MARKER),
+                {"routed_total": routed,
+                 "epoch": self.manifest.fleet_epoch})
+
+    # -------------------------------------------------------------- #
+    # fleet view
+
+    def fleet_view(self) -> dict:
+        """The ``/fleet`` payload: one aggregated snapshot of every
+        worker's liveness, restarts, and polled ops-plane state."""
+        from spatialflink_tpu.utils.telemetry import fleet_snapshot
+
+        with self._lock:
+            procs = dict(self._procs)
+            rcs = dict(self._rcs)
+            polls = dict(self._polls)
+            urls = dict(self._urls)
+            incs = dict(self._incarnations)
+            routed = self._routed
+            routed_by = dict(self._routed_by_worker)
+            restart_log = list(self._restart_log)
+        per_leaf: Dict[int, int] = {}
+        for leaf, wid in self.manifest.fleet_assignment.items():
+            per_leaf[wid] = per_leaf.get(wid, 0) + 1
+        workers = []
+        for wid in range(self.n_workers):
+            hb = os.path.join(F.worker_dir(self.root, wid),
+                              F.HEARTBEAT_FILE)
+            workers.append({
+                "worker": wid,
+                "alive": wid in procs,
+                "rc": rcs.get(wid),
+                "incarnations": incs.get(wid, 0),
+                "restarts": self.manifest.fleet_restarts.get(wid, 0),
+                "heartbeat_age_s": F.heartbeat_age_s(hb),
+                "url": urls.get(wid),
+                "leaves": per_leaf.get(wid, 0),
+                "routed": routed_by.get(wid, 0),
+                "status": (polls.get(wid) or {}).get("status"),
+                "latency": (polls.get(wid) or {}).get("latency"),
+            })
+        return fleet_snapshot(workers, epoch=self.manifest.fleet_epoch,
+                              routed=routed, restart_log=restart_log)
+
+    # -------------------------------------------------------------- #
+    # run
+
+    def run(self) -> int:
+        os.makedirs(self.root, exist_ok=True)
+        leaf_of = self._leaf_fn()
+        self._seed_assignment(leaf_of)
+        graceful = False
+        with self._lock:
+            for wid in range(self.n_workers):
+                ckpt = os.path.join(F.worker_dir(self.root, wid), "ckpt")
+                resume = bool(os.path.isdir(ckpt) and os.listdir(ckpt))
+                self._spawn_locked(wid, resume=resume, reason="start")
+            self._monitor_thread = threading.Thread(
+                target=self._monitor_loop, name="fleet-monitor",
+                daemon=True)
+            self._monitor_thread.start()
+        try:
+            routed = self._route(leaf_of)
+            graceful = _metrics.shutdown_requested()
+            if graceful:
+                self._forward_sigterm()
+            else:
+                self._write_done_markers(routed)
+            with self._lock:
+                self._done_feeding = True
+            rc = self._await_workers()
+            if rc != 0:
+                return rc
+            # a SIGTERM landing after EOF (while workers drain their
+            # already-complete partitions) is still a graceful stop
+            graceful = graceful or _metrics.shutdown_requested()
+            return self._finish(routed, graceful)
+        finally:
+            with self._lock:
+                self._stopping = True
+                procs = dict(self._procs)
+            for proc in procs.values():
+                if proc.poll() is None:
+                    proc.terminate()
+            mon = self._monitor_thread
+            if mon is not None:
+                mon.join(timeout=5.0)
+            for log in self._logs.values():
+                try:
+                    log.close()
+                except OSError:
+                    pass
+
+    def _forward_sigterm(self) -> None:
+        with self._lock:
+            if self._draining:
+                return
+            self._draining = True
+            procs = dict(self._procs)
+        print("# fleet: draining workers (SIGTERM)", flush=True)
+        for proc in procs.values():
+            if proc.poll() is None:
+                try:
+                    proc.terminate()
+                except OSError:
+                    pass
+
+    def _await_workers(self) -> int:
+        """Wait for every worker to reach a clean exit; the monitor keeps
+        restarting crashed ones until the restart cap trips."""
+        while True:
+            if _metrics.shutdown_requested():
+                self._forward_sigterm()  # SIGTERM after EOF: drain anyway
+            with self._lock:
+                failed = self._failed
+                procs = dict(self._procs)
+            if failed:
+                wid, rc = failed
+                print(f"# fleet: worker{wid} failed permanently "
+                      f"(rc={rc}, restart cap {self.restart_cap})",
+                      file=sys.stderr, flush=True)
+                return 1
+            if not procs:
+                return 0
+            time.sleep(0.1)
+
+    def _finish(self, routed: int, graceful: bool) -> int:
+        per_worker = {}
+        runs = {}
+        compiles = 0
+        for wid in range(self.n_workers):
+            wd = F.worker_dir(self.root, wid)
+            per_worker[wid] = F.read_outbox(
+                os.path.join(wd, F.OUTBOX_FILE))
+            runs[wid] = F.read_runs(wd)
+            compiles += sum(int(r.get("post_warmup_compiles") or 0)
+                            for r in runs[wid])
+        merged = F.merge_outboxes(per_worker, self.case.family,
+                                  k=self.params.query.k)
+        tmp = os.path.join(self.root, F.MERGED_FILE + ".tmp")
+        with open(tmp, "w") as f:
+            for doc in merged:
+                f.write(json.dumps(doc, sort_keys=True) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(self.root, F.MERGED_FILE))
+        digest = F.merged_table_digest(merged)
+        with self._lock:
+            restart_log = list(self._restart_log)
+        result = {
+            "digest": digest,
+            "workers": self.n_workers,
+            "routed": routed,
+            "merged_windows": len(merged),
+            "epochs": self.manifest.fleet_epoch,
+            "restarts": {str(k): v for k, v in
+                         self.manifest.fleet_restarts.items()},
+            "restart_log": restart_log,
+            "post_warmup_compiles": compiles,
+            "graceful": graceful,
+            "runs": {str(k): v for k, v in runs.items()},
+        }
+        atomic_write_json(os.path.join(self.root, F.RESULT_FILE), result)
+        print(f"# fleet merged {len(merged)} windows from "
+              f"{self.n_workers} workers (routed {routed}, "
+              f"restarts {sum(self.manifest.fleet_restarts.values())}, "
+              f"post-warmup compiles {compiles}, digest {digest[:16]})",
+              flush=True)
+        return 0
+
+
+# --------------------------------------------------------------------- #
+# driver entry
+
+
+def run_supervisor(args, params, spec, base_argv: List[str]) -> int:
+    """``--fleet N``: run the supervisor role. Owns its own opserver
+    (serving ``/fleet``) and the SIGTERM drain handler; returns the
+    process exit code."""
+    from spatialflink_tpu.runtime.opserver import OpServer
+
+    sup = FleetSupervisor(args, params, spec, base_argv)
+    _set_active(sup)
+    _metrics.clear_shutdown()
+    prev_term = None
+    on_main = threading.current_thread() is threading.main_thread()
+    if on_main:
+        prev_term = signal.signal(
+            signal.SIGTERM, lambda s, f: _metrics.request_shutdown())
+    server = None
+    if args.status_port is not None:
+        server = OpServer(port=args.status_port).start()
+        print(f"# fleet opserver: {server.url}/fleet", flush=True)
+    try:
+        return sup.run()
+    finally:
+        if server is not None:
+            server.close()
+        if on_main and prev_term is not None:
+            signal.signal(signal.SIGTERM, prev_term)
+        _set_active(None)

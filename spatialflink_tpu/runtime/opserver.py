@@ -83,12 +83,13 @@ _ROUTES = {
     "/profile/cells": ("GET",), "/partition": ("GET",),
     "/queries": ("GET", "POST"),
     "/device": ("GET",), "/compile": ("GET",), "/latency": ("GET",),
+    "/fleet": ("GET",),
 }
 _PREFIX_ROUTES = {"/trace/": ("GET",), "/queries/": ("GET", "DELETE")}
 
 _ENDPOINTS = ["/healthz", "/status", "/metrics", "/events", "/trace/recent",
               "/trace/<id>", "/profile/cells", "/partition", "/queries",
-              "/queries/<id>", "/device", "/compile", "/latency"]
+              "/queries/<id>", "/device", "/compile", "/latency", "/fleet"]
 
 
 def _allowed_methods(path: str):
@@ -209,6 +210,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(200, srv.latency_payload())
         elif path == "/partition":
             self._send_json(200, srv.partition_payload())
+        elif path == "/fleet":
+            self._send_json(200, srv.fleet_payload())
         elif path == "/device":
             self._send_json(200, srv.device_payload())
         elif path == "/compile":
@@ -476,6 +479,22 @@ class OpServer:
                             "(enable with --adaptive-grid)"}
         payload = ctl.status()
         payload["adaptive"] = True
+        return payload
+
+    def fleet_payload(self) -> dict:
+        """``/fleet``: the supervisor's aggregated view of every worker —
+        liveness, restarts, heartbeat age, leaf share, and the last polled
+        per-worker ``/status``/``/latency`` payloads; an explanatory note
+        on a single-process (non-fleet) run."""
+        from spatialflink_tpu.runtime.fleetsup import active_fleet
+
+        sup = active_fleet()
+        if sup is None:
+            return {"fleet": False,
+                    "note": "not a fleet supervisor "
+                            "(start one with --fleet N)"}
+        payload = sup.fleet_view()
+        payload["fleet"] = True
         return payload
 
     # ------------------------------ lifecycle -------------------------- #

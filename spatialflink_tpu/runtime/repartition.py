@@ -375,3 +375,48 @@ class RepartitionController:
                                     int(meta.get("version", 0)))
 
         coordinator.register("grid", snapshot, restore)
+
+
+# --------------------------------------------------------------------- #
+# fleet placement: leaves as the unit of worker assignment
+
+
+def balance_leaves(occupancy, n_workers: int):
+    """Greedy LPT packing of leaves onto ``n_workers`` by observed
+    occupancy: heaviest leaf first onto the lightest worker. This is the
+    fleet supervisor's initial placement (PR 8's leaf layout as the
+    placement unit — under the uniform grid the leaves ARE the base
+    cells), and the same routine re-packs after a rebalance decision.
+
+    ``occupancy`` maps leaf id -> observed record count (a seed-scan or a
+    full epoch); returns leaf id -> worker index. Leaves never observed
+    route by ``leaf % n_workers`` at partition time (see
+    ``fleet.Partitioner``) — LPT only places the leaves we have signal
+    for."""
+    n = max(1, int(n_workers))
+    loads = [0] * n
+    assignment = {}
+    for leaf, count in sorted(occupancy.items(),
+                              key=lambda kv: (-kv[1], kv[0])):
+        w = min(range(n), key=lambda i: loads[i])
+        assignment[int(leaf)] = w
+        loads[w] += int(count)
+    return assignment
+
+
+def pick_rebalance(loads):
+    """(donor, receiver) worker pair for a repartition epoch, from a
+    backpressure-style load signal (worker -> scalar; the fleet feeds the
+    aggregated ``/latency`` backpressure share, falling back to record
+    throughput). Returns ``None`` when the spread is too small to act on
+    (hysteresis: moving leaves for a <25% imbalance would thrash)."""
+    if len(loads) < 2:
+        return None
+    donor = max(loads, key=lambda w: loads[w])
+    receiver = min(loads, key=lambda w: loads[w])
+    if donor == receiver:
+        return None
+    hi, lo = float(loads[donor]), float(loads[receiver])
+    if hi <= 0 or (hi - lo) / hi < 0.25:
+        return None
+    return donor, receiver

@@ -31,6 +31,35 @@ class ControlTupleExit(Exception):
     to crash the Flink job — a crude remote stop)."""
 
 
+class GracefulShutdown(ControlTupleExit):
+    """A SIGTERM-style stop request: drain buffered records into the
+    pipeline, let sealed windows emit, write a final checkpoint, exit 0.
+    Subclasses :class:`ControlTupleExit` so every existing stop path
+    (decode buffer flush, driver summary, conservative Kafka commits)
+    treats it as the graceful stop it is; the driver additionally writes
+    a final coordinated checkpoint when the stop came from a signal."""
+
+
+#: process-wide shutdown request flag (set from the driver's SIGTERM
+#: handler; checked at record boundaries so no in-flight record is lost)
+_SHUTDOWN = threading.Event()
+
+
+def request_shutdown() -> None:
+    """Ask the running pipeline to stop gracefully at the next record
+    boundary (signal-handler safe: just sets an event)."""
+    _SHUTDOWN.set()
+
+
+def shutdown_requested() -> bool:
+    return _SHUTDOWN.is_set()
+
+
+def clear_shutdown() -> None:
+    """Reset the flag (run start / test isolation)."""
+    _SHUTDOWN.clear()
+
+
 def check_exit_control_tuple(record) -> None:
     """Raise :class:`ControlTupleExit` if ``record`` is a control tuple.
 

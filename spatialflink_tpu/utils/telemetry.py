@@ -1160,6 +1160,29 @@ def status_snapshot(tel: Optional[Telemetry] = None, health=None,
     return snap
 
 
+def fleet_snapshot(workers: list, *, epoch: int = 0, routed: int = 0,
+                   restart_log: Optional[list] = None) -> dict:
+    """The fleet supervisor's aggregated snapshot schema (``fleet-v1``,
+    served at ``GET /fleet``): one row per worker (liveness, restarts,
+    heartbeat age, leaf share, last polled per-worker ops payloads) plus
+    the fleet-level totals the doctor and the rebalance policy read. A
+    schema builder, not a poller — the supervisor supplies the rows so
+    this stays testable without processes."""
+    alive = sum(1 for w in workers if w.get("alive"))
+    restarts = sum(int(w.get("restarts") or 0) for w in workers)
+    return {
+        "schema": "fleet-v1",
+        "ts_ms": int(time.time() * 1000),
+        "workers": workers,
+        "n_workers": len(workers),
+        "alive": alive,
+        "epoch": int(epoch),
+        "routed": int(routed),
+        "restarts_total": restarts,
+        "restart_log": list(restart_log or [])[-50:],
+    }
+
+
 # --------------------------------------------------------------------- #
 # reporter
 

@@ -26,12 +26,20 @@ def test_bench_guard_passes_thresholds():
             if ln.startswith("{")]
     assert [x["path"] for x in rows] == [
         "window_assign", "decode_columnar", "windowed_pipeline",
-        "skew_adaptive", "query_plane", "latency_record_emit"], r.stdout
+        "skew_adaptive", "query_plane", "latency_record_emit",
+        "fleet_scaling"], r.stdout
     assert all(x["speedup"] > 0 for x in rows if "speedup" in x)
     # the lower-is-better latency row (record→emit p99 through the
     # latency-decomposition plane, gated against its baseline ceiling)
     lat = [x for x in rows if x["path"] == "latency_record_emit"]
     assert len(lat) == 1 and lat[0]["p99_ms"] > 0
+    # the lower-is-better fleet row (absolute single-worker supervised-
+    # fleet wall at the pinned record count, gated against its ceiling;
+    # the bench asserts merged-digest identity across N=1/N=2 in-run)
+    fl = [x for x in rows if x["path"] == "fleet_scaling"]
+    assert len(fl) == 1 and fl[0]["wall_fleet1_s"] > 0
+    assert fl[0]["scaling_n2"] > 0 and fl[0]["overhead_x"] > 0
+    assert fl[0]["merged_windows"] > 0
     assert r.returncode == 0, (
         f"bench_guard regression:\n{r.stdout}\n{r.stderr[-1000:]}")
 
@@ -50,3 +58,6 @@ def test_guard_baseline_rows_exist():
     assert {r["path"] for r in base["latency_rows"]} == {
         "latency_record_emit"}
     assert all(r["p99_ms"] > 0 for r in base["latency_rows"])
+    # the fleet supervision-cost ceiling (lower-is-better third pass)
+    assert {r["path"] for r in base["fleet_rows"]} == {"fleet_scaling"}
+    assert all(r["wall_fleet1_s"] > 0 for r in base["fleet_rows"])

@@ -1,0 +1,432 @@
+"""Supervised multi-worker fleet suite (runtime/fleet.py +
+runtime/fleetsup.py, driver --fleet).
+
+Headline invariant: an N-worker fleet over a leaf-partitioned file replay
+— including one forcibly SIGKILLed worker restarted from its checkpoint —
+produces a merged global window table BYTE-IDENTICAL to a fault-free
+single-worker run, with zero post-warmup recompiles across every
+incarnation. Plus: the leaf packing / rebalance policy, the tailing
+partition source, outbox dedup + fingerprint cross-check, the per-family
+global merge seam, the fleet manifest's durability, worker argv
+construction, the /fleet endpoint, and doctor fleet.
+
+Fast deterministic cases run in tier-1; the randomized kill-point fuzz is
+additionally marked ``slow``.
+"""
+
+import json
+import os
+import random
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+import yaml
+
+from spatialflink_tpu.driver import main
+from spatialflink_tpu.index import UniformGrid
+from spatialflink_tpu.operators.base import merge_window_records
+from spatialflink_tpu.runtime import fleet as F
+from spatialflink_tpu.runtime.fleetsup import (_strip_flags, active_fleet,
+                                               worker_argv)
+from spatialflink_tpu.runtime.repartition import (balance_leaves,
+                                                  pick_rebalance)
+from spatialflink_tpu.streams import SyntheticPointSource, serialize_spatial
+from spatialflink_tpu.utils import metrics as _metrics
+
+pytestmark = pytest.mark.fleet
+
+CONF = "conf/spatialflink-conf.yml"
+
+
+@pytest.fixture(autouse=True)
+def _clear_shutdown_flag():
+    _metrics.clear_shutdown()
+    yield
+    _metrics.clear_shutdown()
+
+
+def _grid():
+    return UniformGrid(115.5, 117.6, 39.6, 41.1, num_grid_partitions=100)
+
+
+def _lines(n_traj=6, steps=40, seed=3):
+    pts = list(SyntheticPointSource(_grid(), num_trajectories=n_traj,
+                                    steps=steps, seed=seed))
+    return [serialize_spatial(p, "GeoJSON") for p in pts]
+
+
+def _write_input(tmp_path, lines, name="in1.geojson"):
+    p = tmp_path / name
+    p.write_text("\n".join(lines) + "\n")
+    return str(p)
+
+
+def _fleet_argv(cfg, path1, fleet_dir, n, *extra, option="1"):
+    return (["--config", cfg, "--option", option, "--input1", path1,
+             "--fleet", str(n), "--fleet-dir", str(fleet_dir),
+             "--fleet-heartbeat", "0.25",
+             "--fleet-epoch-records", "100"] + list(extra))
+
+
+def _result(fleet_dir):
+    doc = F.read_json(os.path.join(str(fleet_dir), F.RESULT_FILE))
+    assert doc is not None, "fleet run left no fleet_result.json"
+    return doc
+
+
+def _merged_table(fleet_dir):
+    out = []
+    with open(os.path.join(str(fleet_dir), F.MERGED_FILE)) as f:
+        for line in f:
+            if line.strip():
+                out.append(json.loads(line))
+    return out
+
+
+# ---------------------------------------------------------------- policy
+
+
+def test_balance_leaves_lpt_packing():
+    occ = {1: 100, 2: 90, 3: 10, 4: 10, 5: 10}
+    a = balance_leaves(occ, 2)
+    # the two hot leaves must land on different workers (greedy LPT)
+    assert a[1] != a[2]
+    loads = {0: 0, 1: 0}
+    for leaf, w in a.items():
+        loads[w] += occ[leaf]
+    assert abs(loads[0] - loads[1]) <= 30
+
+
+def test_balance_leaves_single_worker_and_empty():
+    assert balance_leaves({}, 3) == {}
+    a = balance_leaves({7: 5, 9: 1}, 1)
+    assert set(a.values()) == {0}
+
+
+def test_pick_rebalance_hysteresis():
+    # <25% spread: leave the fleet alone
+    assert pick_rebalance({0: 100.0, 1: 80.0}) is None
+    assert pick_rebalance({0: 0.0, 1: 0.0}) is None
+    assert pick_rebalance({0: 5.0}) is None
+    donor, receiver = pick_rebalance({0: 100.0, 1: 10.0, 2: 50.0})
+    assert (donor, receiver) == (0, 1)
+
+
+# ------------------------------------------------------- tailing source
+
+
+def test_tailing_source_follows_until_done_marker(tmp_path):
+    part = str(tmp_path / "p.ndjson")
+    done = str(tmp_path / "p.done")
+    src = F.TailingReplaySource(part, done, poll_s=0.01)
+    got = []
+
+    def consume():
+        got.extend(src)
+
+    t = threading.Thread(target=consume)
+    t.start()
+    with open(part, "w") as f:
+        f.write("a\nb\n")
+        f.flush()
+        time.sleep(0.1)
+        f.write("c")  # torn line: must be held back
+        f.flush()
+        time.sleep(0.1)
+        assert got == ["a", "b"]
+        f.write("\nd\n")
+        f.flush()
+    F.atomic_write_json(done, {"routed_total": 4})
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert got == ["a", "b", "c", "d"]
+
+
+def test_tailing_source_skip_limit_and_empty_partition(tmp_path):
+    part = str(tmp_path / "p.ndjson")
+    done = str(tmp_path / "p.done")
+    open(part, "w").write("a\nb\nc\nd\n")
+    open(done, "w").write("{}")
+    assert list(F.TailingReplaySource(part, done, skip=1, limit=2)) == \
+        ["b", "c"]
+    # done marker with no partition file at all: clean empty stream
+    os.unlink(part)
+    assert list(F.TailingReplaySource(part, done)) == []
+
+
+def test_tailing_source_graceful_shutdown_while_idle(tmp_path):
+    part = str(tmp_path / "p.ndjson")
+    done = str(tmp_path / "p.done")
+    open(part, "w").write("a\n")
+    src = F.TailingReplaySource(part, done, poll_s=0.01)
+    it = iter(src)
+    assert next(it) == "a"
+    _metrics.request_shutdown()
+    with pytest.raises(_metrics.GracefulShutdown):
+        next(it)  # idle-tailing: the stop must not hang the worker
+
+
+def test_tailing_source_stall_timeout(tmp_path):
+    part = str(tmp_path / "p.ndjson")
+    open(part, "w").write("a\n")
+    src = F.TailingReplaySource(part, str(tmp_path / "p.done"),
+                                poll_s=0.01, stall_timeout_s=0.1)
+    with pytest.raises(RuntimeError, match="stalled"):
+        list(src)
+
+
+# -------------------------------------------------- outbox + global merge
+
+
+def _doc(key, records, fp="x", cell=None):
+    return {"key": key, "window": [0, 5], "cell": cell, "records": records,
+            "count": len(records), "fp": fp}
+
+
+def test_read_outbox_dedups_crash_replay_duplicates(tmp_path):
+    p = str(tmp_path / "outbox.jsonl")
+    with open(p, "w") as f:
+        f.write(json.dumps(_doc("0:5:None", ["r1"], fp="aa")) + "\n")
+        f.write(json.dumps(_doc("0:5:None", ["r1"], fp="aa")) + "\n")
+        f.write(json.dumps(_doc("5:10:None", ["r2"], fp="bb")) + "\n")
+        f.write('{"torn')  # kill mid-write: ignored, replayed later
+    out = F.read_outbox(p)
+    assert sorted(out) == ["0:5:None", "5:10:None"]
+
+
+def test_read_outbox_raises_on_divergent_duplicate(tmp_path):
+    p = str(tmp_path / "outbox.jsonl")
+    with open(p, "w") as f:
+        f.write(json.dumps(_doc("0:5:None", ["r1"], fp="aa")) + "\n")
+        f.write(json.dumps(_doc("0:5:None", ["r2"], fp="cc")) + "\n")
+    with pytest.raises(F.FleetMergeError, match="exactly-once"):
+        F.read_outbox(p)
+
+
+def test_merge_outboxes_union_family_is_assignment_independent():
+    w0 = {"0:5:None": _doc("0:5:None", ["b", "a"])}
+    w1 = {"0:5:None": _doc("0:5:None", ["c"]),
+          "5:10:None": _doc("5:10:None", ["d"])}
+    merged = F.merge_outboxes({0: w0, 1: w1}, "range")
+    assert [m["key"] for m in merged] == ["0:5:None", "5:10:None"]
+    assert merged[0]["records"] == ["a", "b", "c"]  # sorted union
+    # flipping which worker held what must not change the table digest
+    flipped = F.merge_outboxes({0: w1, 1: w0}, "range")
+    assert F.merged_table_digest(merged) == F.merged_table_digest(flipped)
+
+
+def test_merge_outboxes_knn_re_topk():
+    w0 = {"0:5:None": _doc("0:5:None", [["a", 1.0], ["b", 2.0]])}
+    w1 = {"0:5:None": _doc("0:5:None", [["c", 0.5], ["a", 1.0]])}
+    merged = F.merge_outboxes({0: w0, 1: w1}, "knn", k=2)
+    assert merged[0]["records"] == [["c", 0.5], ["a", 1.0]]
+
+
+def test_merge_window_records_seam():
+    assert merge_window_records("range", [["a"], ["b"]]) == ["a", "b"]
+    top = merge_window_records("knn", [[("a", 2.0)], [("b", 1.0)]], k=1)
+    assert top == [("b", 1.0)]
+    with pytest.raises(ValueError, match="kNN merge needs k"):
+        merge_window_records("knn", [[("a", 1.0)]])
+
+
+# ------------------------------------------------------- fleet manifest
+
+
+def test_fleet_manifest_roundtrip(tmp_path):
+    p = str(tmp_path / "fleet.json")
+    m = F.FleetManifest(p)
+    m.assign_all({1: 0, 2: 1})
+    m.assign(3, 0)
+    assert m.advance_epoch() == 1
+    assert m.note_restart(1) == 1
+    assert m.note_restart(1) == 2
+    m.save()
+    m2 = F.FleetManifest(p)  # a crashed supervisor reloads everything
+    assert m2.fleet_assignment == {1: 0, 2: 1, 3: 0}
+    assert m2.fleet_epoch == 1
+    assert m2.fleet_restarts == {1: 2}
+
+
+# --------------------------------------------------------- worker argv
+
+
+def test_worker_argv_strips_and_reissues():
+    base = ["--config", "c.yml", "--option", "1",
+            "--input1", "/orig/in.geojson", "--fleet", "4",
+            "--fleet-dir", "/orig/fleet", "--limit", "100",
+            "--checkpoint-dir", "/orig/ckpt", "--resume",
+            "--strict-recompile", "--panes"]
+    argv = worker_argv(base, fleet_dir="/f", worker_id=2,
+                       heartbeat_s=0.5, resume=True)
+    # fleet/placement flags replaced, pipeline flags inherited
+    assert "--strict-recompile" in argv and "--panes" in argv
+    assert "/orig/in.geojson" not in argv and "/orig/ckpt" not in argv
+    assert "--limit" not in argv  # the supervisor already applied it
+    assert argv[argv.index("--fleet-worker-id") + 1] == "2"
+    assert argv[argv.index("--input1") + 1].endswith(
+        os.path.join("worker2", F.PARTITION_FILE))
+    assert argv.count("--resume") == 1
+    no_resume = worker_argv(base, fleet_dir="/f", worker_id=0,
+                            heartbeat_s=0.5, resume=False)
+    assert "--resume" not in no_resume
+
+
+def test_strip_flags_handles_equals_form():
+    out = _strip_flags(["--fleet=2", "--option", "1", "--limit=5"],
+                       {"--fleet": 1, "--limit": 1})
+    assert out == ["--option", "1"]
+
+
+# ------------------------------------------------------ canonical window
+
+
+def test_canonical_window_doc_matches_journal_key():
+    from spatialflink_tpu.operators import WindowResult
+
+    r = WindowResult(0, 5000, ["x"], extras={"cell": 7})
+    doc = F.canonical_window_doc(r, "range")
+    assert doc["key"] == "0:5000:7"
+    assert doc["window"] == [0, 5000]
+    # identical content => identical fingerprint (the dedup cross-check)
+    assert doc["fp"] == F.canonical_window_doc(r, "range")["fp"]
+
+
+# ----------------------------------------------------- /fleet endpoint
+
+
+def test_fleet_endpoint_without_supervisor_notes_absence():
+    from spatialflink_tpu.runtime.opserver import OpServer
+
+    assert active_fleet() is None
+    srv = OpServer(port=0).start()
+    try:
+        import urllib.request
+
+        with urllib.request.urlopen(f"{srv.url}/fleet", timeout=5) as r:
+            doc = json.loads(r.read().decode())
+        assert doc["fleet"] is False and "--fleet" in doc["note"]
+    finally:
+        srv.close()
+
+
+def test_fleet_snapshot_schema():
+    from spatialflink_tpu.utils.telemetry import fleet_snapshot
+
+    snap = fleet_snapshot([{"worker": 0, "alive": True, "restarts": 2},
+                           {"worker": 1, "alive": False, "restarts": 0}],
+                          epoch=3, routed=100)
+    assert snap["schema"] == "fleet-v1"
+    assert snap["n_workers"] == 2 and snap["alive"] == 1
+    assert snap["restarts_total"] == 2 and snap["epoch"] == 3
+
+
+# --------------------------------------------------- integration smoke
+
+
+def _conf_file(tmp_path):
+    with open(CONF) as f:
+        d = yaml.safe_load(f)
+    p = tmp_path / "conf.yml"
+    p.write_text(yaml.safe_dump(d))
+    return str(p)
+
+
+def test_fleet_kill_recovery_identity_vs_single_worker(tmp_path):
+    """THE acceptance test: N=2 workers over a file replay, worker 0
+    SIGKILLed mid-run by the chaos hook, restarted from its checkpoint by
+    the supervisor — and the merged window table (and its digest) is
+    byte-identical to a fault-free single-worker fleet run, with zero
+    post-warmup recompiles across every incarnation."""
+    cfg = _conf_file(tmp_path)
+    path1 = _write_input(tmp_path, _lines())
+
+    oracle_dir = tmp_path / "fleet1"
+    assert main(_fleet_argv(cfg, path1, oracle_dir, 1)) == 0
+    oracle = _result(oracle_dir)
+    assert oracle["merged_windows"] > 0
+    assert oracle["post_warmup_compiles"] == 0
+
+    kill_dir = tmp_path / "fleet2k"
+    assert main(_fleet_argv(cfg, path1, kill_dir, 2,
+                            "--fleet-chaos-kill", "0:1")) == 0
+    killed = _result(kill_dir)
+    assert sum(int(v) for v in killed["restarts"].values()) >= 1, \
+        "chaos kill never fired — the restart path went untested"
+    assert killed["digest"] == oracle["digest"], \
+        "merged fleet output diverged from the single-worker oracle"
+    assert killed["post_warmup_compiles"] == 0, \
+        "a worker respawn silently recompiled"
+    # the tables themselves, not just the digest
+    o_table = _merged_table(oracle_dir)
+    k_table = _merged_table(kill_dir)
+    assert [(m["key"], m["records"]) for m in k_table] == \
+        [(m["key"], m["records"]) for m in o_table]
+    # supervision left an audit trail
+    log = killed["restart_log"]
+    assert any("chaos kill" in (r.get("reason") or "") for r in log)
+    # doctor fleet reads the same directory
+    from spatialflink_tpu import doctor
+
+    rc = doctor.main(["--json", "fleet", str(kill_dir)])
+    assert rc == 0
+
+
+@pytest.mark.slow
+def test_fleet_randomized_kill_fuzz(tmp_path):
+    """Randomized kill points: whichever window count the kill lands on,
+    the merged table must match the single-worker oracle."""
+    cfg = _conf_file(tmp_path)
+    path1 = _write_input(tmp_path, _lines(n_traj=8, steps=60))
+
+    oracle_dir = tmp_path / "oracle"
+    assert main(_fleet_argv(cfg, path1, oracle_dir, 1)) == 0
+    oracle = _result(oracle_dir)
+
+    rng = random.Random(11)
+    for trial in range(3):
+        wid = rng.randrange(2)
+        nth = rng.randint(1, 6)
+        fdir = tmp_path / f"fuzz{trial}"
+        assert main(_fleet_argv(cfg, path1, fdir, 2, "--fleet-chaos-kill",
+                                f"{wid}:{nth}")) == 0
+        got = _result(fdir)
+        assert got["digest"] == oracle["digest"], \
+            f"trial {trial}: kill {wid}:{nth} changed the merged output"
+        assert got["post_warmup_compiles"] == 0
+
+
+@pytest.mark.slow
+def test_fleet_supervisor_sigterm_drains_workers(tmp_path):
+    """SIGTERM to the supervisor: routing stops, workers drain (final
+    checkpoint each), the partial merge is written, exit 0."""
+    cfg = _conf_file(tmp_path)
+    path1 = _write_input(tmp_path, _lines(n_traj=10, steps=200))
+    fdir = tmp_path / "drain"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "spatialflink_tpu.driver"]
+        + _fleet_argv(cfg, path1, fdir, 2),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    try:
+        deadline = time.monotonic() + 60
+        started = False
+        while time.monotonic() < deadline:
+            if any(os.path.exists(os.path.join(F.worker_dir(str(fdir), w),
+                                               F.OUTBOX_FILE))
+                   for w in (0, 1)):
+                started = True
+                break
+            time.sleep(0.2)
+        assert started, "fleet never started emitting"
+        proc.terminate()
+        out, _ = proc.communicate(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+    assert proc.returncode == 0, out.decode()[-2000:]
+    result = _result(fdir)
+    assert result["graceful"] is True

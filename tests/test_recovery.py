@@ -407,6 +407,59 @@ def test_resume_against_different_source_refused(tmp_path):
               "--checkpoint-dir", cpd, "--resume"])
 
 
+def test_sigterm_graceful_drain_writes_final_checkpoint_and_resumes(
+        tmp_path, monkeypatch, capsys):
+    """Graceful shutdown on the single-process driver: a stop request
+    mid-stream (what the SIGTERM handler raises) drains the records
+    already decoded, writes a FINAL checkpoint past the regular cadence,
+    and exits 0 — then ``--resume`` completes the stream and
+    stopped+resumed output is exactly the uninterrupted run's."""
+    lines = _lines(n_traj=8, steps=60)
+    path1 = str(tmp_path / "in1.geojson")
+    open(path1, "w").write("\n".join(lines))
+    cfg, _url = _conf(tmp_path, "sig")
+    # small decode chunks so windows emit interleaved with decoding —
+    # otherwise the whole file buffers before the first _emit and the
+    # stop request can never land mid-stream
+    monkeypatch.setenv("SPATIALFLINK_DECODE_CHUNK", "16")
+
+    assert main(["--config", cfg, "--option", "1", "--input1", path1]) == 0
+    oracle = capsys.readouterr().out.splitlines()
+    assert len(oracle) > 3
+
+    cpd = str(tmp_path / "cp-sig")
+    argv = ["--config", cfg, "--option", "1", "--input1", path1,
+            "--checkpoint-dir", cpd, "--checkpoint-every", "2"]
+    from spatialflink_tpu import driver as drv
+    from spatialflink_tpu.utils import metrics as _metrics
+
+    orig_emit = drv._emit
+    state = {"n": 0}
+
+    def stop_after_two(result, sink):
+        orig_emit(result, sink)
+        state["n"] += 1
+        if state["n"] == 2:
+            _metrics.request_shutdown()
+
+    try:
+        with monkeypatch.context() as m:
+            m.setattr(drv, "_emit", stop_after_two)
+            assert main(argv) == 0, "graceful stop must NOT be a crash exit"
+        cap = capsys.readouterr()
+        stopped = cap.out.splitlines()
+        assert "graceful shutdown: final checkpoint" in cap.err
+        assert 0 < len(stopped) < len(oracle), \
+            "the stop request never landed mid-stream"
+
+        assert main(argv + ["--resume"]) == 0
+        resumed = capsys.readouterr().out.splitlines()
+        assert sorted(stopped + resumed) == sorted(oracle), \
+            "SIGTERM drain + resume lost or duplicated windows"
+    finally:
+        _metrics.clear_shutdown()
+
+
 # ------------------------------------------------ gates
 
 

@@ -570,7 +570,10 @@ def bench_fleet(n: int) -> list:
     fleet row carries the supervisor's restart and post-warmup-recompile
     ledger fields. On a one-host CPU box these rows are honest about the
     supervision price: spawn + per-line routing dominate, so N>1 buys
-    fault isolation, not throughput (BASELINE.md)."""
+    fault isolation, not throughput (BASELINE.md). A final
+    ``fleet_plane_overhead`` row prices the observability plane at N=2
+    (plane on vs ``--fleet-plane off``) with the merged digest asserted
+    identical either way."""
     import contextlib
     import io
 
@@ -603,7 +606,7 @@ def bench_fleet(n: int) -> list:
             assert rc == 0
             return dt
 
-        def fleet(workers, tag):
+        def fleet(workers, tag, *extra):
             fdir = os.path.join(td, f"fleet-{tag}")
             t0 = time.perf_counter()
             with contextlib.redirect_stdout(sys.stderr):
@@ -611,7 +614,7 @@ def bench_fleet(n: int) -> list:
                     "--config", conf, "--option", "1", "--input1", path1,
                     "--fleet", str(workers), "--fleet-dir", fdir,
                     # no mid-run rebalance inside a timed row
-                    "--fleet-epoch-records", str(10**9)])
+                    "--fleet-epoch-records", str(10**9)] + list(extra))
             dt = time.perf_counter() - t0
             assert rc == 0
             res = fleet_mod.read_json(os.path.join(fdir,
@@ -647,6 +650,25 @@ def bench_fleet(n: int) -> list:
             if workers > 1:
                 row["speedup_vs_fleet1"] = round(dt_f1 / dt, 2)
             rows.append(row)
+        # fleet observability plane overhead at N=2: sidecar + monitor +
+        # timeline harvesting + lineage vs --fleet-plane off. The merged
+        # digest is asserted identical — the plane must be invisible to
+        # exactly-once identity, so this row prices it and nothing else
+        res_on, dt_on = fleet(2, "plane-on")
+        res_off, dt_off = fleet(2, "plane-off", "--fleet-plane", "off")
+        assert res_on["digest"] == res_off["digest"] == digest, (
+            "fleet observability plane changed the merged digest — the "
+            "lineage sidecar leaked into exactly-once identity")
+        rows.append(dict(
+            path="fleet_plane_overhead", workers=2, records=n,
+            wall_s=round(dt_on, 3), wall_s_plane_off=round(dt_off, 3),
+            records_per_sec=round(n / dt_on),
+            overhead_vs_plane_off=round(dt_on / dt_off, 2),
+            merged_p99_ms=((res_on.get("latency") or {})
+                           .get("record_emit") or {}).get("p99"),
+            sum_check_windows=((res_on.get("latency") or {})
+                               .get("sum_check") or {}).get("windows"),
+            digest_identical=True))
     return rows
 
 

@@ -342,7 +342,11 @@ def fleet(path: str, as_json: bool = False, out=None) -> int:
     incarnation's run summary (``runs.jsonl``), the newest post-mortem
     bundle's verdict when one exists, restart reasons from the fleet
     result, recompile events, and record→emit p99 — "who died, why, and
-    did the respawn stay warm" in one read."""
+    did the respawn stay warm" in one read. With the observability plane
+    on the read widens: the end-to-end record→merged-emit stage-budget
+    table from ``fleet_latency.json`` and the merged timeline tail from
+    ``fleet_events.jsonl`` (both optional — plane-off and pre-plane
+    fleet dirs still render)."""
     from spatialflink_tpu.runtime import fleet as fleet_mod
 
     out = sys.stdout if out is None else out
@@ -350,6 +354,22 @@ def fleet(path: str, as_json: bool = False, out=None) -> int:
         raise ValueError(f"{path}: not a fleet directory")
     result = fleet_mod.read_json(
         os.path.join(path, fleet_mod.RESULT_FILE)) or {}
+    fleet_lat = fleet_mod.read_json(
+        os.path.join(path, fleet_mod.LATENCY_FILE))
+    timeline_tail: List[dict] = []
+    try:
+        with open(os.path.join(path, fleet_mod.EVENTS_FILE)) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    timeline_tail.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+        timeline_tail = timeline_tail[-20:]
+    except OSError:
+        pass  # plane off / pre-plane fleet dir: no timeline to show
     worker_ids = sorted(
         int(name[len("worker"):]) for name in os.listdir(path)
         if name.startswith("worker")
@@ -409,7 +429,9 @@ def fleet(path: str, as_json: bool = False, out=None) -> int:
            "epochs": result.get("epochs"),
            "graceful": result.get("graceful"),
            "post_warmup_compiles": result.get("post_warmup_compiles"),
-           "workers": rows}
+           "workers": rows,
+           "latency": fleet_lat,
+           "timeline_tail": timeline_tail}
     if as_json:
         print(json.dumps(doc, sort_keys=True), file=out)
         return 0
@@ -440,6 +462,33 @@ def fleet(path: str, as_json: bool = False, out=None) -> int:
               file=out)
         for reason in r["restart_reasons"]:
             print(f"{'':>6} restart: {reason}", file=out)
+    if fleet_lat:
+        # end-to-end record→merged-emit decomposition: the worker chain
+        # plus spread/outbox-visible/merge/merged-emit — same renderer as
+        # a bundle's table, so the two reads line up stage by stage
+        for line in _latency_table(fleet_lat):
+            print(f"e2e        {line}", file=out)
+        skipped = fleet_lat.get("skipped_no_lat")
+        if skipped:
+            print(f"e2e        {skipped} merged window(s) without a "
+                  "lineage sidecar (plane off for part of the run, or "
+                  "budget rows evicted)", file=out)
+        for wid, s in sorted((fleet_lat.get("workers") or {}).items()):
+            dom = s.get("dominant_stage") or "-"
+            p99 = s.get("record_emit_p99_ms")
+            print(f"sample     w{wid} "
+                  f"p99 {('-' if p99 is None else f'{p99:.1f}ms')} "
+                  f"dom {dom} "
+                  f"backlog {s.get('backlog_residency_ms') or 0:.0f}ms "
+                  f"inc {s.get('incarnation')}", file=out)
+    for ev in timeline_tail:
+        who = (f"w{ev.get('worker')}" if ev.get("src") == "worker"
+               else "sup")
+        extra = {k: v for k, v in ev.items()
+                 if k not in ("ts_ms", "mono_ms", "seq", "kind", "src",
+                              "worker", "worker_seq")}
+        print(f"timeline   #{ev.get('seq'):>4} {who:<4} {ev.get('kind')}"
+              + (f" {extra}" if extra else ""), file=out)
     return 0
 
 
@@ -468,7 +517,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     d.add_argument("bundle_b")
     fl = sub.add_parser("fleet", help="one table over a --fleet-dir: "
                                       "who died, restarts, recompiles, "
-                                      "per-worker p99")
+                                      "per-worker p99, the end-to-end "
+                                      "stage-budget table, and the fleet "
+                                      "timeline tail")
     fl.add_argument("fleet_dir")
     args = ap.parse_args(argv)
     try:

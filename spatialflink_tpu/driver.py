@@ -1800,6 +1800,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="fault-injection hook: SIGKILL worker WID once "
                          "its outbox holds N windows (recovery tests and "
                          "the fault bench row)")
+    ap.add_argument("--fleet-plane", choices=("on", "off"), default="on",
+                    help="fleet observability plane: end-to-end "
+                         "record->merged-emit lineage, the merged event "
+                         "timeline, /fleet/latency|timeline|events|metrics "
+                         "federation, and fleet post-mortem snapshots; "
+                         "'off' disables retention and the outbox lineage "
+                         "sidecar — the merged digest is identical either "
+                         "way (default: on)")
     args = ap.parse_args(argv)
 
     _enable_compilation_cache()
@@ -2354,6 +2362,10 @@ def _run_cli(ap, args, params: Params, spec: CaseSpec, skip1: int,
             # the supervisor discovers the ephemeral port through this
             # drop file and aggregates /status + /latency into /fleet
             wctx.write_url(opserver.url)
+            # a harvestable first event per incarnation: the supervisor's
+            # timeline shows each (re)spawn coming up before any window
+            _telemetry.emit_event("worker-online", worker=wctx.worker_id,
+                                  url=opserver.url)
         print(f"# status server: {opserver.url} "
               "(/healthz /status /metrics /events)", file=sys.stderr)
     if args.live_stats or (args.kafka_follow and tel is not None):
@@ -2429,8 +2441,15 @@ def _run_cli(ap, args, params: Params, spec: CaseSpec, skip1: int,
                 # canonical outbox line BEFORE the emit and the journal
                 # record: a kill between outbox and journal re-appends an
                 # identical line on resume, which the merge dedups — the
-                # exactly-once ordering the fleet merge relies on
-                wctx.note_window(result)
+                # exactly-once ordering the fleet merge relies on. The
+                # window's stage budget rides along as a lineage sidecar
+                # OUTSIDE the fingerprint (--fleet-plane), so the merged
+                # digest cannot depend on it
+                budget = None
+                if (tel is not None
+                        and getattr(args, "fleet_plane", "on") != "off"):
+                    budget = tel.latency.budget_row(result.window_start)
+                wctx.note_window(result, budget=budget)
             if tel is not None:
                 s0 = time.time()
                 with tel.span("sink"):

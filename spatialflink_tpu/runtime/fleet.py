@@ -26,9 +26,12 @@ module is the worker half and the shared contracts:
   records the window), so the supervisor can merge windowAll results
   without re-parsing worker stdout. Appended only for windows the
   emitted-window journal has NOT seen — a crash between the outbox
-  append and the journal record re-appends an IDENTICAL line on resume,
-  which the merge dedups by window key (and cross-checks by
-  fingerprint): exactly-once output identity across a kill.
+  append and the journal record re-appends a canonically identical line
+  on resume (identity = window key + records fingerprint; the
+  observability plane's ``lat`` sidecar may differ across incarnations
+  and is excluded from both), which the merge dedups by window key (and
+  cross-checks by fingerprint): exactly-once output identity across a
+  kill.
 - :class:`FleetManifest` — the supervisor's durable state (leaf→worker
   assignment, repartition epoch, restart counts) with the
   ``snapshot``/``restore`` pair the checkpoint-coverage linter rule
@@ -66,6 +69,12 @@ RUNS_FILE = "runs.jsonl"
 MANIFEST_FILE = "fleet.json"
 MERGED_FILE = "merged.jsonl"
 RESULT_FILE = "fleet_result.json"
+#: observability-plane files (absent under ``--fleet-plane off``)
+EVENTS_FILE = "fleet_events.jsonl"
+LATENCY_FILE = "fleet_latency.json"
+#: the supervisor's fleet-view snapshot dropped next to a dead worker's
+#: flight-recorder bundles (``worker<i>/postmortem/``)
+FLEET_VIEW_FILE = "fleet_view.json"
 
 
 def worker_dir(fleet_dir: str, worker_id: int) -> str:
@@ -243,19 +252,29 @@ def window_key(result) -> str:
     return EmittedWindowJournal.key(result)
 
 
-def canonical_window_doc(result, family: str) -> dict:
+def canonical_window_doc(result, family: str,
+                         lat: Optional[dict] = None) -> dict:
     """One outbox line: the window's identity plus its records in a
     canonical, order-independent serialization (selection families sort
     encoded records; kNN keeps its (distance, id) top-k order, which IS
     canonical). The fingerprint seals the content so duplicate appends
-    across a crash are provably identical."""
+    across a crash are provably identical.
+
+    ``lat`` is the observability plane's lineage SIDECAR (the worker's
+    stage budget + emit wall stamp, :func:`lat_sidecar`). It rides the
+    line but is excluded from the fingerprint — the fp is computed over
+    the records alone, BEFORE the sidecar is attached — and
+    :func:`merged_table_digest` never reads it, so exactly-once identity
+    and the merged digest are plane-independent: a resumed incarnation
+    re-emitting a window with a different budget still dedups cleanly,
+    and ``--fleet-plane off`` produces a byte-identical merged table."""
     if family == "knn":
         records = [[str(oid), float(d)] for oid, d in result.records]
     else:
         enc = _record_encoder()
         records = sorted(enc(r) for r in result.flat_records())
     payload = json.dumps(records, sort_keys=True)
-    return {
+    doc = {
         "key": window_key(result),
         "window": [int(result.window_start), int(result.window_end)],
         "cell": result.extras.get("cell"),
@@ -263,14 +282,45 @@ def canonical_window_doc(result, family: str) -> dict:
         "records": records,
         "fp": hashlib.sha256(payload.encode()).hexdigest()[:16],
     }
+    if lat is not None:
+        doc["lat"] = lat
+    return doc
+
+
+#: the sidecar's allowed stage keys: the worker's sum-to-total chain
+#: (downstream sink stages run after emit and would break the fleet
+#: chain's consecutive-interval construction)
+_SIDECAR_STAGES = ("buffer", "queue", "dispatch", "inflight", "merge",
+                   "emit")
+
+
+def lat_sidecar(budget_row: Optional[dict]) -> Optional[dict]:
+    """Filter one :meth:`~spatialflink_tpu.utils.latencyplane
+    .LatencyPlane.budget_row` into the outbox lineage sidecar: the
+    ingest/emit wall stamps plus the CHAIN stages only, so the
+    supervisor can extend the chain with ``outbox-visible -> merge ->
+    merged-emit`` and keep the sums-to-total invariant end to end.
+    Returns None for windows without an ingest stamp (bulk batches) —
+    they cannot anchor a record→merged-emit measurement."""
+    if not budget_row or budget_row.get("first_ingest_ms") is None:
+        return None
+    stages = budget_row.get("stages") or {}
+    return {
+        "first_ingest_ms": budget_row["first_ingest_ms"],
+        "emitted_ms": budget_row.get("emitted_ms"),
+        "record_emit_ms": budget_row.get("record_emit_ms"),
+        "stages": {s: stages[s] for s in _SIDECAR_STAGES if s in stages},
+    }
 
 
 class OutboxWriter:
     """Append-only canonical window log, one flushed JSON line per emitted
     window. Flushed BEFORE the emitted-window journal records the window:
-    a ``kill -9`` between the two re-appends the identical line on resume
-    (the journal did not suppress it), and :func:`read_outbox` dedups by
-    key — never a lost window, never a divergent one."""
+    a ``kill -9`` between the two re-appends a canonically identical line
+    on resume (the journal did not suppress it; only the diagnostic
+    ``lat`` sidecar — outside the fingerprint — may differ), and
+    :func:`read_outbox` dedups by key — never a lost window, never a
+    divergent one."""
 
     def __init__(self, path: str):
         self.path = path
@@ -483,11 +533,15 @@ class WorkerContext:
     def write_url(self, url: str) -> None:
         atomic_write_json(os.path.join(self.dir, URL_FILE), {"url": url})
 
-    def note_window(self, result) -> None:
+    def note_window(self, result, budget: Optional[dict] = None) -> None:
         """Outbox-append one emitted window (called only for windows the
         journal has NOT suppressed; flushed before the journal records
-        it — see :class:`OutboxWriter` for the crash ordering)."""
-        self.outbox.append(canonical_window_doc(result, self.family))
+        it — see :class:`OutboxWriter` for the crash ordering).
+        ``budget`` is the latency plane's budget row for this window;
+        when present it rides the line as the fingerprint-excluded
+        lineage sidecar (:func:`lat_sidecar`)."""
+        self.outbox.append(canonical_window_doc(
+            result, self.family, lat=lat_sidecar(budget)))
 
     def write_run_summary(self, **fields) -> None:
         """Append this incarnation's exit record to ``runs.jsonl``."""

@@ -1,10 +1,12 @@
 """Fleet supervisor: N supervised worker pipelines, leaf-partitioned
-input, crash-recovering restarts, exactly-once global merge.
+input, crash-recovering restarts, exactly-once global merge, and the
+fleet observability plane.
 
 The reference deploys GeoFlink at parallelism 30: Flink's JobManager
 places keyed subtasks on TaskManagers, restarts dead ones from the last
 checkpoint, and windowAll stages merge the keyed partials into one global
-result. The rebuild's supervisor is that control plane shrunk to one
+result — with the JobManager's web UI as the single pane of glass over
+all of it. The rebuild's supervisor is that control plane shrunk to one
 process:
 
 - **Placement** — the stream partitions by grid LEAF (PR 8's adaptive
@@ -20,21 +22,43 @@ process:
   canonical outboxes back — no shared mutable state between pipelines.
 - **Supervision** — a monitor thread watches exit codes, heartbeat-file
   age, and (optionally) record→emit p99 SLO breaches from the worker's
-  ``/latency`` payload. A dead worker restarts from its latest
-  checkpoint manifest with ``--resume``; the per-incarnation run summary
-  carries the recompile sentinel's post-warmup count, so the respawn
-  PROVES it never silently recompiled instead of asserting it by hope.
+  ``/latency`` payload. Ops polls run CONCURRENTLY with a hard
+  per-request deadline (one hung worker HTTP server cannot delay
+  heartbeat-staleness detection of the others). A dead worker restarts
+  from its latest checkpoint manifest with ``--resume``; the
+  per-incarnation run summary carries the recompile sentinel's
+  post-warmup count, so the respawn PROVES it never silently recompiled
+  instead of asserting it by hope.
+- **Observability** (:class:`FleetMonitor`, ``--fleet-plane``) — the
+  polls feed a bounded per-worker time series (throughput, record→emit
+  p99, dominant stage, backlog residency, buffer depth, compiles); every
+  worker's ``/events`` ring is harvested via ``?since=`` cursors and
+  merged with supervisor lifecycle events (spawn/kill/restart/rebalance/
+  epoch/merge) into ONE causally-ordered timeline, mirrored to
+  ``fleet_events.jsonl``. Outbox tails are scanned incrementally to
+  stamp each window's first-visible wall clock — the ``outbox-visible``
+  stage of the end-to-end record→merged-emit lineage
+  (:func:`compute_merged_lineage`), persisted as ``fleet_latency.json``.
+  The supervisor's opserver federates it all: ``/fleet/latency``,
+  ``/fleet/timeline``, ``/fleet/events``, and ``/fleet/metrics`` (every
+  worker's Prometheus text relabeled with ``worker="wN"`` — one scrape
+  point). On worker death the fleet view is snapshotted next to the dead
+  worker's flight-recorder bundle (``postmortem/fleet_view.json``).
 - **Rebalance** — at repartition epochs the supervisor compares worker
-  loads (backpressure/latency signals when present, routed-record counts
-  otherwise) and :func:`~spatialflink_tpu.runtime.repartition
-  .pick_rebalance` moves leaves off the most loaded worker (with
-  hysteresis) — the fleet analogue of PR 8's in-process repartitioner.
+  loads (the monitor's retained latency/backlog series when present,
+  routed-record counts otherwise) and :func:`~spatialflink_tpu.runtime
+  .repartition.pick_rebalance` moves leaves off the most loaded worker
+  (with hysteresis) — the fleet analogue of PR 8's in-process
+  repartitioner, now fed by the dominant-stage/backlog signal ROADMAP
+  item 1 names instead of raw record counts.
 - **Exactly-once merge** — workers append canonical fingerprinted window
   docs to their outboxes BEFORE journaling them; the supervisor dedups
   by window key, merges per-family through
   :func:`~spatialflink_tpu.operators.base.merge_window_records`, and the
   merged table's digest is byte-stable against a fault-free
-  single-worker run — the property the tier-1 kill test pins.
+  single-worker run — the property the tier-1 kill test pins. The
+  lineage sidecar rides OUTSIDE the fingerprint, so the digest is
+  byte-identical with the plane on or off.
 - **Drain** — SIGTERM stops routing, forwards the signal to every
   worker (each drains open windows and writes a final checkpoint via the
   driver's graceful-shutdown path), then merges whatever was emitted and
@@ -55,15 +79,27 @@ import sys
 import threading
 import time
 import urllib.request
-from typing import Dict, List, Optional, Tuple
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Dict, List, Optional, Tuple
 
 from spatialflink_tpu.runtime import fleet as F
 from spatialflink_tpu.runtime.checkpoint import atomic_write_json
 from spatialflink_tpu.runtime.repartition import (balance_leaves,
                                                   pick_rebalance)
 from spatialflink_tpu.utils import metrics as _metrics
+from spatialflink_tpu.utils import telemetry as _telemetry
+from spatialflink_tpu.utils.latencyplane import CHAIN_STAGES
 
 _ACTIVE_FLEET: Optional["FleetSupervisor"] = None
+
+#: the fleet-level stages appended after the worker's chain — the same
+#: consecutive-interval construction, so the extended chain still sums to
+#: the record→merged-emit total by construction. The table-merge stage is
+#: ``fleet-merge``, NOT ``merge``: the worker chain already owns ``merge``
+#: (device readback) and the stage dict must stay collision-free for the
+#: sum invariant to mean anything
+FLEET_STAGES = ("outbox-visible", "fleet-merge", "merged-emit")
 
 
 def active_fleet() -> Optional["FleetSupervisor"]:
@@ -84,6 +120,8 @@ def _set_active(sup: Optional["FleetSupervisor"]) -> None:
 #: flags the supervisor OWNS per worker (stripped from the inherited argv
 #: and re-issued with worker-specific values) or that must not recurse
 #: into a worker process; value = number of value tokens the flag takes.
+#: (``--fleet-plane`` is deliberately NOT stripped: workers inherit it and
+#: gate the outbox lineage sidecar on it.)
 _WORKER_STRIP = {
     "--fleet": 1, "--fleet-role": 1, "--fleet-dir": 1,
     "--fleet-worker-id": 1, "--fleet-heartbeat": 1,
@@ -152,6 +190,14 @@ def _http_json(url: str, timeout: float = 1.0) -> Optional[dict]:
         return None
 
 
+def _http_text(url: str, timeout: float = 1.0) -> Optional[str]:
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.read().decode()
+    except Exception:
+        return None
+
+
 def _worker_load(poll: dict) -> Optional[float]:
     """A comparable load scalar from a worker's polled ops payloads:
     prefer the backpressure/latency plane (record→emit p99), fall back to
@@ -165,6 +211,447 @@ def _worker_load(poll: dict) -> Optional[float]:
     return None
 
 
+def format_relay(wid: int, line: str, *, digest_active: bool
+                 ) -> Optional[str]:
+    """The supervisor's terminal rendering of one relayed worker stderr
+    line: prefixed ``[wN]`` so N workers stop interleaving anonymously;
+    a worker's own ``# live:`` digest line is suppressed (None) while
+    the fleet digest owns the terminal — the full unprefixed stream
+    still lands in ``worker<i>/worker.log``."""
+    if digest_active and line.startswith("# live:"):
+        return None
+    return f"[w{wid}] {line}"
+
+
+def format_fleet_digest(view: dict) -> str:
+    """One stderr line for the whole fleet — the N-worker analogue of
+    ``opserver.format_digest`` (whose per-worker lines the relay
+    suppresses while this digest is active): liveness, routed records,
+    fleet-wide window count, worst record→emit p99 with the dominant
+    chain stage, and the restart count."""
+    workers = view.get("workers") or []
+    parts = [f"{view.get('alive', 0)}/"
+             f"{view.get('n_workers', len(workers))} up",
+             f"routed {view.get('routed', 0)}"]
+    wins = 0
+    p99: Optional[float] = None
+    totals: Dict[str, float] = {}
+    for w in workers:
+        lat = w.get("latency") or {}
+        wins += int((lat.get("sum_check") or {}).get("windows") or 0)
+        re_h = lat.get("record_emit") or {}
+        if re_h.get("count"):
+            p99 = max(p99 or 0.0, float(re_h.get("p99") or 0.0))
+        for s, h in (lat.get("stages") or {}).items():
+            if s in _telemetry.CHAIN_STAGES_SET:
+                totals[s] = totals.get(s, 0.0) + float(h.get("sum") or 0.0)
+    parts.append(f"win {wins}")
+    if p99 is not None:
+        dom = max(totals, key=totals.get) if any(totals.values()) else None
+        parts.append(f"lat p99 {p99:.0f}ms" + (f" ({dom})" if dom else ""))
+    if view.get("restarts_total"):
+        parts.append(f"restarts {view['restarts_total']}")
+    return "# fleet live: " + " | ".join(parts)
+
+
+class FleetLiveStats:
+    """Daemon thread printing :func:`format_fleet_digest` per interval —
+    the fleet's ``--live-stats``: one line for N workers instead of N
+    interleaved per-worker digests. Prints once at :meth:`start` and one
+    final line at :meth:`close`, mirroring ``opserver.LiveStats``."""
+
+    def __init__(self, sup: "FleetSupervisor", interval_s: float = 5.0):
+        self.sup = sup
+        self.interval_s = max(0.01, float(interval_s))
+        self.emitted = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _tick(self) -> None:
+        try:
+            line = format_fleet_digest(self.sup.fleet_view())
+        except Exception:
+            return  # a digest failure must never take the fleet down
+        print(line, file=sys.stderr, flush=True)
+        self.emitted += 1
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self._tick()
+
+    def start(self) -> "FleetLiveStats":
+        self._tick()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="fleet-live-stats")
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.interval_s + 5.0)
+            self._thread = None
+        self._tick()
+
+
+# --------------------------------------------------------------------- #
+# the fleet observability monitor
+
+
+class FleetMonitor:
+    """The supervisor's retained observability state (``--fleet-plane``):
+
+    - a bounded per-worker time SERIES distilled from the ``/status`` +
+      ``/latency`` polls the supervisor already makes (throughput,
+      record→emit p99, dominant stage, backlog residency, decode buffer
+      depth, recompiles, incarnation) — the rebalance signal ROADMAP
+      item 1 names, and the retained input item 3's controller needs;
+    - the merged fleet EVENT timeline: supervisor lifecycle events plus
+      every worker's own ``/events`` ring (harvested via ``?since=``
+      cursors; the worker's wall stamp and seq are preserved as
+      ``ts_ms``/``worker_seq`` while the fleet ring assigns the merged
+      seq and the supervisor-arrival ``mono_ms``), mirrored append-only
+      to ``<fleet-dir>/fleet_events.jsonl``;
+    - incremental outbox TAILS stamping each window key's first-visible
+      wall clock — the ``outbox-visible`` stage of the end-to-end
+      lineage, and the line counts the chaos hook reads.
+
+    Cross-thread discipline: the monitor loop, poll futures, the routing
+    loop, and HTTP handler threads all touch this state, so EVERY
+    instance-attribute write outside ``__init__`` holds ``self._lock``
+    (the invariant linter's thread-shared-state rule proves it)."""
+
+    def __init__(self, root: str, n_workers: int, *,
+                 series_capacity: int = 256, ring_capacity: int = 1024):
+        self._lock = threading.Lock()
+        self.root = root
+        self.n_workers = int(n_workers)
+        #: the merged timeline ring (fleet seqs; EventRing's own lock)
+        self.ring = _telemetry.EventRing(capacity=ring_capacity)
+        self._series: Dict[int, deque] = {
+            w: deque(maxlen=max(1, int(series_capacity)))
+            for w in range(self.n_workers)}
+        #: per-worker /events?since= cursors (worker seqs; reset per
+        #: incarnation — a fresh ring restarts at 1)
+        self._cursors: Dict[int, int] = {}
+        #: per-worker outbox tail state: byte pos, torn-tail carry, count
+        self._tails: Dict[int, dict] = {}
+        #: (wid, window key) -> first-visible wall clock ms
+        self._seen_ms: Dict[Tuple[int, str], float] = {}
+        self._vis_hist = _telemetry.StreamingHistogram("record-visible-ms")
+        self._last_lat: Dict[int, dict] = {}
+        self._ev_f = open(os.path.join(root, F.EVENTS_FILE), "a")
+
+    # ------------------------- the timeline ------------------------- #
+
+    def note(self, kind: str, **fields) -> dict:
+        """One SUPERVISOR lifecycle event onto the merged timeline and
+        its durable JSONL mirror (flushed — post-mortems read the file
+        after a crash)."""
+        with self._lock:
+            ev = self.ring.append(kind, src="supervisor", **fields)
+            self._write_event_locked(ev)
+        return ev
+
+    def _write_event_locked(self, ev: dict) -> None:
+        """Mirror one timeline event to ``fleet_events.jsonl`` (caller
+        holds the lock)."""
+        try:
+            self._ev_f.write(json.dumps(ev, sort_keys=True) + "\n")
+            self._ev_f.flush()
+        except (OSError, ValueError):
+            pass  # closed during shutdown: the ring still has the event
+
+    def harvest(self, wid: int, payload: Optional[dict]) -> int:
+        """Fold one worker's ``/events?since=`` response into the merged
+        timeline. The worker's own wall stamp overrides the ring default
+        (EventRing honors a ``ts_ms`` field) and its seq is kept as
+        ``worker_seq``; the fleet ring assigns the merged seq and the
+        supervisor-arrival ``mono_ms`` — so a dying worker's last words,
+        harvested before the restart is noted, always order before the
+        restart in the merged timeline."""
+        if not payload:
+            return 0
+        added = 0
+        with self._lock:
+            cur = self._cursors.get(wid, 0)
+            for e in payload.get("events") or []:
+                try:
+                    wseq = int(e.get("seq") or 0)
+                except (TypeError, ValueError):
+                    continue
+                if wseq <= cur:
+                    continue  # ?since= can re-deliver, never lose
+                cur = wseq
+                fields = {k: v for k, v in e.items()
+                          if k not in ("seq", "mono_ms", "kind")}
+                fields["worker"] = wid
+                fields["src"] = "worker"
+                fields["worker_seq"] = wseq
+                ev = self.ring.append(str(e.get("kind")), **fields)
+                self._write_event_locked(ev)
+                added += 1
+            self._cursors[wid] = cur
+        return added
+
+    def cursor(self, wid: int) -> int:
+        with self._lock:
+            return self._cursors.get(wid, 0)
+
+    def reset_cursor(self, wid: int) -> None:
+        """A fresh incarnation's event ring restarts at seq 1 — the
+        harvest cursor must follow it down."""
+        with self._lock:
+            self._cursors[wid] = 0
+
+    # ------------------------- the time series ---------------------- #
+
+    def ingest_poll(self, wid: int, status: Optional[dict],
+                    latency: Optional[dict], *, alive: bool = True,
+                    incarnation: int = 0) -> None:
+        """Distill one ops poll into the worker's bounded time series —
+        the retention the old supervisor threw away after each liveness
+        check."""
+        st = (status or {}).get("status") or {}
+        lat = latency or {}
+        re_h = lat.get("record_emit") or {}
+        totals = {s: float(h.get("sum") or 0.0)
+                  for s, h in (lat.get("stages") or {}).items()
+                  if s in _telemetry.CHAIN_STAGES_SET}
+        dominant = (max(totals, key=totals.get)
+                    if any(totals.values()) else None)
+        bp = lat.get("backpressure") or {}
+        last_bucket = (bp.get("series") or [None])[-1] or {}
+        sample = {
+            "ts_ms": int(time.time() * 1000),
+            "alive": bool(alive),
+            "incarnation": int(incarnation),
+            "records_in": st.get("records_in"),
+            "throughput_rps": st.get("throughput_rps"),
+            "windows": st.get("windows_evaluated"),
+            "record_emit_p99_ms": re_h.get("p99"),
+            "dominant_stage": dominant,
+            "backlog_residency_ms": bp.get("backlog_residency_ms"),
+            "decode_buffer_depth": last_bucket.get("decode_buffer_depth"),
+            "stall": last_bucket.get("stall"),
+            "recompiles": (st.get("device") or {}).get("recompiles"),
+            "restarts": None,  # filled by the supervisor's view, not here
+        }
+        with self._lock:
+            dq = self._series.get(wid)
+            if dq is None:
+                dq = self._series.setdefault(wid, deque(maxlen=256))
+            dq.append(sample)
+            if latency is not None:
+                self._last_lat[wid] = latency
+
+    def rebalance_load(self, wid: int) -> Optional[float]:
+        """The rebalance policy's load scalar for one worker: record→emit
+        p99 PLUS backlog residency from the newest retained sample —
+        latency/backlog truth instead of raw routed counts (ROADMAP
+        item 1's signal). None before any poll landed."""
+        with self._lock:
+            dq = self._series.get(wid)
+            s = dq[-1] if dq else None
+        if not s:
+            return None
+        p99 = s.get("record_emit_p99_ms")
+        res = s.get("backlog_residency_ms")
+        if p99 is None and res is None:
+            return None
+        return float(p99 or 0.0) + float(res or 0.0)
+
+    def series(self, wid: int) -> List[dict]:
+        with self._lock:
+            dq = self._series.get(wid)
+            return [dict(s) for s in dq] if dq else []
+
+    def last_samples(self) -> Dict[int, dict]:
+        with self._lock:
+            return {w: dict(dq[-1]) for w, dq in self._series.items()
+                    if dq}
+
+    def last_latency(self, wid: int) -> Optional[dict]:
+        with self._lock:
+            return self._last_lat.get(wid)
+
+    # ------------------------- outbox tails ------------------------- #
+
+    def scan_outbox(self, wid: int) -> int:
+        """Incrementally tail one worker's outbox: stamp each NEW window
+        key's first-visible wall clock (the ``outbox-visible`` lineage
+        stage; crash-replay duplicates keep the first stamp), feed the
+        record→visible histogram from the line's own sidecar, and return
+        the total complete-line count (the chaos hook's trigger). A torn
+        tail line is carried until its newline arrives — the same
+        holdback the workers' tailing source applies."""
+        path = os.path.join(F.worker_dir(self.root, wid), F.OUTBOX_FILE)
+        now_ms = time.time() * 1e3
+        with self._lock:
+            t = self._tails.get(wid)
+            if t is None:
+                t = self._tails.setdefault(
+                    wid, {"pos": 0, "carry": "", "count": 0})
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                return t["count"]
+            if size < t["pos"]:  # replaced/truncated: rescan from zero
+                t["pos"], t["carry"], t["count"] = 0, "", 0
+            if size == t["pos"]:
+                return t["count"]
+            try:
+                with open(path, "rb") as f:
+                    f.seek(t["pos"])
+                    chunk = f.read()
+            except OSError:
+                return t["count"]
+            t["pos"] += len(chunk)
+            lines = (t["carry"] + chunk.decode("utf-8", "replace")
+                     ).split("\n")
+            t["carry"] = lines.pop()
+            for line in lines:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    doc = json.loads(line)
+                except ValueError:
+                    continue
+                key = doc.get("key")
+                if key is None:
+                    continue
+                t["count"] += 1
+                sk = (wid, str(key))
+                if sk in self._seen_ms:
+                    continue
+                self._seen_ms[sk] = now_ms
+                fi = (doc.get("lat") or {}).get("first_ingest_ms")
+                if isinstance(fi, (int, float)):
+                    self._vis_hist.record(max(0.0, now_ms - fi))
+            if len(self._seen_ms) > 65536:  # runaway guard
+                for sk in list(self._seen_ms)[:32768]:
+                    del self._seen_ms[sk]
+            return t["count"]
+
+    def line_count(self, wid: int) -> int:
+        with self._lock:
+            t = self._tails.get(wid)
+            return int(t["count"]) if t else 0
+
+    def visible_ms(self, wid: int, key: str) -> Optional[float]:
+        """When the supervisor first observed this window's outbox line
+        (None for lines that never crossed a scan — shouldn't happen
+        while the monitor loop runs, but the lineage falls back
+        gracefully)."""
+        with self._lock:
+            return self._seen_ms.get((wid, str(key)))
+
+    def visible_hist(self) -> dict:
+        with self._lock:
+            return self._vis_hist.to_dict()
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self._ev_f.close()
+            except OSError:
+                pass
+
+
+def compute_merged_lineage(merged: List[dict],
+                           per_worker: Dict[int, Dict[str, dict]],
+                           visible_of: Callable[[int, str],
+                                                Optional[float]],
+                           t_merged_ms: float, t_emit_ms: float) -> dict:
+    """End-to-end record→merged-emit lineage over the merged window
+    table. Per merged window the worker chain extends with the fleet
+    stages — the same consecutive-interval construction the worker plane
+    uses, so the stages sum to the total BY CONSTRUCTION:
+
+    - ``spread``: the critical contributor's first ingest minus the
+      GLOBAL first ingest across contributors (a partitioned window
+      starts its clock at the earliest record on ANY worker);
+    - the critical contributor's own chain stages (critical = the
+      contributor whose emit completed last — it gates the merge);
+    - ``outbox-visible``: worker emit → the supervisor first observed
+      the outbox line (the monitor's tail stamp, clamped into
+      [emit, merge-start] — clamping an INTERIOR chain stamp shifts
+      time between adjacent stages and cannot break the sum);
+    - ``fleet-merge``: observed → the global merge's table was built
+      (named apart from the worker's device-readback ``merge`` stage —
+      the stage dict must stay collision-free);
+    - ``merged-emit``: table built → ``merged.jsonl`` durably replaced.
+
+    The residual against the total is exactly the contributing worker's
+    own chain residual — the fleet stages cancel telescopically.
+    Returns the ``fleet-latency-v1`` document ``doctor fleet`` renders
+    with the same stage-budget table bundles get; ``visible_of(wid,
+    key) -> Optional[ms]``. Windows whose contributors carry no sidecar
+    (plane off, evicted budget rows) are counted in ``skipped_no_lat``
+    and excluded — never guessed."""
+    total_h = _telemetry.StreamingHistogram("record-merged-emit-ms")
+    stage_h: Dict[str, _telemetry.StreamingHistogram] = {}
+    chain = ["spread"] + list(CHAIN_STAGES) + list(FLEET_STAGES)
+    recent: List[dict] = []
+    windows = 0
+    max_residual = 0.0
+    skipped = 0
+    for doc in merged:
+        key = doc["key"]
+        contribs = []
+        for wid in doc.get("workers", []):
+            lat = ((per_worker.get(wid) or {}).get(key) or {}).get("lat")
+            if (lat and lat.get("first_ingest_ms") is not None
+                    and lat.get("emitted_ms") is not None):
+                contribs.append((int(wid), lat))
+        if not contribs:
+            skipped += 1
+            continue
+        gfi = min(float(lat["first_ingest_ms"]) for _, lat in contribs)
+        crit_wid, crit = max(contribs,
+                             key=lambda c: float(c[1]["emitted_ms"]))
+        emitted = float(crit["emitted_ms"])
+        vis = visible_of(crit_wid, key)
+        vis = min(max(float(vis) if vis is not None else emitted,
+                      emitted), t_merged_ms)
+        stages = {"spread": float(crit["first_ingest_ms"]) - gfi}
+        for s, v in (crit.get("stages") or {}).items():
+            stages[s] = float(v)
+        stages["outbox-visible"] = vis - emitted
+        stages["fleet-merge"] = t_merged_ms - vis
+        stages["merged-emit"] = t_emit_ms - t_merged_ms
+        total = t_emit_ms - gfi
+        residual = abs(total - sum(stages.values()))
+        windows += 1
+        if residual > max_residual:
+            max_residual = residual
+        total_h.record(max(0.0, total))
+        for s, v in stages.items():
+            h = stage_h.get(s)
+            if h is None:
+                h = stage_h.setdefault(
+                    s, _telemetry.StreamingHistogram(s))
+            h.record(max(0.0, v))
+        recent.append({
+            "key": key, "worker": crit_wid,
+            "first_ingest_ms": gfi,
+            "record_emit_ms": round(total, 3),
+            "stages": {s: round(v, 3) for s, v in stages.items()},
+        })
+    return {
+        "schema": "fleet-latency-v1",
+        "ts_ms": int(t_emit_ms),
+        "chain_stages": chain,
+        "stages": {s: h.to_dict() for s, h in stage_h.items()},
+        "record_emit": total_h.to_dict(),
+        "recent": recent[-64:],
+        "sum_check": {"windows": windows,
+                      "max_residual_ms": round(max_residual, 3)},
+        "skipped_no_lat": skipped,
+    }
+
+
 # --------------------------------------------------------------------- #
 # supervisor
 
@@ -175,12 +662,12 @@ class FleetSupervisor:
     and merges the workers' canonical outboxes into the global window
     table.
 
-    Cross-thread discipline: the monitor thread and the main routing loop
-    share process/poll state, so EVERY instance-attribute write outside
-    ``__init__`` holds ``self._lock`` (the invariant linter's
-    thread-shared-state rule proves this at the AST level). Durable state
-    (assignment, epoch, restart counts) lives in
-    :class:`~spatialflink_tpu.runtime.fleet.FleetManifest`, whose
+    Cross-thread discipline: the monitor thread, poll futures, stderr
+    relays, and the main routing loop share process/poll state, so EVERY
+    instance-attribute write outside ``__init__`` holds ``self._lock``
+    (the invariant linter's thread-shared-state rule proves this at the
+    AST level). Durable state (assignment, epoch, restart counts) lives
+    in :class:`~spatialflink_tpu.runtime.fleet.FleetManifest`, whose
     snapshot/restore pair the checkpoint-coverage rule proves
     field-by-field."""
 
@@ -199,10 +686,24 @@ class FleetSupervisor:
                                                 20000) or 20000))
         self.restart_cap = int(getattr(args, "fleet_restart_cap", 3))
         self.slo_p99_ms = getattr(args, "fleet_slo_p99_ms", None)
+        os.makedirs(self.root, exist_ok=True)
         self.manifest = F.FleetManifest(
             os.path.join(self.root, F.MANIFEST_FILE))
+        #: the observability plane (None under --fleet-plane off: no
+        #: monitor, no sidecar harvesting, federation endpoints answer
+        #: with notes — and the merged digest is provably unchanged)
+        self.monitor: Optional[FleetMonitor] = None
+        if getattr(args, "fleet_plane", "on") != "off":
+            self.monitor = FleetMonitor(self.root, self.n_workers)
         self._chaos = _parse_chaos(getattr(args, "fleet_chaos_kill", None))
         self._chaos_fired = False
+        self._digest_on = bool(getattr(args, "live_stats", False))
+        self._poll_pool = ThreadPoolExecutor(
+            max_workers=max(2, min(self.n_workers + 1, 16)),
+            thread_name_prefix="fleet-poll")
+        self._poll_busy: Dict[int, object] = {}
+        self._relays: Dict[int, threading.Thread] = {}
+        self._merged_lat: Optional[dict] = None
         self._procs: Dict[int, subprocess.Popen] = {}
         self._logs: Dict[int, object] = {}
         self._spawned_at: Dict[int, float] = {}
@@ -299,13 +800,53 @@ class FleetSupervisor:
             self._logs[wid] = log
         log.write(f"--- incarnation {inc} ({reason}) ---\n")
         log.flush()
-        self._procs[wid] = subprocess.Popen(
+        proc = subprocess.Popen(
             [sys.executable, "-m", "spatialflink_tpu.driver"] + argv,
-            stdout=log, stderr=subprocess.STDOUT,
+            stdout=log, stderr=subprocess.PIPE, text=True,
             start_new_session=True)  # controlled drain: WE forward signals
+        self._procs[wid] = proc
+        # stderr relay: every line lands in worker.log AND echoes to the
+        # supervisor's terminal prefixed [wN] (the fleet digest suppresses
+        # the workers' own # live: lines) — see format_relay
+        relay = threading.Thread(target=self._relay_stderr,
+                                 args=(wid, proc, log),
+                                 name=f"fleet-relay-w{wid}", daemon=True)
+        self._relays[wid] = relay
+        relay.start()
         self._spawned_at[wid] = time.monotonic()
         self._urls.pop(wid, None)
         self._slo_strikes[wid] = 0
+        if self.monitor is not None:
+            self.monitor.reset_cursor(wid)
+            self.monitor.note("worker-spawn", worker=wid, incarnation=inc,
+                              resume=bool(resume), reason=reason)
+
+    def _relay_stderr(self, wid: int, proc: subprocess.Popen,
+                      log) -> None:
+        """Pump one incarnation's stderr pipe until EOF (daemon thread,
+        one per spawn). Never writes supervisor state — reads only."""
+        pipe = proc.stderr
+        if pipe is None:
+            return
+        try:
+            for line in pipe:
+                line = line.rstrip("\n")
+                try:
+                    log.write(line + "\n")
+                    log.flush()
+                except (OSError, ValueError):
+                    pass  # log closed during supervisor shutdown
+                rendered = format_relay(wid, line,
+                                        digest_active=self._digest_on)
+                if rendered is not None:
+                    print(rendered, file=sys.stderr, flush=True)
+        except (OSError, ValueError):
+            pass  # pipe torn down mid-read (SIGKILL)
+        finally:
+            try:
+                pipe.close()
+            except OSError:
+                pass
 
     def _restart_locked(self, wid: int, rc: Optional[int],
                         reason: str) -> None:
@@ -314,10 +855,37 @@ class FleetSupervisor:
         self._restart_log.append({"ts_ms": int(time.time() * 1000),
                                   "worker": wid, "rc": rc,
                                   "reason": reason, "restart": n})
+        # fleet post-mortem: freeze the aggregated view next to the dead
+        # worker's flight-recorder bundles BEFORE the respawn mutates it
+        self._snapshot_fleet_view(wid, rc, reason)
+        if self.monitor is not None:
+            self.monitor.note("worker-restart", worker=wid, rc=rc,
+                              reason=reason, restart=n)
         if n > self.restart_cap:
             self._failed = (wid, rc if rc is not None else -1)
+            if self.monitor is not None:
+                self.monitor.note("worker-failed", worker=wid, rc=rc,
+                                  restarts=n, cap=self.restart_cap)
             return
         self._spawn_locked(wid, resume=True, reason=reason)
+
+    def _snapshot_fleet_view(self, wid: int, rc: Optional[int],
+                             reason: str) -> None:
+        """Write ``postmortem/fleet_view.json`` for a dying worker: the
+        supervisor's aggregated view plus the merged timeline tail at
+        the moment of death — what the worker's own flight-recorder
+        bundle cannot see. Diagnostics must never block the restart."""
+        try:
+            pm = os.path.join(F.worker_dir(self.root, wid), "postmortem")
+            os.makedirs(pm, exist_ok=True)
+            view = self.fleet_view()
+            view["death"] = {"worker": wid, "rc": rc, "reason": reason,
+                             "ts_ms": int(time.time() * 1000)}
+            if self.monitor is not None:
+                view["timeline_tail"] = self.monitor.ring.list(None)[-40:]
+            atomic_write_json(os.path.join(pm, F.FLEET_VIEW_FILE), view)
+        except Exception:
+            pass
 
     def _monitor_loop(self) -> None:
         next_poll = 0.0
@@ -329,7 +897,7 @@ class FleetSupervisor:
             now = time.monotonic()
             poll_ops = now >= next_poll
             if poll_ops:
-                next_poll = now + max(1.0, self.heartbeat_s)
+                next_poll = now + max(0.25, self.heartbeat_s)
             for wid, proc in procs.items():
                 rc = proc.poll()
                 if rc is not None:
@@ -337,7 +905,10 @@ class FleetSupervisor:
                     continue
                 self._check_liveness(wid, proc)
                 if poll_ops:
-                    self._poll_ops(wid)
+                    self._schedule_poll(wid)
+            if self.monitor is not None:
+                for wid in range(self.n_workers):
+                    self.monitor.scan_outbox(wid)
             self._check_chaos()
             time.sleep(0.2)
 
@@ -347,6 +918,8 @@ class FleetSupervisor:
                 return
             del self._procs[wid]
             self._rcs[wid] = rc
+            if self.monitor is not None:
+                self.monitor.note("worker-exit", worker=wid, rc=rc)
             done = os.path.exists(
                 os.path.join(F.worker_dir(self.root, wid), F.DONE_MARKER))
             if self._draining or self._stopping or (rc == 0 and done):
@@ -368,6 +941,13 @@ class FleetSupervisor:
             self._kill(wid, proc, f"heartbeat stale {age:.1f}s")
 
     def _kill(self, wid: int, proc: subprocess.Popen, reason: str) -> None:
+        if self.monitor is not None:
+            # harvest the dying worker's own events BEFORE the SIGKILL
+            # and the restart note: its last words must order before the
+            # restart in the merged timeline (bounded — the worker may
+            # already be unresponsive)
+            self._harvest_events(wid, timeout=0.5)
+            self.monitor.note("worker-kill", worker=wid, reason=reason)
         with self._lock:
             self._kill_reason[wid] = reason
         try:
@@ -375,17 +955,45 @@ class FleetSupervisor:
         except OSError:
             pass
 
+    def _schedule_poll(self, wid: int) -> None:
+        """Submit one worker's ops poll to the pool — the monitor loop
+        never blocks on a worker's HTTP server (one hung worker used to
+        serialize behind the others and delay THEIR heartbeat-staleness
+        detection); a still-outstanding poll skips this round instead of
+        stacking requests behind a wedged server."""
+        with self._lock:
+            fut = self._poll_busy.get(wid)
+        if fut is not None and not fut.done():  # type: ignore[union-attr]
+            return
+        try:
+            fut = self._poll_pool.submit(self._poll_ops, wid)
+        except RuntimeError:
+            return  # pool shut down: supervisor exiting
+        with self._lock:
+            self._poll_busy[wid] = fut
+
     def _poll_ops(self, wid: int) -> None:
         url = self._resolve_url(wid)
         if not url:
             return
-        status = _http_json(f"{url}/status")
-        latency = _http_json(f"{url}/latency")
+        # hard per-request deadline, scaled to the heartbeat but bounded:
+        # a wedged worker costs one pool slot for at most ~2s, never the
+        # liveness loop
+        deadline = max(0.5, min(2.0, self.heartbeat_s))
+        status = _http_json(f"{url}/status", timeout=deadline)
+        latency = _http_json(f"{url}/latency", timeout=deadline)
+        if self.monitor is not None:
+            self._harvest_events(wid, timeout=deadline)
         if status is None and latency is None:
             return
         with self._lock:
             self._polls[wid] = {"status": status, "latency": latency,
                                 "ts_ms": int(time.time() * 1000)}
+            alive = wid in self._procs
+            inc = self._incarnations.get(wid, 0)
+        if self.monitor is not None:
+            self.monitor.ingest_poll(wid, status, latency, alive=alive,
+                                     incarnation=inc)
         if self.slo_p99_ms:
             p99 = _worker_load({"latency": latency})
             with self._lock:
@@ -401,6 +1009,17 @@ class FleetSupervisor:
                 self._kill(wid, proc,
                            f"slo breach: record_emit p99 {p99:.1f}ms > "
                            f"{float(self.slo_p99_ms):g}ms x{strikes}")
+
+    def _harvest_events(self, wid: int, timeout: float = 1.0) -> None:
+        mon = self.monitor
+        if mon is None:
+            return
+        url = self._resolve_url(wid)
+        if not url:
+            return
+        payload = _http_json(f"{url}/events?since={mon.cursor(wid)}",
+                             timeout=timeout)
+        mon.harvest(wid, payload)
 
     def _resolve_url(self, wid: int) -> Optional[str]:
         with self._lock:
@@ -425,15 +1044,22 @@ class FleetSupervisor:
             proc = self._procs.get(wid)
         if proc is None:
             return
-        outbox = os.path.join(F.worker_dir(self.root, wid), F.OUTBOX_FILE)
-        try:
-            with open(outbox) as f:
-                lines = sum(1 for ln in f if ln.strip())
-        except OSError:
-            return
+        if self.monitor is not None:
+            # the monitor loop just tailed the outbox — reuse its count
+            lines = self.monitor.line_count(wid)
+        else:
+            outbox = os.path.join(F.worker_dir(self.root, wid),
+                                  F.OUTBOX_FILE)
+            try:
+                with open(outbox) as f:
+                    lines = sum(1 for ln in f if ln.strip())
+            except OSError:
+                return
         if lines >= n:
             with self._lock:
                 self._chaos_fired = True
+            if self.monitor is not None:
+                self.monitor.note("chaos-kill", worker=wid, windows=lines)
             self._kill(wid, proc, f"chaos kill at {lines} windows")
 
     # -------------------------------------------------------------- #
@@ -508,11 +1134,12 @@ class FleetSupervisor:
     def _epoch_boundary(self, assignment: Dict[int, int],
                         occ: Dict[int, int],
                         epoch_by_worker: Dict[int, int]) -> Dict[int, int]:
-        """Rebalance decision at an epoch boundary: worker loads come from
-        the polled backpressure/latency plane when available (record→emit
-        p99), else from this epoch's routed-record counts; leaves move
-        smallest-first from donor to receiver until roughly half the
-        spread is covered."""
+        """Rebalance decision at an epoch boundary: worker loads come
+        from the monitor's retained series when the plane is on
+        (record→emit p99 + backlog residency — latency/backlog truth),
+        else the last raw poll, else this epoch's routed-record counts;
+        leaves move smallest-first from donor to receiver until roughly
+        half the spread is covered."""
         with self._lock:
             for w, n in epoch_by_worker.items():
                 self._routed_by_worker[w] = (
@@ -520,7 +1147,10 @@ class FleetSupervisor:
             polls = dict(self._polls)
         loads: Dict[int, float] = {}
         for wid in range(self.n_workers):
-            sig = _worker_load(polls.get(wid, {}))
+            sig = (self.monitor.rebalance_load(wid)
+                   if self.monitor is not None else None)
+            if sig is None:
+                sig = _worker_load(polls.get(wid, {}))
             loads[wid] = (sig if sig is not None
                           else float(epoch_by_worker.get(wid, 0)))
         pair = pick_rebalance(loads)
@@ -539,11 +1169,18 @@ class FleetSupervisor:
                 moved.append(leaf)
             if moved:
                 self.manifest.assign_all({l: receiver for l in moved})
+                if self.monitor is not None:
+                    self.monitor.note("rebalance", donor=donor,
+                                      receiver=receiver, moved=len(moved),
+                                      loads={str(k): round(v, 3)
+                                             for k, v in loads.items()})
                 print(f"# fleet epoch {self.manifest.fleet_epoch + 1}: "
                       f"moved {len(moved)} leaves worker{donor} -> "
                       f"worker{receiver}", flush=True)
         self.manifest.advance_epoch()
         self.manifest.save()
+        if self.monitor is not None:
+            self.monitor.note("epoch", epoch=self.manifest.fleet_epoch)
         return assignment
 
     def _write_done_markers(self, routed: int) -> None:
@@ -552,9 +1189,12 @@ class FleetSupervisor:
                 os.path.join(F.worker_dir(self.root, wid), F.DONE_MARKER),
                 {"routed_total": routed,
                  "epoch": self.manifest.fleet_epoch})
+        if self.monitor is not None:
+            self.monitor.note("partition-eof", routed=routed,
+                              epoch=self.manifest.fleet_epoch)
 
     # -------------------------------------------------------------- #
-    # fleet view
+    # fleet view + federation payloads
 
     def fleet_view(self) -> dict:
         """The ``/fleet`` payload: one aggregated snapshot of every
@@ -592,6 +1232,129 @@ class FleetSupervisor:
             })
         return fleet_snapshot(workers, epoch=self.manifest.fleet_epoch,
                               routed=routed, restart_log=restart_log)
+
+    _PLANE_NOTE = ("fleet observability plane is off "
+                   "(--fleet-plane off)")
+
+    def fleet_events_payload(self, since: Optional[int] = None) -> dict:
+        """``GET /fleet/events``: the merged timeline ring with the same
+        ``?since=`` cursor semantics as a worker's ``/events`` —
+        ``latest_seq`` never runs ahead of the delivered list."""
+        mon = self.monitor
+        if mon is None:
+            return {"events": [], "total": 0, "latest_seq": 0,
+                    "note": self._PLANE_NOTE}
+        latest = mon.ring.total
+        evs = mon.ring.list(since)
+        if evs:
+            latest = evs[-1]["seq"]
+        elif since is not None:
+            latest = max(latest, since)
+        return {"events": evs, "total": mon.ring.total,
+                "latest_seq": latest}
+
+    def fleet_timeline_payload(self) -> dict:
+        """``GET /fleet/timeline``: the merged causally-ordered fleet
+        timeline (supervisor lifecycle + harvested worker events) plus
+        per-lane counts — the JobManager-web-UI event view, one
+        document."""
+        mon = self.monitor
+        if mon is None:
+            return {"events": [], "lanes": {}, "total": 0,
+                    "note": self._PLANE_NOTE}
+        evs = mon.ring.list(None)
+        lanes: Dict[str, int] = {}
+        for e in evs:
+            lane = (f"w{e.get('worker')}" if e.get("src") == "worker"
+                    else "supervisor")
+            lanes[lane] = lanes.get(lane, 0) + 1
+        return {"schema": "fleet-timeline-v1",
+                "ts_ms": int(time.time() * 1000),
+                "events": evs, "lanes": lanes, "total": mon.ring.total}
+
+    def fleet_latency_payload(self) -> dict:
+        """``GET /fleet/latency``: after the merge, the persisted
+        record→merged-emit lineage document (stage table + sum check);
+        mid-run, the record→outbox-visible histogram plus the monitor's
+        newest per-worker samples — the fleet-wide percentile view."""
+        mon = self.monitor
+        if mon is None:
+            return {"stages": {}, "recent": [], "note": self._PLANE_NOTE}
+        with self._lock:
+            merged = self._merged_lat
+        if merged is not None:
+            doc = dict(merged)
+        else:
+            doc = {
+                "schema": "fleet-latency-v1",
+                "ts_ms": int(time.time() * 1000),
+                "chain_stages": (["spread"] + list(CHAIN_STAGES)
+                                 + list(FLEET_STAGES)),
+                "stages": {},
+                "record_emit": {"count": 0},
+                "recent": [],
+                "sum_check": {"windows": 0, "max_residual_ms": 0.0},
+                "note": "merged lineage lands at the global merge; "
+                        "mid-run this carries record->outbox-visible "
+                        "and the per-worker series",
+            }
+        doc["record_visible"] = mon.visible_hist()
+        doc["workers"] = {str(w): s
+                          for w, s in mon.last_samples().items()}
+        return doc
+
+    def fleet_metrics_text(self) -> str:
+        """``GET /fleet/metrics``: one scrape point for the fleet —
+        every live worker's ``/metrics`` body fetched concurrently under
+        the poll deadline, relabeled with ``worker="wN"`` (the PR 6/9
+        proper-label discipline), ``# TYPE`` headers deduped keeping the
+        first, plus supervisor-level fleet gauges."""
+        with self._lock:
+            urls = dict(self._urls)
+            routed = self._routed
+            alive = len(self._procs)
+        for wid in range(self.n_workers):
+            if wid not in urls:
+                url = self._resolve_url(wid)
+                if url:
+                    urls[wid] = url
+        deadline = max(0.5, min(2.0, self.heartbeat_s))
+        bodies: Dict[int, str] = {}
+        futs = []
+        try:
+            for wid, url in sorted(urls.items()):
+                futs.append((wid, self._poll_pool.submit(
+                    _http_text, f"{url}/metrics", deadline)))
+        except RuntimeError:
+            futs = []  # pool shut down: supervisor exiting
+        for wid, fut in futs:
+            try:
+                body = fut.result(timeout=deadline + 1.0)
+            except Exception:
+                body = None
+            if body:
+                bodies[wid] = _telemetry.relabel_prometheus_lines(
+                    body, "worker", f"w{wid}")
+        lines: List[str] = []
+        seen_types = set()
+        for wid in sorted(bodies):
+            for line in bodies[wid].splitlines():
+                if line.startswith("# TYPE"):
+                    if line in seen_types:
+                        continue
+                    seen_types.add(line)
+                if line:
+                    lines.append(line)
+        restarts = sum(self.manifest.fleet_restarts.values())
+        lines += [
+            "# TYPE spatialflink_fleet_workers_alive gauge",
+            f"spatialflink_fleet_workers_alive {alive}",
+            "# TYPE spatialflink_fleet_routed_records counter",
+            f"spatialflink_fleet_routed_records {routed}",
+            "# TYPE spatialflink_fleet_restarts_total counter",
+            f"spatialflink_fleet_restarts_total {restarts}",
+        ]
+        return "\n".join(lines) + "\n"
 
     # -------------------------------------------------------------- #
     # run
@@ -636,6 +1399,11 @@ class FleetSupervisor:
             mon = self._monitor_thread
             if mon is not None:
                 mon.join(timeout=5.0)
+            self._poll_pool.shutdown(wait=False)
+            for relay in list(self._relays.values()):
+                relay.join(timeout=1.0)
+            if self.monitor is not None:
+                self.monitor.close()
             for log in self._logs.values():
                 try:
                     log.close()
@@ -648,6 +1416,8 @@ class FleetSupervisor:
                 return
             self._draining = True
             procs = dict(self._procs)
+        if self.monitor is not None:
+            self.monitor.note("drain", workers=len(procs))
         print("# fleet: draining workers (SIGTERM)", flush=True)
         for proc in procs.values():
             if proc.poll() is None:
@@ -679,6 +1449,12 @@ class FleetSupervisor:
         per_worker = {}
         runs = {}
         compiles = 0
+        if self.monitor is not None:
+            # one final tail per worker: stamp any line that landed after
+            # the monitor loop's last scan, so every merged window has an
+            # outbox-visible stamp
+            for wid in range(self.n_workers):
+                self.monitor.scan_outbox(wid)
         for wid in range(self.n_workers):
             wd = F.worker_dir(self.root, wid)
             per_worker[wid] = F.read_outbox(
@@ -688,6 +1464,7 @@ class FleetSupervisor:
                             for r in runs[wid])
         merged = F.merge_outboxes(per_worker, self.case.family,
                                   k=self.params.query.k)
+        t_merged_ms = time.time() * 1e3
         tmp = os.path.join(self.root, F.MERGED_FILE + ".tmp")
         with open(tmp, "w") as f:
             for doc in merged:
@@ -695,7 +1472,24 @@ class FleetSupervisor:
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, os.path.join(self.root, F.MERGED_FILE))
+        t_emit_ms = time.time() * 1e3
         digest = F.merged_table_digest(merged)
+        lineage = None
+        if self.monitor is not None:
+            lineage = compute_merged_lineage(
+                merged, per_worker, self.monitor.visible_ms,
+                t_merged_ms, t_emit_ms)
+            lineage["record_visible"] = self.monitor.visible_hist()
+            lineage["workers"] = {str(w): s for w, s in
+                                  self.monitor.last_samples().items()}
+            atomic_write_json(os.path.join(self.root, F.LATENCY_FILE),
+                              lineage)
+            with self._lock:
+                self._merged_lat = lineage
+            self.monitor.note(
+                "merge", windows=len(merged), digest=digest[:16],
+                merged_p99_ms=(lineage.get("record_emit") or {}).get(
+                    "p99"))
         with self._lock:
             restart_log = list(self._restart_log)
         result = {
@@ -711,6 +1505,14 @@ class FleetSupervisor:
             "graceful": graceful,
             "runs": {str(k): v for k, v in runs.items()},
         }
+        if lineage is not None:
+            # headline lineage numbers ride the result doc (full table in
+            # fleet_latency.json); the digest input is UNTOUCHED
+            result["latency"] = {
+                "record_emit": lineage["record_emit"],
+                "sum_check": lineage["sum_check"],
+                "skipped_no_lat": lineage.get("skipped_no_lat", 0),
+            }
         atomic_write_json(os.path.join(self.root, F.RESULT_FILE), result)
         print(f"# fleet merged {len(merged)} windows from "
               f"{self.n_workers} workers (routed {routed}, "
@@ -726,8 +1528,9 @@ class FleetSupervisor:
 
 def run_supervisor(args, params, spec, base_argv: List[str]) -> int:
     """``--fleet N``: run the supervisor role. Owns its own opserver
-    (serving ``/fleet``) and the SIGTERM drain handler; returns the
-    process exit code."""
+    (serving ``/fleet`` and the ``/fleet/latency|timeline|events|metrics``
+    federation), the fleet stderr digest, and the SIGTERM drain handler;
+    returns the process exit code."""
     from spatialflink_tpu.runtime.opserver import OpServer
 
     sup = FleetSupervisor(args, params, spec, base_argv)
@@ -741,10 +1544,19 @@ def run_supervisor(args, params, spec, base_argv: List[str]) -> int:
     server = None
     if args.status_port is not None:
         server = OpServer(port=args.status_port).start()
-        print(f"# fleet opserver: {server.url}/fleet", flush=True)
+        print(f"# fleet opserver: {server.url}/fleet "
+              "(+ /fleet/latency /fleet/timeline /fleet/events "
+              "/fleet/metrics)", flush=True)
+    live = None
+    if getattr(args, "live_stats", False):
+        live = FleetLiveStats(
+            sup, interval_s=getattr(args, "telemetry_interval", 5.0)
+        ).start()
     try:
         return sup.run()
     finally:
+        if live is not None:
+            live.close()
         if server is not None:
             server.close()
         if on_main and prev_term is not None:

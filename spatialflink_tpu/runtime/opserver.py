@@ -29,6 +29,15 @@ module adds that plane, stdlib-only:
                    query (schema-validated JSON body, lands at the next
                    window boundary) — the dynamic query plane
   /queries/<id>    GET: one query's lifecycle record; DELETE: drain it
+  /fleet           supervisor's aggregated per-worker view (fleet runs)
+  /fleet/latency   end-to-end record→merged-emit lineage: fleet stage
+                   table + sum check after the merge, record→visible
+                   histogram and per-worker samples mid-run
+  /fleet/timeline  the merged causally-ordered fleet event timeline
+                   (supervisor lifecycle + harvested worker events)
+  /fleet/events    same ring with worker-style ``?since=`` cursors
+  /fleet/metrics   every worker's Prometheus text relabeled with
+                   ``worker="wN"`` + fleet gauges — one scrape point
   =============== ====================================================
 
 Method handling is uniform: a known route hit with a verb outside its
@@ -83,13 +92,17 @@ _ROUTES = {
     "/profile/cells": ("GET",), "/partition": ("GET",),
     "/queries": ("GET", "POST"),
     "/device": ("GET",), "/compile": ("GET",), "/latency": ("GET",),
-    "/fleet": ("GET",),
+    "/fleet": ("GET",), "/fleet/latency": ("GET",),
+    "/fleet/timeline": ("GET",), "/fleet/events": ("GET",),
+    "/fleet/metrics": ("GET",),
 }
 _PREFIX_ROUTES = {"/trace/": ("GET",), "/queries/": ("GET", "DELETE")}
 
 _ENDPOINTS = ["/healthz", "/status", "/metrics", "/events", "/trace/recent",
               "/trace/<id>", "/profile/cells", "/partition", "/queries",
-              "/queries/<id>", "/device", "/compile", "/latency", "/fleet"]
+              "/queries/<id>", "/device", "/compile", "/latency", "/fleet",
+              "/fleet/latency", "/fleet/timeline", "/fleet/events",
+              "/fleet/metrics"]
 
 
 def _allowed_methods(path: str):
@@ -212,6 +225,23 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(200, srv.partition_payload())
         elif path == "/fleet":
             self._send_json(200, srv.fleet_payload())
+        elif path == "/fleet/latency":
+            self._send_json(200, srv.fleet_latency_payload())
+        elif path == "/fleet/timeline":
+            self._send_json(200, srv.fleet_timeline_payload())
+        elif path == "/fleet/events":
+            since_raw = parse_qs(query).get("since", [None])[0]
+            try:
+                since = None if since_raw is None else int(since_raw)
+            except ValueError:
+                self._send_json(400, {
+                    "error": f"?since must be an integer event seq, "
+                             f"got {since_raw!r}"})
+                return
+            self._send_json(200, srv.fleet_events_payload(since))
+        elif path == "/fleet/metrics":
+            self._send(200, srv.fleet_metrics_text().encode(),
+                       "text/plain; version=0.0.4")
         elif path == "/device":
             self._send_json(200, srv.device_payload())
         elif path == "/compile":
@@ -481,21 +511,64 @@ class OpServer:
         payload["adaptive"] = True
         return payload
 
+    _FLEET_NOTE = "not a fleet supervisor (start one with --fleet N)"
+
+    @staticmethod
+    def _fleet():
+        from spatialflink_tpu.runtime.fleetsup import active_fleet
+
+        return active_fleet()
+
     def fleet_payload(self) -> dict:
         """``/fleet``: the supervisor's aggregated view of every worker —
         liveness, restarts, heartbeat age, leaf share, and the last polled
         per-worker ``/status``/``/latency`` payloads; an explanatory note
         on a single-process (non-fleet) run."""
-        from spatialflink_tpu.runtime.fleetsup import active_fleet
-
-        sup = active_fleet()
+        sup = self._fleet()
         if sup is None:
-            return {"fleet": False,
-                    "note": "not a fleet supervisor "
-                            "(start one with --fleet N)"}
+            return {"fleet": False, "note": self._FLEET_NOTE}
         payload = sup.fleet_view()
         payload["fleet"] = True
         return payload
+
+    def fleet_latency_payload(self) -> dict:
+        """``/fleet/latency``: the end-to-end record→merged-emit lineage
+        (stage-budget table + sums-to-total check once the global merge
+        lands; the record→outbox-visible histogram and newest per-worker
+        monitor samples mid-run)."""
+        sup = self._fleet()
+        if sup is None:
+            return {"stages": {}, "recent": [], "note": self._FLEET_NOTE}
+        return sup.fleet_latency_payload()
+
+    def fleet_timeline_payload(self) -> dict:
+        """``/fleet/timeline``: the merged causally-ordered fleet event
+        timeline — supervisor lifecycle events interleaved with every
+        worker's harvested ``/events`` ring, plus per-lane counts."""
+        sup = self._fleet()
+        if sup is None:
+            return {"events": [], "lanes": {}, "total": 0,
+                    "note": self._FLEET_NOTE}
+        return sup.fleet_timeline_payload()
+
+    def fleet_events_payload(self, since: Optional[int] = None) -> dict:
+        """``/fleet/events``: the merged timeline ring with the same
+        ``?since=<seq>`` cursor semantics as a worker's ``/events``."""
+        sup = self._fleet()
+        if sup is None:
+            return {"events": [], "total": 0, "latest_seq": 0,
+                    "note": self._FLEET_NOTE}
+        return sup.fleet_events_payload(since)
+
+    def fleet_metrics_text(self) -> str:
+        """``/fleet/metrics``: one federated Prometheus scrape — every
+        worker's ``/metrics`` body relabeled ``worker="wN"`` plus fleet
+        gauges (works with the observability plane off: federation only
+        needs the worker URLs the supervisor already resolves)."""
+        sup = self._fleet()
+        if sup is None:
+            return f"# {self._FLEET_NOTE}\n"
+        return sup.fleet_metrics_text()
 
     # ------------------------------ lifecycle -------------------------- #
 

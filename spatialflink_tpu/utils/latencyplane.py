@@ -345,6 +345,16 @@ class LatencyPlane:
             rows = list(self._recent.values())[-max(0, int(k)):]
             return [dict(r, stages=dict(r["stages"])) for r in rows]
 
+    def budget_row(self, window_start: int) -> Optional[dict]:
+        """One window's full budget row (a copy), or None once evicted
+        from the recent ring — the fleet worker reads this at outbox
+        append time so the emitted window's stage chain can travel to
+        the supervisor as a lineage sidecar."""
+        with self._lock:
+            row = self._recent.get(int(window_start))
+            return (None if row is None
+                    else dict(row, stages=dict(row["stages"])))
+
     def to_dict(self) -> dict:
         """The compact ``latency`` block embedded in every snapshot."""
         with self._lock:

@@ -1276,6 +1276,31 @@ def prometheus_text(tel: Optional[Telemetry] = None,
     return "\n".join(lines) + "\n"
 
 
+def relabel_prometheus_lines(text: str, label: str, value: str) -> str:
+    """Prepend ``label="value"`` to every sample line of a Prometheus
+    text exposition; ``#`` comment/TYPE lines and blanks pass through
+    unchanged. The fleet supervisor's ``/fleet/metrics`` federation uses
+    this to pin ``worker="wN"`` onto each worker's scraped ``/metrics``
+    body — the same proper-label discipline :func:`prometheus_text`
+    applies to stage/family/query names, so one fleet scrape point can
+    still ``sum by (stage)`` across workers."""
+    pin = f'{label}="{value}"'
+    out: List[str] = []
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            out.append(line)
+            continue
+        name, brace, rest = line.partition("{")
+        if brace:
+            # `metric{a="b",...} v` -> `metric{worker="wN",a="b",...} v`
+            out.append(f"{name}{{{pin},{rest}" if not rest.startswith("}")
+                       else f"{name}{{{pin}{rest}")
+        else:
+            metric, sp, val = line.partition(" ")
+            out.append(f"{metric}{{{pin}}} {val}" if sp else line)
+    return "\n".join(out) + ("\n" if text.endswith("\n") else "")
+
+
 class TelemetryReporter:
     """Daemon thread writing shared-schema :func:`status_snapshot` JSONL
     lines to ``<out_dir>/telemetry.jsonl`` — one immediately at

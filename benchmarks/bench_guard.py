@@ -33,6 +33,17 @@ Rows (identity field ``path``):
                         re-reading the stream — the ISSUE 10 contract:
                         the control plane must preserve run_multi's
                         amortization (per-query identity asserted)
+- ``controller_pareto`` the chunk governor's closed loop vs a fixed-chunk
+                        sweep: the gated ratio is the Pareto composite
+                        (min over fixed chunks of the better of the
+                        throughput ratio and the p99 ratio) — >= 1 means
+                        no fixed chunk dominates the governed run on both
+                        axes (window-table identity asserted; the full
+                        per-class frontier lives in bench_control.py)
+- ``realtime_vectorized``  the rebuilt realtime mode (columnar
+                        MicroBatcher through the batched drive loop) vs
+                        the pre-rebuild scalar ``_micro_batches`` branch,
+                        fire-table identity asserted
 
 plus one LOWER-IS-BETTER row gated by a second ``bench_diff`` pass
 (``--metric p99_ms --lower-is-better`` against the ``latency_rows``
@@ -393,6 +404,137 @@ def bench_query_plane(n: int) -> dict:
                 churn_post_warmup_compiles=post_warm)
 
 
+def bench_controller_pareto(n: int) -> dict:
+    """Closed-loop governor Pareto gate (ISSUE 18): the GOVERNED windowed
+    range run (decode chunk driven live by the ChunkGovernor off the
+    latency plane's buckets) against a FIXED-chunk sweep of the same
+    pipeline. The gated ``speedup`` is the Pareto composite
+
+        min over fixed chunks c of max(gov_rps / rps_c, p99_c / gov_p99)
+
+    — >= 1 means no fixed chunk dominates the governor on BOTH axes
+    (throughput and record→emit p99), the bench bar's "meet or beat every
+    fixed size on the frontier" stated as one machine-robust ratio (each
+    axis covers the other's noise; per-axis p99 over ~21 windows flaps).
+    Window-table identity across every fixed chunk AND the governed run
+    is asserted, so a governor that bought its numbers by changing
+    results can never pass. ``benchmarks/bench_control.py`` carries the
+    full per-latency-class frontier incl. --chaos; this row is its
+    tier-1 sentinel."""
+    from spatialflink_tpu import driver
+    from spatialflink_tpu.models import Point
+    from spatialflink_tpu.operators import (PointPointRangeQuery,
+                                            QueryConfiguration, QueryType)
+    from spatialflink_tpu.runtime.control import ChunkGovernor
+    from spatialflink_tpu.utils.telemetry import telemetry_session
+
+    lines = _lines(n)
+    cfg, grid = _cfg(), _grid()
+    conf = QueryConfiguration(QueryType.WindowBased, 10_000, 5_000)
+    qp = Point.create(116.5, 40.3, grid, obj_id="q")
+
+    def ticking(tel):
+        # the reporter thread normally closes buckets; a replay bench
+        # drives the same maybe_tick from the feed (time-gated, so the
+        # cadence is the plane's tick_interval_s, not the loop count)
+        for i in range(0, len(lines), 256):
+            yield from lines[i:i + 256]
+            tel.latency.maybe_tick(tel)
+
+    def run(chunk, gov=None):
+        with telemetry_session() as tel:
+            tel.latency.tick_interval_s = 0.05
+            if gov is not None:
+                gov.install()
+            try:
+                op = PointPointRangeQuery(conf, grid)
+                s = driver.decode_stream(ticking(tel), cfg, grid,
+                                         chunk=chunk)
+                t0 = time.perf_counter()
+                table = [(r.window_start, len(r.records))
+                         for r in op.run(s, qp, 0.5)]
+                wall = time.perf_counter() - t0
+                p99 = tel.latency.record_emit.percentile(99)
+            finally:
+                if gov is not None:
+                    gov.uninstall()
+        return table, n / wall, p99
+
+    run(4096)  # warm
+    ref = None
+    fixed = {}
+    for c in (512, 2048, 8192):
+        table, rps, p99 = run(c)
+        if ref is None:
+            ref = table
+        assert table == ref, f"fixed chunk {c} changed the window table"
+        fixed[c] = (rps, p99)
+    gov = ChunkGovernor()
+    table, gov_rps, gov_p99 = run(gov.chunk_callback(), gov)
+    assert table == ref, "governed run changed the window table"
+    st = gov.status()
+    score = min(max(gov_rps / rps, p99 / gov_p99)
+                for rps, p99 in fixed.values())
+    return dict(path="controller_pareto", records=n,
+                speedup=round(score, 2),
+                gov_rps=int(gov_rps), gov_p99_ms=round(gov_p99, 3),
+                gov_final_chunk=st["chunk"], gov_ticks=st["ticks"],
+                gov_steps=st["grows"] + st["shrinks"],
+                fixed={str(c): dict(rps=int(r), p99_ms=round(p, 3))
+                       for c, (r, p) in fixed.items()})
+
+
+def bench_realtime_vectorized(n: int) -> dict:
+    """Realtime-on-the-vectorized-path gate (ISSUE 18): throughput of the
+    rebuilt realtime mode (tumbling count micro-windows cut by the
+    columnar MicroBatcher, driven through the batched pipeline) vs the
+    pre-rebuild scalar branch — per-record flatten into ``_micro_batches``
+    feeding the same drive loop (kept in-tree as the trajectory-family
+    helper, so the oracle is the actual old code, not a reconstruction).
+    Fire-table identity is asserted: same bounds, same selections."""
+    from spatialflink_tpu import driver
+    from spatialflink_tpu.models import Point
+    from spatialflink_tpu.operators import (PointPointRangeQuery,
+                                            QueryConfiguration, QueryType)
+
+    lines = _lines(n)
+    cfg, grid = _cfg(), _grid()
+    conf = QueryConfiguration(QueryType.RealTime, realtime_batch_size=512)
+    qp = Point.create(116.5, 40.3, grid, obj_id="q")
+
+    def run_new():
+        op = PointPointRangeQuery(conf, grid)
+        s = driver.decode_stream(iter(lines), cfg, grid)
+        return [(r.window_start, r.window_end, len(r.records))
+                for r in op.run(s, qp, 0.5)]
+
+    def run_scalar():
+        op = PointPointRangeQuery(conf, grid)
+        stream = iter(driver.decode_stream(iter(lines), cfg, grid))
+        batched = ((r[0].timestamp, r[-1].timestamp, r)
+                   for r in op._micro_batches(stream) if r)
+        mask_cache = op._leaf_mask_cache(
+            lambda: op.conf.adaptive_grid.neighboring_leaf_mask(
+                0.5, qp.cell, point=(qp.x, qp.y)))
+        return [(r.window_start, r.window_end, len(r.records))
+                for r in op._drive_batched(
+                    batched,
+                    lambda recs, tsb: op._eval(recs, qp, 0.5, tsb,
+                                               mask_cache),
+                    realtime=True)]
+
+    run_new(), run_scalar()  # warm both paths' jit shapes
+    t0 = time.perf_counter()
+    new = run_new()
+    dt_new = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    old = run_scalar()
+    dt_old = time.perf_counter() - t0
+    assert new == old, "vectorized realtime diverged from the scalar oracle"
+    return dict(path="realtime_vectorized", records=n, fires=len(new),
+                speedup=round(dt_old / dt_new, 2))
+
+
 def bench_latency_record_emit(n: int) -> dict:
     """Record→emit p99 (ms) through the latency-decomposition plane on a
     windowed range replay at the DEFAULT decode chunk — the tier-1 gate on
@@ -522,7 +664,8 @@ def bench_fleet_scaling(n: int) -> dict:
 def measure(n: int) -> list:
     return [bench_window_assign(n), bench_decode_columnar(n),
             bench_windowed_pipeline(n), bench_skew_adaptive(n),
-            bench_query_plane(n), bench_latency_record_emit(n),
+            bench_query_plane(n), bench_controller_pareto(n),
+            bench_realtime_vectorized(n), bench_latency_record_emit(n),
             bench_fleet_scaling(n)]
 
 

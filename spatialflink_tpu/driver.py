@@ -201,7 +201,12 @@ def decode_chunks(records: Iterable, cfg: StreamConfig, grid: UniformGrid,
     same ``off-type-dropped`` counter (a chunk the columnar parser rejects
     falls back to the exact per-record parse rather than crashing), and
     live sources' starvation sentinel flushes the buffer so chunking adds
-    at most one poll cycle of latency."""
+    at most one poll cycle of latency.
+
+    ``chunk`` is an int OR a zero-arg size callback (the chunk governor's
+    actuator, ``runtime/control.py``): a callback resolves ONCE at each
+    buffer start, so a live resize lands between flushes — never inside
+    one — and the flush threshold stays constant while a chunk fills."""
     from spatialflink_tpu.streams import bulk as B
     from spatialflink_tpu.streams.kafka import STARVED
     from spatialflink_tpu.utils import IdInterner
@@ -291,6 +296,8 @@ def decode_chunks(records: Iterable, cfg: StreamConfig, grid: UniformGrid,
 
     buf: List = []
     kind = None  # "str" (columnar-parseable) | "obj" (parsed) | "raw"
+    chunk_fn = chunk if callable(chunk) else None
+    chunk_n = max(1, int(chunk_fn() if chunk_fn is not None else chunk))
 
     def flush():
         nonlocal buf, kind
@@ -352,6 +359,8 @@ def decode_chunks(records: Iterable, cfg: StreamConfig, grid: UniformGrid,
                 yield out
         if not buf:
             t_first = time.perf_counter()
+            if chunk_fn is not None:
+                chunk_n = max(1, int(chunk_fn()))
         buf.append(rec)
         kind = k
         if shutdown_requested():
@@ -367,7 +376,7 @@ def decode_chunks(records: Iterable, cfg: StreamConfig, grid: UniformGrid,
         # sentinel (direct KafkaSource feeds) must not hold records hostage
         # to a chunk fill — `max_buffer_s` bounds the added decode latency
         # (replay sources fill chunks in microseconds and never hit it)
-        if (len(buf) >= chunk
+        if (len(buf) >= chunk_n
                 or time.perf_counter() - t_first >= max_buffer_s):
             out = flush()
             if out is not None:
@@ -583,11 +592,15 @@ def run_option(params: Params, stream1: Iterable, stream2: Optional[Iterable]
     # windowed modes use the default throughput chunk (live sources bound
     # the buffering to one poll cycle via the starvation sentinel)
     if spec.mode == "realtime":
-        dchunk = max(1, conf.realtime_batch_size)
+        # the vectorized micro-batcher cuts strictly every
+        # realtime_batch_size records regardless of decode-chunk size, so
+        # the governor may drive realtime chunks without moving a single
+        # batch boundary (tests/test_control.py pins the identity)
+        dchunk = _governed_chunk(max(1, conf.realtime_batch_size))
     elif params.window.type == "COUNT":
         dchunk = max(1, min(4096, int(params.window.step_s)))
     else:
-        dchunk = _decode_chunk_env(4096)
+        dchunk = _governed_chunk(_decode_chunk_env(4096))
 
     if spec.family in ("range", "knn", "join"):
         cls = _operator_class(spec)
@@ -673,8 +686,9 @@ def run_option(params: Params, stream1: Iterable, stream2: Optional[Iterable]
 
 
 def _run_trajectory(params, spec, conf, u_grid, q_grid, stream1, stream2):
-    dchunk = (max(1, conf.realtime_batch_size) if spec.mode == "realtime"
-              else 4096)
+    dchunk = _governed_chunk(
+        max(1, conf.realtime_batch_size) if spec.mode == "realtime"
+        else 4096)
     s1 = decode_stream(stream1, params.input1, u_grid, chunk=dchunk)
     q = params.query
     if spec.family == "tfilter":
@@ -1051,6 +1065,26 @@ def _decode_chunk_env(default: int) -> int:
     return max(1, int(v)) if v else default
 
 
+def _governed_chunk(dchunk: int, pinned: bool = False):
+    """The decode-chunk actuator seam: a per-flush size callback that
+    reads the installed chunk governor (``--controller``) LATE — at each
+    buffer start, not at wiring time — so stream construction order vs.
+    governor install order does not matter, and a governor installed
+    mid-run takes effect at the next flush. Without one the callback
+    returns the fixed size (same values as the pre-governor int).
+    ``pinned`` sizes — an explicit ``SPATIALFLINK_DECODE_CHUNK`` env
+    override, or count-window step alignment — stay fixed ints: the
+    operator asked for THAT chunk."""
+    if pinned or os.environ.get("SPATIALFLINK_DECODE_CHUNK"):
+        return dchunk
+    from spatialflink_tpu.runtime.control import active_governor
+
+    def _resolve() -> int:
+        gov = active_governor()
+        return gov.chunk() if gov is not None else dchunk
+    return _resolve
+
+
 def _schema4(cfg: StreamConfig) -> list:
     """csvTsvSchemaAttr padded to the 4 [oID, ts, x, y] slots (None =
     absent) — shared by the bulk file path and the kafka chunked decode."""
@@ -1369,7 +1403,11 @@ def _wire_kafka(params: Params, spec: CaseSpec, args, skip1: int
              if windowed and geom1 == "Point" else None)
     bulk2 = (_kafka_bulk_decode(params.input2, q_grid)
              if windowed and two_stream and geom2 == "Point" else None)
-    chunk = _decode_chunk_env(512 if follow else 2048)
+    # both modes seed at the measured 2048-4096 throughput/latency knee
+    # (the old follow default of 512 sat on the wrong side of it — 20-50%
+    # p99 on the table); the chunk governor, when installed, owns the
+    # size from that starting point via its per-flush callback
+    chunk = _governed_chunk(_decode_chunk_env(2048))
     # --limit bounds THIS run's consumption per stream (from the group's
     # resume point), mirroring the file path's record bound. Follow mode
     # ALWAYS sets the starvation sentinel on windowed sources: the commit
@@ -1667,6 +1705,36 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "applied at window boundaries (activates the "
                          "dynamic plane like --queries-file; both may be "
                          "used together)")
+    ap.add_argument("--controller", metavar="SPEC", nargs="?", const="",
+                    default=None,
+                    help="closed-loop decode-chunk governor: tick on the "
+                         "telemetry-reporter cadence, read the live stage "
+                         "budget, and resize the decode chunk one "
+                         "power-of-two bucket at a time between flushes — "
+                         "shrink when queue/buffer wait dominates and the "
+                         "record→emit p99 breaches, grow when dispatch-"
+                         "bound or idle; never recompiles. SPEC tunes the "
+                         "policy as comma key=value pairs over "
+                         "target_p99_ms/min_chunk/max_chunk/"
+                         "interactive_max_chunk/fast_lane_depth/"
+                         "confirm_ticks/cooldown_ticks/shed_after_stalls/"
+                         "unshed_after_clean/idle_headroom (bare "
+                         "--controller = defaults). Needs a telemetry "
+                         "session (--telemetry-dir/--live-stats/"
+                         "--status-port/...) for the tick source; live "
+                         "state in the controller block of GET /latency "
+                         "and the stderr digest")
+    ap.add_argument("--latency-class", choices=["interactive", "batch"],
+                    default="batch", dest="latency_class",
+                    help="latency class for this run's standing queries "
+                         "(default batch; also the default class for "
+                         "--queries-file/--control-topic admissions that "
+                         "omit 'latency_class'). While any interactive "
+                         "query serves, the --controller fast lane caps "
+                         "the decode chunk at interactive_max_chunk and "
+                         "bounds the pipeline queue depth to "
+                         "fast_lane_depth so interactive emits never park "
+                         "behind throughput amortization")
     ap.add_argument("--multi-query", action="store_true",
                     help="answer ALL configured query points/geometries in "
                          "one dispatch per window (run_multi; default keeps "
@@ -2052,7 +2120,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                   "would serve stale partials) — full-window evaluation",
                   file=sys.stderr)
         registry = QueryRegistry(spec.family, radius=params.query.radius,
-                                 k=params.query.k)
+                                 k=params.query.k,
+                                 default_latency_class=args.latency_class)
         coord = getattr(params, "checkpointer", None)
         restored = bool(coord is not None
                         and registry.register_checkpoint(coord))
@@ -2064,14 +2133,17 @@ def main(argv: Optional[List[str]] = None) -> int:
             seeds = []
             try:
                 if args.queries_file:
-                    seeds = load_queries_file(args.queries_file, spec.family)
+                    seeds = load_queries_file(
+                        args.queries_file, spec.family,
+                        default_latency_class=args.latency_class)
             except (OSError, ValueError) as e:
                 ap.error(f"--queries-file: {e}")
             if not seeds and params.query.query_points:
                 # the config's queryPoints seed the fleet (the registry is
                 # the source of truth for what runs; the static config is
                 # just its time-zero admission batch)
-                seeds = [QuerySpec(id=f"q{i}", family=spec.family, x=x, y=y)
+                seeds = [QuerySpec(id=f"q{i}", family=spec.family, x=x, y=y,
+                                   latency_class=args.latency_class)
                          for i, (x, y) in
                          enumerate(params.query.query_points)]
             try:
@@ -2132,6 +2204,25 @@ def main(argv: Optional[List[str]] = None) -> int:
             print("warning: --slo has no consumer without --status-port, "
                   "--telemetry-dir, or --live-stats (nothing evaluates "
                   "the thresholds)", file=sys.stderr)
+
+    if args.controller is not None:
+        from spatialflink_tpu.runtime.control import (ChunkGovernor,
+                                                      GovernorPolicy)
+
+        try:
+            policy = GovernorPolicy.from_spec(args.controller)
+        except ValueError as e:
+            ap.error(str(e))
+        # dynamic attribute, like checkpointer/query_registry: must not
+        # leak into Params.to_dict()/fingerprints
+        params.chunk_governor = ChunkGovernor(policy=policy)
+        if not (args.telemetry_dir or args.live_stats or args.trace_dir
+                or args.postmortem_dir):
+            print("warning: --controller has no tick source without a "
+                  "telemetry session (--telemetry-dir/--live-stats/"
+                  "--trace-dir/--postmortem-dir): the latency plane's "
+                  "bucket close drives the control law, so the chunk "
+                  "stays at its seed", file=sys.stderr)
 
     if (args.telemetry_dir or args.live_stats or args.trace_dir
             or args.postmortem_dir):
@@ -2325,6 +2416,23 @@ def _run_cli(ap, args, params: Params, spec: CaseSpec, skip1: int,
         # runs (tests) never leak the chain
         repartitioner.install()
         stack.callback(repartitioner.uninstall)
+    governor = getattr(params, "chunk_governor", None)
+    if governor is not None:
+        # the decode streams resolve the governor per flush (late-bound
+        # through _governed_chunk), so installing here — inside the stack,
+        # uninstalled on every exit path — is safe regardless of wiring
+        # order; checkpointed runs carry the control state as the
+        # 'controller' manifest component
+        governor.install()
+        stack.callback(governor.uninstall)
+        if coord is not None:
+            governor.register_checkpoint(coord)
+        pol = governor.policy
+        print(f"# controller: decode-chunk governor on "
+              f"(seed {governor.chunk()}, bounds "
+              f"[{pol.min_chunk}, {pol.max_chunk}], target p99 "
+              f"{pol.target_p99_ms:g}ms; live state at GET /latency)",
+              file=sys.stderr)
     registry = getattr(params, "query_registry", None)
     router = None
     if registry is not None:

@@ -1425,8 +1425,27 @@ class SpatialOperator:
         """
         realtime = self.conf.query_type is QueryType.RealTime
         if realtime:
-            batched = ((r[0].timestamp, r[-1].timestamp, r)
-                       for r in self._micro_batches(stream) if r)
+            # realtime as a degenerate case of the batched path: tumbling
+            # COUNT micro-windows cut by the vectorized MicroBatcher (SoA
+            # slices straight off the decode chunks), driven through the
+            # same pipelined loop as windowed queries — so realtime
+            # inherits the checkpoint barrier, the latency plane, and the
+            # chunk governor. Batch boundaries are count-strict in arrival
+            # order, so results are identical to the old scalar
+            # ``_micro_batches`` path (kept as the trajectory-family
+            # helper and the identity oracle in tests/test_control.py).
+            from spatialflink_tpu.runtime.windows import MicroBatcher
+
+            mb = MicroBatcher(max(1, self.conf.realtime_batch_size))
+            # the open micro-batch checkpoints like a window buffer:
+            # records noted past the source position but not yet fired
+            # restore from the manifest instead of being lost (the old
+            # path relied on decode-chunk/batch-size alignment, which the
+            # governor deliberately breaks)
+            self._register_ckpt_windows("realtime-batcher", mb)
+            if not self.columnar_windows:
+                stream = iter(stream)  # flatten any chunked decode stream
+            batched = mb.batches(stream)
         elif pane_merge is not None and self._panes_active():
             return self._drive_batched(
                 self._pane_windows(stream),
@@ -1449,6 +1468,13 @@ class SpatialOperator:
         batches = REGISTRY.counter("batches-evaluated")
         records_c = REGISTRY.counter("records-evaluated")
         depth = max(1, self.conf.pipeline_depth)
+        # fast lane: while interactive queries are in the fleet, the chunk
+        # governor caps how many deferred windows may queue here (depth is
+        # throughput headroom; every queued window is emit latency for the
+        # interactive class). Checked per batch — a plain bool read — so
+        # the lane engages/disengages live with fleet changes.
+        from spatialflink_tpu.runtime.control import active_governor
+        gov = active_governor()
         pending: deque = deque()  # (start, end, Deferred)
         # named per-operator trace annotations (≙ the reference's named
         # operators in the Flink web UI, StreamingJob.java:70-72): visible
@@ -1566,7 +1592,8 @@ class SpatialOperator:
                     backlog.set(len(pending))
                 else:
                     pending.append((start, end, sel, 0.0, None))
-                yield from drain(depth - 1)
+                eff = depth if gov is None else gov.drain_depth(depth)
+                yield from drain(eff - 1)
             else:
                 yield from drain(0)  # keep window order
                 if tel is not None and (sel or not realtime):

@@ -67,6 +67,18 @@ def _emit_p99(status: dict) -> Optional[float]:
     return h.get("p99") if h.get("count") else None
 
 
+def _shedding(status: dict) -> Optional[float]:
+    """1.0 while the chunk governor holds the query registry in admission
+    shedding, 0.0 while admitting, None without a governor — so
+    ``--slo shedding=0`` turns every shed episode into a health breach
+    transition (and, with the flight recorder attached, a post-mortem
+    bundle of the stall that caused it)."""
+    ctl = status.get("controller") or {}
+    if ctl.get("chunk") is None:
+        return None
+    return 1.0 if ctl.get("shedding") else 0.0
+
+
 def _throughput(status: dict) -> Optional[float]:
     # rate is 0.0 before the first record; treat a never-started stream as
     # unknown (records_in == 0), a stalled one (records then silence) as a
@@ -91,6 +103,7 @@ KNOWN_CHECKS: Dict[str, tuple] = {
     "recompiles": (_device_field("recompiles"), "hi"),
     "device_mem_bytes": (_device_field("mem_bytes_in_use"), "hi"),
     "p99_emit_ms": (_emit_p99, "hi"),
+    "shedding": (_shedding, "hi"),
 }
 
 

@@ -444,7 +444,8 @@ class OpServer:
         """(http_code, payload) for ``POST /queries``: admit a new
         standing query — or stage an update when the id already names a
         live one. Takes effect at the next window boundary."""
-        from spatialflink_tpu.runtime.queryplane import QuerySpecError
+        from spatialflink_tpu.runtime.queryplane import (QuerySpecError,
+                                                         QueryState)
 
         reg = self._registry()
         if reg is None:
@@ -453,6 +454,17 @@ class OpServer:
             entry = reg.admit(body)
         except QuerySpecError as e:
             return 400, {"error": str(e)}
+        if entry.state is QueryState.SHED:
+            # admission shedding: the chunk governor saw sustained
+            # backpressure stalls and flipped the registry into shedding —
+            # the spec is parked (state "shed", auto-released when the
+            # stalls clear), and the caller is told to back off
+            return 429, {"query": entry.to_dict(),
+                         "fleet_version": reg.fleet_version,
+                         "error": "admission shed: pipeline is under "
+                                  "sustained backpressure; the query is "
+                                  "parked and admits when pressure clears "
+                                  "(see /latency controller block)"}
         return 200, {"query": entry.to_dict(),
                      "fleet_version": reg.fleet_version,
                      "applies": "at the next window boundary"}
@@ -685,6 +697,20 @@ def format_digest(snap: dict) -> str:
             s += f" ({la['dominant_stage']})"
         if la.get("stall"):
             s += " STALL"
+        parts.append(s)
+    ctl = st.get("controller") or {}
+    if ctl.get("chunk") is not None:
+        # the actuator, next to the sensor it reacts to: live decode-chunk
+        # setting plus step totals, fast lane, and shedding — one glance
+        # answers "what is the governor doing about that latency"
+        s = f"chunk {ctl['chunk']}"
+        moves = int(ctl.get("grows", 0)) + int(ctl.get("shrinks", 0))
+        if moves:
+            s += f" ({ctl.get('grows', 0)}+/{ctl.get('shrinks', 0)}-)"
+        if ctl.get("fast_lane"):
+            s += " fast-lane"
+        if ctl.get("shedding"):
+            s += " SHED"
         parts.append(s)
     deg = snap.get("degradation") or {}
     if deg:

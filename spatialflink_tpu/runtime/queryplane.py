@@ -88,10 +88,18 @@ class QueryState(enum.Enum):
     ACTIVE = "active"        # serving: owns a slot on the device Q-axis
     DRAINING = "draining"    # retirement requested; serves until apply()
     RETIRED = "retired"      # left the fleet
+    #: admitted while the governor was shedding (backpressure stall):
+    #: parked OUT of the apply() pipeline — visible in the ledger, never
+    #: joins the fleet — until un-shed releases it back to PENDING
+    SHED = "shed"
 
 
 _FAMILIES = ("range", "knn")
 _ROUTE_PREFIXES = ("stdout", "file:", "kafka:")
+#: per-query latency classes: ``interactive`` queries engage the chunk
+#: governor's small-chunk fast lane (bounded drive-loop queue depth);
+#: ``batch`` queries keep the amortized path (``runtime/control.py``)
+_LATENCY_CLASSES = ("interactive", "batch")
 #: per-query SLO keys: window-record-count bounds, plus the latency class
 #: hook — ``p99_emit_ms`` breaches when the query's record→emit p99 (the
 #: ``record-emit-ms@<id>`` histogram the router feeds at its demux point)
@@ -116,6 +124,8 @@ class QuerySpec:
     k: Optional[int] = None
     route: str = "stdout"
     slo: Optional[Dict[str, float]] = None
+    #: ``interactive`` | ``batch`` — the chunk governor's fast-lane flag
+    latency_class: str = "batch"
 
     def to_dict(self) -> dict:
         d = {"id": self.id, "family": self.family, "x": self.x, "y": self.y,
@@ -126,11 +136,13 @@ class QuerySpec:
             d["k"] = self.k
         if self.slo:
             d["slo"] = dict(self.slo)
+        if self.latency_class != "batch":
+            d["latency_class"] = self.latency_class
         return d
 
     @classmethod
-    def from_dict(cls, d: Any, *, default_family: Optional[str] = None
-                  ) -> "QuerySpec":
+    def from_dict(cls, d: Any, *, default_family: Optional[str] = None,
+                  default_latency_class: str = "batch") -> "QuerySpec":
         """Schema-validated build — every admission surface (POST body,
         control record, ``--queries-file`` entry) funnels through here so
         a malformed query is rejected with the SAME named-field error
@@ -139,7 +151,7 @@ class QuerySpec:
             raise QuerySpecError(f"query spec must be an object, got "
                                  f"{type(d).__name__}")
         unknown = set(d) - {"id", "family", "x", "y", "radius", "k",
-                            "route", "slo"}
+                            "route", "slo", "latency_class"}
         if unknown:
             raise QuerySpecError(f"unknown query field(s) "
                                  f"{sorted(unknown)}")
@@ -186,8 +198,13 @@ class QuerySpec:
                 slo = {sk: float(sv) for sk, sv in slo.items()}
             except (TypeError, ValueError):
                 raise QuerySpecError("'slo' thresholds must be numeric")
+        lclass = d.get("latency_class", default_latency_class)
+        if lclass not in _LATENCY_CLASSES:
+            raise QuerySpecError(
+                f"'latency_class' must be one of {_LATENCY_CLASSES}, "
+                f"got {lclass!r}")
         return cls(id=qid, family=family, x=x, y=y, radius=radius, k=k,
-                   route=route, slo=slo)
+                   route=route, slo=slo, latency_class=lclass)
 
 
 @dataclass
@@ -244,12 +261,20 @@ class QueryRegistry:
     different query than asked)."""
 
     def __init__(self, family: str, *, radius: float = 0.0,
-                 k: Optional[int] = None, retain_retired: int = 64):
+                 k: Optional[int] = None, retain_retired: int = 64,
+                 default_latency_class: str = "batch"):
         if family not in _FAMILIES:
             raise ValueError(f"family must be one of {_FAMILIES}")
+        if default_latency_class not in _LATENCY_CLASSES:
+            raise ValueError(
+                f"default_latency_class must be one of {_LATENCY_CLASSES}")
         self.family = family
         self.radius = float(radius)
         self.k = k
+        self.default_latency_class = default_latency_class
+        #: governor-driven admission shedding (see runtime/control.py):
+        #: while True, NEW admissions park in QueryState.SHED
+        self.shedding = False
         self._lock = threading.RLock()
         self._entries: Dict[str, QueryEntry] = {}
         #: ACTIVE/DRAINING ids in slot (admission) order — the Q-axis
@@ -286,17 +311,36 @@ class QueryRegistry:
     def admit(self, spec) -> QueryEntry:
         """Admit a new standing query (PENDING until the next apply), or —
         when the id already names a live query — stage an UPDATE of it.
+        While :attr:`shedding` (the chunk governor saw sustained
+        backpressure stalls), NEW queries land in the ``shed`` lifecycle
+        state instead of joining the staged backlog — the surfaces turn
+        that into HTTP 429 / a control-record reject; updates of already-
+        live queries still stage (they hold their slot either way).
         Thread-safe; callable from any surface."""
         if not isinstance(spec, QuerySpec):
-            spec = QuerySpec.from_dict(spec, default_family=self.family)
+            spec = QuerySpec.from_dict(
+                spec, default_family=self.family,
+                default_latency_class=self.default_latency_class)
         self._validate(spec)
         with self._lock:
             cur = self._entries.get(spec.id)
+            if cur is not None and cur.state is QueryState.SHED:
+                cur.spec = spec  # re-admission while shed: refresh in place
+                return cur
             if cur is not None and cur.state is not QueryState.RETIRED:
                 return self._stage_update(cur, spec)
-            entry = QueryEntry(spec=spec, admitted_ms=int(time.time() * 1000))
+            shed = self.shedding
+            entry = QueryEntry(
+                spec=spec,
+                state=QueryState.SHED if shed else QueryState.PENDING,
+                admitted_ms=int(time.time() * 1000))
             self._entries[spec.id] = entry
-            self._dirty = True
+            if not shed:
+                self._dirty = True
+        if shed:
+            _metrics.REGISTRY.counter("queries-shed").inc()
+            _emit("query-shed", id=spec.id, route=spec.route)
+            return entry
         _metrics.REGISTRY.counter("queries-admitted").inc()
         _emit("query-admitted", id=spec.id, route=spec.route)
         return entry
@@ -311,8 +355,12 @@ class QueryRegistry:
             merged = entry.spec.to_dict()
             merged.update(changes or {})
             merged["id"] = qid
-            spec = self._validate(
-                QuerySpec.from_dict(merged, default_family=self.family))
+            spec = self._validate(QuerySpec.from_dict(
+                merged, default_family=self.family,
+                default_latency_class=self.default_latency_class))
+            if entry.state is QueryState.SHED:
+                entry.spec = spec  # parked: nothing staged to swap
+                return entry
             return self._stage_update(entry, spec)
 
     def _stage_update(self, entry: QueryEntry, spec: QuerySpec
@@ -332,7 +380,7 @@ class QueryRegistry:
             entry = self._entries.get(qid)
             if entry is None or entry.state is QueryState.RETIRED:
                 raise KeyError(qid)
-            if entry.state is QueryState.PENDING:
+            if entry.state in (QueryState.PENDING, QueryState.SHED):
                 self._retire_now(entry)
             elif entry.state is QueryState.ACTIVE:
                 entry.state = QueryState.DRAINING
@@ -403,6 +451,38 @@ class QueryRegistry:
         query keeps its slot until the next apply)."""
         with self._lock:
             return [self._entries[q] for q in self._fleet]
+
+    def has_interactive(self) -> bool:
+        """Any serving query declared ``latency_class: interactive`` —
+        the chunk governor's fast-lane engagement signal (read once per
+        tick, never per record)."""
+        with self._lock:
+            return any(
+                self._entries[q].spec.latency_class == "interactive"
+                for q in self._fleet)
+
+    def set_shedding(self, shedding: bool) -> bool:
+        """Flip admission shedding (the chunk governor's stall verdict).
+        Un-shedding releases every parked ``shed`` entry back to PENDING
+        — they join the fleet at the next apply(), preserving the
+        window-boundary discipline. Returns True when the flag changed."""
+        shedding = bool(shedding)
+        released = []
+        with self._lock:
+            if shedding == self.shedding:
+                return False
+            self.shedding = shedding
+            if not shedding:
+                for entry in self._entries.values():
+                    if entry.state is QueryState.SHED:
+                        entry.state = QueryState.PENDING
+                        released.append(entry.id)
+                if released:
+                    self._dirty = True
+        for qid in released:
+            _metrics.REGISTRY.counter("queries-admitted").inc()
+            _emit("query-admitted", id=qid, released_from_shed=True)
+        return True
 
     def staged_count(self) -> int:
         """Fleet changes staged but not yet landed (PENDING admissions,
@@ -483,9 +563,31 @@ class QueryRegistry:
                     entry.slo_breaches += 1
                     _metrics.REGISTRY.counter("query-slo-breaches").inc()
                     _emit("query-slo-breach", id=qid, records=n_records)
+                    self._recorder_breach(entry, n_records, emit_p99_ms)
                 elif entry.slo_ok is False:
                     _emit("query-slo-recovered", id=qid)
                 entry.slo_ok = ok
+
+    @staticmethod
+    def _recorder_breach(entry: QueryEntry, n_records: int,
+                         emit_p99_ms: Optional[float]) -> None:
+        """Per-query breach TRANSITION → flight-recorder trigger: PR 10
+        only dumped on the GLOBAL health verdict, so one interactive
+        query's ``p99_emit_ms`` breach left no post-mortem. One bundle
+        per query id per run (the recorder's own ``max_dumps`` bounds the
+        total); no recorder installed = no-op."""
+        from spatialflink_tpu.utils.deviceplane import active_recorder
+
+        rec = active_recorder()
+        if rec is None:
+            return
+        qid = entry.id
+        detail = {"query": qid, "records": n_records,
+                  "latency_class": entry.spec.latency_class,
+                  "p99_emit_ms": emit_p99_ms,
+                  "slo": dict(entry.spec.slo or {})}
+        rec.note("query-slo-breach", **detail)
+        rec.dump_once(f"query-slo-{qid}", "query-slo-breach", detail=detail)
 
     def status(self) -> dict:
         """The ``GET /queries`` payload: the full ledger (live + recently
@@ -498,6 +600,7 @@ class QueryRegistry:
                 "fleet_version": self._version,
                 "fleet": fleet, "live": live,
                 "bucket": bucket_size(live),
+                "shedding": self.shedding,
                 "queries": entries,
                 "control_position":
                     None if self._control is None else self._control.position}
@@ -516,6 +619,7 @@ class QueryRegistry:
         with self._lock:
             return {
                 "fleet_version": self._version,
+                "shedding": self.shedding,
                 "fleet": list(self._fleet),
                 "entries": [
                     {"spec": e.spec.to_dict(), "state": e.state.value,
@@ -534,16 +638,19 @@ class QueryRegistry:
         updates — from a checkpoint component."""
         with self._lock:
             self._entries = {}
+            self.shedding = bool(meta.get("shedding", False))
             for row in meta.get("entries", []):
-                spec = QuerySpec.from_dict(row["spec"],
-                                           default_family=self.family)
+                spec = QuerySpec.from_dict(
+                    row["spec"], default_family=self.family,
+                    default_latency_class=self.default_latency_class)
                 entry = QueryEntry(
                     spec=spec, state=QueryState(row["state"]),
                     admitted_ms=int(row.get("admitted_ms", 0)),
                     since_version=int(row.get("since_version", 0)))
                 if row.get("pending_spec"):
                     entry.pending_spec = QuerySpec.from_dict(
-                        row["pending_spec"], default_family=self.family)
+                        row["pending_spec"], default_family=self.family,
+                        default_latency_class=self.default_latency_class)
                 self._entries[entry.id] = entry
             self._fleet = [q for q in meta.get("fleet", [])
                            if q in self._entries]
@@ -771,10 +878,13 @@ class QueryRouter:
         self._files.clear()
 
 
-def load_queries_file(path: str, family: str) -> List[QuerySpec]:
+def load_queries_file(path: str, family: str,
+                      default_latency_class: str = "batch"
+                      ) -> List[QuerySpec]:
     """Parse a ``--queries-file``: a JSON array of query specs, or an
     object ``{"queries": [...]}``. Validation errors name the offending
-    entry."""
+    entry. Specs omitting ``latency_class`` take the run's
+    ``--latency-class`` default."""
     with open(path) as f:
         data = json.load(f)
     if isinstance(data, dict):
@@ -785,7 +895,9 @@ def load_queries_file(path: str, family: str) -> List[QuerySpec]:
     out = []
     for i, d in enumerate(data):
         try:
-            out.append(QuerySpec.from_dict(d, default_family=family))
+            out.append(QuerySpec.from_dict(
+                d, default_family=family,
+                default_latency_class=default_latency_class))
         except QuerySpecError as e:
             raise QuerySpecError(f"{path}: query[{i}]: {e}")
     return out

@@ -468,7 +468,10 @@ class WindowCommitTap:
         self.slide_ms = max(1, int(slide_ms))
         self.parse = parse
         self.bulk_decode = bulk_decode
-        self.bulk_chunk = max(1, bulk_chunk)
+        #: int or a zero-arg size callback (the chunk governor's actuator)
+        #: — read through the :attr:`bulk_chunk` property, which resolves
+        #: a callback per take so a live resize lands between chunks
+        self._bulk_chunk = bulk_chunk
         #: the chunked decoder's obj-id space (set by the driver when the
         #: decoder interns); downstream ChunkedStream consumers read it
         self.interner = getattr(bulk_decode, "interner", None)
@@ -496,6 +499,13 @@ class WindowCommitTap:
                            if tel is not None else None)
         self._backlog_gauge = (tel.gauge("kafka.commit-backlog")
                                if tel is not None else None)
+
+    @property
+    def bulk_chunk(self) -> int:
+        """The decode-chunk size RIGHT NOW (every read site resolves the
+        governor callback afresh, so a resize applies at the next take)."""
+        c = self._bulk_chunk
+        return max(1, int(c() if callable(c) else c))
 
     def _parse_or_dlq(self, raw, position: int):
         """Parse one record; on failure, redeliver-and-retry, then

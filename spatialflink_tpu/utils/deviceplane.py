@@ -641,10 +641,21 @@ class FlightRecorder:
     def _on_breach(self, check: str, value, threshold) -> None:
         self.note("slo-breach", check=check, value=value,
                   threshold=threshold)
-        if "slo-breach" not in self._dumped_reasons:
-            self._dumped_reasons.add("slo-breach")
-            self.dump("slo-breach", detail={"check": check, "value": value,
-                                            "threshold": threshold})
+        self.dump_once("slo-breach", "slo-breach",
+                       detail={"check": check, "value": value,
+                               "threshold": threshold})
+
+    def dump_once(self, key: str, reason: str,
+                  detail: Optional[dict] = None) -> Optional[str]:
+        """:meth:`dump` at most once per ``key`` per run — the trigger
+        discipline every breach-transition hook shares (global health,
+        per-query SLO): an hour of flapping is one bundle, not a disk
+        full. Returns the bundle directory on the first firing."""
+        with self._lock:
+            if key in self._dumped_reasons:
+                return None
+            self._dumped_reasons.add(key)
+        return self.dump(reason, detail=detail)
 
     def close(self) -> None:
         global _ACTIVE_RECORDER

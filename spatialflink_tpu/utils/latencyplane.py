@@ -335,6 +335,19 @@ class LatencyPlane:
         if stall and not was_stalled:
             _telemetry.emit_event("backpressure-stall",
                                   event_time_ms=wm, records_in=records_in)
+        # the closed bucket IS the chunk governor's sensor input: feed it
+        # here (one hook per tick, never per window) so the controller
+        # rides the exact cadence every snapshot surface already drives
+        try:
+            from spatialflink_tpu.runtime.control import active_governor
+
+            gov = active_governor()
+            if gov is not None:
+                p99 = (self.record_emit.percentile(99)
+                       if self.record_emit.count else None)
+                gov.on_tick(bucket, p99)
+        except Exception:
+            pass  # a controller fault must never poison the sensor plane
         return bucket
 
     # ------------------------------ readers ---------------------------- #
@@ -383,8 +396,18 @@ class LatencyPlane:
             stages = {s: h.to_dict() for s, h in self.stages.items()}
             queries = {qid: h.to_dict() for qid, h in self.queries.items()}
             series = [dict(b) for b in self.series]
+        controller = None
+        try:
+            from spatialflink_tpu.runtime.control import active_governor
+
+            gov = active_governor()
+            if gov is not None:
+                controller = gov.status()
+        except Exception:
+            pass
         return {
             "ts_ms": int(time.time() * 1000),
+            "controller": controller,
             "stages": stages,
             "chain_stages": list(CHAIN_STAGES),
             "downstream_stages": list(DOWNSTREAM_STAGES),

@@ -1079,6 +1079,20 @@ def status_digest(snap: dict) -> dict:
         # freshest backpressure annotations — the full table lives at
         # GET /latency
         "latency": _latency_digest(snap.get("latency") or {}),
+        # closed-loop chunk governor (runtime.control): the live actuator
+        # value + step/shed totals, derived from the exported gauges/
+        # counters so federated cross-process digests carry it too; the
+        # full decision tail is the controller block on GET /latency.
+        # chunk=None = no governor installed in this run.
+        "controller": {
+            "chunk": (int(gauges["decode.chunk"])
+                      if gauges.get("decode.chunk") is not None else None),
+            "fast_lane": bool(gauges.get("decode.fast-lane")),
+            "shedding": bool(gauges.get("controller.shedding")),
+            "grows": int(counters.get("chunk-grow", 0)),
+            "shrinks": int(counters.get("chunk-shrink", 0)),
+            "sheds": int(counters.get("shed", 0)),
+        },
     }
 
 

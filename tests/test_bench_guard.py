@@ -21,14 +21,23 @@ def test_bench_guard_passes_thresholds():
     r = subprocess.run(
         [sys.executable, os.path.join(_ROOT, "benchmarks", "bench_guard.py"),
          "--check", "--n", "60000"],
-        capture_output=True, text=True, timeout=420, env=env, cwd=_ROOT)
+        capture_output=True, text=True, timeout=480, env=env, cwd=_ROOT)
     rows = [json.loads(ln) for ln in r.stdout.splitlines()
             if ln.startswith("{")]
     assert [x["path"] for x in rows] == [
         "window_assign", "decode_columnar", "windowed_pipeline",
-        "skew_adaptive", "query_plane", "latency_record_emit",
+        "skew_adaptive", "query_plane", "controller_pareto",
+        "realtime_vectorized", "latency_record_emit",
         "fleet_scaling"], r.stdout
     assert all(x["speedup"] > 0 for x in rows if "speedup" in x)
+    # the governor's Pareto composite row carries its convergence trace
+    # (final chunk, tick/step counts) so a never-ticking controller is
+    # visible even while the composite holds
+    ctl = [x for x in rows if x["path"] == "controller_pareto"]
+    assert len(ctl) == 1 and ctl[0]["gov_ticks"] > 0
+    assert ctl[0]["gov_final_chunk"] > 0 and ctl[0]["gov_p99_ms"] > 0
+    rt = [x for x in rows if x["path"] == "realtime_vectorized"]
+    assert len(rt) == 1 and rt[0]["fires"] > 0
     # the lower-is-better latency row (record→emit p99 through the
     # latency-decomposition plane, gated against its baseline ceiling)
     lat = [x for x in rows if x["path"] == "latency_record_emit"]
@@ -50,7 +59,8 @@ def test_guard_baseline_rows_exist():
     assert base["metric"] == "speedup"
     assert {r["path"] for r in base["rows"]} == {
         "window_assign", "decode_columnar", "windowed_pipeline",
-        "skew_adaptive", "query_plane"}
+        "skew_adaptive", "query_plane", "controller_pareto",
+        "realtime_vectorized"}
     # the floors assert the batched path (and the skew-adaptive grid on
     # the clustered stream) is actually FASTER than its baseline
     assert all(r["speedup"] >= 1.0 for r in base["rows"])

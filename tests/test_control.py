@@ -1,0 +1,602 @@
+"""Closed-loop control tests: governor convergence units (dominant-stage
+direction, hysteresis/cooldown, bounds, pow-two buckets), the
+zero-recompile property across live chunk resizes (compile-registry
+sentinel), fast-lane vs batch isolation, the shed/un-shed admission
+lifecycle end to end (governor -> registry -> 429 on POST /queries),
+realtime-on-vectorized identity vs the old scalar micro-batch path, and
+checkpoint/resume mid-governor-adjustment (controller manifest component
++ the open micro-batch as checkpointed state)."""
+
+import numpy as np
+import pytest
+
+from spatialflink_tpu import driver
+from spatialflink_tpu.config import StreamConfig
+from spatialflink_tpu.index import UniformGrid
+from spatialflink_tpu.models import Point
+from spatialflink_tpu.operators import (PointPointKNNQuery,
+                                        PointPointRangeQuery,
+                                        QueryConfiguration, QueryType)
+from spatialflink_tpu.runtime.checkpoint import (CheckpointCoordinator,
+                                                 record_codec)
+from spatialflink_tpu.runtime.control import (KNEE_CHUNK, ChunkGovernor,
+                                              GovernorPolicy, active_governor,
+                                              chunk_bucket)
+from spatialflink_tpu.runtime.opserver import OpServer
+from spatialflink_tpu.runtime.queryplane import QueryRegistry, QueryState
+from spatialflink_tpu.runtime.windows import MicroBatcher
+from spatialflink_tpu.utils import deviceplane
+from spatialflink_tpu.utils.telemetry import telemetry_session
+
+pytestmark = pytest.mark.control
+
+GRID = UniformGrid(115.5, 117.6, 39.6, 41.1, num_grid_partitions=100)
+CFG = StreamConfig(format="CSV", date_format=None, csv_tsv_schema=[0, 1, 2, 3])
+
+
+def _lines(n, span_ms=100_000, seed=0):
+    rng = np.random.default_rng(seed)
+    t0 = 1_700_000_000_000
+    return [f"v{i % 97},{t0 + i * span_ms // n},"
+            f"{115.5 + rng.random() * 2:.6f},"
+            f"{39.6 + rng.random() * 1.5:.6f}" for i in range(n)]
+
+
+def _bucket(dominant=None, stall=False, depth=0):
+    deltas = {} if dominant is None else {dominant: 1.0, "emit": 0.1}
+    return {"stage_delta_s": deltas, "stall": stall,
+            "decode_buffer_depth": depth}
+
+
+def _tick_n(gov, n, **kw):
+    for _ in range(n):
+        gov.on_tick(_bucket(**kw.pop("bucket_kw", {}) or kw), kw.get("p99"))
+
+
+# ------------------------------------------------------------------ units
+
+
+class TestChunkBucket:
+    def test_snaps_to_nearest_power_of_two(self):
+        assert chunk_bucket(1000) == 1024
+        assert chunk_bucket(1536) == 1024   # exact tie keeps the floor
+        assert chunk_bucket(1537) == 2048
+        assert chunk_bucket(4096) == 4096
+
+    def test_clamps_to_bounds(self):
+        assert chunk_bucket(100000, 256, 8192) == 8192
+        assert chunk_bucket(3, 256, 8192) == 256
+
+
+class TestPolicy:
+    def test_from_spec_roundtrip_and_defaults(self):
+        p = GovernorPolicy.from_spec("")
+        assert p.target_p99_ms == GovernorPolicy().target_p99_ms
+        p = GovernorPolicy.from_spec(
+            "target_p99_ms=150,min_chunk=512,confirm_ticks=1")
+        assert (p.target_p99_ms, p.min_chunk, p.confirm_ticks) == (
+            150.0, 512, 1)
+
+    @pytest.mark.parametrize("spec", [
+        "min_chunk=1000",                 # not a power of two
+        "min_chunk=8192,max_chunk=256",   # inverted bounds
+        "target_p99_ms=0",
+        "nonsense=5",
+        "confirm_ticks=oops",
+        "confirm_ticks",                  # not key=value
+    ])
+    def test_bad_specs_raise(self, spec):
+        with pytest.raises(ValueError):
+            GovernorPolicy.from_spec(spec)
+
+
+class TestGovernorConvergence:
+    def _gov(self, **kw):
+        kw.setdefault("confirm_ticks", 2)
+        kw.setdefault("cooldown_ticks", 0)
+        return ChunkGovernor(policy=GovernorPolicy(**kw))
+
+    def test_wait_dominant_with_breach_shrinks(self):
+        gov = self._gov()
+        for _ in range(2):
+            gov.on_tick(_bucket(dominant="queue"), 400.0)
+        assert gov.chunk() == KNEE_CHUNK // 2
+        assert gov.shrinks == 1
+
+    def test_buffer_dominant_with_breach_shrinks(self):
+        gov = self._gov()
+        for _ in range(2):
+            gov.on_tick(_bucket(dominant="buffer"), 400.0)
+        assert gov.chunk() == KNEE_CHUNK // 2
+
+    def test_stall_always_shrinks_even_under_target(self):
+        gov = self._gov()
+        for _ in range(2):
+            gov.on_tick(_bucket(dominant="dispatch", stall=True), 10.0)
+        assert gov.chunk() == KNEE_CHUNK // 2
+
+    def test_dispatch_dominant_no_breach_grows(self):
+        gov = self._gov()
+        for _ in range(2):
+            gov.on_tick(_bucket(dominant="dispatch"), 100.0)
+        assert gov.chunk() == KNEE_CHUNK * 2
+        assert gov.grows == 1
+
+    def test_idle_headroom_grows(self):
+        gov = self._gov()
+        for _ in range(2):
+            gov.on_tick(_bucket(), 10.0)  # no dominant stage, tiny p99
+        assert gov.chunk() == KNEE_CHUNK * 2
+
+    def test_breach_without_wait_dominance_holds(self):
+        # dispatch-bound AND breaching: neither law fires — growing would
+        # add latency, shrinking would not cut a wait that is not there
+        gov = self._gov()
+        for _ in range(6):
+            gov.on_tick(_bucket(dominant="dispatch"), 400.0)
+        assert gov.chunk() == KNEE_CHUNK
+
+    def test_hysteresis_single_tick_never_steps(self):
+        gov = self._gov(confirm_ticks=2)
+        gov.on_tick(_bucket(dominant="queue"), 400.0)
+        assert gov.chunk() == KNEE_CHUNK
+
+    def test_hysteresis_alternating_directions_never_step(self):
+        gov = self._gov(confirm_ticks=2)
+        for _ in range(4):
+            gov.on_tick(_bucket(dominant="queue"), 400.0)   # shrink vote
+            gov.on_tick(_bucket(dominant="dispatch"), 10.0)  # grow vote
+        assert gov.chunk() == KNEE_CHUNK
+
+    def test_cooldown_quiets_after_step(self):
+        gov = self._gov(confirm_ticks=1, cooldown_ticks=2)
+        gov.on_tick(_bucket(dominant="queue"), 400.0)
+        assert gov.chunk() == KNEE_CHUNK // 2
+        # two cooldown ticks absorb further confirmed votes
+        gov.on_tick(_bucket(dominant="queue"), 400.0)
+        gov.on_tick(_bucket(dominant="queue"), 400.0)
+        assert gov.chunk() == KNEE_CHUNK // 2
+        gov.on_tick(_bucket(dominant="queue"), 400.0)
+        assert gov.chunk() == KNEE_CHUNK // 4
+
+    def test_bounds_clamp_and_count_no_phantom_steps(self):
+        gov = self._gov(confirm_ticks=1, min_chunk=1024, max_chunk=4096)
+        for _ in range(10):
+            gov.on_tick(_bucket(dominant="queue"), 400.0)
+        assert gov.chunk() == 1024
+        assert gov.shrinks == 1  # at the bound nothing counts as a step
+        for _ in range(10):
+            gov.on_tick(_bucket(dominant="dispatch"), 10.0)
+        assert gov.chunk() == 4096
+        assert gov.grows == 2
+
+    def test_decisions_ring_bounded_with_schema(self):
+        gov = self._gov(confirm_ticks=1)
+        for i in range(80):
+            gov.on_tick(_bucket(dominant="queue" if i % 2 else "dispatch"),
+                        400.0 if i % 2 else 10.0)
+        st = gov.status()
+        assert len(st["decisions"]) <= 32
+        d = st["decisions"][-1]
+        assert {"ts_ms", "tick", "action", "chunk",
+                "p99_emit_ms"} <= set(d)
+        assert st["ticks"] == 80
+
+    def test_status_schema(self):
+        st = ChunkGovernor().status()
+        assert {"chunk", "base_chunk", "seed_chunk", "fast_lane",
+                "shedding", "ticks", "grows", "shrinks", "sheds",
+                "streak", "policy", "decisions"} <= set(st)
+        assert st["chunk"] == KNEE_CHUNK
+
+
+# --------------------------------------------------- zero-recompile proof
+
+
+class TestZeroRecompileAcrossResizes:
+    def test_live_resizes_never_recompile(self):
+        """Drive the SAME windowed pipeline at every chunk bucket the
+        governor can visit, warm up at the first, and assert the compile
+        registry sees zero post-warmup compiles — a decode-chunk resize
+        sizes host buffers only (the recompile-surface rule's runtime
+        half)."""
+        lines = _lines(1200)
+        qp = Point.create(116.5, 40.3, GRID, obj_id="q")
+
+        def run(chunk):
+            op = PointPointRangeQuery(
+                QueryConfiguration(QueryType.WindowBased, 10_000, 5_000),
+                GRID)
+            s = driver.decode_stream(iter(lines), CFG, GRID, chunk=chunk)
+            return [(r.window_start, sorted(p.obj_id for p in r.records))
+                    for r in op.run(s, qp, 0.5)]
+
+        reg = deviceplane.registry()
+        reg.begin_run(strict=False)
+        try:
+            base = run(256)
+            reg.mark_warm("chunk-resize test (first bucket warmed)")
+            for chunk in (512, 1024, 2048, 4096, 8192):
+                assert run(chunk) == base, f"chunk {chunk} changed results"
+            assert reg.run_recompiles == 0
+        finally:
+            reg.end_run()
+
+    def test_governed_callback_resolves_per_flush(self):
+        gov = ChunkGovernor(policy=GovernorPolicy(confirm_ticks=1,
+                                                  cooldown_ticks=0))
+        gov.install()
+        try:
+            cb = driver._governed_chunk(4096)
+            assert callable(cb) and cb() == KNEE_CHUNK
+            for _ in range(1):
+                gov.on_tick(_bucket(dominant="queue"), 999.0)
+            assert cb() == KNEE_CHUNK // 2  # same callback, new size
+        finally:
+            gov.uninstall()
+        assert cb() == 4096  # no governor -> the fixed size
+
+    def test_env_pin_wins_over_governor(self, monkeypatch):
+        monkeypatch.setenv("SPATIALFLINK_DECODE_CHUNK", "64")
+        gov = ChunkGovernor().install()
+        try:
+            assert driver._governed_chunk(driver._decode_chunk_env(4096)) \
+                == 64
+        finally:
+            gov.uninstall()
+
+
+# ------------------------------------------------------ fast lane / shed
+
+
+def _registry_with(*classes):
+    reg = QueryRegistry("range", radius=0.5)
+    for i, lclass in enumerate(classes):
+        reg.admit({"id": f"q{i}", "x": 116.5, "y": 40.3,
+                   "latency_class": lclass})
+    reg.apply()
+    return reg
+
+
+class TestFastLane:
+    def test_engages_with_interactive_fleet_only(self):
+        gov = ChunkGovernor(policy=GovernorPolicy(interactive_max_chunk=512,
+                                                  fast_lane_depth=1))
+        reg = _registry_with("batch", "batch").install()
+        try:
+            gov.on_tick(_bucket(), None)
+            assert not gov.fast_lane
+            assert gov.chunk() == KNEE_CHUNK
+            assert gov.drain_depth(4) == 4
+        finally:
+            reg.uninstall()
+        reg = _registry_with("batch", "interactive").install()
+        try:
+            gov.on_tick(_bucket(), None)
+            assert gov.fast_lane
+            assert gov.chunk() == 512        # capped, no streak needed
+            assert gov.drain_depth(4) == 1   # bounded in-flight queue
+        finally:
+            reg.uninstall()
+
+    def test_disengages_when_interactive_retires(self):
+        gov = ChunkGovernor(policy=GovernorPolicy(interactive_max_chunk=512))
+        reg = _registry_with("interactive").install()
+        try:
+            # breach while dispatch-dominant = a direction-0 tick: the
+            # fast lane refreshes without the chunk moving
+            gov.on_tick(_bucket(dominant="dispatch"), 400.0)
+            assert gov.fast_lane
+            reg.retire("q0")
+            reg.apply()
+            gov.on_tick(_bucket(dominant="dispatch"), 400.0)
+            assert not gov.fast_lane and gov.chunk() == KNEE_CHUNK
+        finally:
+            reg.uninstall()
+
+    def test_fast_lane_depth_bound_keeps_results_identical(self):
+        """The drive loop's fast-lane drain bound changes scheduling only:
+        a deep-pipeline run under an engaged fast lane emits the same
+        window table as the un-governed run."""
+        lines = _lines(800)
+        qp = Point.create(116.5, 40.3, GRID, obj_id="q")
+
+        def run():
+            op = PointPointRangeQuery(
+                QueryConfiguration(QueryType.WindowBased, 10_000, 5_000,
+                                   pipeline_depth=4), GRID)
+            s = driver.decode_stream(iter(lines), CFG, GRID)
+            return [(r.window_start, sorted(p.obj_id for p in r.records))
+                    for r in op.run(s, qp, 0.5)]
+
+        base = run()
+        gov = ChunkGovernor().install()
+        reg = _registry_with("interactive").install()
+        try:
+            gov.on_tick(_bucket(), None)
+            assert gov.fast_lane
+            assert run() == base
+        finally:
+            reg.uninstall()
+            gov.uninstall()
+
+    def test_latency_class_validation_and_serialization(self):
+        reg = QueryRegistry("range", radius=0.5)
+        with pytest.raises(ValueError):
+            reg.admit({"id": "bad", "x": 116.5, "y": 40.3,
+                       "latency_class": "urgent"})
+        e = reg.admit({"id": "q", "x": 116.5, "y": 40.3,
+                       "latency_class": "interactive"})
+        assert e.spec.to_dict()["latency_class"] == "interactive"
+        # batch is the default and stays off the wire
+        e2 = reg.admit({"id": "q2", "x": 116.5, "y": 40.3})
+        assert "latency_class" not in e2.spec.to_dict()
+        assert e2.spec.latency_class == "batch"
+
+    def test_default_latency_class_applies_to_admissions(self):
+        reg = QueryRegistry("range", radius=0.5,
+                            default_latency_class="interactive")
+        e = reg.admit({"id": "q", "x": 116.5, "y": 40.3})
+        assert e.spec.latency_class == "interactive"
+        assert not reg.has_interactive()  # PENDING: not serving yet
+        reg.apply()
+        assert reg.has_interactive()
+
+
+class TestShedLifecycle:
+    def _gov(self):
+        return ChunkGovernor(policy=GovernorPolicy(shed_after_stalls=2,
+                                                   unshed_after_clean=2))
+
+    def test_shed_and_unshed_transitions(self):
+        gov = self._gov()
+        reg = _registry_with("batch").install()
+        try:
+            gov.on_tick(_bucket(stall=True), None)
+            assert not reg.shedding
+            gov.on_tick(_bucket(stall=True), None)
+            assert reg.shedding and gov.shedding
+            # admissions while shedding park in SHED, uncounted in staged
+            e = reg.admit({"id": "late", "x": 116.5, "y": 40.3})
+            assert e.state is QueryState.SHED
+            assert reg.staged_count() == 0
+            # one clean bucket is not enough; two release
+            gov.on_tick(_bucket(), None)
+            assert reg.shedding
+            gov.on_tick(_bucket(), None)
+            assert not reg.shedding
+            assert reg._entries["late"].state is QueryState.PENDING
+            reg.apply()
+            assert reg._entries["late"].state is QueryState.ACTIVE
+        finally:
+            reg.uninstall()
+
+    def test_post_queries_returns_429_while_shedding(self):
+        reg = _registry_with("batch").install()
+        try:
+            srv = OpServer(port=0)
+            reg.set_shedding(True)
+            code, payload = srv.admit_query_payload(
+                {"id": "nope", "x": 116.5, "y": 40.3})
+            assert code == 429
+            assert payload["query"]["state"] == "shed"
+            assert "shed" in payload["error"]
+            # the parked spec admits normally after release
+            reg.set_shedding(False)
+            code, payload = srv.admit_query_payload(
+                {"id": "nope", "x": 116.5, "y": 40.3})
+            assert code == 200
+        finally:
+            reg.uninstall()
+
+    def test_retire_while_shed_is_immediate(self):
+        reg = _registry_with("batch")
+        reg.set_shedding(True)
+        reg.admit({"id": "parked", "x": 116.5, "y": 40.3})
+        e = reg.retire("parked")
+        assert e.state is QueryState.RETIRED
+
+    def test_shed_state_rides_registry_snapshot(self):
+        reg = _registry_with("batch")
+        reg.set_shedding(True)
+        reg.admit({"id": "parked", "x": 116.5, "y": 40.3})
+        meta = reg.snapshot()
+        reg2 = QueryRegistry("range", radius=0.5)
+        reg2.restore(meta)
+        assert reg2.shedding
+        assert reg2._entries["parked"].state is QueryState.SHED
+
+
+# ------------------------------------- realtime on the vectorized path
+
+
+class TestRealtimeVectorizedIdentity:
+    def _conf(self, batch=64, depth=2):
+        return QueryConfiguration(QueryType.RealTime,
+                                  realtime_batch_size=batch,
+                                  pipeline_depth=depth)
+
+    def test_microbatcher_cuts_match_scalar_micro_batches(self):
+        lines = _lines(1000)
+        op = PointPointRangeQuery(self._conf(), GRID)
+        s = driver.decode_stream(iter(lines), CFG, GRID, chunk=176)
+        mb = MicroBatcher(64)
+        got = [(a, b, len(recs)) for a, b, recs in mb.batches(s)]
+        # the oracle: the pre-rebuild scalar path's strict count cuts
+        oracle_stream = driver.decode_stream(iter(lines), CFG, GRID)
+        want = [(r[0].timestamp, r[-1].timestamp, len(r))
+                for r in op._micro_batches(iter(oracle_stream)) if r]
+        assert got == want
+
+    @pytest.mark.parametrize("chunk", [32, 176, 512, 4096])
+    def test_realtime_results_identical_across_decode_chunks(self, chunk):
+        """Batch boundaries are count-strict: the decode chunk (what the
+        governor resizes) never moves a micro-window, so realtime output
+        is chunk-invariant — and equal to the scalar path's."""
+        lines = _lines(900)
+        qp = Point.create(116.5, 40.3, GRID, obj_id="q")
+
+        def run(c):
+            op = PointPointRangeQuery(self._conf(), GRID)
+            s = driver.decode_stream(iter(lines), CFG, GRID, chunk=c)
+            return [(r.window_start, r.window_end,
+                     sorted(p.obj_id for p in r.records))
+                    for r in op.run(s, qp, 0.5)]
+
+        assert run(chunk) == run(64)
+
+    def test_realtime_vs_scalar_oracle_full_results(self):
+        lines = _lines(700)
+        qp = Point.create(116.5, 40.3, GRID, obj_id="q")
+        op = PointPointRangeQuery(self._conf(), GRID)
+        s = driver.decode_stream(iter(lines), CFG, GRID, chunk=200)
+        got = [(r.window_start, r.window_end,
+                sorted(p.obj_id for p in r.records))
+               for r in op.run(s, qp, 0.5)]
+        # oracle: drive the batched loop with the scalar generator the old
+        # realtime branch used verbatim
+        op2 = PointPointRangeQuery(self._conf(), GRID)
+        oracle_stream = driver.decode_stream(iter(lines), CFG, GRID)
+        batched = ((r[0].timestamp, r[-1].timestamp, r)
+                   for r in op2._micro_batches(iter(oracle_stream)) if r)
+        mask_cache = op2._leaf_mask_cache(
+            lambda: op2.conf.adaptive_grid.neighboring_leaf_mask(
+                0.5, qp.cell, point=(qp.x, qp.y)))
+        want = [(r.window_start, r.window_end,
+                 sorted(p.obj_id for p in r.records))
+                for r in op2._drive_batched(
+                    batched,
+                    lambda recs, ts: op2._eval(recs, qp, 0.5, ts,
+                                               mask_cache),
+                    realtime=True)]
+        assert got == want
+
+    def test_realtime_knn_rides_the_vectorized_path_too(self):
+        lines = _lines(600)
+        qp = Point.create(116.5, 40.3, GRID, obj_id="q")
+
+        def run(c):
+            op = PointPointKNNQuery(self._conf(), GRID)
+            s = driver.decode_stream(iter(lines), CFG, GRID, chunk=c)
+            return [(r.window_start,
+                     sorted((oid, round(float(d), 9))
+                            for oid, d in r.records))
+                    for r in op.run(s, qp, 0.5)]
+
+        assert run(100) == run(64)
+
+    def test_trailing_partial_batch_fires(self):
+        lines = _lines(130)  # 130 = 2 * 64 + 2 -> three fires
+        op = PointPointRangeQuery(self._conf(), GRID)
+        s = driver.decode_stream(iter(lines), CFG, GRID)
+        mb = MicroBatcher(64)
+        sizes = [len(recs) for _, _, recs in mb.batches(s)]
+        assert sizes == [64, 64, 2]
+
+    def test_realtime_never_emits_empty_selections(self):
+        # a query point far from every record: realtime stays silent (the
+        # reference's fire-per-element trigger never emits empties)
+        lines = _lines(300)
+        qp = Point.create(115.6, 39.7, GRID, obj_id="far")
+        op = PointPointRangeQuery(self._conf(), GRID)
+        s = driver.decode_stream(iter(lines), CFG, GRID)
+        assert [r for r in op.run(s, qp, 0.0001)] == []
+
+    def test_realtime_feeds_latency_plane(self):
+        """The rebuild's point: realtime inherits the telemetry planes.
+        The old scalar path never budgeted a stage; now record->emit
+        histograms and stage budgets populate."""
+        lines = _lines(400)
+        qp = Point.create(116.5, 40.3, GRID, obj_id="q")
+        with telemetry_session(None) as tel:
+            op = PointPointRangeQuery(self._conf(), GRID)
+            s = driver.decode_stream(iter(lines), CFG, GRID)
+            out = list(op.run(s, qp, 0.5))
+            assert out
+            snap = tel.latency.to_dict()
+        assert snap["record_emit"]["count"] >= len(out)
+
+
+# --------------------------------------------- checkpoint / resume
+
+
+class TestCheckpointMidAdjustment:
+    def test_controller_component_roundtrips(self, tmp_path):
+        coord = CheckpointCoordinator(str(tmp_path), job="j")
+        gov = ChunkGovernor(policy=GovernorPolicy(confirm_ticks=2,
+                                                  cooldown_ticks=1,
+                                                  shed_after_stalls=3))
+        gov.register_checkpoint(coord)
+        # mid-adjustment: one confirmed step taken, a streak in progress,
+        # one stall tick banked
+        for _ in range(2):
+            gov.on_tick(_bucket(dominant="queue"), 999.0)
+        gov.on_tick(_bucket(dominant="queue", stall=True), 999.0)
+        st = gov.status()
+        assert st["base_chunk"] == KNEE_CHUNK // 2
+        coord.commit()
+
+        coord2 = CheckpointCoordinator(str(tmp_path), job="j")
+        assert coord2.load()
+        gov2 = ChunkGovernor()
+        gov2.register_checkpoint(coord2)  # restores on registration
+        st2 = gov2.status()
+        assert st2["base_chunk"] == st["base_chunk"]
+        assert st2["streak"] == st["streak"]
+        assert st2["shedding"] == st["shedding"]
+
+    def test_restored_chunk_clamps_to_new_policy_bounds(self, tmp_path):
+        coord = CheckpointCoordinator(str(tmp_path), job="j")
+        gov = ChunkGovernor(seed_chunk=8192)
+        gov.register_checkpoint(coord)
+        coord.commit()
+        coord2 = CheckpointCoordinator(str(tmp_path), job="j")
+        assert coord2.load()
+        gov2 = ChunkGovernor(policy=GovernorPolicy(max_chunk=1024))
+        gov2.register_checkpoint(coord2)
+        assert gov2.chunk() == 1024
+
+    def test_open_micro_batch_snapshot_restore_identity(self):
+        """Cut a stream mid-batch, snapshot the open buffer (columnar
+        segments and all), restore into a fresh batcher, continue with the
+        remaining records: the batch sequence equals the uninterrupted
+        run — no record lost, none duplicated, no boundary moved."""
+        lines = _lines(500)
+        s = driver.decode_stream(iter(lines), CFG, GRID, chunk=96)
+        enc, dec = record_codec(GRID)
+
+        uninterrupted = MicroBatcher(64)
+        want = [(a, b, [p.obj_id for p in recs])
+                for a, b, recs in uninterrupted.batches(
+                    driver.decode_stream(iter(lines), CFG, GRID, chunk=96))]
+
+        mb = MicroBatcher(64)
+        got = []
+        chunks = s.chunks()
+        for i, ch in enumerate(chunks):
+            got.extend((a, b, [p.obj_id for p in recs])
+                       for a, b, recs in mb.add_chunk(ch))
+            if i == 2:
+                break
+        state = mb.snapshot(enc)
+        assert state["records"], "crash point holds an open micro-batch"
+
+        mb2 = MicroBatcher(64)
+        mb2.restore(state, dec)
+        for ch in chunks:
+            got.extend((a, b, [p.obj_id for p in recs])
+                       for a, b, recs in mb2.add_chunk(ch))
+        got.extend((a, b, [p.obj_id for p in recs])
+                   for a, b, recs in mb2.flush())
+        assert got == want
+
+    def test_realtime_drive_registers_batcher_with_coordinator(self,
+                                                               tmp_path):
+        coord = CheckpointCoordinator(str(tmp_path), job="j")
+        conf = QueryConfiguration(QueryType.RealTime, realtime_batch_size=64,
+                                  checkpointer=coord)
+        op = PointPointRangeQuery(conf, GRID)
+        qp = Point.create(116.5, 40.3, GRID, obj_id="q")
+        s = driver.decode_stream(iter(_lines(300)), CFG, GRID)
+        list(op.run(s, qp, 0.5))
+        assert "realtime-batcher" in coord._snapshots

@@ -573,7 +573,10 @@ def bench_fleet(n: int) -> list:
     fault isolation, not throughput (BASELINE.md). A final
     ``fleet_plane_overhead`` row prices the observability plane at N=2
     (plane on vs ``--fleet-plane off``) with the merged digest asserted
-    identical either way."""
+    identical either way, and a ``fleet_rescale`` row prices a live
+    mid-run scale-out (N=2 -> 4 via ``--fleet-rescale``) with the merged
+    digest asserted identical to the fixed-N runs — the fenced
+    exactly-once rescale contract, end-to-end."""
     import contextlib
     import io
 
@@ -669,6 +672,31 @@ def bench_fleet(n: int) -> list:
             sum_check_windows=((res_on.get("latency") or {})
                                .get("sum_check") or {}).get("windows"),
             digest_identical=True))
+        # live rescale: start at N=2, scale out to N=4 mid-run at an
+        # epoch boundary. The merged digest is asserted identical to the
+        # fixed-N runs above — a fenced rescale must be invisible to
+        # exactly-once identity — and the supervisor's rescale ledger
+        # rides along. Epoch cadence is re-enabled here (the sibling rows
+        # pin it huge) so the threshold can actually be consumed.
+        res_rs, dt_rs = fleet(
+            2, "rescale",
+            "--fleet-rescale", f"{max(1, n // 3)}:4",
+            "--fleet-epoch-records", str(max(1, n // 8)))
+        assert res_rs["digest"] == digest, (
+            "fleet_rescale merged digest diverged from the fixed-N runs "
+            "— the fenced rescale leaked into exactly-once identity")
+        rows.append(dict(
+            path="fleet_rescale", workers=2,
+            workers_final=res_rs.get("workers_final"),
+            records=n, wall_s=round(dt_rs, 3),
+            records_per_sec=round(n / dt_rs),
+            rescales=[[r["n_from"], r["n_to"]]
+                      for r in res_rs.get("rescales", [])],
+            merged_windows=res_rs["merged_windows"],
+            restarts=sum(int(v) for v in res_rs["restarts"].values()),
+            post_warmup_compiles=res_rs["post_warmup_compiles"],
+            overhead_vs_fleet1=round(dt_rs / dt_f1, 2),
+            digest_identical=True))
     return rows
 
 
@@ -717,7 +745,9 @@ def main() -> int:
                          "fleets over a 95%%-hot clustered stream "
                          "(merged-digest identity asserted across every "
                          "N; rows carry restart + post-warmup-recompile "
-                         "ledger fields)")
+                         "ledger fields), plus a live mid-run N=2->4 "
+                         "rescale row with the digest asserted identical "
+                         "to the fixed-N runs")
     ap.add_argument("--require-backend", choices=("cpu", "tpu", "gpu"),
                     default=None,
                     help="fail fast (exit 2) when the process would run on "

@@ -58,6 +58,13 @@ and one fleet overhead row gated by a third lower-is-better pass
                         the supervision machinery's cost ceiling (metric
                         ``wall_fleet1_s``); the overhead-vs-single-process
                         ratio and the N=2 scaling ratio ride along
+- ``fleet_rescale``     absolute wall clock of an N=2 fleet that scales
+                        out to N=4 mid-run at an epoch boundary
+                        (``--fleet-rescale``), pinned record count,
+                        merged digest asserted identical to a fixed-N=2
+                        oracle in the same run — the fenced exactly-once
+                        rescale's cost ceiling (carried under the shared
+                        fleet metric key ``wall_fleet1_s``)
                         ungated (a one-host CPU box is spawn/routing-
                         dominated — BASELINE.md carries the honest
                         numbers) and merged-digest identity across
@@ -661,12 +668,83 @@ def bench_fleet_scaling(n: int) -> dict:
         shutil.rmtree(td, ignore_errors=True)
 
 
+def bench_fleet_rescale(n: int) -> dict:
+    """Live-rescale cost gate (lower-is-better): wall clock of an N=2
+    fleet that scales OUT to N=4 mid-run at an epoch boundary
+    (``--fleet-rescale``), at a pinned record count. Merged-digest
+    identity against a fixed-N=2 oracle run of the same replay is
+    asserted in the same run — the fenced exactly-once rescale contract:
+    a live worker-set change must be invisible to the merged output.
+
+    The GATED metric is the rescaling run's absolute wall, carried under
+    ``wall_fleet1_s`` so the shared fleet diff pass (lower-is-better,
+    ``--require-all``) pairs every fleet row on one metric key; the
+    rescale-vs-fixed ratio rides along informationally."""
+    import contextlib
+    import shutil
+
+    from spatialflink_tpu.driver import main as driver_main
+    from spatialflink_tpu.runtime import fleet as fleet_mod
+    from spatialflink_tpu.streams.synthetic import clustered_lines
+
+    n = 12_000  # pinned: spawn cost (two extra workers mid-run) is fixed,
+    # routing cost is per-record — the ceiling needs a fixed workload
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    conf = os.path.join(root, "conf", "spatialflink-conf.yml")
+    lines = clustered_lines(_grid(), n, 0.95, seed=7, fmt="geojson",
+                            dt_ms=1)
+    td = tempfile.mkdtemp(prefix="bench-rescale-")
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                          os.path.join(td, "xla-cache"))
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+    try:
+        path1 = os.path.join(td, "in.geojson")
+        with open(path1, "w") as f:
+            f.write("\n".join(lines) + "\n")
+
+        def fleet(tag, *extra):
+            fdir = os.path.join(td, f"fleet-{tag}")
+            t0 = time.perf_counter()
+            with contextlib.redirect_stdout(sys.stderr):
+                rc = driver_main([
+                    "--config", conf, "--option", "1", "--input1", path1,
+                    "--fleet", "2", "--fleet-dir", fdir,
+                    "--fleet-epoch-records", str(10**9)] + list(extra))
+            dt = time.perf_counter() - t0
+            assert rc == 0
+            res = fleet_mod.read_json(os.path.join(fdir,
+                                                   fleet_mod.RESULT_FILE))
+            return res, dt
+
+        rescale_argv = ["--fleet-rescale", f"{n // 3}:4",
+                        "--fleet-epoch-records", str(n // 6)]
+        fleet("warm", *rescale_argv)  # fills the persistent compile
+        # cache for BOTH worker-set shapes (N=2 and the post-rescale N=4)
+        r_fix, dt_fix = fleet("n2")
+        r_rs, dt_rs = fleet("rs", *rescale_argv)
+        assert r_rs["digest"] == r_fix["digest"], \
+            "fleet merged digest diverged across a live N=2->4 rescale"
+        assert r_rs.get("workers_final") == 4, r_rs.get("workers_final")
+        assert [(r["n_from"], r["n_to"])
+                for r in r_rs.get("rescales", [])] == [(2, 4)]
+        assert r_fix["merged_windows"] > 0
+        return dict(path="fleet_rescale", records=n, workers=2,
+                    workers_final=4,
+                    merged_windows=r_rs["merged_windows"],
+                    wall_fleet2_fixed_s=round(dt_fix, 3),
+                    wall_fleet1_s=round(dt_rs, 3),
+                    rescale_x=round(dt_rs / dt_fix, 2),
+                    post_warmup_compiles=r_rs["post_warmup_compiles"])
+    finally:
+        shutil.rmtree(td, ignore_errors=True)
+
+
 def measure(n: int) -> list:
     return [bench_window_assign(n), bench_decode_columnar(n),
             bench_windowed_pipeline(n), bench_skew_adaptive(n),
             bench_query_plane(n), bench_controller_pareto(n),
             bench_realtime_vectorized(n), bench_latency_record_emit(n),
-            bench_fleet_scaling(n)]
+            bench_fleet_scaling(n), bench_fleet_rescale(n)]
 
 
 def main() -> int:

@@ -346,7 +346,9 @@ def fleet(path: str, as_json: bool = False, out=None) -> int:
     on the read widens: the end-to-end record→merged-emit stage-budget
     table from ``fleet_latency.json`` and the merged timeline tail from
     ``fleet_events.jsonl`` (both optional — plane-off and pre-plane
-    fleet dirs still render)."""
+    fleet dirs still render). Elastic fleets add the fence history
+    (which incarnations were superseded, how many stale zombie rows the
+    merge dropped), the rescale log, and the quarantine log."""
     from spatialflink_tpu.runtime import fleet as fleet_mod
 
     out = sys.stdout if out is None else out
@@ -354,6 +356,13 @@ def fleet(path: str, as_json: bool = False, out=None) -> int:
         raise ValueError(f"{path}: not a fleet directory")
     result = fleet_mod.read_json(
         os.path.join(path, fleet_mod.RESULT_FILE)) or {}
+    manifest_state = fleet_mod.read_json(
+        os.path.join(path, fleet_mod.MANIFEST_FILE)) or {}
+    fence_log = manifest_state.get("fence_log") or []
+    rescale_log = manifest_state.get("rescale_log") or []
+    quarantine_log = manifest_state.get("quarantine_log") or []
+    fences = {int(k): int(v) for k, v in
+              (manifest_state.get("fences") or {}).items()}
     fleet_lat = fleet_mod.read_json(
         os.path.join(path, fleet_mod.LATENCY_FILE))
     timeline_tail: List[dict] = []
@@ -399,13 +408,23 @@ def fleet(path: str, as_json: bool = False, out=None) -> int:
                     break
                 except ValueError:
                     continue
+        # fence-aware read: apply the manifest's byte cutoffs so the
+        # doctor's window counts match what the merge actually admitted,
+        # and surface how many zombie rows were dropped per worker
+        ob_stats: dict = {}
+        cutoffs = {f: c["outbox"] for f, c in fleet_mod.fence_cutoffs_from(
+            {"fence_log": fence_log}, wid).items()}
         windows = fleet_mod.read_outbox(
-            os.path.join(wd, fleet_mod.OUTBOX_FILE))
+            os.path.join(wd, fleet_mod.OUTBOX_FILE),
+            fence_cutoffs=cutoffs, stats=ob_stats)
         rows.append({
             "worker": wid,
             "incarnations": len(runs),
             "restarts": len(restart_reasons.get(wid, [])),
             "restart_reasons": restart_reasons.get(wid, []),
+            "fence": fences.get(wid, 0),
+            "stale_fence_rows": ob_stats.get("stale_fence_rows", 0),
+            "fence_conflicts": ob_stats.get("fence_conflicts", 0),
             "windows": len(windows),
             "emitted": last.get("emitted"),
             "last_rc": last.get("rc"),
@@ -430,6 +449,11 @@ def fleet(path: str, as_json: bool = False, out=None) -> int:
            "graceful": result.get("graceful"),
            "post_warmup_compiles": result.get("post_warmup_compiles"),
            "workers": rows,
+           "fences": {str(k): v for k, v in sorted(fences.items())},
+           "fence_log": fence_log,
+           "rescale_log": rescale_log,
+           "quarantine_log": quarantine_log,
+           "stale_fence_rows": sum(r["stale_fence_rows"] for r in rows),
            "latency": fleet_lat,
            "timeline_tail": timeline_tail}
     if as_json:
@@ -447,21 +471,40 @@ def fleet(path: str, as_json: bool = False, out=None) -> int:
     else:
         print("result     (no fleet_result.json — run incomplete or "
               "killed)", file=out)
-    hdr = (f"{'worker':>6} {'inc':>4} {'restarts':>8} {'windows':>8} "
-           f"{'last rc':>7} {'compiles':>8} {'p99 ms':>8}  last verdict")
+    hdr = (f"{'worker':>6} {'inc':>4} {'restarts':>8} {'fence':>5} "
+           f"{'windows':>8} {'last rc':>7} {'compiles':>8} {'p99 ms':>8}"
+           "  last verdict")
     print(hdr, file=out)
     for r in rows:
         p99 = r["record_emit_p99_ms"]
         verdict = r["last_verdict"] or (
             "graceful stop" if r.get("graceful") else "-")
         print(f"{r['worker']:>6} {r['incarnations']:>4} "
-              f"{r['restarts']:>8} {r['windows']:>8} "
+              f"{r['restarts']:>8} {r['fence']:>5} {r['windows']:>8} "
               f"{('-' if r['last_rc'] is None else r['last_rc']):>7} "
               f"{r['post_warmup_compiles']:>8} "
               f"{('-' if p99 is None else f'{p99:.1f}'):>8}  {verdict}",
               file=out)
         for reason in r["restart_reasons"]:
             print(f"{'':>6} restart: {reason}", file=out)
+        if r["stale_fence_rows"] or r["fence_conflicts"]:
+            print(f"{'':>6} fenced: {r['stale_fence_rows']} stale zombie "
+                  f"row(s) dropped, {r['fence_conflicts']} cross-fence "
+                  "conflict(s) resolved", file=out)
+    for e in fence_log:
+        print(f"fence      w{e.get('worker')} -> fence {e.get('fence')} "
+              f"({e.get('reason')}; outbox cutoff "
+              f"{e.get('outbox_bytes')}B, journal "
+              f"{e.get('journal_bytes')}B)", file=out)
+    for e in rescale_log:
+        print(f"rescale    {e.get('n_from')} -> {e.get('n_to')} workers "
+              f"at {e.get('at_records')} routed records "
+              f"(epoch {e.get('epoch')})", file=out)
+    for e in quarantine_log:
+        extra = {k: v for k, v in e.items()
+                 if k not in ("ts_ms", "worker", "action")}
+        print(f"quarantine w{e.get('worker')} {e.get('action')}"
+              + (f" {extra}" if extra else ""), file=out)
     if fleet_lat:
         # end-to-end record→merged-emit decomposition: the worker chain
         # plus spread/outbox-visible/merge/merged-emit — same renderer as

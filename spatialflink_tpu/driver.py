@@ -1876,6 +1876,35 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "'off' disables retention and the outbox lineage "
                          "sidecar — the merged digest is identical either "
                          "way (default: on)")
+    ap.add_argument("--fleet-rescale", metavar="AT:N[,AT:N...]",
+                    default=None,
+                    help="live rescale: once AT records have been routed, "
+                         "scale the fleet to N workers at the next epoch "
+                         "boundary (coordinated flush barrier, leaf "
+                         "reassignment, fenced worker ids; e.g. "
+                         "'10000:3,20000:2' runs 2->3->2); the merged "
+                         "digest is identical to a fixed-N run")
+    ap.add_argument("--fleet-chaos-stall", metavar="WID:SECONDS",
+                    default=None,
+                    help="fault-injection hook: worker WID's first "
+                         "incarnation wedges heartbeat+checkpoints for "
+                         "SECONDS after its first window while continuing "
+                         "to write (gray-failure drill: the supervisor "
+                         "fences+respawns it WITHOUT a kill; the zombie's "
+                         "stale rows must be dropped at merge)")
+    ap.add_argument("--fleet-quarantine-s", type=float, default=10.0,
+                    metavar="S",
+                    help="gray-failure quarantine deadline: a worker whose "
+                         "suspicion score stays high is first drained of "
+                         "new leaf routes (still merging its output), then "
+                         "fenced+respawned after S seconds without "
+                         "recovery (default: 10)")
+    ap.add_argument("--fleet-fence", type=int, default=0,
+                    metavar="TOKEN",
+                    help=argparse.SUPPRESS)  # supervisor-issued fence
+    ap.add_argument("--fleet-stall-s", type=float, default=0.0,
+                    metavar="S",
+                    help=argparse.SUPPRESS)  # chaos glue, supervisor-set
     args = ap.parse_args(argv)
 
     _enable_compilation_cache()
@@ -2523,10 +2552,16 @@ def _run_cli(ap, args, params: Params, spec: CaseSpec, skip1: int,
         from spatialflink_tpu.runtime.checkpoint import EmittedWindowJournal
 
         # a fresh run — including --resume that found no valid manifest —
-        # must not inherit a previous run's emitted history
-        journal = EmittedWindowJournal(coord.dir,
-                                       fresh=not (args.resume
-                                                  and coord.restored))
+        # must not inherit a previous run's emitted history; a fenced
+        # fleet worker additionally drops journal lines its superseded
+        # predecessor wrote past the fence cutoff (those windows were
+        # never merged, so the successor must re-emit them)
+        journal = EmittedWindowJournal(
+            coord.dir,
+            fresh=not (args.resume and coord.restored),
+            fence=(wctx.fence if wctx is not None else 0),
+            fence_cutoffs=(wctx.journal_fence_cutoffs()
+                           if wctx is not None else None))
 
     n = 0
     stopped = False

@@ -229,18 +229,49 @@ class EmittedWindowJournal:
     flushed per window: a ``kill -9`` cannot lose them (the OS owns the
     buffer once written); only a machine crash can drop the un-fsynced
     tail, in which case the affected windows re-emit with identical
-    contents (at-least-once, never wrong)."""
+    contents (at-least-once, never wrong).
+
+    Under a fenced fleet, lines are stamped ``<fence>\\t<key>`` (fence 0
+    — single-process runs — keeps the bare-key format, so existing
+    journals stay readable and non-fleet runs are byte-identical).
+    ``fence_cutoffs`` maps a superseded fence to the journal byte size
+    recorded when that fence was bumped away: a line stamped with fence
+    *f* that starts at-or-past ``fence_cutoffs[f]`` was written by a
+    zombie incarnation whose corresponding outbox rows are fence-dropped
+    at merge — trusting it would suppress the re-emission that makes the
+    merged table whole, so it is skipped at load."""
 
     FILENAME = "emitted.log"
 
-    def __init__(self, directory: str, fresh: bool = False):
+    def __init__(self, directory: str, fresh: bool = False, *,
+                 fence: int = 0,
+                 fence_cutoffs: Optional[Dict[int, int]] = None):
         self.path = os.path.join(directory, self.FILENAME)
+        self.fence = int(fence)
         if fresh and os.path.exists(self.path):
             os.unlink(self.path)  # a non-resume run starts a new history
         self._seen = set()
         if os.path.exists(self.path):
-            with open(self.path) as f:
-                self._seen = {ln.rstrip("\n") for ln in f if ln.strip()}
+            cuts = fence_cutoffs or {}
+            with open(self.path, "rb") as f:
+                pos = 0
+                for raw in f:
+                    start = pos
+                    pos += len(raw)
+                    ln = raw.decode("utf-8", "replace").rstrip("\n")
+                    if not ln.strip():
+                        continue
+                    head, sep, rest = ln.partition("\t")
+                    lfence, key = 0, ln
+                    if sep:
+                        try:
+                            lfence, key = int(head), rest
+                        except ValueError:
+                            pass  # a tab inside a bare legacy key
+                    cut = cuts.get(lfence)
+                    if cut is not None and start >= int(cut):
+                        continue  # zombie-journaled: window must re-emit
+                    self._seen.add(key)
         self._f = open(self.path, "a")
         self.suppressed = 0
 
@@ -261,7 +292,8 @@ class EmittedWindowJournal:
         k = self.key(result)
         if k not in self._seen:
             self._seen.add(k)
-            self._f.write(k + "\n")
+            self._f.write((f"{self.fence}\t{k}" if self.fence else k)
+                          + "\n")
             self._f.flush()
 
     def close(self) -> None:
@@ -362,6 +394,13 @@ class CheckpointCoordinator:
             self._batches += 1
 
     def due(self) -> bool:
+        from spatialflink_tpu.runtime.faults import active_stall
+        st = active_stall()
+        if st is not None and st.wedged():
+            # injected gray failure: the checkpoint surface wedges with
+            # the heartbeat — a zombie must not commit manifests its
+            # fenced successor would then resume from
+            return False
         if self._batches - self._last_batches >= self.every_batches:
             return True
         return (self.every_seconds is not None

@@ -388,6 +388,26 @@ class ChunkGovernor:
             del self.decisions[:-32]
         _telemetry.emit_event("chunk-governor", action=kind,
                               stall=stall, chunk=decision["chunk"])
+        if shedding:
+            # stall escalation beyond admission shedding: a sustained
+            # stall is a LAYOUT problem as much as a load problem, so ask
+            # the partition layer for an early repartition epoch — in-
+            # process via the installed adaptive-grid controller, and
+            # fleet-wide via a harvestable event the supervisor folds
+            # into its next routing boundary (rebalance/rescale epoch)
+            _telemetry.emit_event("rebalance-request",
+                                  trigger="governor-stall",
+                                  chunk=decision["chunk"],
+                                  p99_emit_ms=decision["p99_emit_ms"])
+            try:
+                from spatialflink_tpu.runtime.repartition import (
+                    active_controller)
+
+                ctl = active_controller()
+                if ctl is not None:
+                    ctl.request_epoch()
+            except Exception:
+                pass
         try:
             from spatialflink_tpu.runtime.queryplane import active_registry
 

@@ -38,6 +38,11 @@ Fault model boundaries (what each class means here):
   record that is corrupt IN the log (true poison) fails every redelivery
   and is the dead-letter queue's job.
 - ``latency`` — a produce/fetch stalls for ``latency_ms`` before running.
+- ``stall`` (:class:`StallFault`, process-level rather than broker-level) —
+  the worker's LIVENESS surfaces wedge for a duration while the pipeline
+  keeps running slowly: heartbeats stop, checkpoints stop committing, but
+  windows keep trickling out. The gray failure / zombie case the fleet's
+  fencing layer exists to contain, injectable via ``--fleet-chaos-stall``.
 
 Every injection bumps a ``chaos-*`` counter in the process metrics registry
 so a run summary can report how degraded the transport actually was.
@@ -47,6 +52,7 @@ from __future__ import annotations
 
 import random
 import threading
+import time
 from dataclasses import dataclass, fields, replace
 from typing import List, Optional
 
@@ -118,6 +124,60 @@ class FaultPlan:
                           or f.name == "seed" else float)
                  for f in fields(cls)}
         return cls(**parse_spec(spec, known, "--chaos"))
+
+
+class StallFault:
+    """Injectable gray failure: the worker wedges for ``duration_s``
+    WITHOUT exiting, and keeps writing.
+
+    Arms on the first emitted window (``on_window``; a worker that never
+    produced anything is indistinguishable from one still booting, which
+    is the boot-timeout's job, not this fault's). While wedged:
+
+    - the :class:`~spatialflink_tpu.runtime.fleet.HeartbeatWriter`'s gate
+      (``wedged``) suppresses beats — the supervisor sees silence;
+    - :meth:`~spatialflink_tpu.runtime.checkpoint.CheckpointCoordinator
+      .due` returns False — a zombie must not commit manifests its fenced
+      successor would resume from;
+    - each subsequent ``on_window`` sleeps ``emit_delay_s`` — the worker
+      is SLOW, not dead: it keeps appending outbox rows after the
+      supervisor has presumed it dead, which is exactly the stale-fence
+      traffic the containment tests need to observe being dropped.
+
+    Installed process-globally (:func:`install_stall`) because the
+    checkpoint coordinator has no handle on the worker context."""
+
+    def __init__(self, duration_s: float, *, emit_delay_s: float = 0.1):
+        self.duration_s = float(duration_s)
+        self.emit_delay_s = float(emit_delay_s)
+        self._armed_at: Optional[float] = None
+
+    def on_window(self) -> None:
+        if self._armed_at is None:
+            self._armed_at = time.monotonic()
+            from spatialflink_tpu.utils.metrics import REGISTRY
+            REGISTRY.counter("chaos-stall").inc()
+        elif self.wedged():
+            time.sleep(self.emit_delay_s)
+
+    def wedged(self) -> bool:
+        return (self._armed_at is not None
+                and time.monotonic() - self._armed_at < self.duration_s)
+
+
+_STALL: Optional[StallFault] = None
+
+
+def install_stall(fault: StallFault) -> StallFault:
+    """Install the process-wide stall fault (one per worker process; the
+    fleet chaos flag is the only writer)."""
+    global _STALL
+    _STALL = fault
+    return fault
+
+
+def active_stall() -> Optional[StallFault]:
+    return _STALL
 
 
 def _corrupt(value):

@@ -127,6 +127,8 @@ _WORKER_STRIP = {
     "--fleet-worker-id": 1, "--fleet-heartbeat": 1,
     "--fleet-epoch-records": 1, "--fleet-restart-cap": 1,
     "--fleet-chaos-kill": 1, "--fleet-slo-p99-ms": 1,
+    "--fleet-rescale": 1, "--fleet-chaos-stall": 1,
+    "--fleet-quarantine-s": 1, "--fleet-fence": 1, "--fleet-stall-s": 1,
     "--input1": 1, "--checkpoint-dir": 1, "--status-port": 1,
     "--output": 1, "--postmortem-dir": 1, "--resume": 0,
     "--limit": 1, "--telemetry-dir": 1, "--trace-dir": 1, "--profile": 1,
@@ -150,11 +152,14 @@ def _strip_flags(argv: List[str], spec: Dict[str, int]) -> List[str]:
 
 
 def worker_argv(base_argv: List[str], *, fleet_dir: str, worker_id: int,
-                heartbeat_s: float, resume: bool) -> List[str]:
+                heartbeat_s: float, resume: bool, fence: int = 0,
+                stall_s: float = 0.0) -> List[str]:
     """A worker's driver argv: the supervisor's own argv minus the
     fleet/placement flags, plus the worker-role glue. Everything else
     (config, query option, panes, strict-recompile, SLO, metrics…)
-    inherits unchanged — a worker IS the single-process pipeline."""
+    inherits unchanged — a worker IS the single-process pipeline.
+    ``fence`` is the incarnation's manifest-issued fence token;
+    ``stall_s`` arms the injectable gray failure (chaos only)."""
     wd = F.worker_dir(fleet_dir, worker_id)
     argv = _strip_flags(list(base_argv), _WORKER_STRIP)
     argv += [
@@ -162,11 +167,14 @@ def worker_argv(base_argv: List[str], *, fleet_dir: str, worker_id: int,
         "--fleet-dir", fleet_dir,
         "--fleet-worker-id", str(worker_id),
         "--fleet-heartbeat", f"{heartbeat_s:g}",
+        "--fleet-fence", str(int(fence)),
         "--input1", os.path.join(wd, F.PARTITION_FILE),
         "--checkpoint-dir", os.path.join(wd, "ckpt"),
         "--postmortem-dir", os.path.join(wd, "postmortem"),
         "--status-port", "0",
     ]
+    if stall_s > 0:
+        argv += ["--fleet-stall-s", f"{stall_s:g}"]
     if resume:
         argv.append("--resume")
     return argv
@@ -180,6 +188,29 @@ def _parse_chaos(spec: Optional[str]) -> Optional[Tuple[int, int]]:
         return None
     wid, _, n = str(spec).partition(":")
     return int(wid), max(1, int(n or 1))
+
+
+def _parse_stall_chaos(spec: Optional[str]) -> Optional[Tuple[int, float]]:
+    """``WID:SECONDS`` — worker WID's first incarnation wedges its
+    heartbeat/checkpoint surfaces for SECONDS after its first emitted
+    window while continuing to write (the zombie-containment hook: the
+    supervisor fences+respawns it WITHOUT a kill and the stale rows must
+    be dropped at merge)."""
+    if not spec:
+        return None
+    wid, _, s = str(spec).partition(":")
+    return int(wid), max(0.1, float(s or 30.0))
+
+
+def _parse_rescale(spec: Optional[str]) -> List[Tuple[int, int]]:
+    """``AT:N[,AT:N...]`` — once AT records have been routed, rescale the
+    fleet to N workers at the next epoch boundary. Sorted by threshold;
+    e.g. ``"150:3,300:2"`` scales 2→3→2 across a run."""
+    out: List[Tuple[int, int]] = []
+    for part in filter(None, (p.strip() for p in (spec or "").split(","))):
+        at, _, n = part.partition(":")
+        out.append((int(at), max(1, int(n or 1))))
+    return sorted(out)
 
 
 def _http_json(url: str, timeout: float = 1.0) -> Optional[dict]:
@@ -340,6 +371,11 @@ class FleetMonitor:
         self._seen_ms: Dict[Tuple[int, str], float] = {}
         self._vis_hist = _telemetry.StreamingHistogram("record-visible-ms")
         self._last_lat: Dict[int, dict] = {}
+        #: set when a harvested worker event escalates a sustained stall
+        #: to a repartition request (the chunk governor's
+        #: ``rebalance-request``); the routing loop pops it and forces an
+        #: early epoch boundary
+        self._rebalance_requested = False
         self._ev_f = open(os.path.join(root, F.EVENTS_FILE), "a")
 
     # ------------------------- the timeline ------------------------- #
@@ -388,11 +424,22 @@ class FleetMonitor:
                 fields["worker"] = wid
                 fields["src"] = "worker"
                 fields["worker_seq"] = wseq
+                if str(e.get("kind")) == "rebalance-request":
+                    # governor stall escalation — routing loop pops this
+                    # and forces an early epoch boundary
+                    self._rebalance_requested = True
                 ev = self.ring.append(str(e.get("kind")), **fields)
                 self._write_event_locked(ev)
                 added += 1
             self._cursors[wid] = cur
         return added
+
+    def pop_rebalance_request(self) -> bool:
+        """True once per harvested ``rebalance-request`` burst; clears
+        the flag so one stall escalation buys one early epoch."""
+        with self._lock:
+            req, self._rebalance_requested = self._rebalance_requested, False
+            return req
 
     def cursor(self, wid: int) -> int:
         with self._lock:
@@ -697,6 +744,12 @@ class FleetSupervisor:
             self.monitor = FleetMonitor(self.root, self.n_workers)
         self._chaos = _parse_chaos(getattr(args, "fleet_chaos_kill", None))
         self._chaos_fired = False
+        self._stall_chaos = _parse_stall_chaos(
+            getattr(args, "fleet_chaos_stall", None))
+        self._stall_injected = False
+        self._rescales = _parse_rescale(getattr(args, "fleet_rescale", None))
+        self.quarantine_s = float(
+            getattr(args, "fleet_quarantine_s", 10.0) or 10.0)
         self._digest_on = bool(getattr(args, "live_stats", False))
         self._poll_pool = ThreadPoolExecutor(
             max_workers=max(2, min(self.n_workers + 1, 16)),
@@ -716,6 +769,20 @@ class FleetSupervisor:
         self._restart_log: List[dict] = []
         self._routed = 0
         self._routed_by_worker: Dict[int, int] = {}
+        # elastic-fleet worker sets: routable actives vs the all-ever set
+        # (merge/done-markers/metrics must cover retirees and scale-outs)
+        self._active: List[int] = list(range(self.n_workers))
+        self._all = set(range(self.n_workers))
+        self._retired: set = set()
+        #: fenced-but-unkilled predecessors (gray-failure containment:
+        #: the zombie keeps running; its rows are dropped by fence)
+        self._zombies: List[Tuple[int, subprocess.Popen]] = []
+        #: wid -> monotonic time quarantine began (routing drained)
+        self._quarantined: Dict[int, float] = {}
+        #: wid -> accumulated gray-failure suspicion score
+        self._suspicion: Dict[int, float] = {}
+        #: wid -> read_outbox stats from the final merge (stale fences)
+        self._outbox_stats: Dict[int, dict] = {}
         self._done_feeding = False
         self._draining = False
         self._stopping = False
@@ -791,9 +858,35 @@ class FleetSupervisor:
         os.makedirs(wd, exist_ok=True)
         inc = self._incarnations.get(wid, 0) + 1
         self._incarnations[wid] = inc
+        fence = self.manifest.fence_of(wid)
+        if resume:
+            # Fence the predecessor BEFORE the successor boots. The byte
+            # sizes recorded here become the validity cutoffs for the OLD
+            # fence: anything a zombie predecessor appends after this
+            # instant lands past the cutoff and is dropped at merge time
+            # by construction — no signal delivery required.
+            ob = os.path.join(wd, F.OUTBOX_FILE)
+            jr = os.path.join(wd, "ckpt", "emitted.log")
+            fence = self.manifest.bump_fence(
+                wid,
+                outbox_bytes=(os.path.getsize(ob)
+                              if os.path.exists(ob) else 0),
+                journal_bytes=(os.path.getsize(jr)
+                               if os.path.exists(jr) else 0),
+                reason=reason)
+            self.manifest.save()
+            if self.monitor is not None:
+                self.monitor.note("fence-bump", worker=wid, fence=fence,
+                                  reason=reason)
+        stall_s = 0.0
+        if (self._stall_chaos is not None and wid == self._stall_chaos[0]
+                and inc == 1):
+            # chaos: only the FIRST incarnation of the target wedges —
+            # its fenced successor must run clean to prove containment
+            stall_s = self._stall_chaos[1]
         argv = worker_argv(self.base_argv, fleet_dir=self.root,
                            worker_id=wid, heartbeat_s=self.heartbeat_s,
-                           resume=resume)
+                           resume=resume, fence=fence, stall_s=stall_s)
         log = self._logs.get(wid)
         if log is None:
             log = open(os.path.join(wd, "worker.log"), "a")
@@ -894,6 +987,7 @@ class FleetSupervisor:
                 if self._stopping or self._failed:
                     return
                 procs = dict(self._procs)
+                wids = sorted(self._all)
             now = time.monotonic()
             poll_ops = now >= next_poll
             if poll_ops:
@@ -907,8 +1001,19 @@ class FleetSupervisor:
                 if poll_ops:
                     self._schedule_poll(wid)
             if self.monitor is not None:
-                for wid in range(self.n_workers):
+                for wid in wids:
                     self.monitor.scan_outbox(wid)
+            self._reap_zombies()
+            self._suspicion_tick()
+            for wid in self._quarantine_tick():
+                with self._lock:
+                    proc = self._procs.get(wid)
+                if proc is not None:
+                    self._fence_respawn(
+                        wid, proc,
+                        (f"gray failure: quarantined {self.quarantine_s:g}s"
+                         " without recovery"),
+                        kill=not self._is_stall_target(wid))
             self._check_chaos()
             time.sleep(0.2)
 
@@ -931,14 +1036,31 @@ class FleetSupervisor:
 
     def _check_liveness(self, wid: int, proc: subprocess.Popen) -> None:
         hb = os.path.join(F.worker_dir(self.root, wid), F.HEARTBEAT_FILE)
-        age = F.heartbeat_age_s(hb)
+        # fence-aware: a beat left behind by the fenced predecessor must
+        # not vouch for the successor (age None = "still booting")
+        age = F.heartbeat_age_s(hb, fence=self.manifest.fence_of(wid))
         with self._lock:
             booted_s = time.monotonic() - self._spawned_at.get(wid, 0.0)
         if age is None:
             if booted_s > self.boot_timeout_s:
-                self._kill(wid, proc, "no heartbeat after boot timeout")
+                self._contain(wid, proc, "no heartbeat after boot timeout")
         elif age > self.hb_timeout_s and booted_s > self.hb_timeout_s:
-            self._kill(wid, proc, f"heartbeat stale {age:.1f}s")
+            self._contain(wid, proc, f"heartbeat stale {age:.1f}s")
+
+    def _is_stall_target(self, wid: int) -> bool:
+        return (self._stall_chaos is not None
+                and wid == self._stall_chaos[0])
+
+    def _contain(self, wid: int, proc: subprocess.Popen,
+                 reason: str) -> None:
+        """Route a hard liveness breach into containment. The stall-chaos
+        target is fenced WITHOUT a kill — the predecessor lives on as a
+        writing zombie, and the merge proving its rows were dropped is the
+        whole point of the drill. Real failures keep the kill."""
+        if self._is_stall_target(wid):
+            self._fence_respawn(wid, proc, reason, kill=False)
+        else:
+            self._kill(wid, proc, reason)
 
     def _kill(self, wid: int, proc: subprocess.Popen, reason: str) -> None:
         if self.monitor is not None:
@@ -954,6 +1076,136 @@ class FleetSupervisor:
             proc.kill()
         except OSError:
             pass
+
+    # -------------------------------------------------------------- #
+    # gray-failure containment: suspicion -> quarantine -> fence
+
+    SUSPECT_ENTER = 3.0
+    SUSPECT_EXIT = 1.0
+    SUSPECT_CAP = 6.0
+
+    def _fence_respawn(self, wid: int, proc: subprocess.Popen,
+                       reason: str, *, kill: bool) -> None:
+        """Fence + respawn a worker WITHOUT waiting for the predecessor
+        to die. With ``kill=False`` the predecessor lives on as a writing
+        zombie — provably contained, because the fence bump in
+        ``_spawn_locked`` records its byte cutoffs before the successor
+        boots, so everything it appends afterwards is stale by
+        construction."""
+        if self.monitor is not None:
+            # bounded: the worker may already be unresponsive
+            self._harvest_events(wid, timeout=0.5)
+        with self._lock:
+            if self._procs.get(wid) is not proc:
+                return  # superseded while harvesting
+            del self._procs[wid]
+            self._zombies.append((wid, proc))
+            self._quarantined.pop(wid, None)
+            self._suspicion.pop(wid, None)
+            if self.monitor is not None:
+                self.monitor.note("worker-fence", worker=wid,
+                                  reason=reason, kill=bool(kill))
+            self._restart_locked(wid, None, reason)
+        if kill:
+            try:
+                proc.kill()
+            except OSError:
+                pass
+
+    def _reap_zombies(self) -> None:
+        """Collect fenced predecessors that finally died. Their exit must
+        NOT trip the restart path — zombies are out of ``_procs``, so
+        ``_on_exit`` never sees them; this reap just records the death."""
+        with self._lock:
+            zombies = list(self._zombies)
+        for wid, proc in zombies:
+            rc = proc.poll()
+            if rc is None:
+                continue
+            with self._lock:
+                try:
+                    self._zombies.remove((wid, proc))
+                except ValueError:
+                    continue
+            if self.monitor is not None:
+                self.monitor.note("zombie-exit", worker=wid, rc=rc)
+
+    def _suspicion_tick(self) -> None:
+        """Score gray failure per monitor cycle from soft signals: a
+        slow-not-dead worker accrues suspicion (stale-ish heartbeat,
+        backpressure stall flag, tail-latency skew vs the fleet median,
+        backlog, throughput collapse) and decays it on healthy cycles.
+        Crossing SUSPECT_ENTER quarantines the worker — new leaf routes
+        drain away while its already-routed output keeps merging; falling
+        back below SUSPECT_EXIT lifts the quarantine (hysteresis). The
+        last routable worker is never quarantined."""
+        samples = (self.monitor.last_samples()
+                   if self.monitor is not None else {})
+        with self._lock:
+            candidates = [w for w in self._active if w in self._procs]
+        p99s = [float(s["record_emit_p99_ms"]) for s in samples.values()
+                if s.get("record_emit_p99_ms") is not None]
+        med_p99 = sorted(p99s)[len(p99s) // 2] if p99s else None
+        rpss = [float(s["throughput_rps"]) for s in samples.values()
+                if s.get("throughput_rps")]
+        med_rps = sorted(rpss)[len(rpss) // 2] if rpss else None
+        now = time.monotonic()
+        for wid in candidates:
+            hb = os.path.join(F.worker_dir(self.root, wid),
+                              F.HEARTBEAT_FILE)
+            age = F.heartbeat_age_s(hb, fence=self.manifest.fence_of(wid))
+            s = samples.get(wid) or {}
+            pts = 0.0
+            if age is not None and age > 2.0 * self.heartbeat_s:
+                pts += 1.5
+            if s.get("stall"):
+                pts += 1.0
+            p99 = s.get("record_emit_p99_ms")
+            if (p99 is not None and med_p99 and len(p99s) >= 2
+                    and float(p99) > 3.0 * med_p99):
+                pts += 1.0
+            res = s.get("backlog_residency_ms")
+            if res is not None and float(res) > 1000.0:
+                pts += 0.5
+            rps = s.get("throughput_rps")
+            if (rps is not None and med_rps and len(rpss) >= 2
+                    and float(rps) < 0.2 * med_rps):
+                pts += 0.5
+            with self._lock:
+                prev = self._suspicion.get(wid, 0.0)
+                score = (min(self.SUSPECT_CAP, prev + pts) if pts > 0
+                         else max(0.0, prev - 0.5))
+                self._suspicion[wid] = score
+                quarantined = wid in self._quarantined
+                routable = [w for w in self._active
+                            if w not in self._quarantined]
+                if (not quarantined and score >= self.SUSPECT_ENTER
+                        and len(routable) > 1):
+                    self._quarantined[wid] = now
+                    self.manifest.note_quarantine(
+                        wid, "quarantine", score=round(score, 2))
+                    self.manifest.save()
+                    if self.monitor is not None:
+                        self.monitor.note("worker-quarantine", worker=wid,
+                                          score=round(score, 2))
+                elif quarantined and score <= self.SUSPECT_EXIT:
+                    self._quarantined.pop(wid, None)
+                    self.manifest.note_quarantine(
+                        wid, "unquarantine", score=round(score, 2))
+                    self.manifest.save()
+                    if self.monitor is not None:
+                        self.monitor.note("worker-unquarantine",
+                                          worker=wid,
+                                          score=round(score, 2))
+
+    def _quarantine_tick(self) -> List[int]:
+        """Workers whose quarantine outlived the deadline — the caller
+        escalates each to a fence+respawn (split out so unit tests can
+        drive the state machine without a live fleet)."""
+        now = time.monotonic()
+        with self._lock:
+            return [w for w, t0 in self._quarantined.items()
+                    if now - t0 > self.quarantine_s]
 
     def _schedule_poll(self, wid: int) -> None:
         """Submit one worker's ops poll to the pool — the monitor loop
@@ -1065,11 +1317,41 @@ class FleetSupervisor:
     # -------------------------------------------------------------- #
     # routing
 
+    def _pick_worker(self, leaf: Optional[int], routed: int,
+                     assignment: Dict[int, int],
+                     outs: Dict[int, object]) -> int:
+        """Quarantine-aware placement: the assigned worker wins while it
+        is routable; a quarantined/retired assignee's NEW records deflect
+        deterministically onto the routable set (its already-routed
+        partition keeps draining — quarantine starves, never truncates)."""
+        with self._lock:
+            routable = [w for w in self._active
+                        if w not in self._quarantined and w in outs]
+        if not routable:
+            routable = sorted(outs)
+        if leaf is None:
+            return routable[routed % len(routable)]
+        wid = assignment.get(leaf)
+        if wid is not None and wid in routable:
+            return wid
+        return routable[leaf % len(routable)]
+
+    def _rescale_due(self, routed: int) -> Optional[int]:
+        """Pop the next ``--fleet-rescale`` threshold once routed records
+        cross it — consumed at an epoch boundary, never mid-epoch."""
+        with self._lock:
+            if self._rescales and routed >= self._rescales[0][0]:
+                return self._rescales.pop(0)[1]
+        return None
+
     def _route(self, leaf_of) -> int:
         """Feed the input file into per-worker partition files, one epoch
         at a time; at each epoch boundary, flush, rebalance if a worker
-        is hot, and persist the manifest. Returns routed-record count."""
-        outs = {}
+        is hot (or rescale if a ``--fleet-rescale`` threshold passed),
+        and persist the manifest. A worker's ``rebalance-request`` event
+        (the chunk governor's sustained-stall escalation) forces an early
+        boundary at the next flush point. Returns routed-record count."""
+        outs: Dict[int, object] = {}
         for wid in range(self.n_workers):
             wd = F.worker_dir(self.root, wid)
             os.makedirs(wd, exist_ok=True)
@@ -1098,21 +1380,30 @@ class FleetSupervisor:
                         routed += 1
                         continue
                     leaf = leaf_of(line)
-                    wid = (assignment.get(leaf, leaf % self.n_workers)
-                           if leaf is not None else routed % self.n_workers)
+                    wid = self._pick_worker(leaf, routed, assignment, outs)
                     outs[wid].write(line + "\n")
                     routed += 1
                     epoch_n += 1
-                    epoch_by_worker[wid] += 1
+                    epoch_by_worker[wid] = epoch_by_worker.get(wid, 0) + 1
                     if leaf is not None:
                         occ[leaf] = occ.get(leaf, 0) + 1
+                    force_epoch = False
                     if epoch_n % 512 == 0:
                         outs[wid].flush()
-                    if epoch_n >= self.epoch_records:
+                        if (self.monitor is not None
+                                and self.monitor.pop_rebalance_request()):
+                            force_epoch = True
+                    if epoch_n >= self.epoch_records or force_epoch:
                         for out in outs.values():
                             out.flush()
-                        assignment = self._epoch_boundary(
-                            assignment, occ, epoch_by_worker)
+                        n_to = self._rescale_due(routed)
+                        if n_to is not None:
+                            assignment = self._apply_rescale(
+                                assignment, occ, epoch_by_worker, outs,
+                                n_to, routed)
+                        else:
+                            assignment = self._epoch_boundary(
+                                assignment, occ, epoch_by_worker)
                         epoch_n = 0
                         epoch_by_worker = {w: 0 for w in outs}
                     if (self.args.limit is not None
@@ -1131,6 +1422,101 @@ class FleetSupervisor:
                     self._routed_by_worker.get(w, 0) + n)
         return routed
 
+    def _apply_rescale(self, assignment: Dict[int, int],
+                       occ: Dict[int, int],
+                       epoch_by_worker: Dict[int, int],
+                       outs: Dict[int, object], n_to: int,
+                       routed: int) -> Dict[int, int]:
+        """Live rescale at an epoch boundary. The boundary IS the
+        barrier: every partition is flushed and no record is in flight,
+        so leaf moves need no state copy — the merge's per-family twin
+        union reassembles a window split across old and new owners.
+        Scale-out spawns FRESH worker ids (a retired id's done marker and
+        fenced outbox must never be re-inhabited); scale-in retires the
+        HIGHEST ids by writing their done markers now (done marker =
+        drain-to-EOF: the retiree finishes its already-routed records,
+        writes its final graceful checkpoint — the savepoint — and exits
+        0). The assignment is recomputed by ``balance_leaves`` over the
+        new width and remapped through the sorted active list."""
+        with self._lock:
+            for w, n in epoch_by_worker.items():
+                self._routed_by_worker[w] = (
+                    self._routed_by_worker.get(w, 0) + n)
+            active = sorted(self._active)
+        n_from = len(active)
+        if n_to > n_from:
+            for _ in range(n_to - n_from):
+                with self._lock:
+                    nw = max(self._all) + 1
+                    self._all.add(nw)
+                    self._active.append(nw)
+                    self._spawn_locked(nw, resume=False,
+                                       reason=f"scale-out at {routed}")
+                active.append(nw)
+                wd = F.worker_dir(self.root, nw)
+                outs[nw] = open(os.path.join(wd, F.PARTITION_FILE), "a")
+        elif n_to < n_from:
+            retire = active[n_to:]
+            active = active[:n_to]
+            with self._lock:
+                self._active = [w for w in self._active
+                                if w not in retire]
+                self._retired.update(retire)
+            for w in retire:
+                out = outs.pop(w, None)
+                if out is not None:
+                    out.flush()
+                    out.close()
+                atomic_write_json(
+                    os.path.join(F.worker_dir(self.root, w),
+                                 F.DONE_MARKER),
+                    {"routed_total": routed,
+                     "epoch": self.manifest.fleet_epoch,
+                     "retired": True})
+            self._await_retirement(retire)
+        packed = balance_leaves(occ, len(active))
+        order = sorted(active)
+        new_assignment = {leaf: order[slot]
+                          for leaf, slot in packed.items()}
+        # leaves the occupancy sample never saw keep their owner if it
+        # survived the rescale, else deflect deterministically
+        for leaf, w in assignment.items():
+            if leaf not in new_assignment:
+                new_assignment[leaf] = (w if w in order
+                                        else order[leaf % len(order)])
+        with self._lock:
+            self.manifest.note_rescale(
+                n_from=n_from, n_to=len(order), at_records=routed,
+                epoch=self.manifest.fleet_epoch + 1)
+            self.manifest.assign_all(new_assignment)
+            self.manifest.advance_epoch()
+            self.manifest.save()
+        if self.monitor is not None:
+            self.monitor.note("rescale", n_from=n_from, n_to=len(order),
+                              at_records=routed,
+                              epoch=self.manifest.fleet_epoch)
+        print(f"# fleet rescale at {routed} records: {n_from} -> "
+              f"{len(order)} workers (epoch {self.manifest.fleet_epoch})",
+              flush=True)
+        return new_assignment
+
+    def _await_retirement(self, wids: List[int],
+                          timeout_s: float = 60.0) -> None:
+        """Bounded wait for retirees to drain to their done markers and
+        exit. A retiree that crashes mid-drain stays covered by the
+        ordinary ``_on_exit`` restart machinery (it is still in
+        ``_procs``), so this wait is a convergence aid, not a
+        correctness gate — routing resumes either way."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self._failed or self._stopping:
+                    return
+                live = [w for w in wids if w in self._procs]
+            if not live:
+                return
+            time.sleep(0.1)
+
     def _epoch_boundary(self, assignment: Dict[int, int],
                         occ: Dict[int, int],
                         epoch_by_worker: Dict[int, int]) -> Dict[int, int]:
@@ -1145,8 +1531,9 @@ class FleetSupervisor:
                 self._routed_by_worker[w] = (
                     self._routed_by_worker.get(w, 0) + n)
             polls = dict(self._polls)
+            active = sorted(self._active)
         loads: Dict[int, float] = {}
-        for wid in range(self.n_workers):
+        for wid in active:
             sig = (self.monitor.rebalance_load(wid)
                    if self.monitor is not None else None)
             if sig is None:
@@ -1184,7 +1571,9 @@ class FleetSupervisor:
         return assignment
 
     def _write_done_markers(self, routed: int) -> None:
-        for wid in range(self.n_workers):
+        with self._lock:
+            wids = sorted(self._all - self._retired)
+        for wid in wids:  # retirees already hold their rescale markers
             atomic_write_json(
                 os.path.join(F.worker_dir(self.root, wid), F.DONE_MARKER),
                 {"routed_total": routed,
@@ -1210,28 +1599,50 @@ class FleetSupervisor:
             routed = self._routed
             routed_by = dict(self._routed_by_worker)
             restart_log = list(self._restart_log)
+            all_wids = sorted(self._all)
+            active = sorted(self._active)
+            retired = sorted(self._retired)
+            quarantined = dict(self._quarantined)
+            suspicion = dict(self._suspicion)
+            zombies = len(self._zombies)
         per_leaf: Dict[int, int] = {}
         for leaf, wid in self.manifest.fleet_assignment.items():
             per_leaf[wid] = per_leaf.get(wid, 0) + 1
         workers = []
-        for wid in range(self.n_workers):
+        for wid in all_wids:
             hb = os.path.join(F.worker_dir(self.root, wid),
                               F.HEARTBEAT_FILE)
+            fence = self.manifest.fence_of(wid)
             workers.append({
                 "worker": wid,
                 "alive": wid in procs,
                 "rc": rcs.get(wid),
                 "incarnations": incs.get(wid, 0),
                 "restarts": self.manifest.fleet_restarts.get(wid, 0),
-                "heartbeat_age_s": F.heartbeat_age_s(hb),
+                "heartbeat_age_s": F.heartbeat_age_s(hb, fence=fence),
                 "url": urls.get(wid),
                 "leaves": per_leaf.get(wid, 0),
                 "routed": routed_by.get(wid, 0),
+                "fence": fence,
+                "quarantined": wid in quarantined,
+                "suspicion": round(suspicion.get(wid, 0.0), 2),
+                "retired": wid in retired,
                 "status": (polls.get(wid) or {}).get("status"),
                 "latency": (polls.get(wid) or {}).get("latency"),
             })
-        return fleet_snapshot(workers, epoch=self.manifest.fleet_epoch,
+        view = fleet_snapshot(workers, epoch=self.manifest.fleet_epoch,
                               routed=routed, restart_log=restart_log)
+        # elastic-fleet state the base snapshot schema predates
+        view["active_workers"] = active
+        view["retired_workers"] = retired
+        view["zombies"] = zombies
+        view["fences"] = {str(w): self.manifest.fence_of(w)
+                          for w in all_wids}
+        view["fence_log"] = list(self.manifest.fleet_fence_log)[-50:]
+        view["rescale_log"] = list(self.manifest.fleet_rescale_log)[-50:]
+        view["quarantine_log"] = list(
+            self.manifest.fleet_quarantine_log)[-50:]
+        return view
 
     _PLANE_NOTE = ("fleet observability plane is off "
                    "(--fleet-plane off)")
@@ -1313,7 +1724,11 @@ class FleetSupervisor:
             urls = dict(self._urls)
             routed = self._routed
             alive = len(self._procs)
-        for wid in range(self.n_workers):
+            all_wids = sorted(self._all)
+            active_n = len(self._active)
+            quarantined_n = len(self._quarantined)
+            zombies_n = len(self._zombies)
+        for wid in all_wids:
             if wid not in urls:
                 url = self._resolve_url(wid)
                 if url:
@@ -1353,6 +1768,18 @@ class FleetSupervisor:
             f"spatialflink_fleet_routed_records {routed}",
             "# TYPE spatialflink_fleet_restarts_total counter",
             f"spatialflink_fleet_restarts_total {restarts}",
+            "# TYPE spatialflink_fleet_workers_active gauge",
+            f"spatialflink_fleet_workers_active {active_n}",
+            "# TYPE spatialflink_fleet_workers_quarantined gauge",
+            f"spatialflink_fleet_workers_quarantined {quarantined_n}",
+            "# TYPE spatialflink_fleet_zombies gauge",
+            f"spatialflink_fleet_zombies {zombies_n}",
+            "# TYPE spatialflink_fleet_fence_bumps_total counter",
+            ("spatialflink_fleet_fence_bumps_total "
+             f"{len(self.manifest.fleet_fence_log)}"),
+            "# TYPE spatialflink_fleet_rescales_total counter",
+            ("spatialflink_fleet_rescales_total "
+             f"{len(self.manifest.fleet_rescale_log)}"),
         ]
         return "\n".join(lines) + "\n"
 
@@ -1393,9 +1820,17 @@ class FleetSupervisor:
             with self._lock:
                 self._stopping = True
                 procs = dict(self._procs)
+                zombies = list(self._zombies)
             for proc in procs.values():
                 if proc.poll() is None:
                     proc.terminate()
+            for _, proc in zombies:
+                # fenced predecessors must not outlive the supervisor
+                if proc.poll() is None:
+                    try:
+                        proc.kill()
+                    except OSError:
+                        pass
             mon = self._monitor_thread
             if mon is not None:
                 mon.join(timeout=5.0)
@@ -1449,19 +1884,40 @@ class FleetSupervisor:
         per_worker = {}
         runs = {}
         compiles = 0
+        with self._lock:
+            all_wids = sorted(self._all)
         if self.monitor is not None:
             # one final tail per worker: stamp any line that landed after
             # the monitor loop's last scan, so every merged window has an
             # outbox-visible stamp
-            for wid in range(self.n_workers):
+            for wid in all_wids:
                 self.monitor.scan_outbox(wid)
-        for wid in range(self.n_workers):
+        for wid in all_wids:
             wd = F.worker_dir(self.root, wid)
+            # fence-aware read: rows a superseded incarnation (a zombie)
+            # appended past its cutoff are dropped and counted here, never
+            # merged — containment by construction, not by kill latency
+            stats: Dict[str, int] = {}
+            cutoffs = {f: c["outbox"] for f, c in
+                       self.manifest.fence_cutoffs(wid).items()}
             per_worker[wid] = F.read_outbox(
-                os.path.join(wd, F.OUTBOX_FILE))
+                os.path.join(wd, F.OUTBOX_FILE),
+                fence_cutoffs=cutoffs, stats=stats)
+            with self._lock:
+                self._outbox_stats[wid] = stats
             runs[wid] = F.read_runs(wd)
             compiles += sum(int(r.get("post_warmup_compiles") or 0)
                             for r in runs[wid])
+        with self._lock:
+            outbox_stats = {w: dict(s)
+                            for w, s in self._outbox_stats.items()}
+        stale_rows = sum(s.get("stale_fence_rows", 0)
+                         for s in outbox_stats.values())
+        fence_conflicts = sum(s.get("fence_conflicts", 0)
+                              for s in outbox_stats.values())
+        if stale_rows and self.monitor is not None:
+            self.monitor.note("stale-fence-drop", rows=stale_rows,
+                              conflicts=fence_conflicts)
         merged = F.merge_outboxes(per_worker, self.case.family,
                                   k=self.params.query.k)
         t_merged_ms = time.time() * 1e3
@@ -1492,9 +1948,15 @@ class FleetSupervisor:
                     "p99"))
         with self._lock:
             restart_log = list(self._restart_log)
+        with self._lock:
+            active = sorted(self._active)
+            retired = sorted(self._retired)
         result = {
             "digest": digest,
             "workers": self.n_workers,
+            "workers_final": len(active),
+            "workers_all": all_wids,
+            "retired_workers": retired,
             "routed": routed,
             "merged_windows": len(merged),
             "epochs": self.manifest.fleet_epoch,
@@ -1503,6 +1965,12 @@ class FleetSupervisor:
             "restart_log": restart_log,
             "post_warmup_compiles": compiles,
             "graceful": graceful,
+            "fences": {str(w): self.manifest.fence_of(w)
+                       for w in all_wids},
+            "stale_fence_rows": stale_rows,
+            "fence_conflicts": fence_conflicts,
+            "rescales": list(self.manifest.fleet_rescale_log),
+            "quarantines": list(self.manifest.fleet_quarantine_log),
             "runs": {str(k): v for k, v in runs.items()},
         }
         if lineage is not None:
@@ -1514,10 +1982,13 @@ class FleetSupervisor:
                 "skipped_no_lat": lineage.get("skipped_no_lat", 0),
             }
         atomic_write_json(os.path.join(self.root, F.RESULT_FILE), result)
+        stale_note = (f", stale fence rows dropped {stale_rows}"
+                      if stale_rows else "")
         print(f"# fleet merged {len(merged)} windows from "
-              f"{self.n_workers} workers (routed {routed}, "
+              f"{len(all_wids)} workers (routed {routed}, "
               f"restarts {sum(self.manifest.fleet_restarts.values())}, "
-              f"post-warmup compiles {compiles}, digest {digest[:16]})",
+              f"post-warmup compiles {compiles}{stale_note}, "
+              f"digest {digest[:16]})",
               flush=True)
         return 0
 

@@ -29,7 +29,11 @@ module adds that plane, stdlib-only:
                    query (schema-validated JSON body, lands at the next
                    window boundary) — the dynamic query plane
   /queries/<id>    GET: one query's lifecycle record; DELETE: drain it
-  /fleet           supervisor's aggregated per-worker view (fleet runs)
+  /fleet           supervisor's aggregated per-worker view (fleet runs):
+                   liveness, restarts, routing — plus the elastic-fleet
+                   state (per-worker fence tokens, quarantine flags and
+                   suspicion scores, active/retired sets, and the fence/
+                   rescale/quarantine history logs)
   /fleet/latency   end-to-end record→merged-emit lineage: fleet stage
                    table + sum check after the merge, record→visible
                    histogram and per-worker samples mid-run

@@ -179,6 +179,17 @@ class RepartitionController:
         if due:
             self.epoch()
 
+    def request_epoch(self) -> None:
+        """Arm the next observation to close the epoch early — the chunk
+        governor's sustained-stall escalation (ROADMAP item 3's residual:
+        stall events trigger repartition epochs, not just shedding).
+        Only the interval clock is touched: the epoch itself still fires
+        from :meth:`note_cells` on the pipeline thread, between chunks —
+        the one place a layout change cannot interleave with a window
+        evaluation."""
+        with self._lock:
+            self._since = max(self._since, self.interval_records)
+
     # ------------------------------------------------------------------ #
     # decisions
 

@@ -28,7 +28,7 @@ def test_bench_guard_passes_thresholds():
         "window_assign", "decode_columnar", "windowed_pipeline",
         "skew_adaptive", "query_plane", "controller_pareto",
         "realtime_vectorized", "latency_record_emit",
-        "fleet_scaling"], r.stdout
+        "fleet_scaling", "fleet_rescale"], r.stdout
     assert all(x["speedup"] > 0 for x in rows if "speedup" in x)
     # the governor's Pareto composite row carries its convergence trace
     # (final chunk, tick/step counts) so a never-ticking controller is
@@ -49,6 +49,13 @@ def test_bench_guard_passes_thresholds():
     assert len(fl) == 1 and fl[0]["wall_fleet1_s"] > 0
     assert fl[0]["scaling_n2"] > 0 and fl[0]["overhead_x"] > 0
     assert fl[0]["merged_windows"] > 0
+    # the live-rescale row (N=2->4 mid-run at an epoch boundary, digest
+    # asserted vs a fixed-N=2 oracle in-run; gated under the shared fleet
+    # metric key)
+    rs = [x for x in rows if x["path"] == "fleet_rescale"]
+    assert len(rs) == 1 and rs[0]["wall_fleet1_s"] > 0
+    assert rs[0]["workers_final"] == 4 and rs[0]["rescale_x"] > 0
+    assert rs[0]["merged_windows"] > 0
     assert r.returncode == 0, (
         f"bench_guard regression:\n{r.stdout}\n{r.stderr[-1000:]}")
 
@@ -68,6 +75,8 @@ def test_guard_baseline_rows_exist():
     assert {r["path"] for r in base["latency_rows"]} == {
         "latency_record_emit"}
     assert all(r["p99_ms"] > 0 for r in base["latency_rows"])
-    # the fleet supervision-cost ceiling (lower-is-better third pass)
-    assert {r["path"] for r in base["fleet_rows"]} == {"fleet_scaling"}
+    # the fleet supervision-cost + live-rescale ceilings (lower-is-better
+    # third pass, both paired on the shared wall_fleet1_s key)
+    assert {r["path"] for r in base["fleet_rows"]} == {
+        "fleet_scaling", "fleet_rescale"}
     assert all(r["wall_fleet1_s"] > 0 for r in base["fleet_rows"])

@@ -173,9 +173,36 @@ def test_tailing_source_stall_timeout(tmp_path):
     part = str(tmp_path / "p.ndjson")
     open(part, "w").write("a\n")
     src = F.TailingReplaySource(part, str(tmp_path / "p.done"),
-                                poll_s=0.01, stall_timeout_s=0.1)
-    with pytest.raises(RuntimeError, match="stalled"):
+                                poll_s=0.01, stall_timeout_s=0.05,
+                                stall_deadline_s=0.2)
+    with pytest.raises(RuntimeError, match="deadline"):
         list(src)
+    # the bounded retry warned (partition-stall) before giving up
+    assert src.stall_events >= 1
+
+
+def test_tailing_source_stall_retry_survives_to_done(tmp_path):
+    """A stall longer than the warn timeout but shorter than the deadline
+    is a bounded retry (counted partition-stall events), not a crash —
+    the pause a quarantine drain or rescale barrier produces."""
+    part = str(tmp_path / "p.ndjson")
+    done = str(tmp_path / "p.done")
+    open(part, "w").write("a\n")
+    src = F.TailingReplaySource(part, done, poll_s=0.01,
+                                stall_timeout_s=0.05,
+                                stall_deadline_s=30.0)
+    got = []
+    t = threading.Thread(target=lambda: got.extend(src))
+    t.start()
+    time.sleep(0.3)  # well past the warn timeout, far from the deadline
+    assert t.is_alive(), "bounded retry gave up before the deadline"
+    assert src.stall_events >= 1
+    with open(part, "a") as f:
+        f.write("b\n")
+    F.atomic_write_json(done, {"routed_total": 2})
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert got == ["a", "b"]
 
 
 # -------------------------------------------------- outbox + global merge
@@ -251,6 +278,267 @@ def test_fleet_manifest_roundtrip(tmp_path):
     assert m2.fleet_restarts == {1: 2}
 
 
+# ------------------------------------------------------- fencing epochs
+
+
+def _fdoc(key, records, fp, fence=0):
+    d = _doc(key, records, fp=fp)
+    if fence:
+        d["fence"] = fence
+    return d
+
+
+def test_heartbeat_fence_stamping_and_age(tmp_path):
+    hb = str(tmp_path / "heartbeat")
+    w = F.HeartbeatWriter(hb, interval_s=0.05, fence=2)
+    w.start()
+    try:
+        time.sleep(0.15)
+        beat = json.load(open(hb))
+        assert beat["fence"] == 2 and beat["pid"] == os.getpid()
+        age = F.heartbeat_age_s(hb, fence=2)
+        assert age is not None and age < 5.0
+        # a successor expecting fence 3 must not read this beat as
+        # liveness — it is the zombie predecessor's write
+        assert F.heartbeat_age_s(hb, fence=3) is None
+    finally:
+        w.close()
+
+
+def test_heartbeat_gate_suppresses_beats(tmp_path):
+    hb = str(tmp_path / "heartbeat")
+    w = F.HeartbeatWriter(hb, interval_s=0.02, fence=1,
+                          gate=lambda: True)
+    w.start()
+    try:
+        time.sleep(0.1)
+        assert not os.path.exists(hb)  # wedged: silence, not beats
+    finally:
+        w.close()
+
+
+def test_heartbeat_age_legacy_mtime_fallback(tmp_path):
+    hb = tmp_path / "heartbeat"
+    hb.write_text("")  # pre-fence format: an empty touch file
+    age = F.heartbeat_age_s(str(hb), fence=1)
+    assert age is not None and age < 5.0
+
+
+def test_read_outbox_drops_zombie_rows_past_fence_cutoff(tmp_path):
+    p = str(tmp_path / "outbox.jsonl")
+    with open(p, "w") as f:
+        f.write(json.dumps(_fdoc("0:5:None", ["r1"], "aa")) + "\n")
+        cutoff = f.tell()
+        # the zombie (still fence 0) keeps writing past its cutoff...
+        f.write(json.dumps(_fdoc("5:10:None", ["zz"], "zz")) + "\n")
+        # ...while the fenced successor re-emits the window correctly
+        f.write(json.dumps(_fdoc("5:10:None", ["r2"], "bb", fence=1))
+                + "\n")
+    stats = {}
+    out = F.read_outbox(p, fence_cutoffs={0: cutoff}, stats=stats)
+    assert sorted(out) == ["0:5:None", "5:10:None"]
+    assert out["0:5:None"]["records"] == ["r1"]  # pre-cutoff row survives
+    assert out["5:10:None"]["records"] == ["r2"]
+    assert stats == {"stale_fence_rows": 1, "fence_conflicts": 0}
+    # stats accumulate across calls (one dict over a whole fleet)
+    F.read_outbox(p, fence_cutoffs={0: cutoff}, stats=stats)
+    assert stats["stale_fence_rows"] == 2
+
+
+def test_read_outbox_cross_fence_conflict_keeps_newest_fence(tmp_path):
+    p = str(tmp_path / "outbox.jsonl")
+    with open(p, "w") as f:
+        f.write(json.dumps(_fdoc("0:5:None", ["old"], "aa")) + "\n")
+        f.write(json.dumps(_fdoc("0:5:None", ["new"], "bb", fence=1))
+                + "\n")
+    stats = {}
+    out = F.read_outbox(p, stats=stats)
+    # cross-fence divergence: the superseded writer is the less trusted
+    # side — keep the newest fence, count a conflict, never abort
+    assert out["0:5:None"]["records"] == ["new"]
+    assert stats["fence_conflicts"] == 1
+    with open(p, "a") as f:
+        f.write(json.dumps(_fdoc("0:5:None", ["x"], "cc", fence=1))
+                + "\n")
+    # SAME-fence divergence stays the hard exactly-once error
+    with pytest.raises(F.FleetMergeError, match="exactly-once"):
+        F.read_outbox(p)
+
+
+def test_fleet_manifest_fence_rescale_quarantine_roundtrip(tmp_path):
+    p = str(tmp_path / "fleet.json")
+    m = F.FleetManifest(p)
+    assert m.fence_of(0) == 0
+    assert m.bump_fence(0, outbox_bytes=100, journal_bytes=40,
+                        reason="stall") == 1
+    assert m.bump_fence(0, outbox_bytes=250, journal_bytes=90,
+                        reason="crash") == 2
+    m.note_rescale(n_from=2, n_to=3, at_records=150, epoch=2)
+    m.note_quarantine(1, "quarantine", score=3.5)
+    m.save()
+    m2 = F.FleetManifest(p)  # durable across a supervisor crash
+    assert m2.fence_of(0) == 2 and m2.fence_of(1) == 0
+    assert m2.fence_cutoffs(0) == {0: {"outbox": 100, "journal": 40},
+                                   1: {"outbox": 250, "journal": 90}}
+    assert m2.fence_cutoffs(1) == {}
+    assert m2.fleet_rescale_log[0]["n_to"] == 3
+    assert m2.fleet_quarantine_log[0]["action"] == "quarantine"
+    # the raw-state projection doctor uses agrees with the method
+    assert F.fence_cutoffs_from(F.read_json(p), 0) == m2.fence_cutoffs(0)
+
+
+def test_emitted_journal_fence_stamping_and_cutoffs(tmp_path):
+    from spatialflink_tpu.operators import WindowResult
+    from spatialflink_tpu.runtime.checkpoint import EmittedWindowJournal
+
+    d = str(tmp_path)
+    r1 = WindowResult(0, 5, ["a"], extras={"cell": 1})
+    r2 = WindowResult(5, 10, ["b"], extras={"cell": 1})
+    j0 = EmittedWindowJournal(d, fresh=True)  # fence-0 incarnation
+    j0.record(r1)
+    cutoff = os.path.getsize(j0.path)
+    j0.record(r2)  # the zombie journals past its cutoff
+    j0.close()
+    # fence-0 lines stay bare keys: single-process byte-compat
+    lines = open(j0.path).read().splitlines()
+    assert lines == ["0:5:1", "5:10:1"]
+    j1 = EmittedWindowJournal(d, fence=1, fence_cutoffs={0: cutoff})
+    # r1 journaled pre-cutoff: suppressed; r2 post-cutoff: must re-emit
+    assert j1.seen(r1) is True
+    assert j1.seen(r2) is False
+    j1.record(r2)
+    j1.close()
+    assert open(j1.path).read().splitlines()[-1] == "1\t5:10:1"
+    # a third incarnation composes both fences' cutoffs
+    j2 = EmittedWindowJournal(d, fence=2,
+                              fence_cutoffs={0: cutoff,
+                                             1: os.path.getsize(j1.path)})
+    assert j2.seen(r1) is True and j2.seen(r2) is True
+    j2.close()
+
+
+def test_stall_fault_arms_wedges_and_expires():
+    from spatialflink_tpu.runtime import faults
+
+    f = faults.StallFault(0.2, emit_delay_s=0.0)
+    assert not f.wedged()  # unarmed until the first emitted window
+    f.on_window()
+    assert f.wedged()
+    time.sleep(0.25)
+    assert not f.wedged()  # the gray failure heals after duration_s
+    prev = faults.active_stall()
+    try:
+        assert faults.install_stall(f) is f
+        assert faults.active_stall() is f
+    finally:
+        faults.install_stall(prev)
+
+
+def test_stall_fault_gates_checkpoint_due(tmp_path):
+    from spatialflink_tpu.runtime import faults
+    from spatialflink_tpu.runtime.checkpoint import CheckpointCoordinator
+
+    coord = CheckpointCoordinator(str(tmp_path / "ckpt"),
+                                  every_batches=1)
+    coord.note_batch()
+    assert coord.due() is True
+    f = faults.StallFault(30.0)
+    f.on_window()  # armed + wedged
+    prev = faults.active_stall()
+    try:
+        faults.install_stall(f)
+        # a wedged zombie must not commit manifests its fenced
+        # successor would resume from
+        assert coord.due() is False
+    finally:
+        faults.install_stall(prev)
+    assert coord.due() is True
+
+
+def test_parse_rescale_and_stall_chaos():
+    from spatialflink_tpu.runtime.fleetsup import (_parse_rescale,
+                                                   _parse_stall_chaos)
+
+    assert _parse_rescale(None) == []
+    assert _parse_rescale("300:2,150:3") == [(150, 3), (300, 2)]
+    assert _parse_rescale("100:") == [(100, 1)]
+    assert _parse_stall_chaos(None) is None
+    assert _parse_stall_chaos("1:2.5") == (1, 2.5)
+    assert _parse_stall_chaos("0:") == (0, 30.0)
+
+
+def _bare_supervisor(tmp_path, **over):
+    """A FleetSupervisor shell with just the state the quarantine
+    machinery touches — the unit-test seam for the suspicion state
+    machine (no processes, no routing)."""
+    from spatialflink_tpu.runtime.fleetsup import FleetSupervisor
+
+    sup = FleetSupervisor.__new__(FleetSupervisor)
+    sup._lock = threading.RLock()
+    sup.root = str(tmp_path)
+    sup.heartbeat_s = 0.05
+    sup.quarantine_s = over.get("quarantine_s", 10.0)
+    sup.monitor = None
+    sup.manifest = F.FleetManifest(str(tmp_path / F.MANIFEST_FILE))
+    sup._active = over.get("active", [0, 1])
+    sup._procs = {w: object() for w in sup._active}
+    sup._quarantined = dict(over.get("quarantined", {}))
+    sup._suspicion = {}
+    sup._stall_chaos = None
+    return sup
+
+
+def _write_stale_heartbeat(tmp_path, wid, age_s):
+    wd = F.worker_dir(str(tmp_path), wid)
+    os.makedirs(wd, exist_ok=True)
+    hb = os.path.join(wd, F.HEARTBEAT_FILE)
+    open(hb, "w").write("")
+    old = time.time() - age_s
+    os.utime(hb, (old, old))
+    return hb
+
+
+def test_suspicion_quarantine_enter_and_hysteresis_exit(tmp_path):
+    sup = _bare_supervisor(tmp_path)
+    _write_stale_heartbeat(tmp_path, 0, age_s=60.0)  # slow, not dead
+    _write_stale_heartbeat(tmp_path, 1, age_s=0.0)   # healthy
+    for _ in range(3):
+        sup._suspicion_tick()
+    assert 0 in sup._quarantined, "stale heartbeat never quarantined"
+    assert 1 not in sup._quarantined
+    assert any(e["action"] == "quarantine" and e["worker"] == 0
+               for e in sup.manifest.fleet_quarantine_log)
+    # recovery: fresh beats decay the score; hysteresis exits at <= 1.0
+    _write_stale_heartbeat(tmp_path, 0, age_s=0.0)
+    for _ in range(12):
+        sup._suspicion_tick()
+    assert 0 not in sup._quarantined, "quarantine never lifted"
+    assert any(e["action"] == "unquarantine"
+               for e in sup.manifest.fleet_quarantine_log)
+
+
+def test_suspicion_never_quarantines_last_routable_worker(tmp_path):
+    sup = _bare_supervisor(tmp_path, active=[0, 1],
+                           quarantined={1: time.monotonic()})
+    # BOTH workers look sick — but with 1 already quarantined, 0 is the
+    # last routable worker and must never be drained
+    _write_stale_heartbeat(tmp_path, 0, age_s=60.0)
+    _write_stale_heartbeat(tmp_path, 1, age_s=60.0)
+    for _ in range(6):
+        sup._suspicion_tick()
+    assert 1 in sup._quarantined  # still sick, still quarantined
+    assert 0 not in sup._quarantined, \
+        "quarantined the only remaining routable worker"
+
+
+def test_quarantine_tick_deadline(tmp_path):
+    sup = _bare_supervisor(tmp_path, quarantine_s=0.05,
+                           quarantined={0: time.monotonic()})
+    assert sup._quarantine_tick() == []
+    time.sleep(0.1)
+    assert sup._quarantine_tick() == [0]  # deadline breach: escalate
+
+
 # --------------------------------------------------------- worker argv
 
 
@@ -270,9 +558,17 @@ def test_worker_argv_strips_and_reissues():
     assert argv[argv.index("--input1") + 1].endswith(
         os.path.join("worker2", F.PARTITION_FILE))
     assert argv.count("--resume") == 1
+    # the fence token is always reissued (0 for a never-fenced slot)
+    assert argv[argv.index("--fleet-fence") + 1] == "0"
     no_resume = worker_argv(base, fleet_dir="/f", worker_id=0,
                             heartbeat_s=0.5, resume=False)
     assert "--resume" not in no_resume
+    fenced = worker_argv(base, fleet_dir="/f", worker_id=0,
+                         heartbeat_s=0.5, resume=True, fence=3,
+                         stall_s=2.5)
+    assert fenced[fenced.index("--fleet-fence") + 1] == "3"
+    assert fenced[fenced.index("--fleet-stall-s") + 1] == "2.5"
+    assert "--fleet-stall-s" not in no_resume  # chaos glue is opt-in
 
 
 def test_strip_flags_handles_equals_form():
@@ -375,10 +671,69 @@ def test_fleet_kill_recovery_identity_vs_single_worker(tmp_path):
     assert rc == 0
 
 
+def test_fleet_rescale_zombie_identity(tmp_path):
+    """The elastic-fleet acceptance test: a live N=2→3→2 rescale with
+    worker 0's first incarnation wedged into a writing zombie (stall
+    chaos), fenced+respawned WITHOUT a kill — and the merged window
+    table is byte-identical to a fault-free fixed-N oracle, with the
+    zombie's stale-fence rows counted and dropped (never a merge error)
+    and zero post-warmup recompiles on every incarnation."""
+    cfg = _conf_file(tmp_path)
+    path1 = _write_input(tmp_path, _lines(n_traj=8, steps=80))
+
+    oracle_dir = tmp_path / "fleet1"
+    assert main(_fleet_argv(cfg, path1, oracle_dir, 1)) == 0
+    oracle = _result(oracle_dir)
+    assert oracle["merged_windows"] > 0
+
+    rdir = tmp_path / "rescale"
+    assert main(_fleet_argv(cfg, path1, rdir, 2,
+                            "--fleet-rescale", "150:3,300:2",
+                            "--fleet-chaos-stall", "0:60",
+                            "--fleet-quarantine-s", "1")) == 0
+    got = _result(rdir)
+    assert got["digest"] == oracle["digest"], \
+        "rescale + zombie changed the merged output"
+    o_table = _merged_table(oracle_dir)
+    r_table = _merged_table(rdir)
+    assert [(m["key"], m["records"]) for m in r_table] == \
+        [(m["key"], m["records"]) for m in o_table]
+    # both rescale points were consumed at epoch boundaries
+    assert [(r["n_from"], r["n_to"]) for r in got["rescales"]] == \
+        [(2, 3), (3, 2)]
+    assert got["retired_workers"] == [2]
+    assert got["workers_final"] == 2
+    # the zombie was fenced (never merged) and kept writing past its
+    # cutoff — containment proven by the dropped-row count
+    assert int(got["fences"]["0"]) >= 1, "stall target was never fenced"
+    assert got["stale_fence_rows"] >= 1, \
+        "zombie wrote no stale rows — containment went unexercised"
+    assert got["post_warmup_compiles"] == 0, \
+        "a respawn or rescale silently recompiled"
+    # doctor fleet renders the fence/rescale/quarantine history
+    import io
+
+    from spatialflink_tpu import doctor
+
+    buf = io.StringIO()
+    assert doctor.fleet(str(rdir), as_json=True, out=buf) == 0
+    doc = json.loads(buf.getvalue())
+    assert doc["stale_fence_rows"] >= 1
+    assert len(doc["rescale_log"]) == 2
+    assert any(e["worker"] == 0 for e in doc["fence_log"])
+    buf = io.StringIO()
+    assert doctor.fleet(str(rdir), as_json=False, out=buf) == 0
+    text = buf.getvalue()
+    assert "rescale    2 -> 3" in text and "fence      w0" in text
+
+
 @pytest.mark.slow
 def test_fleet_randomized_kill_fuzz(tmp_path):
     """Randomized kill points: whichever window count the kill lands on,
-    the merged table must match the single-worker oracle."""
+    the merged table must match the single-worker oracle. Half the
+    trials additionally run a randomized live rescale plus a zombie
+    writer (stall chaos on the OTHER worker) — the composed failure
+    modes must still merge to the oracle."""
     cfg = _conf_file(tmp_path)
     path1 = _write_input(tmp_path, _lines(n_traj=8, steps=60))
 
@@ -387,15 +742,21 @@ def test_fleet_randomized_kill_fuzz(tmp_path):
     oracle = _result(oracle_dir)
 
     rng = random.Random(11)
-    for trial in range(3):
+    for trial in range(4):
         wid = rng.randrange(2)
         nth = rng.randint(1, 6)
+        extra = ["--fleet-chaos-kill", f"{wid}:{nth}"]
+        if trial % 2:
+            at1 = rng.randrange(100, 250)
+            at2 = at1 + rng.randrange(100, 200)
+            extra += ["--fleet-rescale", f"{at1}:3,{at2}:2",
+                      "--fleet-chaos-stall", f"{1 - wid}:60",
+                      "--fleet-quarantine-s", "1"]
         fdir = tmp_path / f"fuzz{trial}"
-        assert main(_fleet_argv(cfg, path1, fdir, 2, "--fleet-chaos-kill",
-                                f"{wid}:{nth}")) == 0
+        assert main(_fleet_argv(cfg, path1, fdir, 2, *extra)) == 0
         got = _result(fdir)
         assert got["digest"] == oracle["digest"], \
-            f"trial {trial}: kill {wid}:{nth} changed the merged output"
+            f"trial {trial}: {extra} changed the merged output"
         assert got["post_warmup_compiles"] == 0
 
 

@@ -561,6 +561,76 @@ def bench_query_plane(path: str, n: int, q: int = 32) -> list:
     return rows
 
 
+def bench_tenant_plane(path: str, n: int, q: int = 8) -> list:
+    """Tenant accounting plane rows (ISSUE 20): the same Q-query dynamic
+    registry fleet (two tenants, Q/2 queries each) over the same replay
+    with the ledger OFF (no telemetry session — the gated hot path) vs
+    ON (a telemetry session: per-dispatch ``note_dispatch`` + the
+    proportional ``resolve`` split). Window-table identity is asserted
+    in the same run — attribution is bookkeeping, never a semantics
+    change — and the on-row carries the ledger's own conservation stats
+    (resolved == dispatched, max residual from the exact-split fold)."""
+    from spatialflink_tpu import driver
+    from spatialflink_tpu.config import StreamConfig
+    from spatialflink_tpu.operators import (PointPointRangeQuery,
+                                            QueryConfiguration, QueryType)
+    from spatialflink_tpu.runtime.queryplane import QueryRegistry
+    from spatialflink_tpu.utils import telemetry as _telemetry
+    from spatialflink_tpu.utils.telemetry import telemetry_session
+
+    import numpy as np
+
+    with open(path) as f:
+        lines = f.read().splitlines()
+    cfg = StreamConfig(format="CSV", date_format=None,
+                       csv_tsv_schema=[0, 1, 2, 3])
+    grid = _params(1).grids()[0]
+    conf = QueryConfiguration(QueryType.WindowBased,
+                              int(WINDOW_S * 1000), int(SLIDE_S * 1000))
+    rng = np.random.default_rng(9)
+    pts = [(float(grid.min_x + rng.random() * (grid.max_x - grid.min_x)),
+            float(grid.min_y + rng.random() * (grid.max_y - grid.min_y)))
+           for _ in range(q)]
+
+    def run():
+        reg = QueryRegistry("range", radius=0.5)
+        for i, (x, y) in enumerate(pts):
+            reg.admit({"id": f"q{i}", "x": x, "y": y,
+                       "tenant": "acme" if i % 2 == 0 else "free"})
+        reg.apply()
+        op = PointPointRangeQuery(conf, grid)
+        stream = driver.decode_stream(iter(lines), cfg, grid)
+        t0 = time.perf_counter()
+        table = [(w.window_start, tuple(len(r) for r in w.records))
+                 for w in op.run_dynamic(stream, reg, 0.5)]
+        return table, time.perf_counter() - t0
+
+    run()  # warm the Q-bucket's jit shapes both configurations share
+    assert _telemetry.active() is None
+    table_off, dt_off = run()
+    with telemetry_session() as tel:
+        table_on, dt_on = run()
+        ledger = tel.tenants.to_dict()
+    assert table_on == table_off, (
+        "tenant ledger changed the window table — attribution must be "
+        "bookkeeping, not semantics")
+    assert ledger["resolved"] > 0 and ledger["pending"] == 0
+    assert ledger["max_residual_ms"] < 1e-6, ledger["max_residual_ms"]
+    base = dict(records=n, queries=q, windows=len(table_off),
+                identical=True)
+    return [
+        dict(base, path="tenant_plane_off", wall_s=round(dt_off, 3),
+             records_per_sec=round(n / dt_off)),
+        dict(base, path="tenant_plane_on", wall_s=round(dt_on, 3),
+             records_per_sec=round(n / dt_on),
+             overhead_vs_off=round(dt_on / dt_off - 1.0, 4),
+             tenants=sorted(ledger["tenants"]),
+             dispatches_resolved=ledger["resolved"],
+             max_residual_ms=ledger["max_residual_ms"],
+             fairness=ledger["fairness"]),
+    ]
+
+
 def bench_fleet(n: int) -> list:
     """Supervised multi-worker fleet rows (``--fleet``): wall clock and
     records/s for N=1/2/4 worker fleets over the 95%-hot clustered
@@ -739,6 +809,13 @@ def main() -> int:
                          "plus a Q-sweep amortization row through the "
                          "registry path vs dedicated per-query pipelines. "
                          "0 (default) disables them")
+    ap.add_argument("--tenant-plane", action="store_true",
+                    help="tenant accounting plane overhead rows: the same "
+                         "two-tenant dynamic registry fleet with the "
+                         "per-dispatch cost ledger off (no telemetry "
+                         "session) vs on, window-table identity asserted "
+                         "in-run; the on-row carries the ledger's "
+                         "conservation stats")
     ap.add_argument("--fleet", action="store_true",
                     help="supervised multi-worker fleet rows: a single-"
                          "process reference run vs --fleet N=1/2/4 worker "
@@ -850,6 +927,11 @@ def main() -> int:
                     rows.append(row)
         if args.query_plane > 1:
             for row in bench_query_plane(path, n, args.query_plane):
+                _stamp(row)
+                print(json.dumps(row), flush=True)
+                rows.append(row)
+        if args.tenant_plane:
+            for row in bench_tenant_plane(path, n):
                 _stamp(row)
                 print(json.dumps(row), flush=True)
                 rows.append(row)

@@ -78,6 +78,16 @@ and one fleet overhead row gated by a third lower-is-better pass
                         carries a 3x margin (absolute ms is machine-
                         sensitive in a way the speedup ratios are not)
 
+and one tenant-ledger overhead row gated by a fourth lower-is-better
+pass (``--metric overhead_vs_off_x`` against the ``tenant_rows``
+ceiling):
+
+- ``tenant_plane``      the per-dispatch tenant cost ledger on vs off
+                        over the same two-tenant dynamic fleet replay
+                        (pinned record count): the gated ratio is the
+                        on/off wall, window-table identity AND the
+                        ledger's conservation invariants asserted in-run
+
 Usage:
     python benchmarks/bench_guard.py [--n N] [--out PATH]
     python benchmarks/bench_guard.py --check          # exit 1 on regression
@@ -116,6 +126,11 @@ LATENCY_MARGIN = 3.0
 #: margin) — worker process spawn and the supervisor's per-line routing
 #: are machine-sensitive absolute costs, so the margin is generous
 FLEET_MARGIN = 3.0
+#: the tenant row's CEILING margin on the ledger-on/ledger-off wall
+#: ratio (lower-is-better): the measured overhead sits near 1.0, so the
+#: ceiling multiplies a ratio, not an absolute, and stays tight enough
+#: that a ledger regressed to per-record cost fails the gate
+TENANT_MARGIN = 1.5
 
 
 def _lines(n: int):
@@ -739,12 +754,75 @@ def bench_fleet_rescale(n: int) -> dict:
         shutil.rmtree(td, ignore_errors=True)
 
 
+def bench_tenant_plane(n: int) -> dict:
+    """Tenant-ledger overhead gate (ISSUE 20, lower-is-better): the same
+    two-tenant Q=8 dynamic registry fleet over the same replay with the
+    per-dispatch cost ledger OFF (no telemetry session — the gated hot
+    path) vs ON (telemetry session: ``note_dispatch`` + the proportional
+    ``resolve`` split, host-side arithmetic on already-materialized
+    masks). The GATED metric is the on/off wall ratio
+    (``overhead_vs_off_x``) at a PINNED record count against a generous
+    ceiling — attribution must stay bookkeeping-priced. Window-table
+    identity and the ledger's own conservation invariants (every
+    dispatch resolved, zero residual from the exact-split fold) are
+    asserted in-run, so a ledger that got cheap by dropping spans or
+    changing results can never pass."""
+    from spatialflink_tpu import driver
+    from spatialflink_tpu.operators import (PointPointRangeQuery,
+                                            QueryConfiguration, QueryType)
+    from spatialflink_tpu.runtime.queryplane import QueryRegistry
+    from spatialflink_tpu.utils import telemetry as _telemetry
+    from spatialflink_tpu.utils.telemetry import telemetry_session
+
+    n = 60_000  # pinned: the overhead ratio mixes per-dispatch ledger
+    # cost into a fixed windowed workload
+    lines = _lines(n)
+    cfg, grid = _cfg(), _grid()
+    conf = QueryConfiguration(QueryType.WindowBased, 10_000, 5_000)
+    rng = np.random.default_rng(11)
+    q = 8
+    pts = [(115.5 + rng.random() * 2, 39.6 + rng.random() * 1.5)
+           for _ in range(q)]
+
+    def run():
+        reg = QueryRegistry("range", radius=0.5)
+        for i, (x, y) in enumerate(pts):
+            reg.admit({"id": f"q{i}", "x": x, "y": y,
+                       "tenant": "acme" if i % 2 == 0 else "free"})
+        reg.apply()
+        op = PointPointRangeQuery(conf, grid)
+        stream = driver.decode_stream(iter(lines), cfg, grid)
+        t0 = time.perf_counter()
+        table = [(w.window_start, tuple(len(r) for r in w.records))
+                 for w in op.run_dynamic(stream, reg, 0.5)]
+        return table, time.perf_counter() - t0
+
+    run()  # warm the Q-bucket's jit shapes both configurations share
+    assert _telemetry.active() is None
+    table_off, dt_off = run()
+    with telemetry_session() as tel:
+        table_on, dt_on = run()
+        ledger = tel.tenants.to_dict()
+    assert table_on == table_off, (
+        "tenant ledger changed the window table — attribution must be "
+        "bookkeeping, not semantics")
+    assert ledger["resolved"] > 0 and ledger["pending"] == 0
+    assert ledger["late_resolves"] == 0
+    assert ledger["max_residual_ms"] < 1e-6, ledger["max_residual_ms"]
+    assert set(ledger["tenants"]) == {"acme", "free"}
+    return dict(path="tenant_plane", records=n, queries=q,
+                overhead_vs_off_x=round(dt_on / dt_off, 2),
+                dispatches_resolved=ledger["resolved"],
+                max_residual_ms=ledger["max_residual_ms"])
+
+
 def measure(n: int) -> list:
     return [bench_window_assign(n), bench_decode_columnar(n),
             bench_windowed_pipeline(n), bench_skew_adaptive(n),
             bench_query_plane(n), bench_controller_pareto(n),
             bench_realtime_vectorized(n), bench_latency_record_emit(n),
-            bench_fleet_scaling(n), bench_fleet_rescale(n)]
+            bench_fleet_scaling(n), bench_fleet_rescale(n),
+            bench_tenant_plane(n)]
 
 
 def main() -> int:
@@ -774,6 +852,7 @@ def main() -> int:
     speed_rows = [r for r in rows if "speedup" in r]
     lat_rows = [r for r in rows if "p99_ms" in r]
     fleet_rows = [r for r in rows if "wall_fleet1_s" in r]
+    tenant_rows = [r for r in rows if "overhead_vs_off_x" in r]
 
     if args.write_baseline:
         floors = [dict(path=r["path"],
@@ -789,6 +868,11 @@ def main() -> int:
                                wall_fleet1_s=round(
                                    r["wall_fleet1_s"] * FLEET_MARGIN, 1))
                           for r in fleet_rows]
+        tenant_ceilings = [dict(path=r["path"],
+                                overhead_vs_off_x=round(
+                                    max(r["overhead_vs_off_x"], 1.0)
+                                    * TENANT_MARGIN, 2))
+                           for r in tenant_rows]
         with open(BASELINE_PATH, "w") as f:
             json.dump({"metric": "speedup",
                        "note": "conservative floors = measured/%.1f "
@@ -799,11 +883,18 @@ def main() -> int:
                                "lower-is-better CEILINGS = measured x "
                                "%.1f (metric wall_fleet1_s: absolute "
                                "single-worker supervised-fleet wall at "
-                               "the pinned record count)"
+                               "the pinned record count); tenant_rows is "
+                               "a lower-is-better CEILING = max(measured, "
+                               "1.0) x %.1f (metric overhead_vs_off_x: "
+                               "the tenant ledger's on/off wall ratio at "
+                               "the pinned record count, identity + "
+                               "conservation asserted in-run)"
                                % (MARGIN, MARGIN_BY_PATH["skew_adaptive"],
-                                  LATENCY_MARGIN, FLEET_MARGIN),
+                                  LATENCY_MARGIN, FLEET_MARGIN,
+                                  TENANT_MARGIN),
                        "rows": floors, "latency_rows": ceilings,
-                       "fleet_rows": fleet_ceilings},
+                       "fleet_rows": fleet_ceilings,
+                       "tenant_rows": tenant_ceilings},
                       f, indent=1)
         print(f"# wrote {BASELINE_PATH}", file=sys.stderr)
         return 0
@@ -848,7 +939,12 @@ def main() -> int:
         # lower-is-better (metric wall_fleet1_s)
         rc_fleet = run_diff(base.get("fleet_rows", []), fleet_rows,
                             "wall_fleet1_s", ["--lower-is-better"])
-        return rc or rc_lat or rc_fleet
+        # fourth pass: the tenant-ledger overhead ceiling (lower-is-
+        # better ratio — the accounting plane must stay bookkeeping-
+        # priced on the dispatch hot path)
+        rc_tenant = run_diff(base.get("tenant_rows", []), tenant_rows,
+                             "overhead_vs_off_x", ["--lower-is-better"])
+        return rc or rc_lat or rc_fleet or rc_tenant
     return 0
 
 

@@ -29,9 +29,9 @@ from spatialflink_tpu.analysis.rules.common import dotted, is_none_guarded
 #: attribute names that cache a session on an instance.
 _SESSION_ATTRS = {"_tel", "tel"}
 #: session facets that are themselves Optional (opt-in planes): names
-#: bound from ``tel.latency``/``tel.costs``/``tel.traces`` inherit the
-#: gating obligation.
-_DERIVED_ATTRS = {"latency", "costs", "traces"}
+#: bound from ``tel.latency``/``tel.costs``/``tel.traces``/``tel.tenants``
+#: inherit the gating obligation.
+_DERIVED_ATTRS = {"latency", "costs", "traces", "tenants"}
 
 
 def _is_active_call(node: ast.AST) -> bool:
@@ -93,7 +93,8 @@ class TelemetryGatingRule(Rule):
     severity = "error"
     scope = ("spatialflink_tpu/streams/*.py",
              "spatialflink_tpu/runtime/windows.py",
-             "spatialflink_tpu/operators/base.py")
+             "spatialflink_tpu/operators/base.py",
+             "spatialflink_tpu/utils/accounting.py")
 
     def check(self, mod: ModuleSource,
               project=None) -> Iterator[Finding]:

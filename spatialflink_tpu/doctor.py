@@ -19,6 +19,10 @@ BEFORE and AFTER a run:
   way ``bench_diff`` refuses them), counter deltas, compile/recompile
   deltas, health verdicts side by side. Exit 0; structural problems
   (unreadable bundle, schema mismatch) exit 2.
+- ``tenants BUNDLE`` — the per-tenant cost table from a bundle's
+  ``tenants.json``: attributed kernel-ms (+ share), bytes, records,
+  windows, SLO/shed/quota counters, the fairness line, and the worst
+  attribution residual — "who was paying for the pipeline when it died".
 
 All output is line-oriented text by default; ``--json`` emits one JSON
 document instead (machine-readable — the same dict the text renders).
@@ -337,6 +341,60 @@ def preflight(require_backend: str = "tpu", as_json: bool = False,
     return 0 if not failed else 1
 
 
+def tenants(path: str, as_json: bool = False, out=None) -> int:
+    """The per-tenant cost table of one bundle's ``tenants.json`` —
+    the post-mortem answer to "who was paying when it died": attributed
+    kernel-ms with shares, bytes moved, records in/out, windows, and the
+    SLO/shed/quota counters, plus the fairness summary and the worst
+    per-dispatch attribution residual (the conservation check)."""
+    out = sys.stdout if out is None else out
+    b = load_bundle(path)
+    ten = b.get("tenants") or {}
+    rows = ten.get("tenants") or {}
+    doc = {"path": path, "tenants": rows,
+           "fairness": ten.get("fairness"),
+           "default_tenant": ten.get("default_tenant"),
+           "pending": ten.get("pending"),
+           "max_residual_ms": ten.get("max_residual_ms")}
+    if as_json:
+        print(json.dumps(doc, sort_keys=True), file=out)
+        return 0
+    print(f"bundle     {path}", file=out)
+    if not rows:
+        print("tenants    (no tenant ledger in this bundle — no telemetry "
+              "session at dump time)", file=out)
+        return 0
+    total_ms = sum(float(r.get("kernel_ms") or 0.0) for r in rows.values())
+    print(f"{'tenant':<16} {'kernel ms':>10} {'share':>6} {'bytes':>12} "
+          f"{'rec in':>9} {'rec out':>8} {'windows':>8} {'slo':>4} "
+          f"{'shed':>5} {'quota':>6}", file=out)
+    for t, r in sorted(rows.items(),
+                       key=lambda kv: -float(kv[1].get("kernel_ms") or 0.0)):
+        kms = float(r.get("kernel_ms") or 0.0)
+        share = f"{kms / total_ms * 100:.0f}%" if total_ms else "-"
+        print(f"{t:<16} {kms:>10.1f} {share:>6} "
+              f"{int(r.get('bytes_moved') or 0):>12} "
+              f"{int(r.get('records_in') or 0):>9} "
+              f"{int(r.get('records_out') or 0):>8} "
+              f"{int(r.get('windows') or 0):>8} "
+              f"{int(r.get('slo_breaches') or 0):>4} "
+              f"{int(r.get('shed') or 0):>5} "
+              f"{int(r.get('quota_rejections') or 0):>6}", file=out)
+    fair = ten.get("fairness") or {}
+    if fair.get("top") is not None:
+        print(f"fairness   top {fair.get('top')} "
+              f"({(fair.get('top_share') or 0.0) * 100:.0f}%), max/min "
+              f"share {(fair.get('max_share') or 0.0) * 100:.0f}%/"
+              f"{(fair.get('min_share') or 0.0) * 100:.0f}%, "
+              f"gini {fair.get('gini') or 0.0:.2f}", file=out)
+    resid = ten.get("max_residual_ms")
+    if resid is not None:
+        print(f"residual   max attribution residual {float(resid):.6f} ms "
+              "(per-dispatch conservation: attributed sums to measured)",
+              file=out)
+    return 0
+
+
 def fleet(path: str, as_json: bool = False, out=None) -> int:
     """One table over a whole fleet directory: per worker, every
     incarnation's run summary (``runs.jsonl``), the newest post-mortem
@@ -558,6 +616,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     d = sub.add_parser("diff", help="compare two bundles")
     d.add_argument("bundle_a")
     d.add_argument("bundle_b")
+    tn = sub.add_parser("tenants", help="per-tenant cost table from one "
+                                        "bundle: attributed kernel-ms "
+                                        "shares, quota/shed counters, "
+                                        "fairness, attribution residual")
+    tn.add_argument("bundle")
     fl = sub.add_parser("fleet", help="one table over a --fleet-dir: "
                                       "who died, restarts, recompiles, "
                                       "per-worker p99, the end-to-end "
@@ -572,6 +635,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             return summarize(args.bundle, as_json=args.json)
         if args.cmd == "fleet":
             return fleet(args.fleet_dir, as_json=args.json)
+        if args.cmd == "tenants":
+            return tenants(args.bundle, as_json=args.json)
         return diff(args.bundle_a, args.bundle_b, as_json=args.json)
     except ValueError as e:
         print(f"doctor: {e}", file=sys.stderr)

@@ -1735,6 +1735,30 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "bounds the pipeline queue depth to "
                          "fast_lane_depth so interactive emits never park "
                          "behind throughput amortization")
+    ap.add_argument("--tenant-default", metavar="NAME", default="default",
+                    dest="tenant_default",
+                    help="tenant charged for queries that omit 'tenant' "
+                         "(and for dispatch cost no standing query claims, "
+                         "e.g. static single-query runs). Per-tenant "
+                         "attributed kernel-ms/bytes, records, windows, "
+                         "SLO/shed/quota counters and the fairness summary "
+                         "serve at GET /tenants (+ /tenants/<id>, "
+                         "tenant=\"T\" Prometheus labels, /fleet/tenants "
+                         "on the supervisor); attribution splits each "
+                         "measured dispatch across live fleet slots by "
+                         "candidate work and sums to the measured span by "
+                         "construction")
+    ap.add_argument("--tenant-quota", metavar="SPEC", action="append",
+                    default=None, dest="tenant_quota",
+                    help="admission quota per tenant as "
+                         "'T:max_active[,kernel_ms_s=X]' (repeatable, or "
+                         "';'-separated). max_active caps the tenant's "
+                         "held query slots (pending+active+draining+shed); "
+                         "kernel_ms_s caps its recent attributed kernel-ms "
+                         "per second. A breach answers POST /queries with "
+                         "429 quota-exceeded and creates NO entry — unlike "
+                         "backpressure shedding, which parks the spec and "
+                         "auto-admits when pressure clears")
     ap.add_argument("--multi-query", action="store_true",
                     help="answer ALL configured query points/geometries in "
                          "one dispatch per window (run_multi; default keeps "
@@ -2111,6 +2135,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                   f"{args.adaptive_grid}x{args.adaptive_grid}, repartition "
                   f"epoch every {args.repartition_interval} records "
                   "(layout at /partition)", file=sys.stderr)
+    # tenant quotas parse up front: a malformed SPEC is a flag error, not a
+    # mid-run surprise at first admission
+    tenant_quotas = {}
+    if getattr(args, "tenant_quota", None):
+        from spatialflink_tpu.utils.accounting import parse_tenant_quotas
+
+        try:
+            tenant_quotas = parse_tenant_quotas(";".join(args.tenant_quota))
+        except ValueError as e:
+            ap.error(f"--tenant-quota: {e}")
     if dynamic_queries:
         from spatialflink_tpu.runtime.queryplane import (QueryRegistry,
                                                          QuerySpec,
@@ -2150,7 +2184,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                   file=sys.stderr)
         registry = QueryRegistry(spec.family, radius=params.query.radius,
                                  k=params.query.k,
-                                 default_latency_class=args.latency_class)
+                                 default_latency_class=args.latency_class,
+                                 default_tenant=args.tenant_default,
+                                 tenant_quotas=tenant_quotas)
         coord = getattr(params, "checkpointer", None)
         restored = bool(coord is not None
                         and registry.register_checkpoint(coord))
@@ -2164,7 +2200,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                 if args.queries_file:
                     seeds = load_queries_file(
                         args.queries_file, spec.family,
-                        default_latency_class=args.latency_class)
+                        default_latency_class=args.latency_class,
+                        default_tenant=args.tenant_default)
             except (OSError, ValueError) as e:
                 ap.error(f"--queries-file: {e}")
             if not seeds and params.query.query_points:
@@ -2287,8 +2324,17 @@ def _run_cli(ap, args, params: Params, spec: CaseSpec, skip1: int,
     the telemetry session can scope the whole run."""
     from spatialflink_tpu.streams.sinks import StdoutSink
     from spatialflink_tpu.streams.sources import FileReplaySource
+    from spatialflink_tpu.utils import telemetry as _telemetry
 
     coord = getattr(params, "checkpointer", None)
+    tel = _telemetry.active()
+    if tel is not None:
+        # the ledger's catch-all tenant follows the flag; on resume the
+        # 'tenants' checkpoint component restores cumulative attribution
+        tel.tenants.default_tenant = getattr(args, "tenant_default",
+                                             "default") or "default"
+        if coord is not None:
+            tel.tenants.register_checkpoint(coord)
     wctx = None
     if getattr(args, "fleet_role", None) == "worker":
         from spatialflink_tpu.runtime.fleet import WorkerContext

@@ -1279,6 +1279,9 @@ class SpatialOperator:
         exact."""
         import jax.numpy as jnp
 
+        from spatialflink_tpu.utils import telemetry as _telemetry
+
+        label = self.telemetry_label or type(self).__name__
         state: dict = {"v": -1, "entries": [], "live": 0, "fn": None,
                        "mask_cache": None}
 
@@ -1327,9 +1330,25 @@ class SpatialOperator:
             masks, gn_c, evals = self._multi_filter_stream(batch, state["fn"])
             take = getattr(records, "take", None)
             limit = keep.size if keep is not None else len(records)
+            tel = _telemetry.active()
+            acct = tel.tenants if tel is not None else None
+            # (id, tenant) per live slot, captured NOW: a later apply()
+            # may repad before the deferred demux runs
+            slots = ([(e.id, e.spec.tenant) for e in state["entries"]]
+                     if acct is not None else None)
 
             def rows(m):
                 m = np.asarray(m)  # ONE (B, N) device->host transfer
+                if acct is not None:
+                    # resolve the parked dispatch span across the live
+                    # slots proportional to mask-true candidate work —
+                    # padded slots (rows >= live) and padded record
+                    # columns (>= limit) never weigh in; host-side sums
+                    # on the already-transferred masks, no device ops
+                    weights = m[:live, :limit].sum(axis=1)
+                    acct.resolve(label, ts_base, [
+                        (qid, tenant, int(c))
+                        for (qid, tenant), c in zip(slots, weights)])
                 out = []
                 for q in range(live):
                     idx = np.nonzero(m[q])[0]
@@ -1489,6 +1508,7 @@ class SpatialOperator:
         book = tel.traces if tel is not None else None
         costs = tel.costs if tel is not None else None
         lat = tel.latency if tel is not None else None
+        acct = tel.tenants if tel is not None else None
         if tel is not None:
             backlog = tel.gauge("window-backlog")
             # per-window dispatch→ready overlap: 1 − blocked/round-trip —
@@ -1577,9 +1597,14 @@ class SpatialOperator:
                 if book is not None:
                     book.note(label, start, "kernel", w0, w1)
                 if costs is not None:
+                    nb = self._payload_nbytes(payload)
                     costs.attribute_kernel(
-                        label, w1 - w0, records=count(payload),
-                        nbytes=self._payload_nbytes(payload))
+                        label, w1 - w0, records=count(payload), nbytes=nb)
+                    # park the measured span on the tenant ledger; the
+                    # dynamic demux (rows()) resolves it across the live
+                    # slots, static paths age into the default tenant
+                    acct.note_dispatch(label, start, w1 - w0,
+                                       count(payload), nb)
                 meta = (fi, li, min(t_seal, w0), w0, w1)
             else:
                 meta = None

@@ -265,7 +265,10 @@ class PointPointKNNQuery(SpatialOperator):
         ``_run_dynamic_filter``'s rationale)."""
         import numpy as np
 
+        from spatialflink_tpu.utils import telemetry as _telemetry
+
         k = k or self.conf.k
+        label = self.telemetry_label or type(self).__name__
         state: dict = {"v": -1, "entries": [], "live": 0, "local": None,
                        "jvalid": None}
 
@@ -295,11 +298,25 @@ class PointPointKNNQuery(SpatialOperator):
             res, evals = self._knn_multi_result(batch, state["local"], k)
             ri = getattr(records, "interner", None)
             interner = ri if ri is not None else self.interner
+            tel = _telemetry.active()
+            acct = tel.tenants if tel is not None else None
+            # (id, tenant) per live slot, captured NOW: a later apply()
+            # may repad before the deferred demux runs
+            slots = ([(e.id, e.spec.tenant) for e in state["entries"]]
+                     if acct is not None else None)
 
             def rows(r):
                 valid = np.asarray(r.valid)
                 oids = np.asarray(r.obj_id)
                 dists = np.asarray(r.dist)
+                if acct is not None:
+                    # resolve the parked dispatch span across live slots
+                    # proportional to each slot's valid-neighbor count —
+                    # padded slots (rows >= live) never weigh in
+                    weights = valid[:live].sum(axis=1)
+                    acct.resolve(label, ts_base, [
+                        (qid, tenant, int(c))
+                        for (qid, tenant), c in zip(slots, weights)])
                 return [
                     [(interner.lookup(int(o)), float(d))
                      for o, d in zip(oids[q][valid[q]], dists[q][valid[q]])]

@@ -40,9 +40,10 @@ process:
   stage of the end-to-end record→merged-emit lineage
   (:func:`compute_merged_lineage`), persisted as ``fleet_latency.json``.
   The supervisor's opserver federates it all: ``/fleet/latency``,
-  ``/fleet/timeline``, ``/fleet/events``, and ``/fleet/metrics`` (every
+  ``/fleet/timeline``, ``/fleet/events``, ``/fleet/metrics`` (every
   worker's Prometheus text relabeled with ``worker="wN"`` — one scrape
-  point). On worker death the fleet view is snapshotted next to the dead
+  point), and ``/fleet/tenants`` (every worker's tenant cost ledger
+  merged, fleet-wide fairness recomputed). On worker death the fleet view is snapshotted next to the dead
   worker's flight-recorder bundle (``postmortem/fleet_view.json``).
 - **Rebalance** — at repartition epochs the supervisor compares worker
   loads (the monitor's retained latency/backlog series when present,
@@ -376,6 +377,12 @@ class FleetMonitor:
         #: ``rebalance-request``); the routing loop pops it and forces an
         #: early epoch boundary
         self._rebalance_requested = False
+        #: per-worker (run_id, snapshot_seq) high-water mark — polls racing
+        #: across the thread pool can land out of order; a snapshot whose
+        #: seq is <= the one already ingested for the same run is stale and
+        #: must not append a time-travelling sample
+        self._snap_seen: Dict[int, Tuple[str, int]] = {}
+        self.stale_polls = 0
         self._ev_f = open(os.path.join(root, F.EVENTS_FILE), "a")
 
     # ------------------------- the timeline ------------------------- #
@@ -484,7 +491,21 @@ class FleetMonitor:
             "recompiles": (st.get("device") or {}).get("recompiles"),
             "restarts": None,  # filled by the supervisor's view, not here
         }
+        run_id = (status or {}).get("run_id")
+        snap_seq = (status or {}).get("snapshot_seq")
         with self._lock:
+            if isinstance(run_id, str) and isinstance(snap_seq, int):
+                seen_run, seen_seq = self._snap_seen.get(wid, ("", 0))
+                if run_id == seen_run and snap_seq <= seen_seq:
+                    # an older snapshot of the same worker process arrived
+                    # after a newer one — drop it rather than letting the
+                    # series (and the rebalance policy reading its tail)
+                    # step backwards
+                    self.stale_polls += 1
+                    return
+                # a new run_id is a restarted worker: its seqs restart at
+                # 1, so the high-water mark resets with it
+                self._snap_seen[wid] = (run_id, snap_seq)
             dq = self._series.get(wid)
             if dq is None:
                 dq = self._series.setdefault(wid, deque(maxlen=256))
@@ -1783,6 +1804,46 @@ class FleetSupervisor:
         ]
         return "\n".join(lines) + "\n"
 
+    def fleet_tenants_payload(self) -> dict:
+        """``GET /fleet/tenants``: every live worker's ``/tenants`` ledger
+        fetched concurrently within the poll deadline and merged — rows
+        summed per tenant, fleet-wide fairness recomputed over the merged
+        kernel-ms shares (``utils.accounting.merge_tenant_payloads``).
+        Like ``/fleet/metrics``, needs only the worker URLs the supervisor
+        already resolves — not the observability monitor."""
+        from spatialflink_tpu.utils import accounting as _accounting
+
+        with self._lock:
+            urls = dict(self._urls)
+            all_wids = sorted(self._all)
+        for wid in all_wids:
+            if wid not in urls:
+                url = self._resolve_url(wid)
+                if url:
+                    urls[wid] = url
+        deadline = max(0.5, min(2.0, self.heartbeat_s))
+        futs = []
+        try:
+            for wid, url in sorted(urls.items()):
+                futs.append((wid, self._poll_pool.submit(
+                    _http_json, f"{url}/tenants", deadline)))
+        except RuntimeError:
+            futs = []  # pool shut down: supervisor exiting
+        payloads = []
+        polled = 0
+        for wid, fut in futs:
+            try:
+                body = fut.result(timeout=deadline + 1.0)
+            except Exception:
+                body = None
+            if isinstance(body, dict):
+                polled += 1
+                if body.get("tenants"):
+                    payloads.append(body)
+        merged = _accounting.merge_tenant_payloads(payloads)
+        merged["workers_polled"] = polled
+        return merged
+
     # -------------------------------------------------------------- #
     # run
 
@@ -2017,7 +2078,7 @@ def run_supervisor(args, params, spec, base_argv: List[str]) -> int:
         server = OpServer(port=args.status_port).start()
         print(f"# fleet opserver: {server.url}/fleet "
               "(+ /fleet/latency /fleet/timeline /fleet/events "
-              "/fleet/metrics)", flush=True)
+              "/fleet/metrics /fleet/tenants)", flush=True)
     live = None
     if getattr(args, "live_stats", False):
         live = FleetLiveStats(

@@ -29,6 +29,11 @@ module adds that plane, stdlib-only:
                    query (schema-validated JSON body, lands at the next
                    window boundary) — the dynamic query plane
   /queries/<id>    GET: one query's lifecycle record; DELETE: drain it
+  /tenants         per-tenant cost ledger: attributed kernel-ms/bytes,
+                   records in/out, windows, SLO/shed/quota counts, the
+                   fairness summary (top payer, shares, Gini), and the
+                   bounded delta time series (utils.accounting)
+  /tenants/<id>    one tenant's row + its kernel-ms series and rate
   /fleet           supervisor's aggregated per-worker view (fleet runs):
                    liveness, restarts, routing — plus the elastic-fleet
                    state (per-worker fence tokens, quarantine flags and
@@ -42,6 +47,8 @@ module adds that plane, stdlib-only:
   /fleet/events    same ring with worker-style ``?since=`` cursors
   /fleet/metrics   every worker's Prometheus text relabeled with
                    ``worker="wN"`` + fleet gauges — one scrape point
+  /fleet/tenants   every worker's /tenants ledger harvested and merged
+                   (summed rows, fleet-wide fairness recomputed)
   =============== ====================================================
 
 Method handling is uniform: a known route hit with a verb outside its
@@ -95,18 +102,21 @@ _ROUTES = {
     "/events": ("GET",), "/trace/recent": ("GET",),
     "/profile/cells": ("GET",), "/partition": ("GET",),
     "/queries": ("GET", "POST"),
+    "/tenants": ("GET",),
     "/device": ("GET",), "/compile": ("GET",), "/latency": ("GET",),
     "/fleet": ("GET",), "/fleet/latency": ("GET",),
     "/fleet/timeline": ("GET",), "/fleet/events": ("GET",),
-    "/fleet/metrics": ("GET",),
+    "/fleet/metrics": ("GET",), "/fleet/tenants": ("GET",),
 }
-_PREFIX_ROUTES = {"/trace/": ("GET",), "/queries/": ("GET", "DELETE")}
+_PREFIX_ROUTES = {"/trace/": ("GET",), "/queries/": ("GET", "DELETE"),
+                  "/tenants/": ("GET",)}
 
 _ENDPOINTS = ["/healthz", "/status", "/metrics", "/events", "/trace/recent",
               "/trace/<id>", "/profile/cells", "/partition", "/queries",
-              "/queries/<id>", "/device", "/compile", "/latency", "/fleet",
+              "/queries/<id>", "/tenants", "/tenants/<id>", "/device",
+              "/compile", "/latency", "/fleet",
               "/fleet/latency", "/fleet/timeline", "/fleet/events",
-              "/fleet/metrics"]
+              "/fleet/metrics", "/fleet/tenants"]
 
 
 def _allowed_methods(path: str):
@@ -246,6 +256,8 @@ class _Handler(BaseHTTPRequestHandler):
         elif path == "/fleet/metrics":
             self._send(200, srv.fleet_metrics_text().encode(),
                        "text/plain; version=0.0.4")
+        elif path == "/fleet/tenants":
+            self._send_json(200, srv.fleet_tenants_payload())
         elif path == "/device":
             self._send_json(200, srv.device_payload())
         elif path == "/compile":
@@ -267,6 +279,12 @@ class _Handler(BaseHTTPRequestHandler):
                 code, payload = srv.retire_query_payload(qid)
             else:
                 code, payload = srv.query_payload(qid)
+            self._send_json(code, payload)
+        elif path == "/tenants":
+            self._send_json(200, srv.tenants_payload())
+        elif path.startswith("/tenants/"):
+            code, payload = srv.tenant_payload(
+                unquote(path[len("/tenants/"):]))
             self._send_json(code, payload)
         else:  # unreachable while _ROUTES and this dispatch agree
             self._send_json(404, {"error": f"unknown path {path!r}",
@@ -376,8 +394,13 @@ class OpServer:
     def traces_payload(self) -> dict:
         book = self._trace_book()
         if book is None:
-            return {"traces": [], "total": 0, "note": self._TRACE_NOTE}
-        return {"traces": book.recent(), "total": book.total}
+            return {"traces": [], "total": 0, "evicted": 0, "latest_seq": 0,
+                    "note": self._TRACE_NOTE}
+        # evicted/latest_seq make ring overflow visible: a poller that sees
+        # latest_seq jump by more than len(traces) knows the ring wrapped
+        # and windows silently fell out between polls
+        return {"traces": book.recent(), "total": book.total,
+                "evicted": book.evicted, "latest_seq": book.total}
 
     def trace_payload(self, trace_id: str):
         """(http_code, payload) for ``/trace/<id>``."""
@@ -450,6 +473,7 @@ class OpServer:
         live one. Takes effect at the next window boundary."""
         from spatialflink_tpu.runtime.queryplane import (QuerySpecError,
                                                          QueryState)
+        from spatialflink_tpu.utils.accounting import QuotaExceeded
 
         reg = self._registry()
         if reg is None:
@@ -458,6 +482,13 @@ class OpServer:
             entry = reg.admit(body)
         except QuerySpecError as e:
             return 400, {"error": str(e)}
+        except QuotaExceeded as e:
+            # quota refusal is NOT shedding: shed parks the spec and
+            # auto-admits later; a quota breach creates no entry at all —
+            # the tenant must retire a query (or the operator must raise
+            # --tenant-quota) before retrying
+            return 429, {"error": f"quota-exceeded: {e}",
+                         "tenant": e.tenant}
         if entry.state is QueryState.SHED:
             # admission shedding: the chunk governor saw sustained
             # backpressure stalls and flipped the registry into shedding —
@@ -487,6 +518,34 @@ class OpServer:
                                   f"{qid!r} (see /queries)"}
         return 200, {"query": entry.to_dict(),
                      "fleet_version": reg.fleet_version}
+
+    # ---------------------- tenant accounting plane -------------------- #
+
+    _TENANTS_NOTE = ("the tenant ledger needs a telemetry session "
+                     "(--telemetry-dir / --live-stats / --trace-dir "
+                     "/ --postmortem-dir)")
+
+    def tenants_payload(self) -> dict:
+        """``GET /tenants``: the per-tenant cost ledger — attributed
+        kernel-ms/bytes (conserved per dispatch against the measured
+        span), records in/out, windows, SLO/shed/quota counters, the
+        fairness summary, and the bounded kernel-ms delta series
+        (``utils.accounting``)."""
+        tel = self._tel()
+        if tel is None:
+            return {"tenants": {}, "n": 0, "note": self._TENANTS_NOTE}
+        return tel.tenants.payload()
+
+    def tenant_payload(self, tenant: str):
+        """(http_code, payload) for ``GET /tenants/<id>``."""
+        tel = self._tel()
+        if tel is None:
+            return 404, {"error": self._TENANTS_NOTE}
+        payload = tel.tenants.tenant_payload(tenant)
+        if payload is None:
+            return 404, {"error": f"unknown tenant {tenant!r} "
+                                  "(see /tenants)"}
+        return 200, payload
 
     # ----------------------- device-truth plane ------------------------ #
 
@@ -585,6 +644,17 @@ class OpServer:
         if sup is None:
             return f"# {self._FLEET_NOTE}\n"
         return sup.fleet_metrics_text()
+
+    def fleet_tenants_payload(self) -> dict:
+        """``/fleet/tenants``: every worker's ``/tenants`` ledger harvested
+        concurrently and merged — summed per-tenant rows, fleet-wide
+        fairness recomputed over the merged shares (like
+        ``/fleet/metrics``, needs only worker URLs, not the monitor)."""
+        sup = self._fleet()
+        if sup is None:
+            return {"tenants": {}, "n": 0, "workers": 0,
+                    "note": self._FLEET_NOTE}
+        return sup.fleet_tenants_payload()
 
     # ------------------------------ lifecycle -------------------------- #
 
@@ -715,6 +785,16 @@ def format_digest(snap: dict) -> str:
             s += " fast-lane"
         if ctl.get("shedding"):
             s += " SHED"
+        parts.append(s)
+    ten = st.get("tenants") or {}
+    if ten.get("n", 0) > 1 and ten.get("top"):
+        # who pays for this pipeline: the top tenant's attributed kernel
+        # share (+ quota refusals when any) — only worth a glance when the
+        # run is actually shared (n>1), full ledger at GET /tenants
+        s = (f"tenant top {ten['top']} "
+             f"{(ten.get('top_share') or 0.0) * 100:.0f}%")
+        if ten.get("quota_rejections"):
+            s += f" quota-rej {ten['quota_rejections']}"
         parts.append(s)
     deg = snap.get("degradation") or {}
     if deg:

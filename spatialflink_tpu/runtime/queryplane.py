@@ -56,7 +56,9 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
+from spatialflink_tpu.utils import accounting as _accounting
 from spatialflink_tpu.utils import metrics as _metrics
+from spatialflink_tpu.utils.accounting import QuotaExceeded
 
 #: the one registry the current process runs (the driver installs at most
 #: one) — how the opserver's POST/DELETE/GET /queries surface finds it
@@ -126,6 +128,10 @@ class QuerySpec:
     slo: Optional[Dict[str, float]] = None
     #: ``interactive`` | ``batch`` — the chunk governor's fast-lane flag
     latency_class: str = "batch"
+    #: accounting principal (``utils/accounting.py``) — cost attribution
+    #: and admission quotas key on this; defaults to the run's
+    #: ``--tenant-default``
+    tenant: str = _accounting.DEFAULT_TENANT
 
     def to_dict(self) -> dict:
         d = {"id": self.id, "family": self.family, "x": self.x, "y": self.y,
@@ -138,11 +144,15 @@ class QuerySpec:
             d["slo"] = dict(self.slo)
         if self.latency_class != "batch":
             d["latency_class"] = self.latency_class
+        if self.tenant != _accounting.DEFAULT_TENANT:
+            d["tenant"] = self.tenant
         return d
 
     @classmethod
     def from_dict(cls, d: Any, *, default_family: Optional[str] = None,
-                  default_latency_class: str = "batch") -> "QuerySpec":
+                  default_latency_class: str = "batch",
+                  default_tenant: str = _accounting.DEFAULT_TENANT,
+                  ) -> "QuerySpec":
         """Schema-validated build — every admission surface (POST body,
         control record, ``--queries-file`` entry) funnels through here so
         a malformed query is rejected with the SAME named-field error
@@ -151,7 +161,7 @@ class QuerySpec:
             raise QuerySpecError(f"query spec must be an object, got "
                                  f"{type(d).__name__}")
         unknown = set(d) - {"id", "family", "x", "y", "radius", "k",
-                            "route", "slo", "latency_class"}
+                            "route", "slo", "latency_class", "tenant"}
         if unknown:
             raise QuerySpecError(f"unknown query field(s) "
                                  f"{sorted(unknown)}")
@@ -203,8 +213,13 @@ class QuerySpec:
             raise QuerySpecError(
                 f"'latency_class' must be one of {_LATENCY_CLASSES}, "
                 f"got {lclass!r}")
+        tenant = d.get("tenant", default_tenant)
+        if not isinstance(tenant, str) or not tenant or len(tenant) > 128:
+            raise QuerySpecError("'tenant' must be a non-empty string "
+                                 "(<= 128 chars)")
         return cls(id=qid, family=family, x=x, y=y, radius=radius, k=k,
-                   route=route, slo=slo, latency_class=lclass)
+                   route=route, slo=slo, latency_class=lclass,
+                   tenant=tenant)
 
 
 @dataclass
@@ -236,6 +251,7 @@ class QueryEntry:
     def to_dict(self) -> dict:
         d = {"id": self.id, "state": self.state.value,
              "spec": self.spec.to_dict(),
+             "tenant": self.spec.tenant,
              "admitted_ms": self.admitted_ms,
              "since_version": self.since_version,
              "windows_emitted":
@@ -262,7 +278,9 @@ class QueryRegistry:
 
     def __init__(self, family: str, *, radius: float = 0.0,
                  k: Optional[int] = None, retain_retired: int = 64,
-                 default_latency_class: str = "batch"):
+                 default_latency_class: str = "batch",
+                 default_tenant: str = _accounting.DEFAULT_TENANT,
+                 tenant_quotas: Optional[Dict[str, dict]] = None):
         if family not in _FAMILIES:
             raise ValueError(f"family must be one of {_FAMILIES}")
         if default_latency_class not in _LATENCY_CLASSES:
@@ -272,6 +290,13 @@ class QueryRegistry:
         self.radius = float(radius)
         self.k = k
         self.default_latency_class = default_latency_class
+        #: accounting principal for specs that omit ``tenant`` and for
+        #: unattributable cost (``--tenant-default``)
+        self.default_tenant = str(default_tenant
+                                  or _accounting.DEFAULT_TENANT)
+        #: per-tenant admission ceilings (``--tenant-quota``) — checked
+        #: at admit(), distinct from governor shedding
+        self.tenant_quotas: Dict[str, dict] = dict(tenant_quotas or {})
         #: governor-driven admission shedding (see runtime/control.py):
         #: while True, NEW admissions park in QueryState.SHED
         self.shedding = False
@@ -308,6 +333,43 @@ class QueryRegistry:
                 "(the Q-axis shares one top-k width; omit 'k' to inherit)")
         return spec
 
+    def _check_quota_locked(self, spec: QuerySpec) -> None:
+        """Enforce the tenant's ``--tenant-quota`` ceilings on a NEW
+        admission (caller holds the lock): slot count over the live
+        lifecycle states, and — when a telemetry session is running —
+        the ledger's recent attributed kernel-ms rate. Raises
+        :class:`QuotaExceeded` (HTTP 429 ``quota-exceeded``, distinct
+        from the governor's ``shed``)."""
+        quota = self.tenant_quotas.get(spec.tenant)
+        if not quota:
+            return
+        reason = None
+        max_active = quota.get("max_active")
+        if max_active is not None:
+            held = sum(
+                1 for e in self._entries.values()
+                if e.spec.tenant == spec.tenant
+                and e.state in (QueryState.PENDING, QueryState.ACTIVE,
+                                QueryState.DRAINING, QueryState.SHED))
+            if held >= int(max_active):
+                reason = (f"max_active {int(max_active)} reached "
+                          f"({held} queries held)")
+        rate_cap = quota.get("kernel_ms_s")
+        tel = _telemetry_active()
+        if reason is None and rate_cap is not None and tel is not None:
+            rate = tel.tenants.kernel_ms_rate(spec.tenant)
+            if rate > float(rate_cap):
+                reason = (f"kernel_ms_s {float(rate_cap):g} exceeded "
+                          f"(attributed {rate:.1f} ms/s)")
+        if reason is None:
+            return
+        _metrics.REGISTRY.counter("queries-quota-rejected").inc()
+        _emit("query-quota-rejected", id=spec.id, tenant=spec.tenant,
+              reason=reason)
+        if tel is not None:
+            tel.tenants.note_quota_rejection(spec.tenant)
+        raise QuotaExceeded(spec.tenant, reason)
+
     def admit(self, spec) -> QueryEntry:
         """Admit a new standing query (PENDING until the next apply), or —
         when the id already names a live query — stage an UPDATE of it.
@@ -320,7 +382,8 @@ class QueryRegistry:
         if not isinstance(spec, QuerySpec):
             spec = QuerySpec.from_dict(
                 spec, default_family=self.family,
-                default_latency_class=self.default_latency_class)
+                default_latency_class=self.default_latency_class,
+                default_tenant=self.default_tenant)
         self._validate(spec)
         with self._lock:
             cur = self._entries.get(spec.id)
@@ -329,6 +392,10 @@ class QueryRegistry:
                 return cur
             if cur is not None and cur.state is not QueryState.RETIRED:
                 return self._stage_update(cur, spec)
+            # NEW admission: the tenant's own ceiling applies before any
+            # slot is taken — a quota rejection creates no entry at all
+            # (shed parks and later releases; quota refuses outright)
+            self._check_quota_locked(spec)
             shed = self.shedding
             entry = QueryEntry(
                 spec=spec,
@@ -340,6 +407,9 @@ class QueryRegistry:
         if shed:
             _metrics.REGISTRY.counter("queries-shed").inc()
             _emit("query-shed", id=spec.id, route=spec.route)
+            tel = _telemetry_active()
+            if tel is not None:
+                tel.tenants.note_shed(spec.tenant)
             return entry
         _metrics.REGISTRY.counter("queries-admitted").inc()
         _emit("query-admitted", id=spec.id, route=spec.route)
@@ -357,7 +427,8 @@ class QueryRegistry:
             merged["id"] = qid
             spec = self._validate(QuerySpec.from_dict(
                 merged, default_family=self.family,
-                default_latency_class=self.default_latency_class))
+                default_latency_class=self.default_latency_class,
+                default_tenant=entry.spec.tenant))
             if entry.state is QueryState.SHED:
                 entry.spec = spec  # parked: nothing staged to swap
                 return entry
@@ -546,6 +617,7 @@ class QueryRegistry:
         tel = _telemetry.active()
         if tel is not None:
             tel.histogram(f"window-records@{qid}").record(n_records)
+            tel.tenants.note_window(entry.spec.tenant, qid, n_records)
         slo = entry.spec.slo
         if slo:
             ok = True
@@ -563,6 +635,8 @@ class QueryRegistry:
                     entry.slo_breaches += 1
                     _metrics.REGISTRY.counter("query-slo-breaches").inc()
                     _emit("query-slo-breach", id=qid, records=n_records)
+                    if tel is not None:
+                        tel.tenants.note_breach(entry.spec.tenant)
                     self._recorder_breach(entry, n_records, emit_p99_ms)
                 elif entry.slo_ok is False:
                     _emit("query-slo-recovered", id=qid)
@@ -601,6 +675,9 @@ class QueryRegistry:
                 "fleet": fleet, "live": live,
                 "bucket": bucket_size(live),
                 "shedding": self.shedding,
+                "default_tenant": self.default_tenant,
+                "tenant_quotas": {t: dict(q)
+                                  for t, q in self.tenant_quotas.items()},
                 "queries": entries,
                 "control_position":
                     None if self._control is None else self._control.position}
@@ -620,6 +697,9 @@ class QueryRegistry:
             return {
                 "fleet_version": self._version,
                 "shedding": self.shedding,
+                "default_tenant": self.default_tenant,
+                "tenant_quotas": {t: dict(q)
+                                  for t, q in self.tenant_quotas.items()},
                 "fleet": list(self._fleet),
                 "entries": [
                     {"spec": e.spec.to_dict(), "state": e.state.value,
@@ -639,10 +719,17 @@ class QueryRegistry:
         with self._lock:
             self._entries = {}
             self.shedding = bool(meta.get("shedding", False))
+            self.default_tenant = str(meta.get("default_tenant")
+                                      or self.default_tenant)
+            if meta.get("tenant_quotas") is not None:
+                self.tenant_quotas = {
+                    str(t): dict(q or {})
+                    for t, q in meta["tenant_quotas"].items()}
             for row in meta.get("entries", []):
                 spec = QuerySpec.from_dict(
                     row["spec"], default_family=self.family,
-                    default_latency_class=self.default_latency_class)
+                    default_latency_class=self.default_latency_class,
+                    default_tenant=self.default_tenant)
                 entry = QueryEntry(
                     spec=spec, state=QueryState(row["state"]),
                     admitted_ms=int(row.get("admitted_ms", 0)),
@@ -650,7 +737,8 @@ class QueryRegistry:
                 if row.get("pending_spec"):
                     entry.pending_spec = QuerySpec.from_dict(
                         row["pending_spec"], default_family=self.family,
-                        default_latency_class=self.default_latency_class)
+                        default_latency_class=self.default_latency_class,
+                        default_tenant=self.default_tenant)
                 self._entries[entry.id] = entry
             self._fleet = [q for q in meta.get("fleet", [])
                            if q in self._entries]
@@ -688,6 +776,14 @@ def _emit(kind: str, **fields) -> None:
     from spatialflink_tpu.utils.telemetry import emit_event
 
     emit_event(kind, **fields)
+
+
+def _telemetry_active():
+    """The active telemetry session, lazily imported (queryplane stays
+    importable without the telemetry module loaded)."""
+    from spatialflink_tpu.utils import telemetry as _telemetry
+
+    return _telemetry.active()
 
 
 # --------------------------------------------------------------------- #
@@ -767,7 +863,7 @@ class ControlTopicConsumer:
             return 1
         except KeyError as e:
             self._reject(f"unknown query id {e}", value)
-        except (QuerySpecError, json.JSONDecodeError,
+        except (QuerySpecError, QuotaExceeded, json.JSONDecodeError,
                 UnicodeDecodeError) as e:
             self._reject(str(e), value)
         return 0
@@ -879,12 +975,13 @@ class QueryRouter:
 
 
 def load_queries_file(path: str, family: str,
-                      default_latency_class: str = "batch"
+                      default_latency_class: str = "batch",
+                      default_tenant: str = _accounting.DEFAULT_TENANT,
                       ) -> List[QuerySpec]:
     """Parse a ``--queries-file``: a JSON array of query specs, or an
     object ``{"queries": [...]}``. Validation errors name the offending
-    entry. Specs omitting ``latency_class`` take the run's
-    ``--latency-class`` default."""
+    entry. Specs omitting ``latency_class`` / ``tenant`` take the run's
+    ``--latency-class`` / ``--tenant-default`` defaults."""
     with open(path) as f:
         data = json.load(f)
     if isinstance(data, dict):
@@ -897,7 +994,8 @@ def load_queries_file(path: str, family: str,
         try:
             out.append(QuerySpec.from_dict(
                 d, default_family=family,
-                default_latency_class=default_latency_class))
+                default_latency_class=default_latency_class,
+                default_tenant=default_tenant))
         except QuerySpecError as e:
             raise QuerySpecError(f"{path}: query[{i}]: {e}")
     return out

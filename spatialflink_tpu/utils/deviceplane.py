@@ -68,7 +68,10 @@ from spatialflink_tpu.utils import metrics as _metrics
 #: 2: + latency.json — the stage-residency decomposition, record→emit
 #: histograms (global + per query) and the backpressure time series, so a
 #: breach bundle answers "which stage blew the budget" offline
-BUNDLE_SCHEMA = 2
+#: 3: + tenants.json — the per-tenant cost ledger (attributed kernel-ms/
+#: bytes, fairness summary, quota counters), so a breach bundle answers
+#: "who was paying for the pipeline when it died"
+BUNDLE_SCHEMA = 3
 
 
 class RecompileError(Exception):
@@ -719,6 +722,10 @@ class FlightRecorder:
         write("latency", lambda: (
             tel.latency.payload(tel=tel) if tel is not None
             else {"stages": {}, "recent": [],
+                  "note": "no telemetry session at dump time"}))
+        write("tenants", lambda: (
+            tel.tenants.payload() if tel is not None
+            else {"tenants": {}, "n": 0,
                   "note": "no telemetry session at dump time"}))
         with self._lock:
             ring = list(self._ring)

@@ -75,11 +75,13 @@ the exact pre-telemetry code.
 from __future__ import annotations
 
 import contextlib
+import itertools
 import json
 import math
 import os
 import threading
 import time
+import uuid
 from typing import Callable, Dict, List, Optional, Tuple
 
 from spatialflink_tpu.utils import metrics as _metrics
@@ -443,6 +445,11 @@ class WindowTraceBook:
         self._lock = threading.Lock()
         self.capacity = max(1, int(capacity))
         self.total = 0
+        #: traces dropped by the capacity ring — overflow used to be
+        #: silent, leaving "where did my lineage go?" unanswerable; the
+        #: ``trace-evictions`` counter and /trace/recent's ``evicted``
+        #: field now say exactly how much history fell off
+        self.evicted = 0
 
     @staticmethod
     def trace_id(query: str, window_start) -> str:
@@ -460,6 +467,8 @@ class WindowTraceBook:
             self.total += 1
             while len(self._traces) > self.capacity:
                 self._traces.popitem(last=False)
+                self.evicted += 1
+                _metrics.REGISTRY.counter("trace-evictions").inc()
         return tr
 
     def note(self, query: str, window_start, stage: str, t0_s: float,
@@ -825,6 +834,13 @@ class Telemetry:
         from spatialflink_tpu.utils.latencyplane import LatencyPlane
 
         self.latency = LatencyPlane()
+        #: per-query/per-tenant cost ledger (utils.accounting): the
+        #: shared padded-fleet dispatch attributed to who asked for it;
+        #: fed at dispatch/window granularity only, so it rides every
+        #: session like the cost profiles do
+        from spatialflink_tpu.utils.accounting import TenantLedger
+
+        self.tenants = TenantLedger()
         #: per-window trace lineage — OPT-IN (``trace=True`` /
         #: ``--trace-dir``): None keeps the plain session's hot-path cost
         #: exactly what PRs 2/5 measured; instrumented sites check this
@@ -947,10 +963,13 @@ class Telemetry:
             "grid": self.cells.to_dict(),
             "costs": self.costs.to_dict(),
             "latency": self.latency.to_dict(),
+            "tenants": self.tenants.to_dict(),
             "device": _deviceplane.status_block(self, self._registry()),
             "traces": {
                 "enabled": self.traces is not None,
                 "total": self.traces.total if self.traces is not None else 0,
+                "evicted": (self.traces.evicted
+                            if self.traces is not None else 0),
             },
         }
 
@@ -960,6 +979,13 @@ class Telemetry:
 
 _ACTIVE: Optional[Telemetry] = None
 _NULL_CM = contextlib.nullcontext()
+
+#: this process incarnation's identity + the monotonic snapshot counter
+#: — stamped into every status_snapshot() so federated collectors can
+#: order and dedupe worker snapshots (a restarted worker gets a fresh
+#: run_id, so its seq restart reads as "new incarnation", never "stale")
+_RUN_ID = uuid.uuid4().hex[:12]
+_SNAP_SEQ = itertools.count(1)
 
 
 def active() -> Optional[Telemetry]:
@@ -1093,6 +1119,29 @@ def status_digest(snap: dict) -> dict:
             "shrinks": int(counters.get("chunk-shrink", 0)),
             "sheds": int(counters.get("shed", 0)),
         },
+        # tenant accounting (utils.accounting): who pays for the shared
+        # dispatch — tenant count, top payer by attributed kernel-ms,
+        # the fairness shares + Gini, and the attribution residual;
+        # the full per-tenant table lives at GET /tenants
+        "tenants": _tenants_digest(snap.get("tenants") or {}),
+    }
+
+
+def _tenants_digest(ten: dict) -> dict:
+    """The compact operator view of the tenant ledger's snapshot block.
+    Absent plane (no session) renders zero-count, never missing keys."""
+    fairness = ten.get("fairness") or {}
+    return {
+        "n": int(ten.get("n") or 0),
+        "top": fairness.get("top"),
+        "top_share": fairness.get("top_share", 0.0),
+        "max_share": fairness.get("max_share", 0.0),
+        "min_share": fairness.get("min_share", 0.0),
+        "gini": fairness.get("gini", 0.0),
+        "quota_rejections": sum(
+            int((r or {}).get("quota_rejections") or 0)
+            for r in (ten.get("tenants") or {}).values()),
+        "max_residual_ms": ten.get("max_residual_ms", 0.0),
     }
 
 
@@ -1145,8 +1194,9 @@ def registry_snapshot(registry: Optional[_metrics.MetricsRegistry] = None
         "grid": {},
         "costs": {},
         "latency": {},
+        "tenants": {},
         "device": _deviceplane.status_block(None, reg),
-        "traces": {"enabled": False, "total": 0},
+        "traces": {"enabled": False, "total": 0, "evicted": 0},
     }
 
 
@@ -1160,6 +1210,13 @@ def status_snapshot(tel: Optional[Telemetry] = None, health=None,
     reporter interval, per digest line; never per record."""
     tel = tel if tel is not None else _ACTIVE
     snap = tel.snapshot() if tel is not None else registry_snapshot(registry)
+    # provenance + ordering stamp for federated collectors: run_id pins
+    # the emitting process incarnation, snapshot_seq orders snapshots
+    # WITHIN it — a poller (FleetMonitor, /fleet/tenants harvesting)
+    # drops any snapshot whose (run_id, seq) it has already seen, and a
+    # changed run_id (restart) resets the ordering instead of wedging it
+    snap["run_id"] = _RUN_ID
+    snap["snapshot_seq"] = next(_SNAP_SEQ)
     snap["status"] = status_digest(snap)
     if health is None and tel is not None:
         health = tel.health
@@ -1287,6 +1344,29 @@ def prometheus_text(tel: Optional[Telemetry] = None,
     emit("spatialflink_counter", "counter",
          [(counter_labels(n), v)
           for n, v in sorted(snap_reg.snapshot().items())])
+    # tenant accounting families (utils.accounting): the attributed-cost
+    # ledger under PROPER tenant="T" labels — the same label discipline
+    # as stage/family/query, so /fleet/metrics relabeling federates them
+    ten = tel.tenants.to_dict()
+    trows = sorted((ten.get("tenants") or {}).items())
+    emit("spatialflink_tenant_kernel_ms_total", "counter",
+         [(f'tenant="{t}"', r.get("kernel_ms", 0.0)) for t, r in trows])
+    emit("spatialflink_tenant_bytes_moved_total", "counter",
+         [(f'tenant="{t}"', r.get("bytes_moved", 0)) for t, r in trows])
+    emit("spatialflink_tenant_records_in_total", "counter",
+         [(f'tenant="{t}"', r.get("records_in", 0)) for t, r in trows])
+    emit("spatialflink_tenant_records_out_total", "counter",
+         [(f'tenant="{t}"', r.get("records_out", 0)) for t, r in trows])
+    emit("spatialflink_tenant_windows_total", "counter",
+         [(f'tenant="{t}"', r.get("windows", 0)) for t, r in trows])
+    emit("spatialflink_tenant_slo_breaches_total", "counter",
+         [(f'tenant="{t}"', r.get("slo_breaches", 0)) for t, r in trows])
+    emit("spatialflink_tenant_quota_rejections_total", "counter",
+         [(f'tenant="{t}"', r.get("quota_rejections", 0))
+          for t, r in trows])
+    fairness = ten.get("fairness") or {}
+    emit("spatialflink_tenant_fairness_gini", "gauge",
+         [("", fairness.get("gini", 0.0))] if trows else [])
     return "\n".join(lines) + "\n"
 
 
@@ -1341,6 +1421,7 @@ class TelemetryReporter:
         # interval (maybe_tick: the /profile/cells scrape path ticks too,
         # and the two must not double-bucket)
         self.telemetry.costs.maybe_tick()
+        self.telemetry.tenants.maybe_tick()
         snap = status_snapshot(self.telemetry)
         with open(self.jsonl_path, "a") as f:
             f.write(json.dumps(snap, sort_keys=True) + "\n")
@@ -1392,6 +1473,9 @@ def telemetry_session(out_dir: Optional[str] = None, interval_s: float = 5.0,
     # the cost-profile series buckets at the session's snapshot cadence,
     # whoever drives it (reporter snapshot or /profile/cells scrape)
     tel.costs.tick_interval_s = max(0.01, float(interval_s))
+    # the tenant ledger's delta buckets ride the same cadence (reporter
+    # snapshot or /tenants scrape — maybe_tick dedupes the drivers)
+    tel.tenants.tick_interval_s = max(0.01, float(interval_s))
     old = set_active(tel)
     old_obs = _ug._CELL_OBSERVER
     _ug._CELL_OBSERVER = tel.record_cells

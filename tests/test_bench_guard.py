@@ -28,7 +28,7 @@ def test_bench_guard_passes_thresholds():
         "window_assign", "decode_columnar", "windowed_pipeline",
         "skew_adaptive", "query_plane", "controller_pareto",
         "realtime_vectorized", "latency_record_emit",
-        "fleet_scaling", "fleet_rescale"], r.stdout
+        "fleet_scaling", "fleet_rescale", "tenant_plane"], r.stdout
     assert all(x["speedup"] > 0 for x in rows if "speedup" in x)
     # the governor's Pareto composite row carries its convergence trace
     # (final chunk, tick/step counts) so a never-ticking controller is
@@ -56,6 +56,14 @@ def test_bench_guard_passes_thresholds():
     assert len(rs) == 1 and rs[0]["wall_fleet1_s"] > 0
     assert rs[0]["workers_final"] == 4 and rs[0]["rescale_x"] > 0
     assert rs[0]["merged_windows"] > 0
+    # the lower-is-better tenant-ledger row (session-on/off wall ratio
+    # over the two-tenant dynamic fleet, gated against its ceiling; the
+    # bench asserts window-table identity and attribution conservation
+    # — every dispatch resolved, zero residual — in-run)
+    tp = [x for x in rows if x["path"] == "tenant_plane"]
+    assert len(tp) == 1 and tp[0]["overhead_vs_off_x"] > 0
+    assert tp[0]["dispatches_resolved"] > 0
+    assert tp[0]["max_residual_ms"] < 1e-6
     assert r.returncode == 0, (
         f"bench_guard regression:\n{r.stdout}\n{r.stderr[-1000:]}")
 
@@ -80,3 +88,7 @@ def test_guard_baseline_rows_exist():
     assert {r["path"] for r in base["fleet_rows"]} == {
         "fleet_scaling", "fleet_rescale"}
     assert all(r["wall_fleet1_s"] > 0 for r in base["fleet_rows"])
+    # the tenant-ledger overhead ceiling (lower-is-better fourth pass):
+    # a ratio ceiling >= 1 — the ledger may cost something, never 1.5x+
+    assert {r["path"] for r in base["tenant_rows"]} == {"tenant_plane"}
+    assert all(r["overhead_vs_off_x"] >= 1.0 for r in base["tenant_rows"])

@@ -464,7 +464,7 @@ class TestPostmortemBundle:
             finally:
                 rec.close()
         manifest = json.load(open(os.path.join(bundle, "manifest.json")))
-        assert manifest["schema"] == deviceplane.BUNDLE_SCHEMA == 2
+        assert manifest["schema"] == deviceplane.BUNDLE_SCHEMA == 3
         assert "latency.json" in manifest["files"]
         lat = json.load(open(os.path.join(bundle, "latency.json")))
         assert set(CHAIN_STAGES) <= set(lat["stages"])

@@ -40,7 +40,7 @@ STATUS_KEYS = {"records_in", "throughput_rps", "windows_evaluated",
                "checkpoint", "breaker_state", "dlq_depth",
                "mesh_degradations", "slo_breaches", "top_cells",
                "skew", "top_cost_cells", "device", "dispatch_overlap",
-               "latency", "controller"}
+               "latency", "controller", "tenants"}
 
 
 def _get(url, timeout=5):

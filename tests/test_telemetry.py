@@ -295,6 +295,7 @@ class _CallCounter:
 
     def __init__(self, monkeypatch):
         from spatialflink_tpu.utils import deviceplane as deviceplane_mod
+        from spatialflink_tpu.utils.accounting import TenantLedger
         from spatialflink_tpu.utils.deviceplane import FlightRecorder
         from spatialflink_tpu.utils.latencyplane import LatencyPlane
         from spatialflink_tpu.utils.telemetry import (CostProfiles,
@@ -330,7 +331,16 @@ class _CallCounter:
                           (LatencyPlane, "window_complete"),
                           (LatencyPlane, "note_downstream"),
                           (LatencyPlane, "query_emit"),
-                          (LatencyPlane, "tick")):
+                          (LatencyPlane, "tick"),
+                          # the tenant ledger rides the same gate: zero
+                          # feeds without a session
+                          (TenantLedger, "note_dispatch"),
+                          (TenantLedger, "resolve"),
+                          (TenantLedger, "note_window"),
+                          (TenantLedger, "note_shed"),
+                          (TenantLedger, "note_breach"),
+                          (TenantLedger, "note_quota_rejection"),
+                          (TenantLedger, "maybe_tick")):
             wrap(cls, name)
 
         orig_mem = deviceplane_mod.device_memory
